@@ -1,0 +1,3 @@
+"""TPU-native dynamic factor model framework (JAX / XLA / pjit)."""
+
+__version__ = "0.1.0"

@@ -1,0 +1,11 @@
+from .ingest import (
+    BiWeight,
+    Dataset,
+    Mean,
+    MonthlyData,
+    NoDetrend,
+    QuarterlyData,
+    default_data_path,
+    find_row_number,
+    readin_data,
+)

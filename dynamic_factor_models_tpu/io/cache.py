@@ -1,0 +1,59 @@
+"""Dataset caching: serialize the ingested panel to .npz.
+
+The ingest is deterministic (reference: readin_functions.jl:355-385), so the
+standardized panel is cached once and reloaded by tests/benchmarks without
+touching Excel (SURVEY.md section 7.2 M0).
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from .ingest import BiWeight, Dataset, MonthlyData, QuarterlyData, readin_data
+
+_ARRAY_FIELDS = [
+    "bpdata_raw",
+    "bpcatcode",
+    "bpdata",
+    "bpdata_unfiltered",
+    "bpdata_noa",
+    "bpdata_trend",
+    "inclcode",
+    "calvec",
+]
+
+
+def save_dataset(ds: Dataset, path: str) -> None:
+    payload = {f: getattr(ds, f) for f in _ARRAY_FIELDS}
+    payload["bpnamevec"] = np.array(ds.bpnamevec)
+    payload["calds"] = np.array(ds.calds)
+    np.savez_compressed(path, **payload)
+
+
+def load_dataset(path: str) -> Dataset:
+    z = np.load(path, allow_pickle=False)
+    return Dataset(
+        **{f: z[f] for f in _ARRAY_FIELDS},
+        bpnamevec=[str(s) for s in z["bpnamevec"]],
+        calds=[(int(y), int(q)) for y, q in z["calds"]],
+    )
+
+
+def cached_dataset(datatype: str = "Real", cache_dir: str | None = None) -> Dataset:
+    """Load the standard BiWeight(100) dataset, building the cache if needed."""
+    if cache_dir is None:
+        cache_dir = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+            "data",
+        )
+    os.makedirs(cache_dir, exist_ok=True)
+    path = os.path.join(cache_dir, f"sw_panel_{datatype.lower()}.npz")
+    if not os.path.exists(path):
+        md = MonthlyData.from_range((1959, 1), (2014, 12), 148)
+        qd = QuarterlyData.from_range((1959, 1), (2014, 4), 85)
+        ds = readin_data(md, qd, BiWeight(100.0), datatype)
+        save_dataset(ds, path)
+        return ds
+    return load_dataset(path)

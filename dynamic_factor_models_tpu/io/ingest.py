@@ -1,0 +1,410 @@
+"""Stock-Watson (2016) panel ingest pipeline (host-side NumPy).
+
+Re-implements the reference data layer (reference: readin_functions.jl:1-385)
+as a pure-NumPy pipeline.  Missing values are NaN (the reference uses Julia
+``Union{Missing,Float64}``); every downstream JAX kernel consumes
+(values-with-NaN, mask) pairs.
+
+Pipeline stages (reference line cites):
+  read xlsx sheet          readin_functions.jl:204-226
+  header schema            readin_functions.jl:258-283
+  deflators lookup         readin_functions.jl:285-301
+  Killian standardization  readin_functions.jl:306-313
+  column selection         readin_functions.jl:254-256
+  deflation                readin_functions.jl:40-76
+  monthly->quarterly       readin_functions.jl:83-102
+  stationarity transforms  readin_functions.jl:104-125
+  outlier adjustment       readin_functions.jl:126-198
+  merge + catcode sort     readin_functions.jl:355-367
+  detrending               readin_functions.jl:317-348
+
+The ingest runs once per dataset and is not performance critical; it stays in
+float64 NumPy for bit-stable parity with the reference outputs.
+"""
+
+from __future__ import annotations
+
+import datetime
+import os
+from dataclasses import dataclass, field
+from typing import NamedTuple, Sequence
+
+import numpy as np
+
+from . import xlsx
+
+__all__ = [
+    "MonthlyData",
+    "QuarterlyData",
+    "BiWeight",
+    "Mean",
+    "NoDetrend",
+    "Dataset",
+    "readin_data",
+    "default_data_path",
+]
+
+
+def default_data_path() -> str:
+    """Locate hom_fac_1.xlsx: $DFM_XLSX_PATH, repo data/, then the reference."""
+    env = os.environ.get("DFM_XLSX_PATH")
+    if env:
+        return env
+    here = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    for cand in (
+        os.path.join(here, "data", "hom_fac_1.xlsx"),
+        "/root/reference/data/hom_fac_1.xlsx",
+    ):
+        if os.path.exists(cand):
+            return cand
+    raise FileNotFoundError(
+        "hom_fac_1.xlsx not found; set DFM_XLSX_PATH or place it in data/"
+    )
+
+
+# ---------------------------------------------------------------------------
+# frequency / detrend configuration (reference: readin_functions.jl:7-36)
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class _Frequency:
+    nobs: int
+    ns: int
+
+    @classmethod
+    def from_range(cls, initvec: Sequence[int], lastvec: Sequence[int], ns: int):
+        ppy = cls.PERIODS_PER_YEAR
+        nobs = ppy * (lastvec[0] - initvec[0] - 1) + lastvec[1] + (ppy - initvec[1] + 1)
+        return cls(nobs, ns)
+
+
+class MonthlyData(_Frequency):
+    PERIODS_PER_YEAR = 12
+    SHEET = "Monthly"
+    NDESC = 2
+    NCODES = 6  # agg, t, def, outlier, include, cat
+
+
+class QuarterlyData(_Frequency):
+    PERIODS_PER_YEAR = 4
+    SHEET = "Quarterly"
+    NDESC = 2
+    NCODES = 5  # t, def, outlier, include, cat (no aggcode)
+
+
+@dataclass(frozen=True)
+class BiWeight:
+    weight: float = 100.0
+
+
+@dataclass(frozen=True)
+class Mean:
+    pass
+
+
+@dataclass(frozen=True)
+class NoDetrend:
+    pass
+
+
+class Dataset(NamedTuple):
+    """The 10-field dataset namedtuple (reference: readin_functions.jl:371-380)."""
+
+    bpdata_raw: np.ndarray
+    bpcatcode: np.ndarray
+    bpdata: np.ndarray
+    bpdata_unfiltered: np.ndarray
+    bpdata_noa: np.ndarray
+    bpdata_trend: np.ndarray
+    inclcode: np.ndarray
+    bpnamevec: list
+    calvec: np.ndarray
+    calds: list
+
+
+@dataclass
+class _SheetData:
+    data: np.ndarray  # quarterly, transformed, outlier-adjusted
+    raw: np.ndarray  # quarterly, pre-transform
+    noa: np.ndarray  # quarterly, transformed, no outlier adjustment
+    dates: list  # list of (year, quarter)
+    catcode: np.ndarray
+    inclcode: np.ndarray
+    names: list
+
+
+# ---------------------------------------------------------------------------
+# transforms (reference: readin_functions.jl:104-125)
+# ---------------------------------------------------------------------------
+
+
+def _transform(x: np.ndarray, tcode: int) -> np.ndarray:
+    if tcode == 1:
+        return x
+    if tcode == 2:
+        out = np.full_like(x, np.nan)
+        out[1:] = x[1:] - x[:-1]
+        return out
+    if tcode == 3:
+        out = np.full_like(x, np.nan)
+        out[2:] = x[2:] - 2 * x[1:-1] + x[:-2]
+        return out
+    if tcode == 4:
+        with np.errstate(invalid="ignore", divide="ignore"):
+            return np.log(x)
+    if tcode == 5:
+        with np.errstate(invalid="ignore", divide="ignore"):
+            return _transform(np.log(x), 2)
+    if tcode == 6:
+        with np.errstate(invalid="ignore", divide="ignore"):
+            return _transform(np.log(x), 3)
+    raise ValueError(f"unknown tcode {tcode}")
+
+
+# ---------------------------------------------------------------------------
+# outlier adjustment (reference: readin_functions.jl:126-198)
+# ---------------------------------------------------------------------------
+
+_OUTLIER_THRESHOLD = {1: 4.5, 2: 3.0}
+
+
+def _adjust_outlier(x: np.ndarray, outliercode: int, io_method: int) -> None:
+    """In-place outlier adjustment of one series; io_method 0-4."""
+    if outliercode == 0:
+        return
+    thr = _OUTLIER_THRESHOLD[outliercode]
+    finite = ~np.isnan(x)
+    zm = np.median(x[finite])
+    iqr = np.quantile(x[finite], 0.75) - np.quantile(x[finite], 0.25)
+    if iqr < 1e-6:
+        raise ValueError("error in adjusting outlier: IQR too small")
+    with np.errstate(invalid="ignore"):
+        i_outlier = np.abs(x - zm) > thr * iqr
+    i_outlier &= finite
+    if io_method == 0:
+        x[i_outlier] = np.nan
+    elif io_method == 1:
+        sign = np.sign(x[i_outlier])
+        x[i_outlier] = zm + sign * thr * iqr
+    elif io_method == 2:
+        x[i_outlier] = zm
+    elif io_method == 3:
+        for i in np.flatnonzero(i_outlier):
+            lo, hi = max(0, i - 3), min(len(x), i + 4)
+            x[i] = np.nanmedian(x[lo:hi])
+    elif io_method == 4:
+        # one-sided median of the 5 preceding obs (window includes x[i]);
+        # replacements are sequential and feed later windows, matching the
+        # reference's in-place loop.
+        for i in np.flatnonzero(i_outlier):
+            lo = max(0, i - 5)
+            x[i] = np.nanmedian(x[lo : i + 1])
+    else:
+        raise ValueError(f"unknown io_method {io_method}")
+
+
+# ---------------------------------------------------------------------------
+# temporal aggregation (reference: readin_functions.jl:83-102)
+# ---------------------------------------------------------------------------
+
+
+def _monthly_to_quarterly(data_m: np.ndarray, dates_m: list) -> tuple[np.ndarray, list]:
+    quarters = [(d.year, (d.month + 2) // 3) for d in dates_m]
+    uq: list = []
+    for q in quarters:
+        if not uq or uq[-1] != q:
+            uq.append(q)
+    qarr = np.empty((len(uq), data_m.shape[1]))
+    quarters_arr = np.array(quarters)
+    for t, q in enumerate(uq):
+        rows = (quarters_arr[:, 0] == q[0]) & (quarters_arr[:, 1] == q[1])
+        # plain mean: any missing month makes the quarter missing
+        qarr[t] = data_m[rows].mean(axis=0)
+    return qarr, uq
+
+
+# ---------------------------------------------------------------------------
+# detrending (reference: readin_functions.jl:317-348)
+# ---------------------------------------------------------------------------
+
+
+def _biweight_trend(data: np.ndarray, bandwidth: float) -> np.ndarray:
+    """Per-series biweight local mean, missing-aware (O(T^2) vectorized)."""
+    T, ns = data.shape
+    t_grid = np.arange(1, T + 1, dtype=float)
+    dt = (t_grid[None, :] - t_grid[:, None]) / bandwidth  # [target t, source s]
+    w = 15.0 / 16.0 * (1.0 - dt**2) ** 2
+    w[np.abs(dt) >= 1.0] = 0.0
+    mask = ~np.isnan(data)  # T x ns
+    vals = np.where(mask, data, 0.0)
+    num = w @ vals  # T x ns
+    den = w @ mask.astype(float)
+    with np.errstate(invalid="ignore", divide="ignore"):
+        trend = num / den
+    trend[~mask] = np.nan
+    return trend
+
+
+def _detrend(data: np.ndarray, method) -> tuple[np.ndarray, np.ndarray]:
+    if isinstance(method, BiWeight):
+        trend = _biweight_trend(data, method.weight)
+        return data - trend, trend
+    if isinstance(method, Mean):
+        trend = np.broadcast_to(np.nanmean(data, axis=0), data.shape).copy()
+        return data - trend, trend
+    if isinstance(method, NoDetrend):
+        return data.copy(), np.full_like(data, np.nan)
+    raise TypeError(f"unknown detrend method {method!r}")
+
+
+# ---------------------------------------------------------------------------
+# per-sheet ingest (reference: readin_functions.jl:200-283)
+# ---------------------------------------------------------------------------
+
+
+def _to_float_matrix(cells: list[list[object]]) -> np.ndarray:
+    out = np.full((len(cells), len(cells[0]) if cells else 0), np.nan)
+    for i, row in enumerate(cells):
+        for j, v in enumerate(row):
+            if isinstance(v, float):
+                out[i, j] = v
+    return out
+
+
+def _read_sheet_data(
+    freq: _Frequency,
+    datatype: str,
+    path: str,
+    correct_outlier: bool = True,
+    io_method: int = 4,
+    cat_include: Sequence[int] = (1, 2, 3, 5),
+) -> _SheetData:
+    grid = xlsx.read_sheet(path, freq.SHEET)
+    nheader = 1 + freq.NDESC + freq.NCODES
+    ns_sheet = freq.ns
+    header_rows = [r[1 : ns_sheet + 1] for r in grid[:nheader]]
+    data_rows = [r[1 : ns_sheet + 1] for r in grid[nheader : nheader + freq.nobs]]
+    date_cells = [r[0] for r in grid[nheader : nheader + freq.nobs]]
+    dates = [
+        d if isinstance(d, datetime.date) else xlsx.serial_to_date(d)
+        for d in date_cells
+    ]
+
+    names = [str(v).upper() for v in header_rows[0]]
+    lab_long = [str(v) for v in header_rows[1]]
+    lab_short = [str(v) for v in header_rows[2]]
+    code_rows = header_rows[3:]
+    if isinstance(freq, MonthlyData):
+        aggcode = np.array([int(v) for v in code_rows[0]])  # noqa: F841 (schema)
+        code_rows = code_rows[1:]
+    tcode = np.array([int(v) for v in code_rows[0]])
+    defcode = np.array([int(v) for v in code_rows[1]])
+    outliercode = np.array([int(v) for v in code_rows[2]])
+    includecode = np.array([int(v) for v in code_rows[3]])
+    catcode = np.array([float(v) for v in code_rows[4]])
+
+    datamat = _to_float_matrix(data_rows)  # nobs x ns_sheet, NaN = missing
+
+    # deflators from the full (unselected) sheet
+    if isinstance(freq, MonthlyData):
+        price_def = datamat[:, names.index("PCEPI")].copy()
+        price_def_lfe = datamat[:, names.index("PCEPILFE")].copy()
+        price_def_pgdp = None
+        # standardize Killian activity index (z-score, sample std)
+        j = names.index("GLOBAL_ACT")
+        col = datamat[:, j]
+        m = ~np.isnan(col)
+        datamat[m, j] = (col[m] - col[m].mean()) / col[m].std(ddof=1)
+    else:
+        price_def = datamat[:, names.index("PCECTPI")].copy()
+        price_def_lfe = datamat[:, names.index("JCXFE")].copy()
+        price_def_pgdp = datamat[:, names.index("GDPCTPI")].copy()
+
+    if datatype == "Real":
+        used = (includecode != 0) & np.isin(np.floor(catcode), list(cat_include))
+    elif datatype == "All":
+        used = includecode != 0
+    else:
+        raise ValueError("datatype must be 'Real' or 'All'")
+
+    data = datamat[:, used].copy()
+    sel_def = defcode[used]
+    sel_tcode = tcode[used]
+    sel_outlier = outliercode[used]
+    sel_names = [n for n, u in zip(names, used) if u]
+
+    deflators = {1: price_def, 2: price_def_lfe, 3: price_def_pgdp}
+    for i, dc in enumerate(sel_def):
+        if dc != 0:
+            data[:, i] = data[:, i] / deflators[dc]
+
+    if isinstance(freq, MonthlyData):
+        data_q, dates_q = _monthly_to_quarterly(data, dates)
+    else:
+        data_q = data
+        dates_q = [(d.year, (d.month + 2) // 3) for d in dates]
+
+    raw = data_q.copy()
+    for i, tc in enumerate(sel_tcode):
+        data_q[:, i] = _transform(data_q[:, i], tc)
+    noa = data_q.copy()
+    if correct_outlier:
+        for i, oc in enumerate(sel_outlier):
+            _adjust_outlier(data_q[:, i], oc, io_method)
+
+    return _SheetData(
+        data=data_q,
+        raw=raw,
+        noa=noa,
+        dates=dates_q,
+        catcode=catcode[used],
+        inclcode=includecode[used],
+        names=sel_names,
+    )
+
+
+# ---------------------------------------------------------------------------
+# top-level ingest (reference: readin_functions.jl:355-385)
+# ---------------------------------------------------------------------------
+
+
+def readin_data(
+    md: MonthlyData,
+    qd: QuarterlyData,
+    detrend_method=BiWeight(100.0),
+    datatype: str = "Real",
+    path: str | None = None,
+) -> Dataset:
+    path = path or default_data_path()
+    m = _read_sheet_data(md, datatype, path)
+    q = _read_sheet_data(qd, datatype, path)
+
+    if m.dates != q.dates:
+        raise ValueError("inconsistent sample size for monthly and quarterly data")
+
+    catcode = np.concatenate([m.catcode, q.catcode])
+    order = np.argsort(catcode, kind="stable")
+    bpdata = np.hstack([m.data, q.data])[:, order]
+    bpdata_unfiltered = bpdata.copy()
+    bpdata, trend = _detrend(bpdata, detrend_method)
+
+    names = m.names + q.names
+    calds = q.dates
+    return Dataset(
+        bpdata_raw=np.hstack([m.raw, q.raw])[:, order],
+        bpcatcode=catcode[order],
+        bpdata=bpdata,
+        bpdata_unfiltered=bpdata_unfiltered,
+        bpdata_noa=np.hstack([m.noa, q.noa])[:, order],
+        bpdata_trend=trend,
+        inclcode=np.concatenate([m.inclcode, q.inclcode])[order],
+        bpnamevec=[names[i] for i in order],
+        calvec=np.array([y + (qq - 1) / 4 for y, qq in calds]),
+        calds=calds,
+    )
+
+
+def find_row_number(date: tuple[int, int], calds: list) -> int:
+    """0-based row index of (year, quarter) in the quarterly calendar."""
+    return calds.index(tuple(date))

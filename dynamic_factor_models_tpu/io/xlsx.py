@@ -1,0 +1,159 @@
+"""Minimal stdlib .xlsx sheet reader.
+
+The environment has no openpyxl/xlrd, so we parse the OOXML zip directly
+(zipfile + regex over the worksheet XML). Only the features the Stock-Watson
+panel file needs are implemented: shared strings, inline numeric values and
+date-styled serial numbers.
+
+Cell coercion mirrors the behavior the reference pipeline depends on
+(reference: readin_functions.jl:217-226): numeric cells become floats,
+date-styled cells become ``datetime.date``, strings stay strings, and empty
+cells are ``None``.  The caller then maps non-float cells to missing.
+"""
+
+from __future__ import annotations
+
+import datetime
+import re
+import zipfile
+from functools import lru_cache
+
+# Built-in OOXML number formats that render as dates, plus any custom format
+# containing y/m/d tokens is detected dynamically from styles.xml.
+_BUILTIN_DATE_FMTS = set(range(14, 23)) | set(range(45, 48))
+
+_EXCEL_EPOCH = datetime.date(1899, 12, 30)
+
+
+def _col_to_index(col: str) -> int:
+    """'A' -> 0, 'B' -> 1, ..., 'AA' -> 26."""
+    idx = 0
+    for ch in col:
+        idx = idx * 26 + (ord(ch) - ord("A") + 1)
+    return idx - 1
+
+
+def _parse_shared_strings(z: zipfile.ZipFile) -> list[str]:
+    try:
+        xml = z.read("xl/sharedStrings.xml").decode("utf-8")
+    except KeyError:
+        return []
+    out = []
+    for si in re.findall(r"<si>(.*?)</si>", xml, re.S):
+        parts = re.findall(r"<t[^>]*>(.*?)</t>", si, re.S)
+        text = "".join(parts)
+        text = (
+            text.replace("&amp;", "&")
+            .replace("&lt;", "<")
+            .replace("&gt;", ">")
+            .replace("&quot;", '"')
+            .replace("&apos;", "'")
+        )
+        out.append(text)
+    return out
+
+
+def _parse_date_styles(z: zipfile.ZipFile) -> set[int]:
+    """Return the set of cellXfs indices whose number format is a date."""
+    try:
+        xml = z.read("xl/styles.xml").decode("utf-8")
+    except KeyError:
+        return set()
+    custom_date = set()
+    for m in re.finditer(r'<numFmt numFmtId="(\d+)" formatCode="([^"]*)"', xml):
+        fmt_id, code = int(m.group(1)), m.group(2)
+        # strip quoted literals and color/locale fields before token scan
+        stripped = re.sub(r'"[^"]*"|\[[^\]]*\]|\\.', "", code)
+        if re.search(r"[ymdhs]", stripped, re.I):
+            custom_date.add(fmt_id)
+    cellxfs = xml[xml.find("<cellXfs") : xml.find("</cellXfs>")]
+    date_xfs = set()
+    for i, m in enumerate(re.finditer(r"<xf [^>]*?>", cellxfs[cellxfs.find(">") + 1 :])):
+        idm = re.search(r'numFmtId="(\d+)"', m.group(0))
+        fmt = int(idm.group(1)) if idm else 0
+        if fmt in _BUILTIN_DATE_FMTS or fmt in custom_date:
+            date_xfs.add(i)
+    return date_xfs
+
+
+def _sheet_targets(z: zipfile.ZipFile) -> dict[str, str]:
+    wb = z.read("xl/workbook.xml").decode("utf-8")
+    rels = z.read("xl/_rels/workbook.xml.rels").decode("utf-8")
+    rel_map = dict(
+        re.findall(r'<Relationship Id="([^"]+)"[^>]*Target="([^"]+)"', rels)
+    )
+    out = {}
+    for m in re.finditer(r'<sheet name="([^"]+)"[^>]*r:id="([^"]+)"', wb):
+        name, rid = m.group(1), m.group(2)
+        target = rel_map[rid]
+        if not target.startswith("xl/"):
+            target = "xl/" + target
+        out[name] = target
+    return out
+
+
+def serial_to_date(serial: float) -> datetime.date:
+    return _EXCEL_EPOCH + datetime.timedelta(days=int(serial))
+
+
+@lru_cache(maxsize=4)
+def _read_workbook(path: str):
+    z = zipfile.ZipFile(path)
+    return z, _parse_shared_strings(z), _parse_date_styles(z), _sheet_targets(z)
+
+
+def read_sheet(path: str, sheet: str) -> list[list[object]]:
+    """Read a worksheet into a dense row-major list of lists.
+
+    Values are float, ``datetime.date``, str, or None (empty cell).
+    """
+    z, shared, date_xfs, targets = _read_workbook(str(path))
+    xml = z.read(targets[sheet]).decode("utf-8")
+
+    rows: dict[int, dict[int, object]] = {}
+    max_row = 0
+    max_col = 0
+    cell_re = re.compile(
+        r'<c r="([A-Z]+)(\d+)"((?:[^>/])*)(?:/>|>(.*?)</c>)', re.S
+    )
+    v_re = re.compile(r"<v>([^<]*)</v>")
+    t_re = re.compile(r't="(\w+)"')
+    s_re = re.compile(r's="(\d+)"')
+    for m in cell_re.finditer(xml):
+        col_s, row_s, attrs, body = m.group(1), m.group(2), m.group(3), m.group(4)
+        r = int(row_s)
+        c = _col_to_index(col_s)
+        value: object = None
+        if body:
+            vm = v_re.search(body)
+            if vm is not None:
+                raw = vm.group(1)
+                tm = t_re.search(attrs)
+                ctype = tm.group(1) if tm else "n"
+                if ctype == "s":
+                    value = shared[int(raw)]
+                elif ctype == "str":
+                    value = raw
+                elif ctype == "b":
+                    value = float(int(raw))
+                else:
+                    val = float(raw)
+                    sm = s_re.search(attrs)
+                    if sm is not None and int(sm.group(1)) in date_xfs:
+                        value = serial_to_date(val)
+                    else:
+                        value = val
+            else:
+                im = re.search(r"<is>.*?<t[^>]*>(.*?)</t>", body, re.S)
+                if im is not None:
+                    value = im.group(1)
+        if value is not None:
+            rows.setdefault(r, {})[c] = value
+            max_row = max(max_row, r)
+            max_col = max(max_col, c)
+
+    grid = [[None] * (max_col + 1) for _ in range(max_row)]
+    for r, cols in rows.items():
+        for c, v in cols.items():
+            grid[r - 1][c] = v
+    return grid

@@ -1,0 +1,38 @@
+import os
+
+# Tests run on a virtual 8-device CPU mesh (SURVEY.md section 4): multi-chip
+# sharding logic is exercised without TPU hardware, and float64 is enabled for
+# golden-value parity with the reference outputs.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", True)
+
+import sys  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+from dynamic_factor_models_tpu.io.cache import cached_dataset  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def dataset_real():
+    return cached_dataset("Real")
+
+
+@pytest.fixture(scope="session")
+def dataset_all():
+    return cached_dataset("All")
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(0)

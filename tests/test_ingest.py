@@ -1,0 +1,106 @@
+"""Ingest-layer tests: panel shapes, schema facts, and transform semantics.
+
+Golden facts from the reference (SURVEY.md sections 2.1, 6): 224 quarters,
+148 monthly + 85 quarterly source series, 207 selected columns for :All,
+calendar 1959Q1-2014Q4.
+"""
+
+import numpy as np
+import pytest
+
+from dynamic_factor_models_tpu.io import find_row_number
+from dynamic_factor_models_tpu.io.ingest import _adjust_outlier, _biweight_trend, _transform
+
+
+def test_panel_dimensions(dataset_real, dataset_all):
+    assert dataset_real.bpdata.shape[0] == 224
+    assert dataset_all.bpdata.shape == (224, 207)
+    assert dataset_real.bpdata.shape[1] == 86
+    assert int((dataset_real.inclcode == 1).sum()) == 58
+    assert int((dataset_all.inclcode == 1).sum()) == 139
+
+
+def test_calendar(dataset_real):
+    assert dataset_real.calds[0] == (1959, 1)
+    assert dataset_real.calds[-1] == (2014, 4)
+    assert find_row_number((1959, 3), dataset_real.calds) == 2
+    assert find_row_number((2014, 4), dataset_real.calds) == 223
+    np.testing.assert_allclose(dataset_real.calvec[:4], [1959.0, 1959.25, 1959.5, 1959.75])
+
+
+def test_catcode_sorted(dataset_real):
+    cc = dataset_real.bpcatcode
+    assert np.all(np.diff(cc) >= 0)
+
+
+def test_detrend_consistency(dataset_real):
+    # bpdata + trend == unfiltered wherever both observed
+    s = dataset_real.bpdata + dataset_real.bpdata_trend
+    m = ~np.isnan(s)
+    np.testing.assert_allclose(s[m], dataset_real.bpdata_unfiltered[m], atol=1e-10)
+
+
+def test_gdp_series_present(dataset_real, dataset_all):
+    for ds in (dataset_real, dataset_all):
+        assert "GDPC96" in ds.bpnamevec
+    for name in ("WPU0561", "MCOILWTICO", "MCOILBRENTEU", "RAC_IMP", "FEDFUNDS"):
+        assert name in dataset_all.bpnamevec
+
+
+def test_transform_codes():
+    x = np.array([1.0, 2.0, 4.0, 8.0])
+    np.testing.assert_allclose(_transform(x, 1), x)
+    d1 = _transform(x, 2)
+    assert np.isnan(d1[0])
+    np.testing.assert_allclose(d1[1:], [1, 2, 4])
+    d2 = _transform(x, 3)
+    assert np.isnan(d2[:2]).all()
+    np.testing.assert_allclose(d2[2:], [1, 2])
+    np.testing.assert_allclose(_transform(x, 4), np.log(x))
+    np.testing.assert_allclose(_transform(x, 5)[1:], np.diff(np.log(x)))
+
+
+def test_outlier_one_sided_median():
+    x = np.sin(np.arange(41.0))
+    x[20] = 50.0
+    y = x.copy()
+    _adjust_outlier(y, 1, 4)
+    assert y[20] != 50.0
+    assert abs(y[20]) <= np.nanmax(np.abs(np.delete(x, 20)))
+    # untouched elsewhere
+    np.testing.assert_allclose(np.delete(y, 20), np.delete(x, 20))
+
+
+def test_outlier_missing_replacement():
+    x = np.sin(np.arange(41.0))
+    x[20] = 50.0
+    _adjust_outlier(x, 2, 0)
+    assert np.isnan(x[20])
+
+
+def test_biweight_trend_constant():
+    # a constant series has itself as trend
+    data = np.ones((50, 1))
+    trend = _biweight_trend(data, 10.0)
+    np.testing.assert_allclose(trend, 1.0)
+
+
+def test_biweight_trend_missing_aware():
+    data = np.ones((50, 2))
+    data[10:15, 0] = np.nan
+    trend = _biweight_trend(data, 10.0)
+    assert np.isnan(trend[10:15, 0]).all()
+    m = ~np.isnan(trend[:, 0])
+    np.testing.assert_allclose(trend[m, 0], 1.0)
+
+
+def test_rebuild_from_xlsx_matches_cache(dataset_real):
+    """Exercise the full xlsx->panel pipeline (not the npz cache) end to end."""
+    from dynamic_factor_models_tpu.io import BiWeight, MonthlyData, QuarterlyData, readin_data
+
+    md = MonthlyData.from_range((1959, 1), (2014, 12), 148)
+    qd = QuarterlyData.from_range((1959, 1), (2014, 4), 85)
+    fresh = readin_data(md, qd, BiWeight(100.0), "Real")
+    np.testing.assert_array_equal(fresh.bpdata, dataset_real.bpdata)
+    np.testing.assert_array_equal(fresh.bpdata_raw, dataset_real.bpdata_raw)
+    assert fresh.bpnamevec == list(dataset_real.bpnamevec)
