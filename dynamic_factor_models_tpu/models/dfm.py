@@ -1,0 +1,349 @@
+"""Dynamic factor model estimation: iterated PCA / alternating least squares.
+
+TPU-native rewrite of the reference estimation core (dfm_functions.ipynb
+cells 4-7, 20-21, 25, 27).  The reference's ALS loop — per-series OLS for
+loadings, then per-period OLS for factors, until the SSR change falls below
+tol*T*ns (cell 20:25-43) — becomes a ``lax.while_loop`` whose body is two
+batched masked normal-equation solves, entirely inside ``jit``:
+
+    lambda-step:  for all series i at once:   (F'W_i F) lam_i = F'W_i x_i
+    F-step:       for all periods t at once:  (L'W_t L) f_t  = L'W_t x_t
+
+with W the observation mask.  Series failing the minimum-observation rule are
+excluded by zero weights (the reference leaves their loadings `missing`, which
+drops them from every per-period regression — same effect).
+
+Missing-data semantics match the reference exactly: tss/nobs bookkeeping over
+observed entries of the standardized window (cell 20:15-16), the
+sqrt((n-1)/n) population-std correction (cell 25), and the convergence rule
+|SSR_old - SSR| < tol*T*ns (cell 20:41).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.lags import uar
+from ..ops.linalg import pca_score, solve_normal, standardize_data
+from ..ops.masking import compact, fillz, mask_of
+from ..utils.backend import on_backend
+from .constraints import LambdaConstraint, apply_constraint_batch
+from .var import VARResults, estimate_var
+
+__all__ = [
+    "DFMConfig",
+    "FactorEstimateStats",
+    "DFMResults",
+    "estimate_factor",
+    "estimate_factor_loading",
+    "estimate_dfm",
+    "compute_series",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class DFMConfig:
+    """Hyperparameters of the DFM (reference cells 6-7 + driver cell 15)."""
+
+    nfac_u: int = 1  # unobserved factors
+    nfac_o: int = 0  # observed factors (reference declares but never exercises)
+    nt_min_factor: int = 20  # min obs for a series to enter factor estimation
+    nt_min_loading: int = 40  # min obs for a series to get a loading
+    tol: float = 1e-8  # ALS convergence tolerance (scaled by T*ns)
+    n_uarlag: int = 4  # idiosyncratic AR lags
+    n_factorlag: int = 4  # factor-VAR lags
+    max_iter: int = 200_000
+
+    @property
+    def nfac_t(self) -> int:
+        return self.nfac_o + self.nfac_u
+
+
+class FactorEstimateStats(NamedTuple):
+    """SSR/TSS bookkeeping of the factor stage (reference cell 4)."""
+
+    T: int
+    ns: int
+    nobs: jnp.ndarray
+    tss: jnp.ndarray
+    ssr: jnp.ndarray
+    R2: jnp.ndarray  # per included series, NaN where below nt_min
+    n_iter: jnp.ndarray
+
+
+class DFMResults(NamedTuple):
+    factor: jnp.ndarray  # (T, nfac_t), NaN outside the estimation window
+    lam: jnp.ndarray  # (ns, nfac_t) loadings, NaN where below nt_min_loading
+    uar_coef: jnp.ndarray  # (ns, n_uarlag) idiosyncratic AR coefficients
+    uar_ser: jnp.ndarray  # (ns,) idiosyncratic AR standard errors
+    r2: jnp.ndarray  # (ns,) loading-regression R^2
+    fes: FactorEstimateStats
+    var: VARResults | None  # factor-evolution VAR
+
+
+# ---------------------------------------------------------------------------
+# ALS core (jitted)
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnames=("nfac", "max_iter", "n_constr"))
+def _als_core(
+    xz,  # (Tw, ns) standardized data, NaN->0
+    m,  # (Tw, ns) observation mask (float)
+    lam_ok,  # (ns,) series passing nt_min
+    f0,  # (Tw, nfac) PCA initialization
+    tol_scaled,  # tol * T * ns
+    nfac: int,
+    max_iter: int,
+    n_constr: int = 0,
+    c_series=None,  # (nc,) constrained series indices
+    c_R=None,  # (nc, k, nfac)
+    c_r=None,  # (nc, k) standardized restriction values
+):
+    W = m * lam_ok[None, :]
+
+    def lam_step(f):
+        A = jnp.einsum("tr,ti,ts->irs", f, m, f)
+        rhs = jnp.einsum("tr,ti->ir", f, m * xz)
+        lam = jax.vmap(solve_normal)(A, rhs)
+        if n_constr:
+            constraint = LambdaConstraint(c_series, c_R, c_r)
+            lam = apply_constraint_batch(lam, A, constraint, ok=lam_ok)
+        return lam
+
+    def f_step(lam):
+        A = jnp.einsum("ir,ti,is->trs", lam, W, lam)
+        rhs = jnp.einsum("ir,ti->tr", lam, W * xz)
+        f = jax.vmap(solve_normal)(A, rhs)
+        ssr = (W * (xz - f @ lam.T) ** 2).sum()
+        return f, ssr
+
+    def cond(carry):
+        _, _, ssr, diff, it = carry
+        return (diff >= tol_scaled) & (it < max_iter)
+
+    def body(carry):
+        f, _, ssr_old, _, it = carry
+        lam = lam_step(f)
+        f, ssr = f_step(lam)
+        return f, lam, ssr, jnp.abs(ssr_old - ssr), it + 1
+
+    lam0 = jnp.zeros((xz.shape[1], nfac), xz.dtype)
+    init = (f0, lam0, jnp.asarray(0.0, xz.dtype), jnp.asarray(jnp.inf, xz.dtype), 0)
+    f, lam, ssr, _, n_iter = jax.lax.while_loop(cond, body, init)
+    return f, lam, ssr, n_iter
+
+
+@partial(jax.jit, static_argnames=())
+def _r2_pass(xz, m, f, lam_ok):
+    """Final per-series R^2 of x_i on the estimated factors (cell 20:45-52)."""
+    A = jnp.einsum("tr,ti,ts->irs", f, m, f)
+    rhs = jnp.einsum("tr,ti->ir", f, m * xz)
+    b = jax.vmap(solve_normal)(A, rhs)
+    e = (xz - f @ b.T) * m
+    ssr = (e**2).sum(axis=0)
+    n = m.sum(axis=0)
+    ybar = (m * xz).sum(axis=0) / n
+    tss = (m * (xz - ybar[None, :]) ** 2).sum(axis=0)
+    return jnp.where(lam_ok, 1.0 - ssr / tss, jnp.nan)
+
+
+def estimate_factor(
+    data,
+    inclcode,
+    initperiod: int,
+    lastperiod: int,
+    config: DFMConfig,
+    constraint: LambdaConstraint | None = None,
+    max_iter: int | None = None,
+    compute_R2: bool = True,
+    backend: str | None = None,
+):
+    """Iterated-PCA factor extraction (reference cell 20, `estimate_factor!`).
+
+    Window bounds are 0-based inclusive.  Returns (factor, fes) with factor
+    full-length, NaN outside the window.
+    """
+    if config.nfac_o:
+        raise NotImplementedError(
+            "observed factors: declared but never implemented by the reference "
+            "(dfm_functions.ipynb cell 1); pending"
+        )
+    with on_backend(backend):
+        data = jnp.asarray(data)
+        inclcode = np.asarray(inclcode)
+        est = data[:, inclcode == 1]
+        xw = est[initperiod : lastperiod + 1]
+        Tw, ns = xw.shape
+        nfac = config.nfac_u
+
+        xstd, stds = standardize_data(xw)
+        mask = mask_of(xstd)
+        m = mask.astype(xstd.dtype)
+        xz = fillz(xstd)
+
+        tss = (xz**2 * m).sum()
+        nobs = m.sum()
+        lam_ok = m.sum(axis=0) >= config.nt_min_factor
+
+        # PCA init on the fully-balanced column block (cells 9-10, 20:18-21).
+        balanced = np.asarray(mask.all(axis=0))
+        f0 = pca_score(xz[:, balanced], nfac)
+
+        kwargs = {}
+        n_constr = 0
+        if constraint is not None:
+            n_constr = len(constraint.series)
+            kwargs = dict(
+                c_series=jnp.asarray(constraint.series),
+                c_R=constraint.R,
+                c_r=constraint.standardized(stds),
+            )
+        f, lam, ssr, n_iter = _als_core(
+            xz,
+            m,
+            lam_ok,
+            f0,
+            config.tol * Tw * ns,
+            nfac,
+            max_iter if max_iter is not None else config.max_iter,
+            n_constr,
+            **kwargs,
+        )
+
+        R2 = _r2_pass(xz, m, f, lam_ok) if compute_R2 else jnp.full(ns, jnp.nan)
+        factor = jnp.full((data.shape[0], nfac), jnp.nan, data.dtype)
+        factor = factor.at[initperiod : lastperiod + 1].set(f)
+        fes = FactorEstimateStats(Tw, ns, nobs, tss, ssr, R2, n_iter)
+        return factor, fes
+
+
+# ---------------------------------------------------------------------------
+# loadings + idiosyncratic AR (reference cell 21)
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnames=("n_uarlag", "nt_min", "n_constr"))
+def _loading_core(
+    yw,  # (Tw, ns) raw data window
+    fw,  # (Tw, nfac) factors in window
+    nt_min: int,
+    n_uarlag: int,
+    n_constr: int = 0,
+    c_series=None,
+    c_R=None,  # (nc, k, nfac+1) with const column
+    c_r=None,
+):
+    Tw, ns = yw.shape
+    X = jnp.hstack([fillz(fw), jnp.ones((Tw, 1), yw.dtype)])
+    # rows where any factor is missing are dropped for every series, matching
+    # the reference's drop_missing_row([y fac]) (cell 21:7)
+    W = (mask_of(yw) & mask_of(fw).all(axis=1)[:, None]).astype(yw.dtype)
+    A = jnp.einsum("tk,ti,tl->ikl", X, W, X)
+    rhs = jnp.einsum("tk,ti->ik", X, W * fillz(yw))
+    b = jax.vmap(solve_normal)(A, rhs)  # (ns, nfac+1)
+    count = W.sum(axis=0)
+    ok = count >= nt_min
+    if n_constr:
+        constraint = LambdaConstraint(c_series, c_R, c_r)
+        b = apply_constraint_batch(b, A, constraint, ok=ok)
+
+    e = jnp.where(W.astype(bool), fillz(yw) - X @ b.T, jnp.nan)
+    ssr = (fillz(e) ** 2 * W).sum(axis=0)
+    ybar = (W * fillz(yw)).sum(axis=0) / count
+    tss = (W * (fillz(yw) - ybar[None, :]) ** 2).sum(axis=0)
+    r2 = 1.0 - ssr / tss
+
+    def fit_uar(e_i, w_i):
+        vals, valid = compact(e_i, w_i)
+        return uar(vals, n_uarlag, valid)
+
+    coef, ser = jax.vmap(fit_uar, in_axes=1)(e, W.astype(bool))
+    # R^2 ~ 1: residual is numerically zero; reference zeroes the AR
+    degenerate = r2 >= 0.9999
+    coef = jnp.where(degenerate[:, None], 0.0, coef)
+    ser = jnp.where(degenerate, 0.0, ser)
+
+    # series below nt_min: no estimate (the reference silently reuses the
+    # previous series' AR state here — SURVEY.md section 2.5 quirk 3, fixed)
+    lam = jnp.where(ok[:, None], b[:, :-1], jnp.nan)
+    r2 = jnp.where(ok, r2, jnp.nan)
+    coef = jnp.where(ok[:, None], coef, jnp.nan)
+    ser = jnp.where(ok, ser, jnp.nan)
+    return lam, r2, coef, ser
+
+
+def estimate_factor_loading(
+    data,
+    factor,
+    initperiod: int,
+    lastperiod: int,
+    config: DFMConfig,
+    constraint: LambdaConstraint | None = None,
+    backend: str | None = None,
+):
+    """Full-sample loadings + idiosyncratic AR(n_uarlag) per series (cell 21).
+
+    Runs over ALL panel columns (not just inclcode==1).  Returns
+    (lam, r2, uar_coef, uar_ser).
+    """
+    with on_backend(backend):
+        data = jnp.asarray(data)
+        yw = data[initperiod : lastperiod + 1]
+        fw = jnp.asarray(factor)[initperiod : lastperiod + 1]
+        kwargs = {}
+        n_constr = 0
+        if constraint is not None:
+            n_constr = len(constraint.series)
+            kwargs = dict(
+                c_series=jnp.asarray(constraint.series),
+                c_R=constraint.with_const_column(),
+                c_r=constraint.r,
+            )
+        return _loading_core(
+            yw, fw, config.nt_min_loading, config.n_uarlag, n_constr, **kwargs
+        )
+
+
+# ---------------------------------------------------------------------------
+# full pipeline (reference cell 27, `estimate!`)
+# ---------------------------------------------------------------------------
+
+
+def estimate_dfm(
+    data,
+    inclcode,
+    initperiod: int,
+    lastperiod: int,
+    config: DFMConfig = DFMConfig(),
+    constraint_factor: LambdaConstraint | None = None,
+    constraint_loading: LambdaConstraint | None = None,
+    backend: str | None = None,
+) -> DFMResults:
+    """Non-parametric DFM: factors -> loadings -> factor VAR (cell 27).
+
+    The parametric (state-space EM) path is `models.ssm.estimate_dfm_em` —
+    a capability the reference declared but never implemented.
+    """
+    with on_backend(backend):
+        factor, fes = estimate_factor(
+            data, inclcode, initperiod, lastperiod, config, constraint_factor
+        )
+        lam, r2, uar_coef, uar_ser = estimate_factor_loading(
+            data, factor, initperiod, lastperiod, config, constraint_loading
+        )
+        var = estimate_var(
+            factor, config.n_factorlag, initperiod, lastperiod, withconst=True
+        )
+        return DFMResults(factor, lam, uar_coef, uar_ser, r2, fes, var)
+
+
+def compute_series(results: DFMResults, series_idx) -> jnp.ndarray:
+    """Common component F lam_i' of one or more series (reference cell 28)."""
+    return results.factor @ results.lam[series_idx].T

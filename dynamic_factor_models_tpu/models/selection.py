@@ -1,0 +1,150 @@
+"""Factor-number selection: Bai-Ng ICp2, Amengual-Watson, Ahn-Horenstein.
+
+Rewrite of reference cells 35-40.  The reference's O(max_nfac^2) loop of full
+DFM refits (SURVEY.md section 3.3) is kept serial per r (each fit is already
+one jitted while-loop; the fits for different r have different shapes), but
+every inner regression is batched.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.lags import lagmat
+from ..ops.linalg import solve_normal
+from ..ops.masking import fillz, mask_of
+from .dfm import DFMConfig, FactorEstimateStats, estimate_factor
+
+__all__ = [
+    "bai_ng_criterion",
+    "amengual_watson_test",
+    "estimate_factor_numbers",
+    "ahn_horenstein_er",
+    "FactorNumberEstimateStats",
+]
+
+
+def bai_ng_criterion(fes: FactorEstimateStats, nfac_t: int) -> jnp.ndarray:
+    """Bai-Ng ICp2 with unbalanced-panel-adjusted counts (reference cell 35)."""
+    nbar = fes.nobs / fes.T
+    g = jnp.log(jnp.minimum(nbar, fes.T)) * (nbar + fes.T) / fes.nobs
+    return jnp.log(fes.ssr / fes.nobs) + nfac_t * g
+
+
+class FactorNumberEstimateStats(NamedTuple):
+    """Selection-statistics bundle (reference cell 37)."""
+
+    bn_icp: np.ndarray  # (max_nfac,)
+    ssr_static: np.ndarray  # (max_nfac,)
+    R2_static: np.ndarray  # (ns, max_nfac)
+    aw_icp: np.ndarray  # (max_nfac, max_nfac), NaN above diagonal
+    ssr_dynamic: np.ndarray
+    R2_dynamic: np.ndarray  # (ns, max_nfac, max_nfac)
+    tss: float
+    nobs: float
+    T: int
+
+    @property
+    def trace_r2(self) -> np.ndarray:
+        return 1.0 - self.ssr_static / self.tss
+
+    @property
+    def marginal_r2(self) -> np.ndarray:
+        tr = self.trace_r2
+        return np.concatenate([tr[:1], np.diff(tr)])
+
+
+def ahn_horenstein_er(marginal_r2: np.ndarray) -> np.ndarray:
+    """Ahn-Horenstein eigenvalue-ratio criterion from marginal trace R^2
+    (driver cell 31/35 convention: ER_r = margR2_r / margR2_{r+1})."""
+    return marginal_r2[:-1] / marginal_r2[1:]
+
+
+def amengual_watson_test(
+    data,
+    inclcode,
+    factor,
+    initperiod: int,
+    lastperiod: int,
+    config: DFMConfig,
+    nfac_static: int,
+):
+    """Number-of-dynamic-factors test (reference cell 40).
+
+    Residualize each included series on [1, lags 1..p of the static factors]
+    over the full sample, then re-estimate static DFMs of every order on the
+    residual panel (window shifted +nlag) and return their Bai-Ng values.
+    """
+    data = jnp.asarray(data)
+    inclcode = np.asarray(inclcode)
+    est = data[:, inclcode == 1]
+    T, ns = est.shape
+    nlag = config.n_factorlag
+
+    x = jnp.hstack(
+        [jnp.ones((T, 1), data.dtype), lagmat(jnp.asarray(factor), range(1, nlag + 1))]
+    )
+    xm = mask_of(x).all(axis=1)
+    W = (mask_of(est) & xm[:, None]).astype(data.dtype)
+    xz = fillz(x)
+    A = jnp.einsum("tk,ti,tl->ikl", xz, W, xz)
+    rhs = jnp.einsum("tk,ti->ik", xz, W * fillz(est))
+    b = jax.vmap(solve_normal)(A, rhs)
+    ndf = W.sum(axis=0) - x.shape[1]
+    keep = ndf >= config.nt_min_factor
+    resid = jnp.where(W.astype(bool) & keep[None, :], fillz(est) - xz @ b.T, jnp.nan)
+
+    aw = np.full(nfac_static, np.nan)
+    ssr = np.full(nfac_static, np.nan)
+    r2 = np.full((ns, nfac_static), np.nan)
+    ones = np.ones(ns, dtype=inclcode.dtype)
+    for nfac_d in range(1, nfac_static + 1):
+        cfg_d = dataclasses.replace(config, nfac_u=nfac_d, nfac_o=0)
+        _, fes = estimate_factor(
+            resid, ones, initperiod + nlag, lastperiod, cfg_d
+        )
+        aw[nfac_d - 1] = float(bai_ng_criterion(fes, nfac_d))
+        ssr[nfac_d - 1] = float(fes.ssr)
+        r2[:, nfac_d - 1] = np.asarray(fes.R2)
+    return aw, ssr, r2
+
+
+def estimate_factor_numbers(
+    data,
+    inclcode,
+    initperiod: int,
+    lastperiod: int,
+    config: DFMConfig,
+    max_nfac: int,
+    dynamic: bool = True,
+) -> FactorNumberEstimateStats:
+    """Fit DFMs for r = 1..max_nfac and collect selection statistics
+    (reference cell 39).  Set dynamic=False to skip the O(r^2)
+    Amengual-Watson refits."""
+    inclcode = np.asarray(inclcode)
+    ns = int((inclcode == 1).sum())
+    bn = np.full(max_nfac, np.nan)
+    ssr_s = np.full(max_nfac, np.nan)
+    R2_s = np.full((ns, max_nfac), np.nan)
+    aw = np.full((max_nfac, max_nfac), np.nan)
+    ssr_d = np.full((max_nfac, max_nfac), np.nan)
+    R2_d = np.full((ns, max_nfac, max_nfac), np.nan)
+    tss = nobs = T = None
+    for i, nfac in enumerate(range(1, max_nfac + 1)):
+        cfg = dataclasses.replace(config, nfac_u=nfac)
+        factor, fes = estimate_factor(data, inclcode, initperiod, lastperiod, cfg)
+        bn[i] = float(bai_ng_criterion(fes, nfac))
+        ssr_s[i] = float(fes.ssr)
+        R2_s[:, i] = np.asarray(fes.R2)
+        if dynamic:
+            aw[: nfac, i], ssr_d[: nfac, i], R2_d[:, : nfac, i] = amengual_watson_test(
+                data, inclcode, factor, initperiod, lastperiod, cfg, nfac
+            )
+        tss, nobs, T = float(fes.tss), float(fes.nobs), fes.T
+    return FactorNumberEstimateStats(bn, ssr_s, R2_s, aw, ssr_d, R2_d, tss, nobs, T)

@@ -1,0 +1,131 @@
+"""VAR(p) in companion state-space form, with Cholesky-identified IRFs.
+
+TPU-native rewrite of the reference VAR layer (dfm_functions.ipynb cells 3,
+22-24, 42-43): masked balanced OLS replaces row dropping, the companion/
+selector/impact matrices are built functionally, and impulse responses are a
+``lax.scan`` over the horizon ``vmap``-ed over shocks (the reference's
+per-shock matvec loop, cell 43).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.lags import lagmat
+from ..ops.linalg import solve_normal
+from ..ops.masking import fillz, mask_of
+
+__all__ = ["VARResults", "estimate_var", "impulse_response", "companion_matrices"]
+
+
+class VARResults(NamedTuple):
+    """Estimated VAR: y_t = Q z_t, z_t = M z_{t-1} + G u_t (reference cell 3)."""
+
+    betahat: jnp.ndarray  # (1+ns*nlag, ns) coefficient matrix (const first)
+    resid: jnp.ndarray  # (T, ns) residuals, NaN outside used rows
+    seps: jnp.ndarray  # (ns, ns) innovation covariance, dof-corrected
+    M: jnp.ndarray  # (ns*nlag, ns*nlag) companion
+    Q: jnp.ndarray  # (ns, ns*nlag) selector
+    G: jnp.ndarray  # (ns*nlag, ns) structural impact (Cholesky, recursive id)
+    T_used: jnp.ndarray  # scalar: rows entering the regression
+    nlag: int
+
+
+def companion_matrices(betahat: jnp.ndarray, seps: jnp.ndarray, nlag: int):
+    """Companion M, selector Q, impact G = chol(seps) (reference cell 24).
+
+    betahat rows: [const, lag-1 block, ..., lag-p block]; G's lower-triangular
+    Cholesky factor encodes the recursive (ordering-dependent) identification.
+    """
+    ns = seps.shape[0]
+    b = betahat[1:].T  # (ns, ns*nlag): row per equation, const dropped
+    M = jnp.zeros((ns * nlag, ns * nlag), dtype=betahat.dtype)
+    M = M.at[:ns, :].set(b)
+    if nlag > 1:
+        M = M.at[ns:, : ns * (nlag - 1)].set(jnp.eye(ns * (nlag - 1), dtype=betahat.dtype))
+    Q = jnp.zeros((ns, ns * nlag), dtype=betahat.dtype).at[:, :ns].set(jnp.eye(ns, dtype=betahat.dtype))
+    G = jnp.zeros((ns * nlag, ns), dtype=betahat.dtype).at[:ns, :].set(jnp.linalg.cholesky(seps))
+    return M, Q, G
+
+
+@partial(jax.jit, static_argnames=("nlag", "withconst", "compute_matrices"))
+def _estimate_var_window(yw, nlag: int, withconst: bool, compute_matrices: bool):
+    Tw, ns = yw.shape
+    xlag = lagmat(yw, range(1, nlag + 1))
+    x = jnp.hstack([jnp.ones((Tw, 1), dtype=yw.dtype), fillz(xlag)]) if withconst else fillz(xlag)
+    w = mask_of(yw).all(axis=1) & mask_of(xlag).all(axis=1)
+    wf = w.astype(yw.dtype)
+    Xw = x * wf[:, None]
+    A = Xw.T @ x
+    betahat = solve_normal(A, Xw.T @ fillz(yw))
+    ehat = jnp.where(w[:, None], fillz(yw) - x @ betahat, jnp.nan)
+    T_used = w.sum()
+    K = x.shape[1]
+    e0 = jnp.where(w[:, None], fillz(ehat), 0.0)
+    seps = e0.T @ e0 / (T_used - K)
+    if compute_matrices:
+        M, Q, G = companion_matrices(
+            betahat if withconst else jnp.vstack([jnp.zeros((1, ns), yw.dtype), betahat]),
+            seps,
+            nlag,
+        )
+    else:
+        M = Q = G = jnp.zeros((0, 0), dtype=yw.dtype)
+    return betahat, ehat, seps, M, Q, G, T_used
+
+
+def estimate_var(
+    y,
+    nlag: int = 1,
+    initperiod: int = 0,
+    lastperiod: int | None = None,
+    withconst: bool = True,
+    compute_matrices: bool = True,
+) -> VARResults:
+    """Estimate a VAR(nlag) on rows [initperiod, lastperiod] of y
+    (0-based inclusive window; reference cell 23).
+
+    Rows with any missing value in [y, lags] are excluded (Balanced rule);
+    seps uses the (T_used - K) dof correction.
+    """
+    y = jnp.asarray(y)
+    if lastperiod is None:
+        lastperiod = y.shape[0] - 1
+    yw = y[initperiod : lastperiod + 1]
+    betahat, ehat, seps, M, Q, G, T_used = _estimate_var_window(
+        yw, nlag, withconst, compute_matrices
+    )
+    resid = jnp.full_like(y, jnp.nan).at[initperiod : lastperiod + 1].set(ehat)
+    return VARResults(betahat, resid, seps, M, Q, G, T_used, nlag)
+
+
+@partial(jax.jit, static_argnames=("T",))
+def _irf_all(M, Q, G, T: int):
+    def step(x, _):
+        return M @ x, Q @ x
+
+    def one_shock(g):
+        _, out = jax.lax.scan(step, g, None, length=T)
+        return out.T  # (ns, T)
+
+    return jax.vmap(one_shock, in_axes=1, out_axes=2)(G)  # (ns, T, nshock)
+
+
+def impulse_response(var: VARResults, shock_ids, T: int) -> jnp.ndarray:
+    """IRFs to Cholesky-orthogonalized shocks (reference cells 42-43).
+
+    shock_ids: "all", an int, or a sequence of 0-based shock indices.
+    Returns (ns, T, nshock) — or (ns, T) for a scalar shock id.  The
+    reference's scalar path references an undefined variable (SURVEY.md
+    section 2.5 quirk 1); it is implemented correctly here.
+    """
+    irfs = _irf_all(var.M, var.Q, var.G, T)
+    if isinstance(shock_ids, str) and shock_ids == "all":
+        return irfs
+    if isinstance(shock_ids, int):
+        return irfs[:, :, shock_ids]
+    return irfs[:, :, jnp.asarray(shock_ids)]

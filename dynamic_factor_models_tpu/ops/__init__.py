@@ -1,0 +1,18 @@
+from .masking import mask_of, fillz, compact, row_mask
+from .linalg import (
+    solve_normal,
+    ols,
+    ols_masked,
+    ols_batched_series,
+    pca_score,
+    standardize_data,
+    compute_r2,
+)
+from .lags import lagmat, uar, detrended_year_growth
+from .hac import form_kernel, hac, regress_hac, compute_chow, compute_qlr
+from .filters import (
+    compute_bw_weight,
+    compute_gain,
+    ma_weight,
+    baxter_king_lowpass_weight,
+)

@@ -1,0 +1,56 @@
+"""Filter weights and spectral gains (reference cells 31-33; Figure 2).
+
+The gain is evaluated for all frequencies at once as |W e^{i l w}| via a
+single complex matmul instead of the reference's per-frequency Horner loop.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = [
+    "compute_bw_weight",
+    "compute_gain",
+    "ma_weight",
+    "baxter_king_lowpass_weight",
+]
+
+
+def compute_bw_weight(B: int) -> jnp.ndarray:
+    """Tukey biweight lag window on [-B, B], normalized to sum 1 (cell 31)."""
+    i = jnp.abs(jnp.arange(-B, B + 1))
+    w = (1.0 - (i / B) ** 2) ** 2
+    return w / w.sum()
+
+
+def compute_gain(w: jnp.ndarray, lam: jnp.ndarray) -> jnp.ndarray:
+    """|gain| of a two-sided filter with weights w at frequencies lam (cell 33).
+
+    w has odd length 2B+1 covering lags -B..B; lam may be a vector.
+    """
+    B = (w.shape[0] - 1) // 2
+    lags = jnp.arange(-B, B + 1)
+    lam = jnp.atleast_1d(lam)
+    phase = jnp.exp(1j * jnp.outer(lam, lags))  # e^{i lam l}, l = -B..B
+    gain = phase @ w.astype(phase.dtype)
+    return jnp.abs(gain)
+
+
+def ma_weight(B: int, half_width: int) -> jnp.ndarray:
+    """Flat two-sided MA over +/- half_width on the [-B, B] lag grid
+    (Stock_Watson.ipynb cell 26)."""
+    lags = jnp.arange(-B, B + 1)
+    w = (jnp.abs(lags) <= half_width).astype(float)
+    return w / w.sum()
+
+
+def baxter_king_lowpass_weight(maxlag: int) -> jnp.ndarray:
+    """Baxter-King low-pass weights, cutoff period 2*maxlag quarters
+    (Stock_Watson.ipynb cell 26)."""
+    nper = 2 * maxlag
+    ombar = 2 * jnp.pi / nper
+    t1 = jnp.arange(1, maxlag + 1)
+    tmp0 = ombar / jnp.pi
+    tmp1 = (1.0 / (jnp.pi * t1)) * jnp.sin(t1 * ombar)
+    w = jnp.concatenate([tmp1[::-1], jnp.array([tmp0]), tmp1])
+    return w / w.sum()

@@ -1,0 +1,111 @@
+"""Newey-West HAC covariance, Chow and QLR (sup-Wald) break tests.
+
+TPU-native rewrite of reference cells 46-58.  The QLR scan over break dates —
+the reference's widest hot loop (SURVEY.md section 3.5, thousands of small HAC
+regressions) — is a single ``vmap`` over breaks here, and callers further
+``vmap`` over series.
+
+Inputs are dense (already compacted) series: the driver compacts [y X] rows
+before testing, exactly as the reference does (Stock_Watson.ipynb cell 57).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from .linalg import ols, solve_normal
+
+__all__ = ["form_kernel", "hac", "regress_hac", "compute_chow", "compute_qlr"]
+
+
+def form_kernel(q: int) -> jnp.ndarray:
+    """Bartlett kernel weights 1 - i/(q+1), i = 0..q (cell 46)."""
+    return 1.0 - jnp.arange(q + 1) / (q + 1)
+
+
+def _form_hscrc(z: jnp.ndarray, X: jnp.ndarray, q: int) -> jnp.ndarray:
+    """HAC sandwich: sum of +/-q kernel-weighted autocovariances of z = X.*u,
+    pre/post-multiplied by (X'X)^-1 (cell 55)."""
+    kernel = form_kernel(q)
+    T = z.shape[0]
+    v = kernel[0] * z.T @ z
+    for i in range(1, q + 1):
+        gamma = z[i:].T @ z[: T - i]
+        v = v + kernel[i] * (gamma + gamma.T)
+    XX = X.T @ X
+    XXinv = jnp.linalg.pinv(XX, hermitian=True)
+    return XXinv @ v @ XXinv
+
+
+def hac(u: jnp.ndarray, X: jnp.ndarray, q: int):
+    """HAC covariance of OLS coefficients and its standard errors (cell 53)."""
+    z = X * u[:, None]
+    vbeta = _form_hscrc(z, X, q)
+    return vbeta, jnp.sqrt(jnp.diag(vbeta))
+
+
+def regress_hac(y: jnp.ndarray, X: jnp.ndarray, q: int):
+    """OLS with HAC variance (cell 51)."""
+    betahat, ehat = ols(y, X)
+    vbeta, se_beta = hac(ehat, X, q)
+    return betahat, vbeta, se_beta
+
+
+@partial(jax.jit, static_argnames=("q",))
+def compute_chow(y: jnp.ndarray, X: jnp.ndarray, q: int, n_pre) -> jnp.ndarray:
+    """Chow break-test Wald statistic with HAC(q) variance (cell 49).
+
+    `n_pre` is the number of pre-break rows (the reference's `T_break`,
+    i.e. D = [zeros(n_pre); ones(T-n_pre)]); may be a traced value so QLR can
+    vmap over break dates.
+    """
+    k = X.shape[1]
+    T = y.shape[0]
+    D = (jnp.arange(T) >= n_pre).astype(X.dtype)
+    Xfull = jnp.hstack([X, X * D[:, None]])
+    betahat, vbeta, _ = regress_hac(y, Xfull, q)
+    gamma = betahat[k:]
+    v1 = vbeta[k:, k:]
+    return gamma @ solve_normal(v1, gamma)
+
+
+@partial(jax.jit, static_argnames=("ccut", "q"))
+def compute_qlr(
+    y: jnp.ndarray,
+    X2: jnp.ndarray,
+    ccut: float,
+    q: int,
+    X1: jnp.ndarray | None = None,
+):
+    """QLR sup-Wald over central break dates (cell 58).
+
+    Returns (max Chow with q=0, max Chow with HAC(q)).  When exogenous
+    regressors X1 are supplied, only X2's coefficients break — the reference's
+    vcat shape bug on this path (SURVEY.md section 2.5 quirk 2) is fixed here;
+    the reference only ever exercises X1=None.
+    """
+    T = y.shape[0]
+    n1t = int(ccut * T)
+    n2t = T - n1t
+    breaks = jnp.arange(n1t, n2t + 1)
+
+    if X1 is None:
+        chow0 = jax.vmap(lambda b: compute_chow(y, X2, 0, b))(breaks)
+        chowq = jax.vmap(lambda b: compute_chow(y, X2, q, b))(breaks)
+    else:
+        k = X2.shape[1]
+
+        def chow_partial(qq, n_pre):
+            D = (jnp.arange(T) >= n_pre).astype(X2.dtype)
+            Xfull = jnp.hstack([X1, X2, X2 * D[:, None]])
+            betahat, vbeta, _ = regress_hac(y, Xfull, qq)
+            gamma = betahat[-k:]
+            v1 = vbeta[-k:, -k:]
+            return gamma @ solve_normal(v1, gamma)
+
+        chow0 = jax.vmap(lambda b: chow_partial(0, b))(breaks)
+        chowq = jax.vmap(lambda b: chow_partial(q, b))(breaks)
+    return chow0.max(), chowq.max()

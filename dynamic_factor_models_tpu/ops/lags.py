@@ -1,0 +1,50 @@
+"""Lag matrices and univariate autoregressions (reference cell 18)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .linalg import ols_masked
+from .masking import compact, mask_of
+
+__all__ = ["lagmat", "uar", "detrended_year_growth"]
+
+
+def lagmat(X: jnp.ndarray, lags) -> jnp.ndarray:
+    """Stack lagged copies of X's columns with leading NaN padding.
+
+    lags is a static sequence (e.g. range(1, 5)).  Column block i holds
+    X lagged by lags[i].
+    """
+    X = jnp.atleast_2d(X.T).T  # promote vectors to (T, 1)
+    T, nc = X.shape
+    blocks = []
+    for lag in lags:
+        pad = jnp.full((lag, nc), jnp.nan, dtype=X.dtype)
+        blocks.append(jnp.vstack([pad, X[: T - lag]]))
+    return jnp.hstack(blocks)
+
+
+def uar(y: jnp.ndarray, n_lags: int, valid: jnp.ndarray | None = None):
+    """AR(n_lags) on a (compacted) series by OLS; returns (coef, ser).
+
+    `valid` marks the live prefix when y comes from ``masking.compact``.
+    The ser uses the reference's dof convention sqrt(ssr / (T_valid - n_lags))
+    (dfm_functions.ipynb cell 18, `uar`).
+    """
+    if valid is None:
+        valid = mask_of(y)
+    x = lagmat(y, range(1, n_lags + 1))
+    # a row is usable when it is in the live prefix, beyond the lag padding,
+    # and none of its lag values are missing (compacted prefixes satisfy the
+    # last condition automatically)
+    w = valid & mask_of(x).all(axis=1) & (jnp.arange(y.shape[0]) >= n_lags)
+    coef, ehat = ols_masked(y, jnp.nan_to_num(x), w)
+    ssr = jnp.where(w, jnp.nan_to_num(ehat), 0.0) ** 2
+    ser = jnp.sqrt(ssr.sum() / (valid.sum() - n_lags))
+    return coef, ser
+
+
+def detrended_year_growth(y: jnp.ndarray) -> jnp.ndarray:
+    """4-quarter rolling sum via lagmat (reference cell 28)."""
+    return lagmat(y, range(0, 4)).sum(axis=1)

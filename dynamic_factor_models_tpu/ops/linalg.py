@@ -1,0 +1,116 @@
+"""Masked least squares, PCA, and standardization.
+
+TPU-native replacements for the reference's regression kernels
+(dfm_functions.ipynb cells 10-17, 25, 29).  Ragged row-dropping becomes
+0/1-weighted normal equations `(X'WX) b = X'Wy` solved with a pseudo-inverse,
+which makes every per-series / per-period regression uniformly shaped and
+batchable with ``vmap`` — the reference's ``ols_skipmissing(Unbalanced)``
+per-column loop (cell 17) is one batched solve here.
+
+The pseudo-inverse (eigh-based, normal matrices are symmetric PSD) also covers
+the rank-deficient regressions the reference hits in the Figure-6 sweep
+(r up to 60 factors with as few as 20 observations per series).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .masking import fillz, mask_of
+
+__all__ = [
+    "solve_normal",
+    "ols",
+    "ols_masked",
+    "ols_batched_series",
+    "pca_score",
+    "standardize_data",
+    "compute_r2",
+]
+
+
+def solve_normal(A: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Minimum-norm solve of the (possibly singular) normal equations A x = b.
+
+    A is symmetric PSD (a Gram matrix X'WX).  pinv(A) @ b equals the
+    Moore-Penrose least-squares solution pinv(sqrt(W)X) sqrt(W)y.
+    """
+    return jnp.linalg.pinv(A, hermitian=True) @ b
+
+
+def ols(y: jnp.ndarray, X: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Dense OLS `b = X \\ y; e = y - Xb` (reference cell 12)."""
+    A = X.T @ X
+    b = solve_normal(A, X.T @ y)
+    return b, y - X @ b
+
+
+def ols_masked(
+    y: jnp.ndarray, X: jnp.ndarray, w: jnp.ndarray
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Weighted OLS of a vector y on X with 0/1 row weights w.
+
+    Returns (beta, residual) with residual NaN outside the weighted rows —
+    the masked analogue of `ols_skipmissing(..., Balanced())` (cell 15).
+    """
+    Xw = X * w[:, None]
+    A = Xw.T @ X
+    beta = solve_normal(A, Xw.T @ fillz(y))
+    resid = jnp.where(w, fillz(y) - X @ beta, jnp.nan)
+    return beta, resid
+
+
+def ols_batched_series(
+    Y: jnp.ndarray, X: jnp.ndarray, W: jnp.ndarray
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Batched masked OLS: each column of Y regressed on shared X.
+
+    Y: (T, N) with NaN missing; X: (T, K); W: (T, N) 0/1 weights.
+    Returns betas (K, N) and residuals (T, N) with NaN at unweighted rows.
+    Replaces the reference's per-column `Unbalanced` loop (cell 17) with one
+    einsum + batched solve — MXU-friendly.
+    """
+    Yz = fillz(Y)
+    A = jnp.einsum("tk,tn,tl->nkl", X, W, X)  # N x K x K
+    rhs = jnp.einsum("tk,tn->nk", X, W * Yz)  # N x K
+    betas = jax.vmap(solve_normal)(A, rhs).T  # K x N
+    resid = jnp.where(W.astype(bool), Yz - X @ betas, jnp.nan)
+    return betas, resid
+
+
+def pca_score(X: jnp.ndarray, nfac: int) -> jnp.ndarray:
+    """First `nfac` principal-component scores X V[:, :nfac] (cell 10)."""
+    _, _, Vt = jnp.linalg.svd(X, full_matrices=False)
+    return X @ Vt[:nfac].T
+
+
+def standardize_data(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-column z-score over observed entries, population-std convention.
+
+    Mirrors reference cell 25 exactly, including the sqrt((n-1)/n) correction
+    that converts sample std to the population std of the original
+    Stock-Watson GAUSS code (SURVEY.md section 2.5 quirk 6 — required for
+    parity).
+    Returns (standardized, std-row).
+    """
+    m = mask_of(x)
+    n = m.sum(axis=0)
+    xz = fillz(x)
+    mean = xz.sum(axis=0) / n
+    dev = jnp.where(m, xz - mean, 0.0)
+    var_sample = (dev**2).sum(axis=0) / (n - 1)
+    std = jnp.sqrt(var_sample) * jnp.sqrt((n - 1) / n)
+    out = jnp.where(m, (xz - mean) / std, jnp.nan)
+    return out, std
+
+
+def compute_r2(y: jnp.ndarray, e: jnp.ndarray, w=None) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """R^2 = 1 - SSR/TSS with TSS about the (weighted) mean of y (cell 29)."""
+    if w is None:
+        w = jnp.ones_like(y)
+    n = w.sum()
+    ybar = (fillz(y) * w).sum() / n
+    ssr = (fillz(e) ** 2 * w).sum()
+    tss = ((fillz(y) - ybar) ** 2 * w).sum()
+    return 1.0 - ssr / tss, ssr, tss

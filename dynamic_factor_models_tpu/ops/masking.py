@@ -1,0 +1,57 @@
+"""Missing-data primitives.
+
+The reference represents missing data as Julia ``Union{Missing,Float64}`` and
+drops ragged row subsets per regression (reference: dfm_functions.ipynb cells
+8-9, ``drop_missing_row``/``drop_missing_col``).  Ragged shapes do not jit, so
+the TPU-native representation is a (values-with-NaN, boolean-mask) pair and
+every kernel carries the mask through weighted normal equations instead of
+dropping rows.  ``compact`` provides the jit-safe analogue of row dropping for
+the few places where order-sensitive compaction matters (idiosyncratic AR on
+residual series).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["mask_of", "fillz", "compact", "row_mask"]
+
+
+def mask_of(x: jnp.ndarray) -> jnp.ndarray:
+    """True where observed."""
+    return ~jnp.isnan(x)
+
+
+def fillz(x: jnp.ndarray) -> jnp.ndarray:
+    """NaN -> 0, for masked arithmetic."""
+    return jnp.nan_to_num(x, nan=0.0, posinf=jnp.inf, neginf=-jnp.inf)
+
+
+def row_mask(*arrays: jnp.ndarray) -> jnp.ndarray:
+    """Rows where every column of every array is observed.
+
+    Equivalent of the reference's ``drop_missing_row([y X])`` row selector
+    (dfm_functions.ipynb cell 8) without changing shapes.
+    """
+    m = None
+    for a in arrays:
+        am = mask_of(a)
+        if a.ndim > 1:
+            am = am.all(axis=-1)
+        m = am if m is None else (m & am)
+    return m
+
+
+def compact(x: jnp.ndarray, mask: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Stable-move observed entries of a vector to the front (jit-safe).
+
+    Returns (values, valid) where values[:count] are the observed entries in
+    original order and valid marks the live prefix.  This is the static-shape
+    analogue of Julia's row dropping: downstream kernels weight by ``valid``.
+    """
+    order = jnp.argsort(~mask, stable=True)
+    vals = x[order]
+    count = mask.sum()
+    valid = jnp.arange(x.shape[0]) < count
+    vals = jnp.where(valid, vals, 0.0)
+    return vals, valid

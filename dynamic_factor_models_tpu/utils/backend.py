@@ -1,0 +1,38 @@
+"""Backend selection: the `backend={"cpu","tpu"}` kwarg of the entry points.
+
+The same JAX program runs on either device; estimation entry points accept
+``backend=`` and execute under ``jax.default_device`` (BASELINE.json
+north-star API).  ``backend=None`` keeps JAX's default placement.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+__all__ = ["resolve_device", "on_backend"]
+
+_ALIASES = {"tpu": ("tpu", "axon"), "cpu": ("cpu",), "gpu": ("gpu", "cuda", "rocm")}
+
+
+def resolve_device(backend: str | None):
+    if backend is None:
+        return None
+    platforms = _ALIASES.get(backend, (backend,))
+    for d in jax.devices():
+        if d.platform in platforms:
+            return d
+    raise ValueError(
+        f"backend {backend!r} not available; devices = {jax.devices()}"
+    )
+
+
+@contextlib.contextmanager
+def on_backend(backend: str | None):
+    dev = resolve_device(backend)
+    if dev is None:
+        yield None
+    else:
+        with jax.default_device(dev):
+            yield dev

@@ -3,7 +3,7 @@ import os
 # Tests run on a virtual 8-device CPU mesh (SURVEY.md section 4): multi-chip
 # sharding logic is exercised without TPU hardware, and float64 is enabled for
 # golden-value parity with the reference outputs.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"  # env presets axon (TPU); tests run CPU
 flags = os.environ.get("XLA_FLAGS", "")
 if "host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
@@ -11,6 +11,9 @@ os.environ.setdefault("JAX_ENABLE_X64", "1")
 
 import jax  # noqa: E402
 
+# The axon TPU plugin (sitecustomize) force-sets jax_platforms="axon,cpu" at
+# registration, so the env var alone is not enough — override at config level.
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
 
 import sys  # noqa: E402

@@ -1,0 +1,184 @@
+"""Property tests for the masked kernel layer (SURVEY.md section 4):
+OLS vs closed form, PCA orthogonality/score equivalence, HAC PSD-ness,
+lagmat shapes, masked-vs-dropped equivalence.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dynamic_factor_models_tpu import ops
+
+
+@pytest.fixture()
+def xy(rng):
+    T, k = 120, 3
+    X = rng.standard_normal((T, k))
+    beta = np.array([1.0, -2.0, 0.5])
+    y = X @ beta + 0.1 * rng.standard_normal(T)
+    return X, y, beta
+
+
+def test_ols_matches_lstsq(xy):
+    X, y, _ = xy
+    b, e = ops.ols(jnp.asarray(y), jnp.asarray(X))
+    b_np = np.linalg.lstsq(X, y, rcond=None)[0]
+    np.testing.assert_allclose(np.asarray(b), b_np, atol=1e-10)
+    np.testing.assert_allclose(np.asarray(e), y - X @ b_np, atol=1e-10)
+
+
+def test_ols_masked_equals_dropped_rows(xy, rng):
+    X, y, _ = xy
+    miss = rng.random(len(y)) < 0.3
+    y_nan = y.copy()
+    y_nan[miss] = np.nan
+    w = ~miss
+    b_m, e_m = ops.ols_masked(jnp.asarray(y_nan), jnp.asarray(X), jnp.asarray(w))
+    b_d = np.linalg.lstsq(X[w], y[w], rcond=None)[0]
+    np.testing.assert_allclose(np.asarray(b_m), b_d, atol=1e-10)
+    assert np.isnan(np.asarray(e_m)[miss]).all()
+    np.testing.assert_allclose(np.asarray(e_m)[w], y[w] - X[w] @ b_d, atol=1e-10)
+
+
+def test_ols_batched_series_equals_loop(rng):
+    T, k, N = 80, 4, 7
+    X = rng.standard_normal((T, k))
+    Y = rng.standard_normal((T, N))
+    W = (rng.random((T, N)) > 0.25).astype(float)
+    Y_nan = np.where(W.astype(bool), Y, np.nan)
+    B, E = ops.ols_batched_series(jnp.asarray(Y_nan), jnp.asarray(X), jnp.asarray(W))
+    for i in range(N):
+        w = W[:, i].astype(bool)
+        b_ref = np.linalg.lstsq(X[w], Y[w, i], rcond=None)[0]
+        np.testing.assert_allclose(np.asarray(B)[:, i], b_ref, atol=1e-9)
+
+
+def test_rank_deficient_min_norm(rng):
+    # more regressors than observations: pinv path returns min-norm solution
+    T, k = 10, 20
+    X = rng.standard_normal((T, k))
+    y = rng.standard_normal(T)
+    b, _ = ops.ols(jnp.asarray(y), jnp.asarray(X))
+    b_np = np.linalg.lstsq(X, y, rcond=None)[0]
+    np.testing.assert_allclose(np.asarray(b), b_np, atol=1e-8)
+
+
+def test_pca_score(rng):
+    X = rng.standard_normal((60, 12))
+    s = np.asarray(ops.pca_score(jnp.asarray(X), 3))
+    # scores equal X V; columns orthogonal with squared norms = singular values^2
+    _, sv, Vt = np.linalg.svd(X, full_matrices=False)
+    ref = X @ Vt[:3].T
+    # sign freedom per column
+    for j in range(3):
+        assert np.allclose(s[:, j], ref[:, j], atol=1e-8) or np.allclose(
+            s[:, j], -ref[:, j], atol=1e-8
+        )
+    G = s.T @ s
+    np.testing.assert_allclose(G, np.diag(sv[:3] ** 2), atol=1e-8)
+
+
+def test_standardize_matches_reference_convention(rng):
+    x = rng.standard_normal((50, 4))
+    x[rng.random((50, 4)) < 0.2] = np.nan
+    out, std = ops.standardize_data(jnp.asarray(x))
+    out = np.asarray(out)
+    for j in range(4):
+        col = x[:, j]
+        m = ~np.isnan(col)
+        n = m.sum()
+        mu = col[m].mean()
+        sd = col[m].std(ddof=1) * np.sqrt((n - 1) / n)  # population-std quirk
+        np.testing.assert_allclose(out[m, j], (col[m] - mu) / sd, atol=1e-10)
+        np.testing.assert_allclose(float(std[j]), sd, atol=1e-12)
+        # standardized column has mean 0 over observed entries
+        assert abs(out[m, j].mean()) < 1e-10
+
+
+def test_lagmat_shapes_and_padding():
+    X = jnp.arange(1.0, 11.0).reshape(10, 1)
+    L = np.asarray(ops.lagmat(X, [1, 3]))
+    assert L.shape == (10, 2)
+    assert np.isnan(L[0, 0]) and np.isnan(L[:3, 1]).all()
+    np.testing.assert_allclose(L[1:, 0], np.arange(1.0, 10.0))
+    np.testing.assert_allclose(L[3:, 1], np.arange(1.0, 8.0))
+
+
+def test_uar_recovers_ar1(rng):
+    T = 2000
+    y = np.zeros(T)
+    eps = rng.standard_normal(T)
+    for t in range(1, T):
+        y[t] = 0.7 * y[t - 1] + eps[t]
+    coef, ser = ops.uar(jnp.asarray(y), 2)
+    assert abs(float(coef[0]) - 0.7) < 0.05
+    assert abs(float(ser) - 1.0) < 0.05
+
+
+def test_hac_psd_and_matches_white(rng):
+    T, k = 150, 3
+    X = rng.standard_normal((T, k))
+    u = rng.standard_normal(T)
+    vbeta, se = ops.hac(jnp.asarray(u), jnp.asarray(X), 6)
+    ev = np.linalg.eigvalsh(np.asarray(vbeta))
+    assert ev.min() > -1e-10  # PSD
+    # q=0 equals the White sandwich
+    v0, _ = ops.hac(jnp.asarray(u), jnp.asarray(X), 0)
+    z = X * u[:, None]
+    XXinv = np.linalg.inv(X.T @ X)
+    white = XXinv @ (z.T @ z) @ XXinv
+    np.testing.assert_allclose(np.asarray(v0), white, atol=1e-10)
+
+
+def test_chow_detects_break(rng):
+    T = 200
+    X = np.ones((T, 1))
+    y = np.concatenate([rng.standard_normal(100), 5 + rng.standard_normal(100)])
+    stat_break = float(ops.compute_chow(jnp.asarray(y), jnp.asarray(X), 0, 100))
+    y_nobreak = rng.standard_normal(T)
+    stat_none = float(ops.compute_chow(jnp.asarray(y_nobreak), jnp.asarray(X), 0, 100))
+    assert stat_break > 100 * stat_none
+
+
+def test_qlr_max_over_breaks(rng):
+    T = 120
+    X = np.ones((T, 1))
+    y = np.concatenate([np.zeros(70), 3 * np.ones(50)]) + 0.5 * rng.standard_normal(T)
+    lm, lmr = ops.compute_qlr(jnp.asarray(y), jnp.asarray(X), 0.15, 4)
+    assert float(lm) > 10 and float(lmr) > 10
+
+
+def test_bw_weight_matches_reference_formula():
+    B = 100
+    w = np.asarray(ops.compute_bw_weight(B))
+    assert w.shape == (2 * B + 1,)
+    np.testing.assert_allclose(w.sum(), 1.0, atol=1e-12)
+    raw = np.array([(1 - (abs(i) / B) ** 2) ** 2 for i in range(-B, B + 1)])
+    np.testing.assert_allclose(w, raw / raw.sum(), atol=1e-12)
+
+
+def test_gain_of_identity_filter():
+    w = jnp.zeros(21).at[10].set(1.0)  # delta at lag 0
+    lam = jnp.linspace(0.0, np.pi, 7)
+    np.testing.assert_allclose(np.asarray(ops.compute_gain(w, lam)), 1.0, atol=1e-12)
+
+
+def test_gain_ma_lowpass():
+    w = ops.ma_weight(100, 40)
+    g0 = float(ops.compute_gain(w, jnp.array([0.0]))[0])
+    gpi = float(ops.compute_gain(w, jnp.array([np.pi]))[0])
+    assert abs(g0 - 1.0) < 1e-12 and gpi < 0.05
+
+
+def test_compact():
+    x = jnp.array([np.nan, 1.0, np.nan, 2.0, 3.0])
+    vals, valid = ops.compact(x, ops.mask_of(x))
+    np.testing.assert_allclose(np.asarray(vals)[:3], [1.0, 2.0, 3.0])
+    assert np.asarray(valid).sum() == 3
+
+
+def test_virtual_cpu_mesh_available():
+    """The 8-device virtual CPU mesh must exist for sharding tests."""
+    import jax
+
+    assert len(jax.devices()) == 8
