@@ -30,7 +30,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ops.lags import uar
-from ..ops.linalg import pca_score, solve_normal, standardize_data
+from ..ops.linalg import ols_batched_series, pca_score, solve_normal, standardize_data
 from ..ops.masking import compact, fillz, mask_of
 from ..utils.backend import on_backend
 from .constraints import LambdaConstraint, apply_constraint_batch
@@ -140,14 +140,11 @@ def _als_core(
     return f, lam, ssr, n_iter
 
 
-@partial(jax.jit, static_argnames=())
+@jax.jit
 def _r2_pass(xz, m, f, lam_ok):
     """Final per-series R^2 of x_i on the estimated factors (cell 20:45-52)."""
-    A = jnp.einsum("tr,ti,ts->irs", f, m, f)
-    rhs = jnp.einsum("tr,ti->ir", f, m * xz)
-    b = jax.vmap(solve_normal)(A, rhs)
-    e = (xz - f @ b.T) * m
-    ssr = (e**2).sum(axis=0)
+    _, resid = ols_batched_series(xz, f, m)
+    ssr = (fillz(resid) ** 2 * m).sum(axis=0)
     n = m.sum(axis=0)
     ybar = (m * xz).sum(axis=0) / n
     tss = (m * (xz - ybar[None, :]) ** 2).sum(axis=0)
@@ -194,6 +191,11 @@ def estimate_factor(
 
         # PCA init on the fully-balanced column block (cells 9-10, 20:18-21).
         balanced = np.asarray(mask.all(axis=0))
+        if int(balanced.sum()) < nfac:
+            raise ValueError(
+                f"nfac_u={nfac} exceeds the {int(balanced.sum())} fully-observed "
+                "series available for PCA initialization in this window"
+            )
         f0 = pca_score(xz[:, balanced], nfac)
 
         kwargs = {}

@@ -1,0 +1,219 @@
+"""Table-4 instability scan: per-series Chow and QLR tests + split-sample
+fitted-value correlations.
+
+Rewrite of the reference's widest driver loop (Stock_Watson.ipynb cell 57,
+SURVEY.md section 3.5): thousands of small HAC regressions become one
+``lax.scan`` over break dates whose body is a ``vmap`` over all series — the
+scan carries the per-series running sup-Wald maxima, so memory stays
+O(ns * T * k) instead of O(ns * breaks * T * k).
+
+Per-series row compaction semantics follow the reference exactly: the rows of
+[y, F] with any missing value are dropped (here: stable-compacted with a zero
+pad, which leaves every Gram/autocovariance sum unchanged), and the break
+index is taken on the compacted series.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from scipy import stats as sps
+
+from ..ops.hac import form_kernel
+from ..ops.linalg import solve_normal
+from ..ops.masking import fillz, mask_of
+
+__all__ = ["InstabilityResults", "instability_scan", "split_sample_fitted_correlations"]
+
+# hard-coded QLR critical values used by the reference (cell 57:10), indexed
+# by number of factors; levels 99/95/90%
+QLR_THRESHOLDS = {4: 4 * np.array([5.12, 4.09, 3.59]), 8: 8 * np.array([3.57, 2.98, 2.69])}
+LEVELS = (0.99, 0.95, 0.90)
+COR_PCT = (0.05, 0.25, 0.50, 0.75, 0.95)
+
+
+class InstabilityResults(NamedTuple):
+    chow_stats: np.ndarray  # (ns,) NaN where the 80/80 sample rule fails
+    qlr_stats: np.ndarray  # (ns,)
+    chow_rej_ratios: np.ndarray  # (3,) at 99/95/90%
+    qlr_rej_ratios: np.ndarray  # (3,)
+    cor_pre_quantiles: np.ndarray  # (5,) at 5/25/50/75/95%
+    cor_post_quantiles: np.ndarray  # (5,)
+
+
+def _compact_series(y: np.ndarray, X: np.ndarray):
+    """Host-side stable compaction of [y X] complete rows, zero-padded.
+
+    Zero pad rows contribute nothing to any X'X / HAC sum, so downstream
+    statistics equal those on the dropped-row series.
+    """
+    T = y.shape[0]
+    m = np.isfinite(y) & np.isfinite(X).all(axis=1)
+    order = np.argsort(~m, kind="stable")
+    yc = np.where(m[order], y[order], 0.0)
+    Xc = np.where(m[order][:, None], X[order], 0.0)
+    return yc, Xc, int(m.sum())
+
+
+def _chow_padded(y, X, q: int, n_pre, count):
+    """Chow Wald statistic on a zero-padded compacted series.
+
+    The break dummy is zeroed beyond the live prefix so pad rows stay inert.
+    """
+    T, k = X.shape
+    live = jnp.arange(T) < count
+    D = ((jnp.arange(T) >= n_pre) & live).astype(X.dtype)
+    Xf = jnp.hstack([X, X * D[:, None]])
+    A = Xf.T @ Xf
+    beta = solve_normal(A, Xf.T @ y)
+    u = jnp.where(live, y - Xf @ beta, 0.0)
+    z = Xf * u[:, None]
+    kernel = form_kernel(q)
+    v = kernel[0] * z.T @ z
+    for i in range(1, q + 1):
+        gamma = z[i:].T @ z[: T - i]
+        v = v + kernel[i] * (gamma + gamma.T)
+    XXinv = jnp.linalg.pinv(A, hermitian=True)
+    vbeta = XXinv @ v @ XXinv
+    g = beta[k:]
+    v1 = vbeta[k:, k:]
+    return g @ solve_normal(v1, g)
+
+
+@partial(jax.jit, static_argnames=("q", "ccut", "compute_q0"))
+def _scan_qlr(Y, X, counts, q: int, ccut: float, compute_q0: bool = False):
+    """sup-Wald over central break dates for every series at once.
+
+    Y: (ns, T) compacted series; X: (ns, T, k); counts: (ns,).
+    Break grid is global; per-series validity window is
+    [floor(ccut*count), count - floor(ccut*count)] as in the reference.
+    The q=0 variant (the reference's `lm`) is skipped unless requested —
+    Table 4 only consumes the HAC(q) variant, and each pass is a full
+    vmapped HAC regression per break.
+    """
+    ns, T = Y.shape
+    n1t = jnp.floor(ccut * counts).astype(jnp.int32)
+    n2t = counts - n1t
+
+    chow_b = jax.vmap(_chow_padded, in_axes=(0, 0, None, None, 0))
+
+    def body(carry, b):
+        lm0, lmq = carry
+        valid = (b >= n1t) & (b <= n2t)
+        sq = chow_b(Y, X, q, b, counts)
+        lmq = jnp.where(valid, jnp.maximum(lmq, sq), lmq)
+        if compute_q0:
+            s0 = chow_b(Y, X, 0, b, counts)
+            lm0 = jnp.where(valid, jnp.maximum(lm0, s0), lm0)
+        return (lm0, lmq), None
+
+    init = (jnp.full(ns, -jnp.inf), jnp.full(ns, -jnp.inf))
+    (lm0, lmq), _ = jax.lax.scan(body, init, jnp.arange(T + 1))
+    return lm0, lmq
+
+
+@partial(jax.jit, static_argnames=("q",))
+def _chow_fixed(Y, X, counts, n_pre, q: int):
+    return jax.vmap(_chow_padded, in_axes=(0, 0, None, None, 0))(Y, X, q, n_pre, counts)
+
+
+def split_sample_fitted_correlations(data, factor_full, factor_pre, factor_post):
+    """Correlations of full-sample vs subsample fitted values (cell 57:41-52).
+
+    For each series: OLS of y on each factor set over complete rows (no
+    constant, matching the reference), fitted values X @ b, correlation over
+    jointly observed rows.
+    """
+    data = jnp.asarray(data)
+
+    def fitted(y, X):
+        w = (mask_of(y) & mask_of(X).all(axis=1)).astype(data.dtype)
+        Xz = fillz(X)
+        Xw = Xz * w[:, None]
+        b = solve_normal(Xw.T @ Xz, Xw.T @ (fillz(y) * w))
+        yhat = X @ b  # NaN outside the factor window
+        return yhat
+
+    def corr(a, b):
+        m = mask_of(a) & mask_of(b)
+        az = jnp.where(m, a, 0.0)  # NaN*0 is NaN, so zero out first
+        bz = jnp.where(m, b, 0.0)
+        n = m.sum()
+        av = jnp.where(m, az - az.sum() / n, 0.0)
+        bv = jnp.where(m, bz - bz.sum() / n, 0.0)
+        return (av * bv).sum() / jnp.sqrt((av**2).sum() * (bv**2).sum())
+
+    def per_series(y):
+        yh = fitted(y, jnp.asarray(factor_full))
+        yh_pre = fitted(y, jnp.asarray(factor_pre))
+        yh_post = fitted(y, jnp.asarray(factor_post))
+        return corr(yh, yh_pre), corr(yh, yh_post)
+
+    return jax.vmap(per_series, in_axes=1)(data)
+
+
+def instability_scan(
+    data,
+    factor_full,
+    factor_pre,
+    factor_post,
+    n_pre_break: int,
+    nfac: int,
+    q: int = 6,
+    ccut: float = 0.15,
+    min_obs: int = 80,
+    qlr_thresholds: np.ndarray | None = None,
+) -> InstabilityResults:
+    """Full Table-4 computation for one factor count (cell 57).
+
+    n_pre_break: number of panel rows up to and including the break quarter
+    (the reference's 1-based `lastpreberiod`, e.g. 104 for a 1984Q4 break).
+    """
+    data_np = np.asarray(data)
+    F = np.asarray(factor_full)
+    T, ns = data_np.shape
+
+    Yc = np.zeros((ns, T))
+    Xc = np.zeros((ns, T, F.shape[1]))
+    counts = np.zeros(ns, dtype=np.int64)
+    eligible = np.zeros(ns, dtype=bool)
+    for i in range(ns):
+        y = data_np[:, i]
+        pre_obs = np.isfinite(y[:n_pre_break]).sum()
+        post_obs = np.isfinite(y[n_pre_break:]).sum()
+        eligible[i] = (pre_obs >= min_obs) and (post_obs >= min_obs)
+        Yc[i], Xc[i], counts[i] = _compact_series(y, F)
+
+    chow = np.asarray(_chow_fixed(jnp.asarray(Yc), jnp.asarray(Xc), jnp.asarray(counts), n_pre_break, q))
+    _, qlr = _scan_qlr(jnp.asarray(Yc), jnp.asarray(Xc), jnp.asarray(counts), q, ccut)
+    qlr = np.asarray(qlr)
+    chow = np.where(eligible, chow, np.nan)
+    qlr = np.where(eligible, qlr, np.nan)
+
+    chi2_thr = sps.chi2.ppf(LEVELS, df=nfac)
+    n_valid = np.isfinite(chow).sum()
+    chow_rej = np.array([(chow > t).sum() / n_valid for t in chi2_thr])
+    if qlr_thresholds is not None:
+        qlr_thr = np.asarray(qlr_thresholds)
+    elif nfac in QLR_THRESHOLDS:
+        qlr_thr = QLR_THRESHOLDS[nfac]
+    else:
+        raise ValueError(
+            f"no built-in QLR critical values for nfac={nfac} (the reference "
+            "hard-codes nfac 4 and 8); pass qlr_thresholds explicitly"
+        )
+    qlr_rej = np.array([(qlr > t).sum() / n_valid for t in qlr_thr])
+
+    cor_pre, cor_post = split_sample_fitted_correlations(
+        data, factor_full, factor_pre, factor_post
+    )
+    cor_pre = np.where(eligible, np.asarray(cor_pre), np.nan)
+    cor_post = np.where(eligible, np.asarray(cor_post), np.nan)
+    cor_pre_q = np.quantile(cor_pre[np.isfinite(cor_pre)], COR_PCT)
+    cor_post_q = np.quantile(cor_post[np.isfinite(cor_post)], COR_PCT)
+
+    return InstabilityResults(chow, qlr, chow_rej, qlr_rej, cor_pre_q, cor_post_q)

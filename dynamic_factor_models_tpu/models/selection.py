@@ -12,12 +12,11 @@ import dataclasses
 
 from typing import NamedTuple
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 
 from ..ops.lags import lagmat
-from ..ops.linalg import solve_normal
+from ..ops.linalg import ols_batched_series
 from ..ops.masking import fillz, mask_of
 from .dfm import DFMConfig, FactorEstimateStats, estimate_factor
 
@@ -92,13 +91,10 @@ def amengual_watson_test(
     )
     xm = mask_of(x).all(axis=1)
     W = (mask_of(est) & xm[:, None]).astype(data.dtype)
-    xz = fillz(x)
-    A = jnp.einsum("tk,ti,tl->ikl", xz, W, xz)
-    rhs = jnp.einsum("tk,ti->ik", xz, W * fillz(est))
-    b = jax.vmap(solve_normal)(A, rhs)
+    _, resid = ols_batched_series(est, fillz(x), W)
     ndf = W.sum(axis=0) - x.shape[1]
     keep = ndf >= config.nt_min_factor
-    resid = jnp.where(W.astype(bool) & keep[None, :], fillz(est) - xz @ b.T, jnp.nan)
+    resid = jnp.where(keep[None, :], resid, jnp.nan)
 
     aw = np.full(nfac_static, np.nan)
     ssr = np.full(nfac_static, np.nan)

@@ -1,0 +1,30 @@
+"""Canonical correlation analysis via SVD whitening.
+
+Replacement for the reference's MultivariateStats.jl `fit(CCA, ...,
+method=:svd)` calls (Stock_Watson.ipynb cells 60-61).  Columns are centered,
+each block is whitened by its thin SVD, and the canonical correlations are
+the singular values of the cross product of the whitened blocks.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["canonical_correlations"]
+
+
+def canonical_correlations(X: jnp.ndarray, Y: jnp.ndarray) -> jnp.ndarray:
+    """Canonical correlations between X (n, p) and Y (n, q), descending.
+
+    Observations in rows.  Returns min(p, q) values in [0, 1].
+    """
+    Xc = X - X.mean(axis=0)
+    Yc = Y - Y.mean(axis=0)
+    Ux, sx, _ = jnp.linalg.svd(Xc, full_matrices=False)
+    Uy, sy, _ = jnp.linalg.svd(Yc, full_matrices=False)
+    # drop numerically null directions to keep correlations <= 1
+    Ux = jnp.where(sx > sx.max() * 1e-12, 1.0, 0.0)[None, :] * Ux
+    Uy = jnp.where(sy > sy.max() * 1e-12, 1.0, 0.0)[None, :] * Uy
+    s = jnp.linalg.svd(Ux.T @ Uy, compute_uv=False)
+    k = min(X.shape[1], Y.shape[1])
+    return jnp.clip(s[:k], 0.0, 1.0)

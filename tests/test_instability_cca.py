@@ -1,0 +1,92 @@
+"""Golden tests for Table 4 (Chow/QLR instability), Table 5 (FAVAR CCA), and
+the Figure-7 constrained-loading path."""
+
+import numpy as np
+import pytest
+
+from dynamic_factor_models_tpu.models.constraints import construct_constraint
+from dynamic_factor_models_tpu.models.dfm import (
+    DFMConfig,
+    compute_series,
+    estimate_dfm,
+    estimate_factor,
+)
+from dynamic_factor_models_tpu.models.favar_instruments import favar_instrument_table
+from dynamic_factor_models_tpu.models.instability import instability_scan
+
+
+def test_table4_r4(dataset_all):
+    ds = dataset_all
+    cfg = DFMConfig(nfac_u=4)
+    F_full, _ = estimate_factor(ds.bpdata, ds.inclcode, 2, 223, cfg)
+    F_pre, _ = estimate_factor(ds.bpdata, ds.inclcode, 2, 103, cfg)
+    F_post, _ = estimate_factor(ds.bpdata, ds.inclcode, 104, 223, cfg)
+    res = instability_scan(ds.bpdata, F_full, F_pre, F_post, 104, 4)
+    np.testing.assert_allclose(res.chow_rej_ratios, [0.369, 0.534, 0.625], atol=1e-3)
+    np.testing.assert_allclose(res.qlr_rej_ratios, [0.619, 0.767, 0.830], atol=1e-3)
+    np.testing.assert_allclose(
+        res.cor_pre_quantiles, [0.658, 0.888, 0.962, 0.986, 0.996], atol=1e-3
+    )
+
+
+@pytest.fixture(scope="module")
+def dfm8_all(dataset_all):
+    return estimate_dfm(
+        dataset_all.bpdata, dataset_all.inclcode, 2, 223, DFMConfig(nfac_u=8)
+    )
+
+
+def test_table5_set_a(dataset_all, dfm8_all):
+    r_res, r_lev = favar_instrument_table(
+        dataset_all.bpdata,
+        dataset_all.bpnamevec,
+        ["GDPC96", "PAYEMS", "PCECTPI", "FEDFUNDS"],
+        dfm8_all.factor,
+        dfm8_all.var,
+        4,
+        2,
+        223,
+    )
+    np.testing.assert_allclose(r_res, [0.759, 0.645, 0.595, 0.493], atol=1e-3)
+    assert r_lev.shape == (4,) and (r_lev <= 1.0).all()
+
+
+def test_table5_set_b(dataset_all, dfm8_all):
+    r_res, _ = favar_instrument_table(
+        dataset_all.bpdata,
+        dataset_all.bpnamevec,
+        ["GDPC96", "PAYEMS", "PCECTPI", "FEDFUNDS",
+         "NAPMPRI", "WPU0561", "CP90_TBILL", "GS10_TB3M"],
+        dfm8_all.factor,
+        dfm8_all.var,
+        4,
+        2,
+        223,
+    )
+    assert abs(r_res[0] - 0.829) < 1e-3
+    assert abs(r_res[-1] - 0.013) < 1e-3
+
+
+def test_figure7_unit_loading_constraint(dataset_all):
+    """Oil-price DFM: R=I, r=e1 pins the oil loadings to the first factor
+    (Stock_Watson.ipynb cells 63-65)."""
+    ds = dataset_all
+    nfac = 8
+    varnames = ["WPU0561", "MCOILWTICO", "MCOILBRENTEU", "RAC_IMP"]
+    incl_names = [n for n, c in zip(ds.bpnamevec, ds.inclcode) if c == 1]
+    R = np.eye(nfac)
+    r = np.eye(nfac)[0]
+    res = estimate_dfm(
+        ds.bpdata, ds.inclcode, 104, 223, DFMConfig(nfac_u=nfac),
+        constraint_factor=construct_constraint(varnames, incl_names, R, r),
+        constraint_loading=construct_constraint(varnames, ds.bpnamevec, R, r),
+    )
+    lam = np.asarray(res.lam)
+    e1 = np.eye(nfac)[0]
+    for v in varnames:
+        np.testing.assert_allclose(lam[ds.bpnamevec.index(v)], e1, atol=1e-8)
+    # common component of a constrained series is exactly the first factor
+    cc = np.asarray(compute_series(res, ds.bpnamevec.index("WPU0561")))
+    f0 = np.asarray(res.factor[:, 0])
+    m = np.isfinite(cc) & np.isfinite(f0)
+    np.testing.assert_allclose(cc[m], f0[m], atol=1e-10)
