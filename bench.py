@@ -1,0 +1,82 @@
+#!/usr/bin/env python
+"""Headline benchmark: 1000-replication FAVAR IRF wild bootstrap on the
+Stock-Watson panel (BASELINE.json north star: < 10 s on TPU).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} where
+vs_baseline = 10s-target / measured wall-clock (>1 is better than target).
+Also reports EM iterations/sec as an auxiliary field.
+"""
+
+import json
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 1)[0])
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main():
+    from dynamic_factor_models_tpu.io.cache import cached_dataset
+    from dynamic_factor_models_tpu.models.dfm import DFMConfig, estimate_factor
+    from dynamic_factor_models_tpu.models.favar import wild_bootstrap_irfs
+    from dynamic_factor_models_tpu.models.ssm import em_step, SSMParams
+    from dynamic_factor_models_tpu.ops.masking import fillz, mask_of
+
+    dev = jax.devices()[0]
+    ds = cached_dataset("Real")
+
+    # factors via ALS (f32-safe tolerance; parity is covered by the CPU tests)
+    cfg = DFMConfig(nfac_u=4, tol=1e-6, max_iter=2000)
+    F, _ = estimate_factor(ds.bpdata, ds.inclcode, 2, 223, cfg)
+
+    n_reps, horizon = 1000, 24
+    run = lambda seed: wild_bootstrap_irfs(
+        F, 4, 2, 223, horizon=horizon, n_reps=n_reps, seed=seed
+    )
+    run(0).draws.block_until_ready()  # compile
+    t0 = time.perf_counter()
+    bs = run(1)
+    bs.draws.block_until_ready()
+    dt = time.perf_counter() - t0
+
+    # auxiliary: EM iterations/sec on the included panel (steady state)
+    est = jnp.asarray(np.asarray(ds.bpdata))[:, np.asarray(ds.inclcode) == 1][2:224]
+    from dynamic_factor_models_tpu.ops.linalg import standardize_data
+
+    xstd, _ = standardize_data(est)
+    xz, m = fillz(xstd), mask_of(xstd)
+    r, p, N = 4, 4, xz.shape[1]
+    params = SSMParams(
+        lam=jnp.zeros((N, r)).at[:, 0].set(1.0),
+        R=jnp.ones(N),
+        A=jnp.concatenate([0.5 * jnp.eye(r)[None], jnp.zeros((p - 1, r, r))]),
+        Q=jnp.eye(r),
+    )
+    params, _ = em_step(params, xz, m)  # compile
+    jax.block_until_ready(params)
+    n_iter = 20
+    t1 = time.perf_counter()
+    for _ in range(n_iter):
+        params, ll = em_step(params, xz, m)
+    jax.block_until_ready(params)
+    em_ips = n_iter / (time.perf_counter() - t1)
+
+    print(
+        json.dumps(
+            {
+                "metric": "favar_irf_wild_bootstrap_1000rep_wallclock",
+                "value": round(dt, 4),
+                "unit": "s",
+                "vs_baseline": round(10.0 / dt, 2),
+                "device": str(dev),
+                "em_iters_per_sec": round(em_ips, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

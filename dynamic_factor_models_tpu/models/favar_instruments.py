@@ -14,7 +14,6 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ops.cca import canonical_correlations
-from ..ops.masking import mask_of
 from .var import VARResults, estimate_var
 
 __all__ = ["cca_with_factors", "choose_stepwise", "favar_instrument_table"]
@@ -28,6 +27,18 @@ def _complete_rows(*arrays):
     return m
 
 
+def _residual_cca(var_resid, factor_var_resid) -> np.ndarray:
+    """Canonical correlations of two residual blocks over jointly complete
+    periods (shared by the Table-5 rows and the stepwise search)."""
+    m = _complete_rows(var_resid, factor_var_resid)
+    return np.asarray(
+        canonical_correlations(
+            jnp.asarray(np.asarray(var_resid)[m]),
+            jnp.asarray(np.asarray(factor_var_resid)[m]),
+        )
+    )
+
+
 def cca_with_factors(X, factor, var_resid, factor_var_resid):
     """Canonical correlations of residual and level blocks (cell 61).
 
@@ -35,15 +46,12 @@ def cca_with_factors(X, factor, var_resid, factor_var_resid):
     factor-VAR residuals, and between variable levels and factor levels,
     each over jointly complete periods.
     """
-    m = _complete_rows(var_resid, factor_var_resid)
-    r_res = canonical_correlations(
-        jnp.asarray(np.asarray(var_resid)[m]), jnp.asarray(np.asarray(factor_var_resid)[m])
-    )
+    r_res = _residual_cca(var_resid, factor_var_resid)
     m2 = _complete_rows(X, factor)
     r_lev = canonical_correlations(
         jnp.asarray(np.asarray(X)[m2]), jnp.asarray(np.asarray(factor)[m2])
     )
-    return np.asarray(r_res), np.asarray(r_lev)
+    return r_res, np.asarray(r_lev)
 
 
 def favar_instrument_table(data, names, var_names, factor, factor_var: VARResults,
@@ -81,13 +89,16 @@ def choose_stepwise(data, names, factor, factor_var: VARResults, nfac: int,
             X = data[:, chosen + [j]]
             var = estimate_var(jnp.asarray(X), nlag, initperiod, lastperiod,
                                withconst=True, compute_matrices=False)
-            m = _complete_rows(var.resid, fvr)
-            r = canonical_correlations(
-                jnp.asarray(np.asarray(var.resid)[m]), jnp.asarray(fvr[m])
-            )
+            r = _residual_cca(var.resid, fvr)
             r_min = float(r[min(X.shape[1], fvr.shape[1]) - 1])
             if r_min > best_r:
                 best_r, best_j = r_min, j
+        if best_j is None:
+            raise ValueError(
+                f"stepwise selection stalled after {len(chosen)} of {nfac} "
+                "variables: no fully-observed candidate yields a finite "
+                "canonical correlation"
+            )
         chosen.append(best_j)
         cand_idx.remove(best_j)
     return [names[j] for j in chosen]

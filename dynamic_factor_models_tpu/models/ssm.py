@@ -1,0 +1,316 @@
+"""State-space DFM: Kalman filter/smoother (lax.scan) + EM, end-to-end jitted.
+
+This is the `Parametric` estimation path the reference declared but never
+implemented (dfm_functions.ipynb cell 1:3; SURVEY.md section 0) — the spec is
+Doz-Giannone-Reichlin (2012) / Banbura-Modugno (2014) EM for factor models
+with arbitrary missing-data patterns:
+
+    x_t = Lam f_t + eps_t,        eps_t ~ N(0, diag(R))
+    f_t = A_1 f_{t-1} + ... + A_p f_{t-p} + u_t,   u_t ~ N(0, Q)
+
+TPU-first design choices:
+  * the filter/smoother are ``lax.scan`` over time with static shapes;
+  * missing observations are handled by masking rows of Lam (never by
+    changing shapes), so one compiled program serves every missing pattern;
+  * the measurement update uses the information (Woodbury) form — per-step
+    cost O(N r^2 + k^3) with k = r*p the state dim, never O(N^3);
+  * one EM iteration (E-step scans + closed-form M-step) is a single jitted
+    function; `em iters/sec` is the tracked benchmark metric (BASELINE.json).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.linalg import solve_normal, standardize_data
+from ..ops.masking import fillz, mask_of
+from ..utils.backend import on_backend
+from .dfm import DFMConfig, estimate_dfm
+
+__all__ = [
+    "SSMParams",
+    "KalmanResult",
+    "kalman_filter",
+    "kalman_smoother",
+    "em_step",
+    "estimate_dfm_em",
+    "EMResults",
+]
+
+
+class SSMParams(NamedTuple):
+    """Parameters of the state-space DFM.
+
+    lam: (N, r) loadings; R: (N,) idiosyncratic variances;
+    A: (p, r, r) VAR coefficient blocks; Q: (r, r) factor innovation cov.
+    """
+
+    lam: jnp.ndarray
+    R: jnp.ndarray
+    A: jnp.ndarray
+    Q: jnp.ndarray
+
+    @property
+    def r(self) -> int:
+        return self.lam.shape[1]
+
+    @property
+    def p(self) -> int:
+        return self.A.shape[0]
+
+
+class KalmanResult(NamedTuple):
+    loglik: jnp.ndarray
+    means: jnp.ndarray  # (T, k) filtered or smoothed state means
+    covs: jnp.ndarray  # (T, k, k)
+    pred_means: jnp.ndarray  # (T, k) one-step-ahead means (filter only)
+    pred_covs: jnp.ndarray  # (T, k, k)
+
+
+def _companion(params: SSMParams):
+    r, p = params.r, params.p
+    k = r * p
+    Tm = jnp.zeros((k, k), params.A.dtype)
+    Tm = Tm.at[:r, :].set(jnp.concatenate([params.A[i] for i in range(p)], axis=1))
+    if p > 1:
+        Tm = Tm.at[r:, : k - r].set(jnp.eye(k - r, dtype=params.A.dtype))
+    Qs = jnp.zeros((k, k), params.Q.dtype).at[:r, :r].set(params.Q)
+    return Tm, Qs
+
+
+def _init_state(params: SSMParams):
+    """Diffuse-ish init: zero mean, large isotropic covariance."""
+    k = params.r * params.p
+    return jnp.zeros(k, params.lam.dtype), 1e2 * jnp.eye(k, dtype=params.lam.dtype)
+
+
+@jax.jit
+def _filter_scan(params: SSMParams, x, mask):
+    """Masked Kalman filter; x (T, N) NaN-free (pre-filled), mask (T, N)."""
+    Tm, Qs = _companion(params)
+    k = Tm.shape[0]
+    r = params.r
+    lam = params.lam  # (N, r) — state loadings are [lam, 0, ..., 0]
+    s0, P0 = _init_state(params)
+    dtype = x.dtype
+    log2pi = jnp.asarray(np.log(2.0 * np.pi), dtype)
+
+    def step(carry, inp):
+        s, P = carry
+        xt, mt = inp
+        # predict
+        sp = Tm @ s
+        Pp = Tm @ P @ Tm.T + Qs
+        Pp = 0.5 * (Pp + Pp.T)
+        # masked information-form update (Woodbury): only first r state dims
+        # load on observations
+        rinv = mt / params.R  # (N,), 0 at missing
+        lam_r = lam * rinv[:, None]  # (N, r)
+        C = jnp.zeros((k, k), dtype).at[:r, :r].set(lam.T @ lam_r)
+        v = xt - lam @ sp[:r]  # innovation (garbage at missing; weighted by 0)
+        gain_rhs = jnp.zeros(k, dtype).at[:r].set(lam_r.T @ v)
+        Ppinv = jnp.linalg.pinv(Pp, hermitian=True)
+        Pu = jnp.linalg.pinv(Ppinv + C, hermitian=True)
+        su = sp + Pu @ gain_rhs
+        # log-likelihood via matrix determinant lemma:
+        # log|S| = sum_obs log R_ii + log|Pp| - log|Pu|
+        n_obs = mt.sum()
+        _, ld_pp = jnp.linalg.slogdet(Pp)
+        _, ld_pu = jnp.linalg.slogdet(Pu)
+        ld_R = (mt * jnp.log(params.R)).sum()
+        quad = (rinv * v * v).sum() - gain_rhs @ Pu @ gain_rhs
+        ll = -0.5 * (n_obs * log2pi + ld_R + ld_pp - ld_pu + quad)
+        return (su, Pu), (su, Pu, sp, Pp, ll)
+
+    (_, _), (means, covs, pmeans, pcovs, lls) = jax.lax.scan(
+        step, (s0, P0), (x, mask.astype(dtype))
+    )
+    return KalmanResult(lls.sum(), means, covs, pmeans, pcovs)
+
+
+def kalman_filter(params: SSMParams, x, backend: str | None = None) -> KalmanResult:
+    """Masked Kalman filter over a (T, N) panel with NaN missing values."""
+    with on_backend(backend):
+        x = jnp.asarray(x)
+        mask = mask_of(x)
+        return _filter_scan(params, fillz(x), mask)
+
+
+@jax.jit
+def _smoother_scan(params: SSMParams, filt: KalmanResult):
+    """Rauch-Tung-Striebel backward pass; also returns lag-one covariances."""
+    Tm, _ = _companion(params)
+
+    def step(carry, inp):
+        s_next, P_next = carry
+        su, Pu, sp_next, Pp_next = inp
+        J = Pu @ Tm.T @ jnp.linalg.pinv(Pp_next, hermitian=True)
+        s_sm = su + J @ (s_next - sp_next)
+        P_sm = Pu + J @ (P_next - Pp_next) @ J.T
+        # Cov(s_{t+1}, s_t | T) = P_{t+1|T} J_t'
+        lag1 = P_next @ J.T
+        return (s_sm, P_sm), (s_sm, P_sm, lag1)
+
+    # iterate t = T-2 .. 0 pairing (filtered_t, predicted_{t+1}, smoothed_{t+1})
+    last = (filt.means[-1], filt.covs[-1])
+    inputs = (
+        filt.means[:-1],
+        filt.covs[:-1],
+        filt.pred_means[1:],
+        filt.pred_covs[1:],
+    )
+    (_, _), (s_sm, P_sm, lag1) = jax.lax.scan(step, last, inputs, reverse=True)
+    means = jnp.concatenate([s_sm, filt.means[-1:]], axis=0)
+    covs = jnp.concatenate([P_sm, filt.covs[-1:]], axis=0)
+    # lag1[t] = Cov(s_{t+1}, s_t | T) for t = 0..T-2
+    return means, covs, lag1
+
+
+def kalman_smoother(params: SSMParams, x, backend: str | None = None):
+    """Kalman smoother: returns (smoothed_means, smoothed_covs, loglik).
+
+    The `backend={"cpu","tpu"}` kwarg follows the north-star API
+    (BASELINE.json): same program, device chosen by flag.
+    """
+    with on_backend(backend):
+        x = jnp.asarray(x)
+        filt = _filter_scan(params, fillz(x), mask_of(x))
+        means, covs, _ = _smoother_scan(params, filt)
+        return means, covs, filt.loglik
+
+
+# ---------------------------------------------------------------------------
+# EM
+# ---------------------------------------------------------------------------
+
+
+@jax.jit
+def em_step(params: SSMParams, x, mask):
+    """One EM iteration (E-step scans + closed-form M-step); returns
+    (new_params, loglik of the *current* params)."""
+    r, p = params.r, params.p
+    dtype = x.dtype
+    m = mask.astype(dtype)
+
+    filt = _filter_scan(params, x, mask)
+    s_sm, P_sm, lag1 = _smoother_scan(params, filt)
+
+    f = s_sm[:, :r]  # E[f_t | T]
+    Pf = P_sm[:, :r, :r]  # Var(f_t | T)
+
+    # --- loadings + R (masked, batched over series) ---
+    # Sxf_i = sum_t m_it x_it E[f_t]';  Sff_i = sum_t m_it (E f E f' + Pf)
+    Eff = jnp.einsum("tr,ts->trs", f, f) + Pf  # (T, r, r)
+    Sff = jnp.einsum("ti,trs->irs", m, Eff)
+    Sxf = jnp.einsum("ti,tr->ir", m * x, f)
+    lam = jax.vmap(solve_normal)(Sff, Sxf)  # (N, r)
+    resid = x - f @ lam.T
+    extra = jnp.einsum("ir,trs,is->ti", lam, Pf, lam)  # lam' Pf lam
+    n_i = m.sum(axis=0)
+    R = ((m * (resid**2 + extra)).sum(axis=0)) / n_i
+    R = jnp.maximum(R, 1e-8)
+
+    # --- factor VAR blocks + Q from smoothed second moments ---
+    S11 = (jnp.einsum("tr,ts->rs", s_sm[1:, :r], s_sm[1:, :r])
+           + P_sm[1:, :r, :r].sum(axis=0))
+    S00 = (jnp.einsum("tk,tl->kl", s_sm[:-1], s_sm[:-1]) + P_sm[:-1].sum(axis=0))
+    S10 = (jnp.einsum("tr,tk->rk", s_sm[1:, :r], s_sm[:-1])
+           + lag1[:, :r, :].sum(axis=0))
+    Ak = S10 @ jnp.linalg.pinv(S00, hermitian=True)  # (r, k)
+    Tn = x.shape[0]
+    Q = (S11 - Ak @ S10.T) / (Tn - 1)
+    Q = 0.5 * (Q + Q.T)
+    A = jnp.stack([Ak[:, i * r : (i + 1) * r] for i in range(p)])
+    return SSMParams(lam, R, A, Q), filt.loglik
+
+
+class EMResults(NamedTuple):
+    params: SSMParams
+    factors: jnp.ndarray  # (T, r) smoothed factors (standardized units)
+    factor_covs: jnp.ndarray  # (T, r, r)
+    loglik_path: np.ndarray
+    n_iter: int
+    stds: jnp.ndarray  # per-series standardization scale
+    means: jnp.ndarray
+
+
+def _init_params_from_als(
+    data, inclcode, initperiod, lastperiod, config, xz, m_arr
+) -> SSMParams:
+    """Initialize EM from the non-parametric ALS fit: VAR blocks from the
+    factor VAR, loadings/R from masked OLS of the standardized panel on the
+    ALS factors."""
+    res = estimate_dfm(data, inclcode, initperiod, lastperiod, config)
+    r = config.nfac_u
+    p = config.n_factorlag
+    b = res.var.betahat[1:].T  # (r, r*p) companion top rows
+    A = jnp.stack([b[:, i * r : (i + 1) * r] for i in range(p)])
+    Q = res.var.seps
+    fw = res.factor[initperiod : lastperiod + 1]
+    W = m_arr.astype(xz.dtype)
+    Sff = jnp.einsum("ti,tr,ts->irs", W, fw, fw)
+    Sxf = jnp.einsum("ti,tr->ir", W * xz, fw)
+    lam0 = jax.vmap(solve_normal)(Sff, Sxf)
+    resid0 = jnp.where(m_arr, xz - fw @ lam0.T, 0.0)
+    R0 = jnp.maximum((resid0**2).sum(axis=0) / W.sum(axis=0), 1e-6)
+    return SSMParams(lam0, R0, A, Q)
+
+
+def estimate_dfm_em(
+    data,
+    inclcode,
+    initperiod: int,
+    lastperiod: int,
+    config: DFMConfig = DFMConfig(nfac_u=4),
+    max_em_iter: int = 200,
+    tol: float = 1e-6,
+    backend: str | None = None,
+) -> EMResults:
+    """State-space DFM via EM on the standardized included panel
+    (BASELINE.json config 2: `State-space DFM via EM + Kalman smoother`).
+
+    Converges when the relative log-likelihood improvement drops below tol.
+    """
+    with on_backend(backend):
+        data = jnp.asarray(data)
+        inclcode = np.asarray(inclcode)
+        est = data[:, inclcode == 1]
+        xw = est[initperiod : lastperiod + 1]
+        xstd, stds = standardize_data(xw)
+        m_arr = mask_of(xstd)
+        xz = fillz(xstd)
+        # original (pre-standardization) per-series means, for reconstruction
+        mw = mask_of(xw)
+        n_mean = (fillz(xw) * mw).sum(axis=0) / mw.sum(axis=0)
+
+        r = config.nfac_u
+        params = _init_params_from_als(
+            data, inclcode, initperiod, lastperiod, config, xz, m_arr
+        )
+
+        llpath = []
+        ll_prev = -jnp.inf
+        it = 0
+        for it in range(1, max_em_iter + 1):
+            params, ll = em_step(params, xz, m_arr)
+            ll = float(ll)
+            llpath.append(ll)
+            if it > 1 and abs(ll - ll_prev) < tol * (1.0 + abs(ll_prev)):
+                break
+            ll_prev = ll
+
+        means, covs, _ = kalman_smoother(params, jnp.where(m_arr, xz, jnp.nan))
+        return EMResults(
+            params=params,
+            factors=means[:, :r],
+            factor_covs=covs[:, :r, :r],
+            loglik_path=np.asarray(llpath),
+            n_iter=it,
+            stds=stds,
+            means=n_mean,
+        )

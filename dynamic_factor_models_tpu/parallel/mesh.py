@@ -1,0 +1,43 @@
+"""Device-mesh helpers: replication sharding + collective aggregation.
+
+The reference has no parallelism or communication backend of any kind
+(SURVEY.md section 2.6) — everything here is new TPU-native design: a
+``jax.sharding.Mesh`` over the chips, ``NamedSharding`` placement of the
+embarrassing axes (bootstrap replications, series blocks), and XLA-emitted
+collectives (psum/all_gather) instead of NCCL/MPI calls.  Over a v5e slice the
+collectives ride ICI; the same program runs on the virtual CPU mesh in CI.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["make_mesh", "shard_over", "replicate", "P", "Mesh", "NamedSharding"]
+
+
+def make_mesh(n_devices: int | None = None, axis_names=("rep",), shape=None) -> Mesh:
+    """Build a mesh over the first n_devices (default: all).
+
+    axis_names/shape allow 2-D meshes, e.g. ("rep", "series") for bootstrap
+    x series-block sharding.
+    """
+    devs = jax.devices()
+    if n_devices is None:
+        n_devices = len(devs)
+    devs = np.array(devs[:n_devices])
+    if shape is None:
+        shape = (n_devices,) + (1,) * (len(axis_names) - 1)
+    return Mesh(devs.reshape(shape), axis_names)
+
+
+def shard_over(mesh: Mesh, axis: str, x, dim: int = 0):
+    """Place array x with dimension `dim` sharded over mesh axis `axis`."""
+    spec = [None] * x.ndim
+    spec[dim] = axis
+    return jax.device_put(x, NamedSharding(mesh, P(*spec)))
+
+
+def replicate(mesh: Mesh, x):
+    return jax.device_put(x, NamedSharding(mesh, P()))

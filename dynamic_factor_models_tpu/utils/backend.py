@@ -20,9 +20,17 @@ def resolve_device(backend: str | None):
     if backend is None:
         return None
     platforms = _ALIASES.get(backend, (backend,))
+    # scan the default devices first, then ask for each platform explicitly —
+    # non-default platforms (e.g. cpu under a TPU session) are only reachable
+    # via jax.devices(platform)
     for d in jax.devices():
         if d.platform in platforms:
             return d
+    for p in platforms:
+        try:
+            return jax.devices(p)[0]
+        except RuntimeError:
+            continue
     raise ValueError(
         f"backend {backend!r} not available; devices = {jax.devices()}"
     )
