@@ -1,0 +1,31 @@
+from .dfm import (
+    DFMConfig,
+    DFMResults,
+    FactorEstimateStats,
+    compute_series,
+    estimate_dfm,
+    estimate_factor,
+    estimate_factor_loading,
+)
+from .var import VARResults, estimate_var, impulse_response
+from .selection import (
+    FactorNumberEstimateStats,
+    ahn_horenstein_er,
+    amengual_watson_test,
+    bai_ng_criterion,
+    estimate_factor_numbers,
+)
+from .constraints import LambdaConstraint, construct_constraint
+from .instability import InstabilityResults, instability_scan
+from .favar_instruments import cca_with_factors, choose_stepwise, favar_instrument_table
+from .ssm import (
+    EMResults,
+    SSMParams,
+    em_step,
+    estimate_dfm_em,
+    kalman_filter,
+    kalman_smoother,
+)
+from .favar import BootstrapIRFs, wild_bootstrap_irfs
+from .dynpca import DynamicPCAResults, dynamic_pca, spectral_density
+from .multilevel import MultilevelResults, estimate_multilevel_dfm

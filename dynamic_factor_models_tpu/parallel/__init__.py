@@ -1,0 +1,1 @@
+from .mesh import Mesh, NamedSharding, P, make_mesh, replicate, shard_over

@@ -1,0 +1,280 @@
+"""Stock-Watson (2016) replication driver: Figures 1-7 and Tables 2-5 as data.
+
+Mirrors the reference driver notebook (Stock_Watson.ipynb) end to end on this
+framework.  Each function returns plain arrays/dicts (plotting left to the
+caller); `run_all` produces the complete replication bundle.  Golden values
+for the committed notebook outputs are asserted in tests/ (BASELINE.md).
+
+Benchmark hyperparameters (driver cell 15): nt_min_fe=20, nt_min_fle=40,
+nfac_o=0, nfac_u=1, n_uarlag=4, n_factorlag=4, tol=1e-8.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..io import BiWeight, MonthlyData, QuarterlyData, find_row_number, readin_data
+from ..io.cache import cached_dataset
+from ..models.constraints import construct_constraint
+from ..models.dfm import DFMConfig, compute_series, estimate_dfm, estimate_factor
+from ..models.favar_instruments import choose_stepwise, favar_instrument_table
+from ..models.instability import instability_scan
+from ..models.selection import ahn_horenstein_er, estimate_factor_numbers
+from ..ops.filters import (
+    baxter_king_lowpass_weight,
+    compute_bw_weight,
+    compute_gain,
+    ma_weight,
+)
+from ..ops.lags import detrended_year_growth
+
+BENCHMARK_CONFIG = DFMConfig(
+    nfac_u=1, nfac_o=0, nt_min_factor=20, nt_min_loading=40,
+    tol=1e-8, n_uarlag=4, n_factorlag=4,
+)
+
+PERIODS_ALL = ((1959, 3), (2014, 4))
+PERIODS_PRE = ((1959, 3), (1983, 4))
+PERIODS_POST = ((1984, 1), (2014, 4))
+
+
+def load_datasets(path: str | None = None):
+    """Both datasets with the driver's ingest settings (cells 6-10)."""
+    if path is None:
+        return cached_dataset("Real"), cached_dataset("All")
+    md = MonthlyData.from_range((1959, 1), (2014, 12), 148)
+    qd = QuarterlyData.from_range((1959, 1), (2014, 4), 85)
+    return (
+        readin_data(md, qd, BiWeight(100.0), "Real", path=path),
+        readin_data(md, qd, BiWeight(100.0), "All", path=path),
+    )
+
+
+def _window(ds, periods):
+    return (
+        find_row_number(periods[0], ds.calds),
+        find_row_number(periods[1], ds.calds),
+    )
+
+
+def figure1(ds, config: DFMConfig = BENCHMARK_CONFIG):
+    """4-quarter growth of GDP/IP/employment/sales vs 1-factor common
+    component (cells 13-24)."""
+    i0, i1 = _window(ds, PERIODS_ALL)
+    res = estimate_dfm(ds.bpdata, ds.inclcode, i0, i1, config)
+    names = ["GDPC96", "INDPRO", "PAYEMS", "A0M057"]
+    out = {}
+    for name in names:
+        i = ds.bpnamevec.index(name)
+        yf = compute_series(res, i)
+        out[name] = {
+            "actual": 100 * np.asarray(detrended_year_growth(jnp.asarray(ds.bpdata[:, i]))),
+            "common": 100 * np.asarray(detrended_year_growth(yf)),
+        }
+    return {"year": np.asarray(ds.calvec), "series": out}
+
+
+def figure2(hp_weight_path: str = "/root/reference/data/hpfilter_trend.asc"):
+    """Filter weights and spectral gains (cell 26)."""
+    maxlag = 100
+    wvec = np.linspace(0.0, np.pi, 500)
+    weights = {
+        "biweight": np.asarray(compute_bw_weight(maxlag)),
+        "ma40": np.asarray(ma_weight(maxlag, 40)),
+        "bandpass": np.asarray(baxter_king_lowpass_weight(maxlag)),
+    }
+    try:
+        weights["hp"] = np.loadtxt(hp_weight_path)
+    except OSError:
+        pass  # HP weights are data shipped with the reference only
+    gains = {
+        k: np.asarray(compute_gain(jnp.asarray(w), jnp.asarray(wvec)))
+        for k, w in weights.items()
+    }
+    return {"laglead": np.arange(-maxlag, maxlag + 1), "weights": weights,
+            "frequencies": wvec, "gains": gains}
+
+
+def table2(ds_real, ds_all, config: DFMConfig = BENCHMARK_CONFIG,
+           max_nfac_a: int = 6, max_nfac_b: int = 11, dynamic: bool = True):
+    """Factor-number statistics: panels A (:Real), B (:All), C (AW)
+    (cells 29-39)."""
+    i0, i1 = _window(ds_real, PERIODS_ALL)
+    fa = estimate_factor_numbers(
+        ds_real.bpdata, ds_real.inclcode, i0, i1, config, max_nfac_a, dynamic=dynamic
+    )
+    fb = estimate_factor_numbers(
+        ds_all.bpdata, ds_all.inclcode, i0, i1, config, max_nfac_b, dynamic=dynamic
+    )
+    return {
+        "A": {"trace_r2": fa.trace_r2, "marginal_r2": fa.marginal_r2,
+              "bn_icp": fa.bn_icp, "ah_er": ahn_horenstein_er(fa.marginal_r2)},
+        "B": {"trace_r2": fb.trace_r2, "marginal_r2": fb.marginal_r2,
+              "bn_icp": fb.bn_icp, "ah_er": ahn_horenstein_er(fb.marginal_r2)},
+        "C": {"aw_icp": fb.aw_icp},
+    }
+
+
+def figure4(ds, config: DFMConfig = BENCHMARK_CONFIG, nfacs=(1, 3, 5)):
+    """GDP common component for r in {1,3,5} (cells 41-43)."""
+    i0, i1 = _window(ds, PERIODS_ALL)
+    i = ds.bpnamevec.index("GDPC96")
+    out = {"year": np.asarray(ds.calvec),
+           "gdp_growth": np.asarray(detrended_year_growth(jnp.asarray(ds.bpdata[:, i])))}
+    for nf in nfacs:
+        res = estimate_dfm(ds.bpdata, ds.inclcode, i0, i1,
+                           dataclasses.replace(config, nfac_u=nf))
+        out[f"common_r{nf}"] = np.asarray(detrended_year_growth(compute_series(res, i)))
+    return out
+
+
+def normalize_split_sample(fac_full: np.ndarray, fac_sub: np.ndarray) -> np.ndarray:
+    """Rescale a subsample factor to the full-sample factor's STD over the
+    subsample's support; the subsample mean is kept (cell 45 does the same —
+    it re-adds m_p, not m_f — so this is deliberate parity, not a bug)."""
+    m = np.isfinite(fac_sub)
+    sf = np.nanstd(fac_full[m], ddof=1)
+    mp, sp = np.nanmean(fac_sub[m]), np.nanstd(fac_sub[m], ddof=1)
+    out = fac_sub.copy()
+    out[m] = (fac_sub[m] - mp) * sf / sp + mp
+    return out
+
+
+def figure5(ds, config: DFMConfig = BENCHMARK_CONFIG):
+    """First factor: full vs pre-84 vs post-84 estimates (cells 45-47)."""
+    facs = []
+    for periods in (PERIODS_ALL, PERIODS_PRE, PERIODS_POST):
+        i0, i1 = _window(ds, periods)
+        F, _ = estimate_factor(ds.bpdata, ds.inclcode, i0, i1, config)
+        facs.append(np.asarray(F[:, 0]))
+    f_full, f_pre, f_post = facs
+    f_pre = normalize_split_sample(f_full, f_pre)
+    f_post = normalize_split_sample(f_full, f_post)
+    out = {
+        k: -np.asarray(detrended_year_growth(jnp.asarray(v)))
+        for k, v in {"full": f_full, "pre": f_pre, "post": f_post}.items()
+    }
+    out["year"] = np.asarray(ds.calvec)
+    return out
+
+
+def figure6(ds_all, config: DFMConfig = BENCHMARK_CONFIG, max_r: int = 60):
+    """Cumulative trace R^2 for r = 1..max_r, single ALS iteration
+    (cells 49-53; 180 model fits in the reference)."""
+    out = {}
+    for label, periods in (("all", PERIODS_ALL), ("pre", PERIODS_PRE),
+                           ("post", PERIODS_POST)):
+        i0, i1 = _window(ds_all, periods)
+        tr = []
+        for r in range(1, max_r + 1):
+            try:
+                _, fes = estimate_factor(
+                    ds_all.bpdata, ds_all.inclcode, i0, i1,
+                    dataclasses.replace(config, nfac_u=r),
+                    max_iter=1, compute_R2=False,
+                )
+                tr.append(1.0 - float(fes.ssr) / float(fes.tss))
+            except ValueError:  # r exceeds balanced block in a subsample
+                tr.append(np.nan)
+        out[label] = np.asarray(tr)
+    return out
+
+
+def table3(ds_all, config: DFMConfig = BENCHMARK_CONFIG, nfac_max: int = 10):
+    """Per-series R^2 vs number of factors (cell 55; 207 x 10)."""
+    i0, i1 = _window(ds_all, PERIODS_ALL)
+    r2 = np.full((len(ds_all.inclcode), nfac_max), np.nan)
+    for nfac in range(1, nfac_max + 1):
+        res = estimate_dfm(ds_all.bpdata, ds_all.inclcode, i0, i1,
+                           dataclasses.replace(config, nfac_u=nfac))
+        r2[:, nfac - 1] = np.asarray(res.r2)
+    return r2
+
+
+def table4(ds_all, config: DFMConfig = BENCHMARK_CONFIG, nfac_us=(4, 8)):
+    """Instability statistics (cell 57)."""
+    i0, i1 = _window(ds_all, PERIODS_ALL)
+    ibrk = find_row_number((1984, 4), ds_all.calds)
+    out = {}
+    for nfac in nfac_us:
+        cfg = dataclasses.replace(config, nfac_u=nfac)
+        F_full, _ = estimate_factor(ds_all.bpdata, ds_all.inclcode, i0, i1, cfg)
+        F_pre, _ = estimate_factor(ds_all.bpdata, ds_all.inclcode, i0, ibrk, cfg)
+        F_post, _ = estimate_factor(ds_all.bpdata, ds_all.inclcode, ibrk + 1, i1, cfg)
+        out[nfac] = instability_scan(
+            ds_all.bpdata, F_full, F_pre, F_post, ibrk + 1, nfac
+        )
+    return out
+
+
+def table5(ds_all, config: DFMConfig = BENCHMARK_CONFIG, stepwise: bool = True):
+    """FAVAR instrument canonical correlations (cells 60-61)."""
+    i0, i1 = _window(ds_all, PERIODS_ALL)
+    res = estimate_dfm(ds_all.bpdata, ds_all.inclcode, i0, i1,
+                       dataclasses.replace(config, nfac_u=8))
+    sets = {
+        "A": ["GDPC96", "PAYEMS", "PCECTPI", "FEDFUNDS"],
+        "B": ["GDPC96", "PAYEMS", "PCECTPI", "FEDFUNDS",
+              "NAPMPRI", "WPU0561", "CP90_TBILL", "GS10_TB3M"],
+        "O": ["OILPROD_SA", "GLOBAL_ACT", "WPU0561", "GDPC96",
+              "PAYEMS", "PCECTPI", "FEDFUNDS", "TWEXMMTH"],
+    }
+    if stepwise:
+        sets["C"] = choose_stepwise(
+            ds_all.bpdata, ds_all.bpnamevec, res.factor, res.var, 8, 4, i0, i1
+        )
+    out = {}
+    for key, names in sets.items():
+        r_res, r_lev = favar_instrument_table(
+            ds_all.bpdata, ds_all.bpnamevec, names, res.factor, res.var, 4, i0, i1
+        )
+        out[key] = {"variables": names, "residual_cca": r_res, "level_cca": r_lev}
+    return out
+
+
+def figure7(ds_all, config: DFMConfig = BENCHMARK_CONFIG):
+    """Oil-price DFM with unit-loading constraint, post-85, r=8
+    (cells 63-65)."""
+    i0 = find_row_number((1985, 1), ds_all.calds)
+    i1 = find_row_number((2014, 4), ds_all.calds)
+    nfac = 8
+    varnames = ["WPU0561", "MCOILWTICO", "MCOILBRENTEU", "RAC_IMP"]
+    incl_names = [n for n, c in zip(ds_all.bpnamevec, ds_all.inclcode) if c == 1]
+    R = np.eye(nfac)
+    r = np.eye(nfac)[0]
+    res = estimate_dfm(
+        ds_all.bpdata, ds_all.inclcode, i0, i1,
+        dataclasses.replace(config, nfac_u=nfac),
+        constraint_factor=construct_constraint(varnames, incl_names, R, r),
+        constraint_loading=construct_constraint(varnames, ds_all.bpnamevec, R, r),
+    )
+    oil_ids = [ds_all.bpnamevec.index(v) for v in varnames]
+    return {
+        "year": np.asarray(ds_all.calvec),
+        "oil_prices": 400 * np.asarray(ds_all.bpdata)[:, oil_ids],
+        "common_component": 400 * np.asarray(compute_series(res, oil_ids[0])),
+        "names": varnames,
+    }
+
+
+def run_all(fast: bool = True, path: str | None = None) -> dict:
+    """Full replication bundle.  fast=True trims the heaviest sweeps
+    (Table 2 AW refits, Figure 6 r<=60, stepwise Table 5 column)."""
+    ds_real, ds_all = load_datasets(path)
+    return {
+        "figure1": figure1(ds_real),
+        "figure2": figure2(),
+        "table2": table2(ds_real, ds_all,
+                         max_nfac_a=6, max_nfac_b=11 if not fast else 6,
+                         dynamic=not fast),
+        "figure4": figure4(ds_real),
+        "figure5": figure5(ds_real),
+        "figure6": figure6(ds_all, max_r=10 if fast else 60),
+        "table3": table3(ds_all, nfac_max=4 if fast else 10),
+        "table4": table4(ds_all, nfac_us=(4,) if fast else (4, 8)),
+        "table5": table5(ds_all, stepwise=not fast),
+        "figure7": figure7(ds_all),
+    }

@@ -1,0 +1,1 @@
+from .backend import on_backend, resolve_device

@@ -1,0 +1,45 @@
+"""Model checkpointing: serialize fitted results as flat npz archives.
+
+The reference recomputes everything from the xlsx each run (SURVEY.md
+section 5.4).  Here fitted models (pytrees of arrays) round-trip to a single
+.npz; long bootstrap/EM runs can checkpoint per-shard RNG keys and partial
+state the same way.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+
+__all__ = ["save_pytree", "load_pytree"]
+
+_SEP = "__"
+
+
+def save_pytree(path: str, tree) -> None:
+    """Save an arbitrary pytree of arrays/scalars to one .npz file."""
+    leaves, treedef = jax.tree.flatten(tree)
+    payload = {f"leaf{_SEP}{i}": np.asarray(leaf) for i, leaf in enumerate(leaves)}
+    payload["treedef"] = np.array(str(treedef))
+    np.savez_compressed(path, **payload)
+
+
+def load_pytree(path: str, like):
+    """Load a pytree saved by save_pytree; `like` supplies the structure
+    (e.g. a template DFMResults/SSMParams with dummy leaves)."""
+    z = np.load(path, allow_pickle=False)
+    leaves_like, treedef = jax.tree.flatten(like)
+    n = len([k for k in z.files if k.startswith("leaf" + _SEP)])
+    if n != len(leaves_like):
+        raise ValueError(
+            f"checkpoint has {n} leaves but template expects {len(leaves_like)}"
+        )
+    stored_def = str(z["treedef"])
+    if stored_def != str(treedef):
+        raise ValueError(
+            "checkpoint tree structure does not match the template:\n"
+            f"  stored:   {stored_def}\n  template: {treedef}"
+        )
+    leaves = [z[f"leaf{_SEP}{i}"] for i in range(n)]
+    return jax.tree.unflatten(treedef, leaves)

@@ -1,0 +1,52 @@
+"""Profiling/tracing hooks (SURVEY.md section 5.1).
+
+Thin wrappers over jax.profiler so estimation loops can annotate their hot
+regions; traces are viewable in TensorBoard/Perfetto.  The convergence-trace
+recorder replaces the reference's commented-out `println("diff = ...")`
+debugging (dfm_functions.ipynb cell 20:42) with structured data.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from dataclasses import dataclass, field
+
+import jax
+
+__all__ = ["annotate", "trace_to", "ConvergenceTrace"]
+
+
+def annotate(name: str):
+    """Named region for profiler traces: `with annotate("als_step"): ...`"""
+    return jax.profiler.TraceAnnotation(name)
+
+
+@contextlib.contextmanager
+def trace_to(logdir: str):
+    """Capture a profiler trace of the enclosed block into logdir."""
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+@dataclass
+class ConvergenceTrace:
+    """Records per-iteration objective values + wall time of an ALS/EM loop."""
+
+    name: str = "loop"
+    values: list = field(default_factory=list)
+    times: list = field(default_factory=list)
+    _t0: float = field(default_factory=time.perf_counter)
+
+    def record(self, value: float) -> None:
+        self.values.append(float(value))
+        self.times.append(time.perf_counter() - self._t0)
+
+    @property
+    def iters_per_sec(self) -> float:
+        if len(self.times) < 2:
+            return float("nan")
+        return (len(self.times) - 1) / (self.times[-1] - self.times[0])

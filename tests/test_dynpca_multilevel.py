@@ -1,0 +1,106 @@
+"""Dynamic (spectral) PCA and two-level DFM tests on synthetic data with
+known structure."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dynamic_factor_models_tpu.models.dynpca import (
+    dynamic_eigenvalue_shares,
+    dynamic_pca,
+    spectral_density,
+)
+from dynamic_factor_models_tpu.models.multilevel import estimate_multilevel_dfm
+from dynamic_factor_models_tpu.ops.cca import canonical_correlations
+
+
+@pytest.fixture(scope="module")
+def gdfm_panel():
+    rng = np.random.default_rng(2)
+    T, N = 400, 40
+    u = rng.standard_normal(T + 2)
+    chi = np.zeros((T, N))
+    for i in range(N):
+        b = rng.standard_normal(3)
+        chi[:, i] = b[0] * u[2:] + b[1] * u[1:-1] + b[2] * u[:-2]
+    x = chi + 0.8 * rng.standard_normal((T, N))
+    return x, chi
+
+
+def test_dynamic_pca_recovers_common_component(gdfm_panel):
+    x, chi = gdfm_panel
+    res = dynamic_pca(x, q=1, M=24)
+    chi_hat = np.asarray(res.common_component)
+    cors = [
+        abs(np.corrcoef(chi_hat[30:-30, i], chi[30:-30, i])[0, 1])
+        for i in range(x.shape[1])
+    ]
+    assert np.mean(cors) > 0.95
+    # one dynamic factor dominates at every frequency
+    ev = np.asarray(res.eigenvalues)
+    assert (ev[:, 0] / ev[:, 1]).min() > 5
+    assert 0.5 < float(res.variance_share) < 1.0
+
+
+def test_dynamic_eigenvalue_shares_monotone(gdfm_panel):
+    x, _ = gdfm_panel
+    res = dynamic_pca(x, q=1, M=16)
+    shares = dynamic_eigenvalue_shares(res)
+    assert np.all(np.diff(shares) >= -1e-12)
+    assert shares[0] > 0.5 and abs(shares[-1] - 1.0) < 1e-8
+
+
+def test_spectral_density_hermitian_psd(gdfm_panel):
+    x, _ = gdfm_panel
+    freqs, spec = spectral_density(x[:, :10], M=12)
+    s = np.asarray(spec)
+    np.testing.assert_allclose(s, np.conj(np.transpose(s, (0, 2, 1))), atol=1e-10)
+    ev = np.linalg.eigvalsh(s)
+    assert ev.min() > -1e-8
+
+
+def test_dynamic_pca_white_noise_flat_spectrum():
+    rng = np.random.default_rng(7)
+    x = rng.standard_normal((500, 12))
+    res = dynamic_pca(x, q=1, M=12)
+    # no common structure: top eigenvalue share stays near 1/N, far below 0.5
+    shares = dynamic_eigenvalue_shares(res)
+    assert shares[0] < 0.4
+
+
+@pytest.fixture(scope="module")
+def two_level_panel():
+    rng = np.random.default_rng(3)
+    T, n_blocks, nb, rg, rb = 300, 4, 15, 2, 1
+    N = n_blocks * nb
+    F = rng.standard_normal((T, rg))
+    G = [rng.standard_normal((T, rb)) for _ in range(n_blocks)]
+    x = np.zeros((T, N))
+    for c in range(n_blocks):
+        Lg = rng.standard_normal((nb, rg))
+        Lb = 1.5 * rng.standard_normal((nb, rb))
+        x[:, c * nb : (c + 1) * nb] = (
+            F @ Lg.T + G[c] @ Lb.T + 0.5 * rng.standard_normal((T, nb))
+        )
+    x[rng.random((T, N)) < 0.05] = np.nan
+    blocks = [np.arange(c * nb, (c + 1) * nb) for c in range(n_blocks)]
+    return x, F, G, blocks
+
+
+def test_multilevel_recovers_both_levels(two_level_panel):
+    x, F, G, blocks = two_level_panel
+    res = estimate_multilevel_dfm(x, blocks, 2, 1)
+    cc = np.asarray(canonical_correlations(res.global_factors, jnp.asarray(F)))
+    assert cc.min() > 0.98
+    for c, Gc in enumerate(G):
+        corr = np.corrcoef(np.asarray(res.block_factors[c][:, 0]), Gc[:, 0])[0, 1]
+        assert abs(corr) > 0.9
+    vd = res.variance_decomposition
+    assert abs(vd["global"] + vd["block"] + vd["idiosyncratic"] - 1.0) < 0.05
+    assert vd["idiosyncratic"] < 0.25
+
+
+def test_multilevel_rejects_overlapping_blocks(two_level_panel):
+    x, _, _, _ = two_level_panel
+    with pytest.raises(ValueError, match="disjoint"):
+        estimate_multilevel_dfm(x, [np.arange(0, 10), np.arange(5, 15)], 1, 1)

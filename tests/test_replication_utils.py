@@ -1,0 +1,68 @@
+"""Replication-driver smoke tests + checkpoint round-trip."""
+
+import numpy as np
+import pytest
+
+from dynamic_factor_models_tpu.replication import stock_watson as sw
+from dynamic_factor_models_tpu.utils.checkpoint import load_pytree, save_pytree
+
+
+def test_figure1_and_5_shapes(dataset_real):
+    f1 = sw.figure1(dataset_real)
+    assert set(f1["series"]) == {"GDPC96", "INDPRO", "PAYEMS", "A0M057"}
+    for v in f1["series"].values():
+        assert v["actual"].shape == (224,) and v["common"].shape == (224,)
+        # common component tracks the actual series
+        m = np.isfinite(v["actual"]) & np.isfinite(v["common"])
+        assert np.corrcoef(v["actual"][m], v["common"][m])[0, 1] > 0.5
+
+    f5 = sw.figure5(dataset_real)
+    m = np.isfinite(f5["full"]) & np.isfinite(f5["pre"])
+    # split-sample estimates of the same factor agree in-sample
+    assert abs(np.corrcoef(f5["full"][m], f5["pre"][m])[0, 1]) > 0.9
+
+
+def test_figure2_filters():
+    f2 = sw.figure2()
+    for k in ("biweight", "ma40", "bandpass"):
+        w = f2["weights"][k]
+        assert w.shape == (201,)
+        np.testing.assert_allclose(w.sum(), 1.0, atol=1e-10)
+        g = f2["gains"][k]
+        assert abs(g[0] - 1.0) < 1e-6  # unit gain at frequency zero
+        assert g[-1] < 0.2  # low-pass behavior
+
+
+def test_figure6_monotone_cumulative_r2(dataset_all):
+    f6 = sw.figure6(dataset_all, max_r=5)
+    tr = f6["all"]
+    assert np.all(np.diff(tr[np.isfinite(tr)]) > 0)
+    np.testing.assert_allclose(tr[0], 0.215, atol=1e-3)  # cell 37 r=1
+
+
+def test_table3_r2_increasing(dataset_all):
+    t3 = sw.table3(dataset_all, nfac_max=3)
+    assert t3.shape == (207, 3)
+    fin = np.isfinite(t3).all(axis=1)
+    # factor spaces are re-estimated per r (not nested), so per-series R^2
+    # can dip slightly; but the average must rise and large dips are bugs
+    assert (np.diff(t3[fin].mean(axis=0)) > 0).all()
+    assert (np.diff(t3[fin], axis=1) > -0.05).mean() > 0.95
+
+
+def test_checkpoint_roundtrip(tmp_path, dataset_real):
+    from dynamic_factor_models_tpu.models.dfm import DFMConfig, estimate_dfm
+
+    res = estimate_dfm(dataset_real.bpdata, dataset_real.inclcode, 2, 223, DFMConfig())
+    p = str(tmp_path / "dfm.npz")
+    save_pytree(p, res)
+    res2 = load_pytree(p, res)
+    np.testing.assert_array_equal(np.asarray(res.factor), np.asarray(res2.factor))
+    np.testing.assert_array_equal(np.asarray(res.lam), np.asarray(res2.lam))
+    np.testing.assert_array_equal(np.asarray(res.var.M), np.asarray(res2.var.M))
+
+
+def test_checkpoint_rejects_mismatched_template(tmp_path):
+    save_pytree(str(tmp_path / "x.npz"), {"a": np.ones(3)})
+    with pytest.raises(ValueError, match="leaves"):
+        load_pytree(str(tmp_path / "x.npz"), {"a": np.ones(3), "b": np.ones(2)})
