@@ -41,6 +41,18 @@ def load_dataset(path: str) -> Dataset:
     )
 
 
+def benchmark_ingest(datatype: str = "Real", path: str | None = None) -> Dataset:
+    """Run the ingest with the driver's benchmark settings (Stock_Watson.ipynb
+    cells 6-10): 1959-2014 panel, 148 monthly + 85 quarterly series,
+    BiWeight(100) detrending.  The single source of truth for these
+    hyperparameters."""
+    md = MonthlyData.from_range((1959, 1), (2014, 12), 148)
+    qd = QuarterlyData.from_range((1959, 1), (2014, 4), 85)
+    if path is None:
+        return readin_data(md, qd, BiWeight(100.0), datatype)
+    return readin_data(md, qd, BiWeight(100.0), datatype, path=path)
+
+
 def cached_dataset(datatype: str = "Real", cache_dir: str | None = None) -> Dataset:
     """Load the standard BiWeight(100) dataset, building the cache if needed."""
     if cache_dir is None:
@@ -51,9 +63,7 @@ def cached_dataset(datatype: str = "Real", cache_dir: str | None = None) -> Data
     os.makedirs(cache_dir, exist_ok=True)
     path = os.path.join(cache_dir, f"sw_panel_{datatype.lower()}.npz")
     if not os.path.exists(path):
-        md = MonthlyData.from_range((1959, 1), (2014, 12), 148)
-        qd = QuarterlyData.from_range((1959, 1), (2014, 4), 85)
-        ds = readin_data(md, qd, BiWeight(100.0), datatype)
+        ds = benchmark_ingest(datatype)
         save_dataset(ds, path)
         return ds
     return load_dataset(path)

@@ -159,6 +159,10 @@ def dynamic_pca(
             raise ValueError(
                 f"lag-window half-width M={M} must be smaller than T={x.shape[0]}"
             )
+        if not 1 <= q <= x.shape[1]:
+            raise ValueError(
+                f"q={q} dynamic factors out of range for an N={x.shape[1]} panel"
+            )
         xstd, _ = standardize_data(x)
         m = mask_of(xstd).astype(xstd.dtype)
         freqs, evals, cspec, cacov, chi, share = _dynpca_core(fillz(xstd), m, M, q)

@@ -85,6 +85,10 @@ def estimate_multilevel_dfm(
         Tw, N = xz.shape
 
         blocks = [np.asarray(b) for b in blocks]
+        if not blocks or any(b.size == 0 for b in blocks):
+            raise ValueError("blocks must be a non-empty sequence of non-empty index arrays")
+        if max_outer < 1:
+            raise ValueError(f"max_outer must be >= 1, got {max_outer}")
         covered = np.concatenate(blocks)
         if len(set(covered.tolist())) != len(covered):
             raise ValueError("blocks must be disjoint")

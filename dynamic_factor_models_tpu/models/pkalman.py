@@ -1,0 +1,278 @@
+"""Parallel-in-time Kalman filter/smoother via associative scans.
+
+Temporal parallelization of the masked state-space DFM filter (models/ssm.py)
+following Sarkka & Garcia-Fernandez (2020), "Temporal Parallelization of
+Bayesian Smoothers" (IEEE TAC 66(1)) — the sequence-parallelism story of this
+framework: the O(T) sequential `lax.scan` recursion becomes an
+O(log T)-depth ``jax.lax.associative_scan`` whose per-step elements are
+independent, so XLA can spread the time axis over the MXU *and*, combined
+with `parallel.timescan.sharded_scan`, over the chips of a mesh (time-block
+sharding with a single all-gather of per-block prefixes — the DFM analogue of
+ring/sequence parallelism for long contexts).
+
+The reference has no state-space code at all (SURVEY.md section 0: the
+`Parametric` method is declared in dfm_functions.ipynb cell 1:3 and never
+implemented), so both the sequential and this parallel formulation are new
+capability; they agree to float tolerance (tests/test_pkalman.py).
+
+Masked-panel adaptation: with observation model x_t = Lam f_t + eps,
+eps ~ N(0, diag(R)), and missing entries encoded as zero rows of the masked
+loading  Lam_t = m_t * Lam, every element of the parallel filter reduces to
+r-dimensional algebra through the Woodbury identity — per-element cost
+O(N r + r^3 + k^2 r) with k = r*p, never O(N^3) or O(k^3) in the element
+construction (the associative combine itself is O(k^3), same as one
+sequential step).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .ssm import KalmanResult, SSMParams, _companion, _init_state
+
+__all__ = [
+    "FilterElement",
+    "SmootherElement",
+    "filter_elements",
+    "combine_filter",
+    "combine_smoother",
+    "kalman_filter_associative",
+    "kalman_smoother_associative",
+]
+
+
+class FilterElement(NamedTuple):
+    """One conditional-Gaussian element (A, b, C, eta, J) of the parallel
+    filter: p(s_t | s_{t-1}, y_t) ~ N(A s_{t-1} + b, C) with information
+    pair (eta, J) flowing backward (Sarkka-GF lemma 7)."""
+
+    A: jnp.ndarray  # (k, k)
+    b: jnp.ndarray  # (k,)
+    C: jnp.ndarray  # (k, k)
+    eta: jnp.ndarray  # (k,)
+    J: jnp.ndarray  # (k, k)
+
+
+class SmootherElement(NamedTuple):
+    """Backward element (E, g, L): p(s_t | s_{t+1}, y_{1:t}) ~
+    N(E s_{t+1} + g, L) (Sarkka-GF lemma 9)."""
+
+    E: jnp.ndarray  # (k, k)
+    g: jnp.ndarray  # (k,)
+    L: jnp.ndarray  # (k, k)
+
+
+def _mT(a):
+    return jnp.swapaxes(a, -1, -2)
+
+
+def _mv(M, v):
+    return (M @ v[..., None])[..., 0]
+
+
+def combine_filter(e1: FilterElement, e2: FilterElement) -> FilterElement:
+    """Associative combine, e1 the earlier block (Sarkka-GF lemma 8).
+
+    Batch-aware over leading dims (``lax.associative_scan`` calls the combine
+    on time-sliced stacks of elements).
+    """
+    k = e1.A.shape[-1]
+    eye = jnp.eye(k, dtype=e1.A.dtype)
+    # D = A2 (I + C1 J2)^{-1}
+    D = _mT(jnp.linalg.solve(_mT(eye + e1.C @ e2.J), _mT(e2.A)))
+    A = D @ e1.A
+    b = _mv(D, e1.b + _mv(e1.C, e2.eta)) + e2.b
+    C = D @ e1.C @ _mT(e2.A) + e2.C
+    # E = A1' (I + J2 C1)^{-1}
+    E = _mT(jnp.linalg.solve(_mT(eye + e2.J @ e1.C), e1.A))
+    eta = _mv(E, e2.eta - _mv(e2.J, e1.b)) + e1.eta
+    J = E @ e2.J @ e1.A + e1.J
+    return FilterElement(A, b, 0.5 * (C + _mT(C)), eta, 0.5 * (J + _mT(J)))
+
+
+def combine_smoother(e1: SmootherElement, e2: SmootherElement) -> SmootherElement:
+    """Associative combine for the backward pass, e1 the earlier block
+    (batch-aware)."""
+    E = e1.E @ e2.E
+    g = _mv(e1.E, e2.g) + e1.g
+    L = e1.E @ e2.L @ _mT(e1.E) + e1.L
+    return SmootherElement(E, g, 0.5 * (L + _mT(L)))
+
+
+def _generic_elements(params: SSMParams, x, m):
+    """Elements for t >= 2 (predictive covariance = Qs), batched over time.
+
+    All observation-space algebra collapses to r x r through Woodbury:
+    with Zr = Lam' diag(m/R) Lam and w = Lam' (m/R * x),
+        Lam_m' S^{-1} Lam_m = (I + Zr Q)^{-1} Zr,
+        Lam_m' S^{-1} x     = (I + Zr Q)^{-1} w.
+    """
+    Tm, _ = _companion(params)
+    r = params.r
+    k = Tm.shape[0]
+    lam = params.lam
+    dtype = x.dtype
+    eye_r = jnp.eye(r, dtype=dtype)
+
+    def one(xt, mt):
+        rinv = mt / params.R  # (N,), 0 at missing
+        lam_r = lam * rinv[:, None]
+        Zr = lam.T @ lam_r  # (r, r)
+        w = lam_r.T @ xt  # (r,)
+        # key r x r factor: (I + Zr Q)^{-1}
+        IZQ = eye_r + Zr @ params.Q
+        SinvZ = jnp.linalg.solve(IZQ, Zr)  # Lam'S^{-1}Lam
+        Sinvw = jnp.linalg.solve(IZQ, w)  # Lam'S^{-1}x
+        # lift to state dim: only the first r state coords load on obs
+        KH = jnp.zeros((k, k), dtype).at[:r, :r].set(params.Q @ SinvZ)
+        A = Tm - KH @ Tm
+        b = jnp.zeros(k, dtype).at[:r].set(params.Q @ Sinvw)
+        C = jnp.zeros((k, k), dtype)
+        # (Q^{-1} + Zr)^{-1} = (I + Q Zr)^{-1} Q, no Q inverse required
+        C = C.at[:r, :r].set(jnp.linalg.solve(IZQ.T, params.Q))
+        eta = Tm.T @ jnp.zeros(k, dtype).at[:r].set(Sinvw)
+        J = Tm.T @ jnp.zeros((k, k), dtype).at[:r, :r].set(SinvZ) @ Tm
+        return FilterElement(A, b, 0.5 * (C + C.T), eta, 0.5 * (J + J.T))
+
+    return jax.vmap(one)(x, m)
+
+
+def _first_element(params: SSMParams, x0, m0):
+    """t = 1 element: full-state posterior from the diffuse prior
+    (A=0, b=m_{1|1}, C=P_{1|1}; eta/J never read for the earliest block)."""
+    Tm, Qs = _companion(params)
+    k = Tm.shape[0]
+    r = params.r
+    dtype = x0.dtype
+    s0, P0 = _init_state(params)
+    sp = Tm @ s0
+    Pp = Tm @ P0 @ Tm.T + Qs
+    rinv = m0 / params.R
+    lam_r = params.lam * rinv[:, None]
+    Z = jnp.zeros((k, k), dtype).at[:r, :r].set(params.lam.T @ lam_r)
+    v = x0 - params.lam @ sp[:r]
+    rhs = jnp.zeros(k, dtype).at[:r].set(lam_r.T @ v)
+    Pu = jnp.linalg.pinv(jnp.linalg.pinv(Pp, hermitian=True) + Z, hermitian=True)
+    su = sp + Pu @ rhs
+    zk = jnp.zeros(k, dtype)
+    zkk = jnp.zeros((k, k), dtype)
+    return FilterElement(zkk, su, 0.5 * (Pu + Pu.T), zk, zkk)
+
+
+def filter_elements(params: SSMParams, x, mask) -> FilterElement:
+    """Per-step elements for the whole panel; x (T, N) NaN-free, mask (T, N)
+    float/bool.  Element t=0 folds in the prior."""
+    m = mask.astype(x.dtype)
+    first = _first_element(params, x[0], m[0])
+    rest = _generic_elements(params, x[1:], m[1:])
+    return jax.tree.map(
+        lambda a, b: jnp.concatenate([a[None], b], axis=0), first, rest
+    )
+
+
+def _loglik_from_filtered(params: SSMParams, x, m, means, covs):
+    """Per-step predictive log-likelihoods recomputed from the filtered path
+    (vmapped over t — embarrassingly parallel, unlike the sequential scan).
+
+    Identical decomposition to ssm._filter_scan: via the matrix determinant
+    lemma, log|S_t| = sum_obs log R_ii + log|Pp_t| - log|Pu_t|.
+    """
+    Tm, Qs = _companion(params)
+    r = params.r
+    k = Tm.shape[0]
+    dtype = x.dtype
+    log2pi = jnp.asarray(np.log(2.0 * np.pi), dtype)
+    s0, P0 = _init_state(params)
+
+    pred_means = jnp.concatenate([(Tm @ s0)[None], (means[:-1] @ Tm.T)], axis=0)
+    pred_covs = (
+        jnp.einsum("ij,tjl,kl->tik", Tm, jnp.concatenate([P0[None], covs[:-1]]), Tm)
+        + Qs[None]
+    )
+
+    def one(xt, mt, sp, Pp, Pu):
+        rinv = mt / params.R
+        lam_r = params.lam * rinv[:, None]
+        v = xt - params.lam @ sp[:r]
+        rhs = jnp.zeros(k, dtype).at[:r].set(lam_r.T @ v)
+        _, ld_pp = jnp.linalg.slogdet(Pp)
+        _, ld_pu = jnp.linalg.slogdet(Pu)
+        ld_R = (mt * jnp.log(params.R)).sum()
+        quad = (rinv * v * v).sum() - rhs @ Pu @ rhs
+        return -0.5 * (mt.sum() * log2pi + ld_R + ld_pp - ld_pu + quad)
+
+    lls = jax.vmap(one)(x, m, pred_means, pred_covs, covs)
+    return lls.sum(), pred_means, pred_covs
+
+
+def kalman_filter_associative(
+    params: SSMParams, x, mask, scan=None
+) -> KalmanResult:
+    """Masked Kalman filter with O(log T) depth.
+
+    `scan` lets callers swap the scan implementation — the default is
+    ``jax.lax.associative_scan``; pass `parallel.timescan.sharded_scan`'s
+    bound form to run time-block-sharded across a mesh.
+    """
+    elems = filter_elements(params, x, mask)
+    if scan is None:
+        scanned = jax.lax.associative_scan(combine_filter, elems)
+    else:
+        scanned = scan(combine_filter, elems)
+    means, covs = scanned.b, scanned.C
+    m = mask.astype(x.dtype)
+    ll, pred_means, pred_covs = _loglik_from_filtered(params, x, m, means, covs)
+    return KalmanResult(ll, means, covs, pred_means, pred_covs)
+
+
+def smoother_elements(params: SSMParams, filt: KalmanResult) -> SmootherElement:
+    """Backward elements from the filtered path, batched over time."""
+    Tm, Qs = _companion(params)
+    k = Tm.shape[0]
+
+    def one(su, Pu):
+        Pp = Tm @ Pu @ Tm.T + Qs
+        E = jnp.linalg.solve(Pp.T, Tm @ Pu).T  # Pu Tm' Pp^{-1} (RTS gain)
+        g = su - E @ (Tm @ su)
+        L = Pu - E @ Tm @ Pu
+        return SmootherElement(E, g, 0.5 * (L + L.T))
+
+    rest = jax.vmap(one)(filt.means[:-1], filt.covs[:-1])
+    last = SmootherElement(
+        jnp.zeros((k, k), filt.means.dtype),
+        filt.means[-1],
+        filt.covs[-1],
+    )
+    return jax.tree.map(
+        lambda a, b: jnp.concatenate([a, b[None]], axis=0), rest, last
+    )
+
+
+def kalman_smoother_associative(params: SSMParams, x, mask, scan=None):
+    """Parallel filter + parallel RTS smoother.
+
+    Returns (smoothed_means, smoothed_covs, loglik, lag1) where
+    lag1[t] = Cov(s_{t+1}, s_t | y_{1:T}) for t = 0..T-2 — the quantity the
+    EM M-step consumes (ssm.em_step).
+    """
+    filt = kalman_filter_associative(params, x, mask, scan=scan)
+    elems = smoother_elements(params, filt)
+    # backward pass = forward scan over time-flipped elements with swapped
+    # operand order (combine is non-commutative; explicit flip keeps the
+    # "earlier ⊗ later" convention independent of the scan implementation)
+    rev = jax.tree.map(lambda a: jnp.flip(a, 0), elems)
+    swapped = lambda a, b: combine_smoother(b, a)
+    sm = (
+        jax.lax.associative_scan(swapped, rev)
+        if scan is None
+        else scan(swapped, rev)
+    )
+    sm = jax.tree.map(lambda a: jnp.flip(a, 0), sm)
+    means, covs = sm.g, sm.L
+    # lag-one smoothed covariance: P_{t+1|T} E_t'
+    lag1 = jnp.einsum("tij,tkj->tik", covs[1:], elems.E[:-1])
+    return means, covs, filt.loglik, lag1

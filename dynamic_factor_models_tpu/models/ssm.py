@@ -133,11 +133,24 @@ def _filter_scan(params: SSMParams, x, mask):
     return KalmanResult(lls.sum(), means, covs, pmeans, pcovs)
 
 
-def kalman_filter(params: SSMParams, x, backend: str | None = None) -> KalmanResult:
-    """Masked Kalman filter over a (T, N) panel with NaN missing values."""
+def kalman_filter(
+    params: SSMParams, x, backend: str | None = None, method: str = "sequential"
+) -> KalmanResult:
+    """Masked Kalman filter over a (T, N) panel with NaN missing values.
+
+    method="sequential" is the O(T) ``lax.scan``; "associative" is the
+    O(log T)-depth parallel-in-time formulation (models/pkalman.py) —
+    identical results to float tolerance, preferable for long samples.
+    """
+    if method not in ("sequential", "associative"):
+        raise ValueError(f"method must be 'sequential' or 'associative', got {method!r}")
     with on_backend(backend):
         x = jnp.asarray(x)
         mask = mask_of(x)
+        if method == "associative":
+            from .pkalman import kalman_filter_associative
+
+            return kalman_filter_associative(params, fillz(x), mask)
         return _filter_scan(params, fillz(x), mask)
 
 
@@ -171,14 +184,26 @@ def _smoother_scan(params: SSMParams, filt: KalmanResult):
     return means, covs, lag1
 
 
-def kalman_smoother(params: SSMParams, x, backend: str | None = None):
+def kalman_smoother(
+    params: SSMParams, x, backend: str | None = None, method: str = "sequential"
+):
     """Kalman smoother: returns (smoothed_means, smoothed_covs, loglik).
 
     The `backend={"cpu","tpu"}` kwarg follows the north-star API
-    (BASELINE.json): same program, device chosen by flag.
+    (BASELINE.json): same program, device chosen by flag.  method as in
+    `kalman_filter`; "associative" also parallelizes the backward pass.
     """
+    if method not in ("sequential", "associative"):
+        raise ValueError(f"method must be 'sequential' or 'associative', got {method!r}")
     with on_backend(backend):
         x = jnp.asarray(x)
+        if method == "associative":
+            from .pkalman import kalman_smoother_associative
+
+            means, covs, ll, _ = kalman_smoother_associative(
+                params, fillz(x), mask_of(x)
+            )
+            return means, covs, ll
         filt = _filter_scan(params, fillz(x), mask_of(x))
         means, covs, _ = _smoother_scan(params, filt)
         return means, covs, filt.loglik

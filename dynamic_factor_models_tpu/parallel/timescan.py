@@ -1,0 +1,90 @@
+"""Sequence parallelism: associative scans sharded over a mesh's time axis.
+
+The DFM analogue of ring/context parallelism for long sequences (the global
+design requirement; SURVEY.md section 5.7): a time recursion whose combine is
+associative — the parallel Kalman filter/smoother elements
+(models/pkalman.py), cumulative products of companion matrices for IRFs,
+prefix log-likelihoods — runs time-block-sharded across devices:
+
+    1. each device runs a local ``lax.associative_scan`` on its block;
+    2. ONE ``all_gather`` over the mesh axis exchanges the per-block totals
+       (the classic Blelchoch block-scan exchange; O(n_dev * elem) bytes on
+       ICI, independent of T);
+    3. each device folds the gathered prefixes (n_dev tiny combines) and
+       applies its exclusive block-prefix to the local results.
+
+Implemented with ``shard_map`` so the collective is explicit and rides the
+mesh axis; everything composes with jit.  The reference has no distributed
+code of any kind (SURVEY.md section 2.6) — this is new TPU-native design.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:  # jax >= 0.4.35
+    from jax import shard_map
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map
+
+__all__ = ["sharded_scan", "time_sharding"]
+
+
+def time_sharding(mesh: Mesh, axis: str = "time"):
+    """NamedSharding placing an elements-pytree's leading (time) dim on
+    `axis`."""
+    return NamedSharding(mesh, P(axis))
+
+
+def sharded_scan(combine, elems, mesh: Mesh, axis: str = "time"):
+    """Inclusive associative scan over the leading axis of an elements pytree,
+    sharded over `mesh[axis]`.
+
+    `combine(earlier, later)` must be associative (not necessarily
+    commutative).  The leading dimension must divide evenly by the mesh-axis
+    size.  Returns the same pytree, scanned, with the same sharding.
+    """
+    n_dev = mesh.shape[axis]
+    T = jax.tree.leaves(elems)[0].shape[0]
+    if T % n_dev:
+        raise ValueError(f"time length {T} not divisible by mesh axis size {n_dev}")
+
+    spec = P(axis)
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(spec,),
+        out_specs=spec,
+        check_vma=False,
+    )
+    def block_scan(local):
+        # 1. local inclusive scan on this device's time block
+        scanned = jax.lax.associative_scan(combine, local)
+        # 2. exchange block totals: (n_dev, ...) on every device
+        total = jax.tree.map(lambda a: a[-1], scanned)
+        gathered = jax.tree.map(
+            lambda a: jax.lax.all_gather(a, axis_name=axis), total
+        )
+        # 3. exclusive prefix of the gathered totals for this device's block
+        idx = jax.lax.axis_index(axis)
+
+        def fold(i, carry):
+            nxt = jax.tree.map(lambda a: a[i], gathered)
+            return jax.lax.cond(
+                i < idx, lambda: combine(carry, nxt), lambda: carry
+            )
+
+        first = jax.tree.map(lambda a: a[0], gathered)
+        prefix = jax.lax.fori_loop(1, n_dev, fold, first)
+        # apply: block 0 keeps its local scan; others fold the prefix in front
+        with_prefix = jax.vmap(lambda e: combine(prefix, e))(scanned)
+        return jax.tree.map(
+            lambda a, b: jnp.where(idx == 0, a, b), scanned, with_prefix
+        )
+
+    return block_scan(elems)

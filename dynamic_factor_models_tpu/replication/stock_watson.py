@@ -12,12 +12,13 @@ nfac_o=0, nfac_u=1, n_uarlag=4, n_factorlag=4, tol=1e-8.
 from __future__ import annotations
 
 import dataclasses
+import os
 
 import jax.numpy as jnp
 import numpy as np
 
-from ..io import BiWeight, MonthlyData, QuarterlyData, find_row_number, readin_data
-from ..io.cache import cached_dataset
+from ..io import find_row_number
+from ..io.cache import benchmark_ingest, cached_dataset
 from ..models.constraints import construct_constraint
 from ..models.dfm import DFMConfig, compute_series, estimate_dfm, estimate_factor
 from ..models.favar_instruments import choose_stepwise, favar_instrument_table
@@ -45,12 +46,7 @@ def load_datasets(path: str | None = None):
     """Both datasets with the driver's ingest settings (cells 6-10)."""
     if path is None:
         return cached_dataset("Real"), cached_dataset("All")
-    md = MonthlyData.from_range((1959, 1), (2014, 12), 148)
-    qd = QuarterlyData.from_range((1959, 1), (2014, 4), 85)
-    return (
-        readin_data(md, qd, BiWeight(100.0), "Real", path=path),
-        readin_data(md, qd, BiWeight(100.0), "All", path=path),
-    )
+    return benchmark_ingest("Real", path=path), benchmark_ingest("All", path=path)
 
 
 def _window(ds, periods):
@@ -77,8 +73,13 @@ def figure1(ds, config: DFMConfig = BENCHMARK_CONFIG):
     return {"year": np.asarray(ds.calvec), "series": out}
 
 
-def figure2(hp_weight_path: str = "/root/reference/data/hpfilter_trend.asc"):
-    """Filter weights and spectral gains (cell 26)."""
+def figure2(hp_weight_path: str | None = None):
+    """Filter weights and spectral gains (cell 26).
+
+    The HP-filter weights are precomputed data shipped with the reference
+    (data/hpfilter_trend.asc); point hp_weight_path (or the
+    DFM_HP_WEIGHTS_PATH env var) at a copy to include them.
+    """
     maxlag = 100
     wvec = np.linspace(0.0, np.pi, 500)
     weights = {
@@ -86,10 +87,16 @@ def figure2(hp_weight_path: str = "/root/reference/data/hpfilter_trend.asc"):
         "ma40": np.asarray(ma_weight(maxlag, 40)),
         "bandpass": np.asarray(baxter_king_lowpass_weight(maxlag)),
     }
-    try:
+    if hp_weight_path is None:
+        hp_weight_path = os.environ.get(
+            "DFM_HP_WEIGHTS_PATH", "/root/reference/data/hpfilter_trend.asc"
+        )
+        try:
+            weights["hp"] = np.loadtxt(hp_weight_path)
+        except FileNotFoundError:
+            pass  # optional: reference data not present on this machine
+    else:
         weights["hp"] = np.loadtxt(hp_weight_path)
-    except OSError:
-        pass  # HP weights are data shipped with the reference only
     gains = {
         k: np.asarray(compute_gain(jnp.asarray(w), jnp.asarray(wvec)))
         for k, w in weights.items()

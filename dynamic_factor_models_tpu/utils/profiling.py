@@ -8,7 +8,6 @@ debugging (dfm_functions.ipynb cell 20:42) with structured data.
 
 from __future__ import annotations
 
-import contextlib
 import time
 from dataclasses import dataclass, field
 
@@ -22,14 +21,8 @@ def annotate(name: str):
     return jax.profiler.TraceAnnotation(name)
 
 
-@contextlib.contextmanager
-def trace_to(logdir: str):
-    """Capture a profiler trace of the enclosed block into logdir."""
-    jax.profiler.start_trace(logdir)
-    try:
-        yield
-    finally:
-        jax.profiler.stop_trace()
+# jax.profiler.trace already pairs start/stop with exception-safe cleanup
+trace_to = jax.profiler.trace
 
 
 @dataclass
