@@ -48,8 +48,6 @@ def benchmark_ingest(datatype: str = "Real", path: str | None = None) -> Dataset
     hyperparameters."""
     md = MonthlyData.from_range((1959, 1), (2014, 12), 148)
     qd = QuarterlyData.from_range((1959, 1), (2014, 4), 85)
-    if path is None:
-        return readin_data(md, qd, BiWeight(100.0), datatype)
     return readin_data(md, qd, BiWeight(100.0), datatype, path=path)
 
 

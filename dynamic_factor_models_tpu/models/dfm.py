@@ -106,11 +106,13 @@ def _als_core(
     c_R=None,  # (nc, k, nfac)
     c_r=None,  # (nc, k) standardized restriction values
 ):
+    from ..ops.pallas_gram import masked_gram
+
     W = m * lam_ok[None, :]
 
     def lam_step(f):
-        A = jnp.einsum("tr,ti,ts->irs", f, m, f)
-        rhs = jnp.einsum("tr,ti->ir", f, m * xz)
+        # per-series masked Gram (K4's Unbalanced loop) — Pallas at scale
+        A, rhs = masked_gram(f, xz, m)
         lam = jax.vmap(solve_normal)(A, rhs)
         if n_constr:
             constraint = LambdaConstraint(c_series, c_R, c_r)
@@ -118,8 +120,8 @@ def _als_core(
         return lam
 
     def f_step(lam):
-        A = jnp.einsum("ir,ti,is->trs", lam, W, lam)
-        rhs = jnp.einsum("ir,ti->tr", lam, W * xz)
+        # per-period masked Gram: series play the reduction axis here
+        A, rhs = masked_gram(lam, xz.T, W.T)
         f = jax.vmap(solve_normal)(A, rhs)
         ssr = (W * (xz - f @ lam.T) ** 2).sum()
         return f, ssr

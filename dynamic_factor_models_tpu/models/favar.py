@@ -30,7 +30,7 @@ from ..parallel.mesh import NamedSharding, P, make_mesh
 from ..utils.backend import on_backend
 from .var import VARResults, companion_matrices, estimate_var, impulse_response
 
-__all__ = ["BootstrapIRFs", "wild_bootstrap_irfs"]
+__all__ = ["BootstrapIRFs", "wild_bootstrap_irfs", "wild_bootstrap_irfs_resumable"]
 
 
 class BootstrapIRFs(NamedTuple):
@@ -106,6 +106,30 @@ def _sharded_core(out_sharding):
     )
 
 
+def _prepare_window(y, initperiod: int, lastperiod: int) -> jnp.ndarray:
+    """Window [initperiod, lastperiod], leading all-NaN rows dropped; raises
+    if what remains is not a contiguous complete block."""
+    yw = jnp.asarray(y)[initperiod : lastperiod + 1]
+    complete = np.asarray(mask_of(yw).all(axis=1))
+    first = int(np.argmax(complete))
+    if not complete[first:].all():
+        raise ValueError(
+            "bootstrap window must be contiguous and complete after the "
+            "first observed row"
+        )
+    return yw[first:]
+
+
+def _run_core(yw, key, nlag, horizon, n_reps, mesh):
+    """Dispatch one batch of replications, mesh-sharded when a mesh is given."""
+    if mesh is not None:
+        n_dev = mesh.devices.size
+        n_padded = ((n_reps + n_dev - 1) // n_dev) * n_dev
+        core = _sharded_core(NamedSharding(mesh, P("rep")))
+        return core(yw, key, nlag, horizon, n_padded)[:n_reps]
+    return _bootstrap_core(yw, key, nlag, horizon, n_reps)
+
+
 def wild_bootstrap_irfs(
     y,
     nlag: int,
@@ -129,17 +153,8 @@ def wild_bootstrap_irfs(
     quantile all-gather.
     """
     with on_backend(backend):
-        y = jnp.asarray(y)
-        yw = y[initperiod : lastperiod + 1]
         # drop leading incomplete rows (factor windows start with NaN lags)
-        complete = np.asarray(mask_of(yw).all(axis=1))
-        first = int(np.argmax(complete))
-        if not complete[first:].all():
-            raise ValueError(
-                "bootstrap window must be contiguous and complete after the "
-                "first observed row"
-            )
-        yw = yw[first:]
+        yw = _prepare_window(y, initperiod, lastperiod)
 
         var = estimate_var(yw, nlag, 0, yw.shape[0] - 1, withconst=True)
         point = impulse_response(var, "all", horizon)
@@ -147,16 +162,84 @@ def wild_bootstrap_irfs(
         key = jax.random.PRNGKey(seed)
         if mesh is None and len(jax.devices()) > 1:
             mesh = make_mesh()
-        if mesh is not None:
-            # pad replications to a multiple of the mesh size and ask GSPMD to
-            # shard the replication axis; the program is embarrassingly
-            # parallel so XLA partitions the whole vmapped body per chip
-            n_dev = mesh.devices.size
-            n_reps_padded = ((n_reps + n_dev - 1) // n_dev) * n_dev
-            core = _sharded_core(NamedSharding(mesh, P("rep")))
-            draws = core(yw, key, nlag, horizon, n_reps_padded)[:n_reps]
-        else:
-            draws = _bootstrap_core(yw, key, nlag, horizon, n_reps)
+        # the replication program is embarrassingly parallel: GSPMD shards the
+        # vmapped body over the mesh's "rep" axis
+        draws = _run_core(yw, key, nlag, horizon, n_reps, mesh)
 
+        q = jnp.quantile(draws, jnp.asarray(quantile_levels), axis=0)
+        return BootstrapIRFs(point, draws, q, np.asarray(quantile_levels))
+
+
+def wild_bootstrap_irfs_resumable(
+    y,
+    nlag: int,
+    initperiod: int,
+    lastperiod: int,
+    checkpoint_path: str,
+    horizon: int = 24,
+    n_reps: int = 1000,
+    chunk_reps: int = 100,
+    seed: int = 0,
+    quantile_levels=(0.05, 0.16, 0.5, 0.84, 0.95),
+    mesh=None,
+    backend: str | None = None,
+) -> BootstrapIRFs:
+    """Fault-tolerant bootstrap: checkpoints partial draws after every chunk.
+
+    The failure-recovery subsystem the reference lacks (SURVEY.md section
+    5.3): replications run in chunks of `chunk_reps`, each chunk keyed by
+    ``fold_in(seed_key, chunk_index)`` so the draw stream is independent of
+    where a run was interrupted; after each chunk the draws-so-far and the
+    next chunk index are written to `checkpoint_path` (npz, atomic rename).
+    Re-invoking with the same arguments resumes at the first incomplete
+    chunk and returns results identical to an uninterrupted run.  A
+    checkpoint whose spec (seed, chunking, model, window, horizon) or data
+    fingerprint differs is discarded, never silently blended.
+    """
+    import hashlib
+    import os
+
+    with on_backend(backend):
+        yw = _prepare_window(y, initperiod, lastperiod)
+        var = estimate_var(yw, nlag, 0, yw.shape[0] - 1, withconst=True)
+        point = impulse_response(var, "all", horizon)
+        if mesh is None and len(jax.devices()) > 1:
+            mesh = make_mesh()
+
+        spec = np.asarray([seed, chunk_reps, nlag, initperiod, lastperiod, horizon])
+        fingerprint = hashlib.sha1(
+            np.ascontiguousarray(np.asarray(yw, np.float64)).tobytes()
+        ).hexdigest()
+
+        n_chunks = -(-n_reps // chunk_reps)
+        start_chunk = 0
+        done: list[np.ndarray] = []
+        if os.path.exists(checkpoint_path):
+            with np.load(checkpoint_path) as z:
+                if (
+                    "spec" in z
+                    and np.array_equal(z["spec"], spec)
+                    and str(z["fingerprint"]) == fingerprint
+                ):
+                    start_chunk = min(int(z["next_chunk"]), n_chunks)
+                    done = list(z["draws"][:start_chunk])
+
+        key = jax.random.PRNGKey(seed)
+        for c in range(start_chunk, n_chunks):
+            draws_c = _run_core(
+                yw, jax.random.fold_in(key, c), nlag, horizon, chunk_reps, mesh
+            )
+            done.append(np.asarray(draws_c))
+            tmp = checkpoint_path + ".tmp.npz"  # explicit suffix: savez won't rename
+            np.savez(
+                tmp,
+                draws=np.stack(done),
+                next_chunk=c + 1,
+                spec=spec,
+                fingerprint=fingerprint,
+            )
+            os.replace(tmp, checkpoint_path)
+
+        draws = jnp.asarray(np.concatenate(done, axis=0)[:n_reps])
         q = jnp.quantile(draws, jnp.asarray(quantile_levels), axis=0)
         return BootstrapIRFs(point, draws, q, np.asarray(quantile_levels))

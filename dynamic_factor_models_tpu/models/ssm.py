@@ -25,6 +25,7 @@ from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
+import jax.scipy.linalg as jsl
 import numpy as np
 
 from ..ops.linalg import solve_normal, standardize_data
@@ -72,6 +73,22 @@ class KalmanResult(NamedTuple):
     pred_covs: jnp.ndarray  # (T, k, k)
 
 
+def _psd_floor(Q: jnp.ndarray) -> jnp.ndarray:
+    """Symmetrize and floor the eigenvalues of a covariance estimate.
+
+    The filter's Cholesky updates require Q strictly PD (Pp = TPT' + Qs is
+    PD iff Q and P are); the EM M-step covariance S11 - A S10' is only PSD
+    up to float error and can acquire tiny negative eigenvalues with
+    near-collinear factors.  Flooring at eps-scale keeps the fast Cholesky
+    path valid without measurably moving a healthy Q.
+    """
+    Q = 0.5 * (Q + Q.T)
+    e, v = jnp.linalg.eigh(Q)
+    eps = jnp.asarray(jnp.finfo(Q.dtype).eps, Q.dtype)
+    floor = jnp.maximum(e[-1] * 16.0 * eps, eps)
+    return (v * jnp.maximum(e, floor)) @ v.T
+
+
 def _companion(params: SSMParams):
     r, p = params.r, params.p
     k = r * p
@@ -100,6 +117,8 @@ def _filter_scan(params: SSMParams, x, mask):
     dtype = x.dtype
     log2pi = jnp.asarray(np.log(2.0 * np.pi), dtype)
 
+    eye_k = jnp.eye(k, dtype=dtype)
+
     def step(carry, inp):
         s, P = carry
         xt, mt = inp
@@ -114,14 +133,20 @@ def _filter_scan(params: SSMParams, x, mask):
         C = jnp.zeros((k, k), dtype).at[:r, :r].set(lam.T @ lam_r)
         v = xt - lam @ sp[:r]  # innovation (garbage at missing; weighted by 0)
         gain_rhs = jnp.zeros(k, dtype).at[:r].set(lam_r.T @ v)
-        Ppinv = jnp.linalg.pinv(Pp, hermitian=True)
-        Pu = jnp.linalg.pinv(Ppinv + C, hermitian=True)
+        # Pp is PD (Q PD ⇒ the companion prediction keeps full rank), so
+        # Cholesky replaces the eigh-based pinv and yields log-dets for free
+        Lp = jnp.linalg.cholesky(Pp)
+        Ppinv = jsl.cho_solve((Lp, True), eye_k)
+        M = Ppinv + C
+        Lm = jnp.linalg.cholesky(0.5 * (M + M.T))
+        Pu = jsl.cho_solve((Lm, True), eye_k)
+        Pu = 0.5 * (Pu + Pu.T)
         su = sp + Pu @ gain_rhs
         # log-likelihood via matrix determinant lemma:
         # log|S| = sum_obs log R_ii + log|Pp| - log|Pu|
         n_obs = mt.sum()
-        _, ld_pp = jnp.linalg.slogdet(Pp)
-        _, ld_pu = jnp.linalg.slogdet(Pu)
+        ld_pp = 2.0 * jnp.log(jnp.diagonal(Lp)).sum()
+        ld_pu = -2.0 * jnp.log(jnp.diagonal(Lm)).sum()
         ld_R = (mt * jnp.log(params.R)).sum()
         quad = (rinv * v * v).sum() - gain_rhs @ Pu @ gain_rhs
         ll = -0.5 * (n_obs * log2pi + ld_R + ld_pp - ld_pu + quad)
@@ -145,6 +170,9 @@ def kalman_filter(
     if method not in ("sequential", "associative"):
         raise ValueError(f"method must be 'sequential' or 'associative', got {method!r}")
     with on_backend(backend):
+        # the Cholesky-based recursions need Q strictly PD; floor here so a
+        # caller-supplied singular/indefinite Q degrades gracefully
+        params = params._replace(Q=_psd_floor(params.Q))
         x = jnp.asarray(x)
         mask = mask_of(x)
         if method == "associative":
@@ -162,7 +190,9 @@ def _smoother_scan(params: SSMParams, filt: KalmanResult):
     def step(carry, inp):
         s_next, P_next = carry
         su, Pu, sp_next, Pp_next = inp
-        J = Pu @ Tm.T @ jnp.linalg.pinv(Pp_next, hermitian=True)
+        # J = Pu Tm' Pp_next^{-1}; Pp_next PD, Pu symmetric, so solve the
+        # transposed system with Cholesky instead of forming a pinv
+        J = jsl.cho_solve((jnp.linalg.cholesky(Pp_next), True), Tm @ Pu).T
         s_sm = su + J @ (s_next - sp_next)
         P_sm = Pu + J @ (P_next - Pp_next) @ J.T
         # Cov(s_{t+1}, s_t | T) = P_{t+1|T} J_t'
@@ -196,6 +226,7 @@ def kalman_smoother(
     if method not in ("sequential", "associative"):
         raise ValueError(f"method must be 'sequential' or 'associative', got {method!r}")
     with on_backend(backend):
+        params = params._replace(Q=_psd_floor(params.Q))
         x = jnp.asarray(x)
         if method == "associative":
             from .pkalman import kalman_smoother_associative
@@ -248,8 +279,7 @@ def em_step(params: SSMParams, x, mask):
            + lag1[:, :r, :].sum(axis=0))
     Ak = S10 @ jnp.linalg.pinv(S00, hermitian=True)  # (r, k)
     Tn = x.shape[0]
-    Q = (S11 - Ak @ S10.T) / (Tn - 1)
-    Q = 0.5 * (Q + Q.T)
+    Q = _psd_floor((S11 - Ak @ S10.T) / (Tn - 1))
     A = jnp.stack([Ak[:, i * r : (i + 1) * r] for i in range(p)])
     return SSMParams(lam, R, A, Q), filt.loglik
 
@@ -275,7 +305,7 @@ def _init_params_from_als(
     p = config.n_factorlag
     b = res.var.betahat[1:].T  # (r, r*p) companion top rows
     A = jnp.stack([b[:, i * r : (i + 1) * r] for i in range(p)])
-    Q = res.var.seps
+    Q = _psd_floor(res.var.seps)
     fw = res.factor[initperiod : lastperiod + 1]
     W = m_arr.astype(xz.dtype)
     Sff = jnp.einsum("ti,tr,ts->irs", W, fw, fw)

@@ -69,11 +69,13 @@ def ols_batched_series(
     Y: (T, N) with NaN missing; X: (T, K); W: (T, N) 0/1 weights.
     Returns betas (K, N) and residuals (T, N) with NaN at unweighted rows.
     Replaces the reference's per-column `Unbalanced` loop (cell 17) with one
-    einsum + batched solve — MXU-friendly.
+    fused masked-Gram contraction + batched solve — MXU-friendly; large
+    panels on TPU route through the Pallas kernel (ops/pallas_gram.py).
     """
+    from .pallas_gram import masked_gram
+
     Yz = fillz(Y)
-    A = jnp.einsum("tk,tn,tl->nkl", X, W, X)  # N x K x K
-    rhs = jnp.einsum("tk,tn->nk", X, W * Yz)  # N x K
+    A, rhs = masked_gram(X, Yz, W)  # (N, K, K), (N, K)
     betas = jax.vmap(solve_normal)(A, rhs).T  # K x N
     resid = jnp.where(W.astype(bool), Yz - X @ betas, jnp.nan)
     return betas, resid

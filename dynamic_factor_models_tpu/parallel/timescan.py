@@ -28,8 +28,12 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 try:  # jax >= 0.4.35
     from jax import shard_map
+
+    _SHARD_MAP_KW = {"check_vma": False}
 except ImportError:  # pragma: no cover - older jax
     from jax.experimental.shard_map import shard_map
+
+    _SHARD_MAP_KW = {"check_rep": False}  # pre-rename spelling of the kwarg
 
 __all__ = ["sharded_scan", "time_sharding"]
 
@@ -60,7 +64,7 @@ def sharded_scan(combine, elems, mesh: Mesh, axis: str = "time"):
         mesh=mesh,
         in_specs=(spec,),
         out_specs=spec,
-        check_vma=False,
+        **_SHARD_MAP_KW,
     )
     def block_scan(local):
         # 1. local inclusive scan on this device's time block

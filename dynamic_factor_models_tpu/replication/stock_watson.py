@@ -93,8 +93,8 @@ def figure2(hp_weight_path: str | None = None):
         )
         try:
             weights["hp"] = np.loadtxt(hp_weight_path)
-        except FileNotFoundError:
-            pass  # optional: reference data not present on this machine
+        except OSError:
+            pass  # optional: reference data absent/unreadable on this machine
     else:
         weights["hp"] = np.loadtxt(hp_weight_path)
     gains = {

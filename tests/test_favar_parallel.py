@@ -63,3 +63,35 @@ def test_graft_entry_and_dryrun():
     params, ll = out
     assert np.isfinite(float(ll))
     g.dryrun_multichip(8)
+
+
+def test_bootstrap_resumable_matches_uninterrupted(factors, tmp_path):
+    from dynamic_factor_models_tpu.models.favar import wild_bootstrap_irfs_resumable
+
+    ckpt = str(tmp_path / "boot.npz")
+    kw = dict(nlag=2, initperiod=0, lastperiod=factors.shape[0] - 1,
+              horizon=6, n_reps=24, chunk_reps=10, seed=3)
+    full = wild_bootstrap_irfs_resumable(factors, checkpoint_path=ckpt, **kw)
+    assert np.isfinite(np.asarray(full.draws)).all()
+
+    # simulate preemption: rewind the checkpoint to after chunk 1 and resume
+    with np.load(ckpt) as z:
+        np.savez(ckpt, draws=z["draws"][:1], next_chunk=1,
+                 spec=z["spec"], fingerprint=z["fingerprint"])
+    resumed = wild_bootstrap_irfs_resumable(factors, checkpoint_path=ckpt, **kw)
+    np.testing.assert_array_equal(np.asarray(resumed.draws), np.asarray(full.draws))
+
+
+def test_bootstrap_resumable_discards_stale_checkpoint(factors, tmp_path):
+    from dynamic_factor_models_tpu.models.favar import wild_bootstrap_irfs_resumable
+
+    ckpt = str(tmp_path / "boot.npz")
+    kw = dict(initperiod=0, lastperiod=factors.shape[0] - 1,
+              horizon=6, n_reps=10, chunk_reps=10, seed=3)
+    wild_bootstrap_irfs_resumable(factors, nlag=2, checkpoint_path=ckpt, **kw)
+    # same shapes, different model spec: checkpoint must be discarded
+    again = wild_bootstrap_irfs_resumable(factors, nlag=4, checkpoint_path=ckpt, **kw)
+    fresh = wild_bootstrap_irfs_resumable(
+        factors, nlag=4, checkpoint_path=str(tmp_path / "b2.npz"), **kw
+    )
+    np.testing.assert_array_equal(np.asarray(again.draws), np.asarray(fresh.draws))

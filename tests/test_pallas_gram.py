@@ -1,0 +1,57 @@
+"""Pallas masked-Gram kernel vs the XLA einsum reference path.
+
+Runs the kernel in interpreter mode on the CPU test mesh (SURVEY.md
+section 4: TPU kernels must be testable without TPU hardware); the compiled
+path is exercised on the real chip by bench.py.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dynamic_factor_models_tpu.ops.linalg import ols_batched_series
+from dynamic_factor_models_tpu.ops.masking import fillz, mask_of
+from dynamic_factor_models_tpu.ops.pallas_gram import (
+    masked_gram_pallas,
+    masked_gram_xla,
+)
+
+
+@pytest.mark.parametrize("T,N,K", [(224, 207, 5), (300, 130, 9), (64, 32, 3)])
+def test_pallas_matches_xla(rng, T, N, K):
+    X = jnp.asarray(rng.standard_normal((T, K)))
+    Y = jnp.asarray(rng.standard_normal((T, N)))
+    W = jnp.asarray((rng.random((T, N)) > 0.2).astype(np.float64))
+    A0, b0 = masked_gram_xla(X, Y, W)
+    A1, b1 = masked_gram_pallas(X, Y, W, tile_t=128, tile_n=128, interpret=True)
+    np.testing.assert_allclose(np.asarray(A1), np.asarray(A0), rtol=1e-10)
+    np.testing.assert_allclose(np.asarray(b1), np.asarray(b0), rtol=1e-10)
+
+
+def test_pallas_padding_exact(rng):
+    # shapes deliberately not tile multiples: padding must contribute nothing
+    T, N, K = 130, 70, 4
+    X = jnp.asarray(rng.standard_normal((T, K)))
+    Y = jnp.asarray(rng.standard_normal((T, N)))
+    W = jnp.ones((T, N))
+    A0, b0 = masked_gram_xla(X, Y, W)
+    A1, b1 = masked_gram_pallas(X, Y, W, tile_t=128, tile_n=128, interpret=True)
+    np.testing.assert_allclose(np.asarray(A1), np.asarray(A0), rtol=1e-10)
+    np.testing.assert_allclose(np.asarray(b1), np.asarray(b0), rtol=1e-10)
+
+
+def test_gram_feeds_batched_ols(rng):
+    # the wired path: ols_batched_series solves the kernel's normal equations
+    T, N, K = 96, 11, 3
+    X = jnp.asarray(rng.standard_normal((T, K)))
+    beta_true = rng.standard_normal((K, N))
+    Y = X @ jnp.asarray(beta_true)
+    Y = Y.at[rng.integers(0, T, 40), rng.integers(0, N, 40)].set(jnp.nan)
+    W = mask_of(Y).astype(X.dtype)
+    betas, resid = ols_batched_series(Y, X, W)
+    np.testing.assert_allclose(np.asarray(betas), beta_true, atol=1e-8)
+    r = np.asarray(resid)
+    assert np.all(np.isnan(r[~np.asarray(W, bool)]))
+    np.testing.assert_allclose(
+        np.nan_to_num(r), np.zeros_like(r), atol=1e-8
+    )
