@@ -230,7 +230,16 @@ def _monthly_to_quarterly(data_m: np.ndarray, dates_m: list) -> tuple[np.ndarray
 
 
 def _biweight_trend(data: np.ndarray, bandwidth: float) -> np.ndarray:
-    """Per-series biweight local mean, missing-aware (O(T^2) vectorized)."""
+    """Per-series biweight local mean, missing-aware.
+
+    Prefers the native banded C++ kernel (io/native.py, O(T*bandwidth*ns)
+    streaming); the vectorized NumPy O(T^2) path is the fallback and the
+    parity reference (tests/test_native.py)."""
+    from .native import biweight_trend_native
+
+    native = biweight_trend_native(data, bandwidth)
+    if native is not None:
+        return native
     T, ns = data.shape
     t_grid = np.arange(1, T + 1, dtype=float)
     dt = (t_grid[None, :] - t_grid[:, None]) / bandwidth  # [target t, source s]

@@ -1,0 +1,85 @@
+"""Lazy ctypes loader for the native (C++) ingest kernels.
+
+The reference is pure Julia with no native code (SURVEY.md section 2), so
+there is nothing to port — these are new native components for the runtime
+around the JAX compute path: the ingest hot loop (biweight detrend,
+readin_functions.jl:335-348 equivalent) compiled with g++ on first use and
+loaded via ctypes (no pybind11 in the image; SURVEY.md section 7 environment
+notes).
+
+Build artifacts land in <repo>/build/.  Set DFM_NATIVE=0 to force the NumPy
+fallback; if g++ or a writable build dir is unavailable the fallback engages
+silently — the native path is an accelerator, never a requirement.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+import numpy as np
+
+_LIB = None
+_TRIED = False
+
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _build_and_load():
+    src = os.path.join(_repo_root(), "native", "biweight.cpp")
+    if not os.path.exists(src):
+        return None
+    build_dir = os.path.join(_repo_root(), "build")
+    so_path = os.path.join(build_dir, "libdfm_native.so")
+    try:
+        if not os.path.exists(so_path) or os.path.getmtime(so_path) < os.path.getmtime(src):
+            os.makedirs(build_dir, exist_ok=True)
+            subprocess.run(
+                ["g++", "-O3", "-march=native", "-funroll-loops", "-shared",
+                 "-fPIC", "-o", so_path + ".tmp", src],
+                check=True,
+                capture_output=True,
+            )
+            os.replace(so_path + ".tmp", so_path)
+        lib = ctypes.CDLL(so_path)
+        lib.biweight_trend.argtypes = [
+            ctypes.POINTER(ctypes.c_double),
+            ctypes.c_long,
+            ctypes.c_long,
+            ctypes.c_double,
+            ctypes.POINTER(ctypes.c_double),
+        ]
+        lib.biweight_trend.restype = None
+        return lib
+    except (OSError, subprocess.CalledProcessError):
+        return None
+
+
+def _get_lib():
+    global _LIB, _TRIED
+    if not _TRIED:
+        _TRIED = True
+        if os.environ.get("DFM_NATIVE", "1") != "0":
+            _LIB = _build_and_load()
+    return _LIB
+
+
+def biweight_trend_native(data: np.ndarray, bandwidth: float) -> np.ndarray | None:
+    """Native banded biweight trend; None when the library is unavailable."""
+    lib = _get_lib()
+    if lib is None:
+        return None
+    x = np.ascontiguousarray(data, dtype=np.float64)
+    T, ns = x.shape
+    out = np.empty_like(x)
+    lib.biweight_trend(
+        x.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        ctypes.c_long(T),
+        ctypes.c_long(ns),
+        ctypes.c_double(float(bandwidth)),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+    )
+    return out

@@ -92,26 +92,32 @@ class DFMResults(NamedTuple):
 # ---------------------------------------------------------------------------
 
 
-@partial(jax.jit, static_argnames=("nfac", "max_iter", "n_constr"))
+@partial(jax.jit, static_argnames=("nfac", "nfac_o", "max_iter", "n_constr"))
 def _als_core(
     xz,  # (Tw, ns) standardized data, NaN->0
     m,  # (Tw, ns) observation mask (float)
     lam_ok,  # (ns,) series passing nt_min
-    f0,  # (Tw, nfac) PCA initialization
+    f0,  # (Tw, nfac) PCA initialization of the unobserved block
     tol_scaled,  # tol * T * ns
     nfac: int,
     max_iter: int,
     n_constr: int = 0,
     c_series=None,  # (nc,) constrained series indices
-    c_R=None,  # (nc, k, nfac)
+    c_R=None,  # (nc, k, nfac_o+nfac)
     c_r=None,  # (nc, k) standardized restriction values
+    nfac_o: int = 0,
+    fo=None,  # (Tw, nfac_o) observed factors (NaN-free in the window)
 ):
     from ..ops.pallas_gram import masked_gram
 
     W = m * lam_ok[None, :]
+    if nfac_o == 0:
+        fo = jnp.zeros((xz.shape[0], 0), xz.dtype)
 
-    def lam_step(f):
-        # per-series masked Gram (K4's Unbalanced loop) — Pallas at scale
+    def lam_step(fu):
+        # per-series masked Gram (K4's Unbalanced loop) — Pallas at scale;
+        # loadings are estimated jointly on [observed, unobserved] factors
+        f = jnp.concatenate([fo, fu], axis=1)
         A, rhs = masked_gram(f, xz, m)
         lam = jax.vmap(solve_normal)(A, rhs)
         if n_constr:
@@ -120,26 +126,29 @@ def _als_core(
         return lam
 
     def f_step(lam):
-        # per-period masked Gram: series play the reduction axis here
-        A, rhs = masked_gram(lam, xz.T, W.T)
-        f = jax.vmap(solve_normal)(A, rhs)
-        ssr = (W * (xz - f @ lam.T) ** 2).sum()
-        return f, ssr
+        # per-period masked Gram over the unobserved block only: the observed
+        # factors' contribution is subtracted from the target first
+        lam_o, lam_u = lam[:, :nfac_o], lam[:, nfac_o:]
+        xr = xz - fo @ lam_o.T
+        A, rhs = masked_gram(lam_u, xr.T, W.T)
+        fu = jax.vmap(solve_normal)(A, rhs)
+        ssr = (W * (xr - fu @ lam_u.T) ** 2).sum()
+        return fu, ssr
 
     def cond(carry):
         _, _, ssr, diff, it = carry
         return (diff >= tol_scaled) & (it < max_iter)
 
     def body(carry):
-        f, _, ssr_old, _, it = carry
-        lam = lam_step(f)
-        f, ssr = f_step(lam)
-        return f, lam, ssr, jnp.abs(ssr_old - ssr), it + 1
+        fu, _, ssr_old, _, it = carry
+        lam = lam_step(fu)
+        fu, ssr = f_step(lam)
+        return fu, lam, ssr, jnp.abs(ssr_old - ssr), it + 1
 
-    lam0 = jnp.zeros((xz.shape[1], nfac), xz.dtype)
+    lam0 = jnp.zeros((xz.shape[1], nfac_o + nfac), xz.dtype)
     init = (f0, lam0, jnp.asarray(0.0, xz.dtype), jnp.asarray(jnp.inf, xz.dtype), 0)
-    f, lam, ssr, _, n_iter = jax.lax.while_loop(cond, body, init)
-    return f, lam, ssr, n_iter
+    fu, lam, ssr, _, n_iter = jax.lax.while_loop(cond, body, init)
+    return jnp.concatenate([fo, fu], axis=1), lam, ssr, n_iter
 
 
 @jax.jit
@@ -162,18 +171,30 @@ def estimate_factor(
     constraint: LambdaConstraint | None = None,
     max_iter: int | None = None,
     compute_R2: bool = True,
+    observed_factor=None,
     backend: str | None = None,
 ):
     """Iterated-PCA factor extraction (reference cell 20, `estimate_factor!`).
 
     Window bounds are 0-based inclusive.  Returns (factor, fes) with factor
     full-length, NaN outside the window.
+
+    `observed_factor` (T, nfac_o) supplies the observed factors when
+    config.nfac_o > 0 — the FAVAR-style capability the reference declares
+    (`nfac_o`, dfm_functions.ipynb cells 6-7) but never implements: observed
+    factors enter every loading regression; only the unobserved block is
+    solved for in the F-step.  Output factor columns are ordered
+    [observed, unobserved].
     """
     if config.nfac_o:
-        raise NotImplementedError(
-            "observed factors: declared but never implemented by the reference "
-            "(dfm_functions.ipynb cell 1); pending"
-        )
+        if observed_factor is None:
+            raise ValueError("config.nfac_o > 0 requires observed_factor")
+        observed_factor = jnp.asarray(observed_factor)
+        if observed_factor.shape[1] != config.nfac_o:
+            raise ValueError(
+                f"observed_factor has {observed_factor.shape[1]} columns, "
+                f"config.nfac_o = {config.nfac_o}"
+            )
     with on_backend(backend):
         data = jnp.asarray(data)
         inclcode = np.asarray(inclcode)
@@ -191,14 +212,28 @@ def estimate_factor(
         nobs = m.sum()
         lam_ok = m.sum(axis=0) >= config.nt_min_factor
 
-        # PCA init on the fully-balanced column block (cells 9-10, 20:18-21).
+        fo_kwargs = {}
+        fo = None
+        if config.nfac_o:
+            fo = observed_factor[initperiod : lastperiod + 1].astype(xz.dtype)
+            if not bool(np.asarray(mask_of(fo).all())):
+                raise ValueError("observed_factor must be NaN-free in the window")
+            fo_kwargs = dict(nfac_o=config.nfac_o, fo=fo)
+
+        # PCA init on the fully-balanced column block (cells 9-10, 20:18-21);
+        # with observed factors, on that block's residual after projecting
+        # them out, so the unobserved block starts orthogonal to them
         balanced = np.asarray(mask.all(axis=0))
         if int(balanced.sum()) < nfac:
             raise ValueError(
                 f"nfac_u={nfac} exceeds the {int(balanced.sum())} fully-observed "
                 "series available for PCA initialization in this window"
             )
-        f0 = pca_score(xz[:, balanced], nfac)
+        xb = xz[:, balanced]
+        if fo is not None:
+            bo = solve_normal(fo.T @ fo, fo.T @ xb)
+            xb = xb - fo @ bo
+        f0 = pca_score(xb, nfac)
 
         kwargs = {}
         n_constr = 0
@@ -219,10 +254,11 @@ def estimate_factor(
             max_iter if max_iter is not None else config.max_iter,
             n_constr,
             **kwargs,
+            **fo_kwargs,
         )
 
         R2 = _r2_pass(xz, m, f, lam_ok) if compute_R2 else jnp.full(ns, jnp.nan)
-        factor = jnp.full((data.shape[0], nfac), jnp.nan, data.dtype)
+        factor = jnp.full((data.shape[0], config.nfac_t), jnp.nan, data.dtype)
         factor = factor.at[initperiod : lastperiod + 1].set(f)
         fes = FactorEstimateStats(Tw, ns, nobs, tss, ssr, R2, n_iter)
         return factor, fes
@@ -328,6 +364,7 @@ def estimate_dfm(
     config: DFMConfig = DFMConfig(),
     constraint_factor: LambdaConstraint | None = None,
     constraint_loading: LambdaConstraint | None = None,
+    observed_factor=None,
     backend: str | None = None,
 ) -> DFMResults:
     """Non-parametric DFM: factors -> loadings -> factor VAR (cell 27).
@@ -337,7 +374,13 @@ def estimate_dfm(
     """
     with on_backend(backend):
         factor, fes = estimate_factor(
-            data, inclcode, initperiod, lastperiod, config, constraint_factor
+            data,
+            inclcode,
+            initperiod,
+            lastperiod,
+            config,
+            constraint_factor,
+            observed_factor=observed_factor,
         )
         lam, r2, uar_coef, uar_ser = estimate_factor_loading(
             data, factor, initperiod, lastperiod, config, constraint_loading

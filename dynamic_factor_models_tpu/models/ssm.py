@@ -253,6 +253,10 @@ def em_step(params: SSMParams, x, mask):
     dtype = x.dtype
     m = mask.astype(dtype)
 
+    # guard caller-supplied params the same way kalman_filter does: the
+    # Cholesky recursions need Q strictly PD (M-step outputs are pre-floored,
+    # so for internal EM loops this is a no-op re-floor)
+    params = params._replace(Q=_psd_floor(params.Q))
     filt = _filter_scan(params, x, mask)
     s_sm, P_sm, lag1 = _smoother_scan(params, filt)
 

@@ -28,12 +28,18 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 try:  # jax >= 0.4.35
     from jax import shard_map
-
-    _SHARD_MAP_KW = {"check_vma": False}
 except ImportError:  # pragma: no cover - older jax
     from jax.experimental.shard_map import shard_map
 
-    _SHARD_MAP_KW = {"check_rep": False}  # pre-rename spelling of the kwarg
+# the replication-check kwarg was renamed check_rep -> check_vma on a
+# different jax version boundary than the import move, so pick by signature
+import inspect as _inspect
+
+_params = _inspect.signature(shard_map).parameters
+_SHARD_MAP_KW = (
+    {"check_vma": False} if "check_vma" in _params else {"check_rep": False}
+)
+del _inspect, _params
 
 __all__ = ["sharded_scan", "time_sharding"]
 

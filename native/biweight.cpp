@@ -1,0 +1,57 @@
+// Native ingest kernels for dynamic_factor_models_tpu.
+//
+// The biweight local-mean detrend (reference readin_functions.jl:317-348,
+// `bi_weight_filter`) is the ingest hot loop: O(T * bandwidth * ns) with a
+// per-element missing-aware renormalization.  The NumPy path materializes the
+// dense (T, T) weight matrix; this kernel streams the banded window row by
+// row (rows are contiguous in the (T, ns) panel), touching each input cell
+// O(bandwidth) times with no T x T intermediate.
+//
+// Built lazily by io/native.py with `g++ -O3 -shared -fPIC`; loaded via
+// ctypes (no pybind11 in the image).  Semantics match io/ingest.py
+// `_biweight_trend` exactly: tricube-free Tukey biweight 15/16 (1-dt^2)^2 on
+// |dt| < 1 with dt = (s - t)/bandwidth, NaN targets stay NaN, weights over
+// missing sources are dropped and the kernel renormalized.
+
+#include <algorithm>
+#include <cmath>
+#include <vector>
+
+extern "C" {
+
+void biweight_trend(const double* data, long T, long ns, double bandwidth,
+                    double* out) {
+  std::vector<double> num(static_cast<size_t>(ns));
+  std::vector<double> den(static_cast<size_t>(ns));
+  const long B = static_cast<long>(std::ceil(bandwidth));
+  for (long t = 0; t < T; ++t) {
+    std::fill(num.begin(), num.end(), 0.0);
+    std::fill(den.begin(), den.end(), 0.0);
+    const long s0 = std::max(0L, t - B);
+    const long s1 = std::min(T - 1, t + B);
+    for (long s = s0; s <= s1; ++s) {
+      const double dt = static_cast<double>(s - t) / bandwidth;
+      const double u = 1.0 - dt * dt;
+      if (u <= 0.0) continue;
+      const double w = 15.0 / 16.0 * u * u;
+      const double* row = data + s * ns;
+      // branch-free so the compiler vectorizes: v==v is false only for NaN
+      for (long j = 0; j < ns; ++j) {
+        const double v = row[j];
+        const bool good = (v == v);
+        num[static_cast<size_t>(j)] += w * (good ? v : 0.0);
+        den[static_cast<size_t>(j)] += good ? w : 0.0;
+      }
+    }
+    double* orow = out + t * ns;
+    const double* drow = data + t * ns;
+    for (long j = 0; j < ns; ++j) {
+      const size_t sj = static_cast<size_t>(j);
+      orow[j] = std::isnan(drow[j])
+                    ? std::nan("")
+                    : (den[sj] > 0.0 ? num[sj] / den[sj] : std::nan(""));
+    }
+  }
+}
+
+}  // extern "C"

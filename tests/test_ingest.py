@@ -101,6 +101,26 @@ def test_rebuild_from_xlsx_matches_cache(dataset_real):
     md = MonthlyData.from_range((1959, 1), (2014, 12), 148)
     qd = QuarterlyData.from_range((1959, 1), (2014, 4), 85)
     fresh = readin_data(md, qd, BiWeight(100.0), "Real")
-    np.testing.assert_array_equal(fresh.bpdata, dataset_real.bpdata)
+    # detrended panel: 1e-14-scale summation-order noise is allowed between
+    # the native banded biweight kernel and the NumPy matmul fallback
+    np.testing.assert_allclose(
+        fresh.bpdata, dataset_real.bpdata, rtol=1e-10, atol=1e-12, equal_nan=True
+    )
+    # pre-detrend pipeline is exactly deterministic
     np.testing.assert_array_equal(fresh.bpdata_raw, dataset_real.bpdata_raw)
     assert fresh.bpnamevec == list(dataset_real.bpnamevec)
+
+
+def test_outlier_adjustment_idempotent(rng):
+    # SURVEY.md section 4: applying the outlier rule to already-adjusted data
+    # must be a no-op (all replacement strategies clamp inside the IQR fence)
+    from dynamic_factor_models_tpu.io.ingest import _adjust_outlier
+
+    for io_method in range(5):
+        x = rng.standard_normal(200)
+        x[[10, 50, 90]] = [40.0, -35.0, 60.0]
+        once = x.copy()
+        _adjust_outlier(once, 1, io_method)
+        twice = once.copy()
+        _adjust_outlier(twice, 1, io_method)
+        np.testing.assert_array_equal(once, twice, err_msg=f"io_method={io_method}")

@@ -154,3 +154,20 @@ def test_estimate_dfm_em_end_to_end(dataset_real):
         canonical_correlations(res.factors, jnp.asarray(np.asarray(F_np)[2:224]))
     )
     assert cc[0] > 0.97
+
+
+def test_em_step_singular_q_stays_finite(rng):
+    # caller-supplied PSD-singular Q must not NaN-poison the Cholesky filter
+    # (em_step floors Q like kalman_filter/kalman_smoother do)
+    x = jnp.asarray(rng.standard_normal((60, 5)))
+    m = jnp.ones((60, 5), bool)
+    params = SSMParams(
+        lam=jnp.asarray(rng.standard_normal((5, 2))),
+        R=jnp.ones(5),
+        A=jnp.asarray([[[0.5, 0.0], [0.0, 0.0]]]),
+        Q=jnp.diag(jnp.asarray([1.0, 0.0])),
+    )
+    newp, ll = em_step(params, x, m)
+    assert np.isfinite(float(ll))
+    for v in newp:
+        assert np.isfinite(np.asarray(v)).all()
