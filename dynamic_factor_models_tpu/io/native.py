@@ -28,22 +28,45 @@ def _repo_root() -> str:
     return os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def _cpu_tag() -> str:
+    """Short fingerprint of the host CPU's ISA extensions.
+
+    -march=native output is only valid on the CPU family that built it; keying
+    the cached .so by this tag forces a rebuild when the checkout moves to a
+    different machine (shared volume, migrated VM) instead of SIGILL-ing."""
+    import hashlib
+
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith("flags"):
+                    return hashlib.sha1(line.encode()).hexdigest()[:8]
+    except OSError:
+        pass
+    import platform
+
+    return hashlib.sha1(platform.processor().encode()).hexdigest()[:8]
+
+
 def _build_and_load():
     src = os.path.join(_repo_root(), "native", "biweight.cpp")
     if not os.path.exists(src):
         return None
     build_dir = os.path.join(_repo_root(), "build")
-    so_path = os.path.join(build_dir, "libdfm_native.so")
+    so_path = os.path.join(build_dir, f"libdfm_native-{_cpu_tag()}.so")
     try:
         if not os.path.exists(so_path) or os.path.getmtime(so_path) < os.path.getmtime(src):
             os.makedirs(build_dir, exist_ok=True)
+            # per-process temp name: concurrent first-use builds must not
+            # interleave writes to the same file before the atomic rename
+            tmp = f"{so_path}.tmp.{os.getpid()}"
             subprocess.run(
                 ["g++", "-O3", "-march=native", "-funroll-loops", "-shared",
-                 "-fPIC", "-o", so_path + ".tmp", src],
+                 "-fPIC", "-o", tmp, src],
                 check=True,
                 capture_output=True,
             )
-            os.replace(so_path + ".tmp", so_path)
+            os.replace(tmp, so_path)
         lib = ctypes.CDLL(so_path)
         lib.biweight_trend.argtypes = [
             ctypes.POINTER(ctypes.c_double),

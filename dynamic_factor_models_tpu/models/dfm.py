@@ -190,10 +190,16 @@ def estimate_factor(
         if observed_factor is None:
             raise ValueError("config.nfac_o > 0 requires observed_factor")
         observed_factor = jnp.asarray(observed_factor)
-        if observed_factor.shape[1] != config.nfac_o:
+        if observed_factor.ndim != 2 or observed_factor.shape[1] != config.nfac_o:
             raise ValueError(
-                f"observed_factor has {observed_factor.shape[1]} columns, "
-                f"config.nfac_o = {config.nfac_o}"
+                f"observed_factor must be 2-D with config.nfac_o = "
+                f"{config.nfac_o} columns, got shape {observed_factor.shape}"
+            )
+        if observed_factor.shape[0] != np.asarray(data).shape[0]:
+            raise ValueError(
+                f"observed_factor must be full-length like data "
+                f"({np.asarray(data).shape[0]} rows, the window is sliced "
+                f"internally), got {observed_factor.shape[0]} rows"
             )
     with on_backend(backend):
         data = jnp.asarray(data)

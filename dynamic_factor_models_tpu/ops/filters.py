@@ -13,6 +13,7 @@ __all__ = [
     "compute_gain",
     "ma_weight",
     "baxter_king_lowpass_weight",
+    "hp_trend_weight",
 ]
 
 
@@ -54,3 +55,28 @@ def baxter_king_lowpass_weight(maxlag: int) -> jnp.ndarray:
     tmp1 = (1.0 / (jnp.pi * t1)) * jnp.sin(t1 * ombar)
     w = jnp.concatenate([tmp1[::-1], jnp.array([tmp0]), tmp1])
     return w / w.sum()
+
+
+def hp_trend_weight(maxlag: int, lam: float = 1600.0) -> jnp.ndarray:
+    """Two-sided Hodrick-Prescott trend-filter weights on the [-B, B] grid.
+
+    The reference ships these precomputed (data/hpfilter_trend.asc, 201
+    weights; Stock_Watson.ipynb cell 26) — here they are computed directly:
+    the HP trend is tau = (I + lam D'D)^{-1} y on a window of length
+    2*maxlag+1, and the middle row of that smoother matrix is the symmetric
+    weight vector applied to leads/lags of y.  Matches the shipped file to
+    float precision for maxlag=100, lam=1600 (tests/test_replication_utils.py).
+    """
+    n = 2 * maxlag + 1
+    # second-difference operator: (n-2) x n
+    D = (
+        jnp.zeros((n - 2, n))
+        .at[jnp.arange(n - 2), jnp.arange(n - 2)]
+        .set(1.0)
+        .at[jnp.arange(n - 2), jnp.arange(1, n - 1)]
+        .set(-2.0)
+        .at[jnp.arange(n - 2), jnp.arange(2, n)]
+        .set(1.0)
+    )
+    S = jnp.eye(n) + lam * (D.T @ D)
+    return jnp.linalg.solve(S, jnp.eye(n)[maxlag])

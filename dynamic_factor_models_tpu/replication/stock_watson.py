@@ -28,6 +28,7 @@ from ..ops.filters import (
     baxter_king_lowpass_weight,
     compute_bw_weight,
     compute_gain,
+    hp_trend_weight,
     ma_weight,
 )
 from ..ops.lags import detrended_year_growth
@@ -76,9 +77,11 @@ def figure1(ds, config: DFMConfig = BENCHMARK_CONFIG):
 def figure2(hp_weight_path: str | None = None):
     """Filter weights and spectral gains (cell 26).
 
-    The HP-filter weights are precomputed data shipped with the reference
-    (data/hpfilter_trend.asc); point hp_weight_path (or the
-    DFM_HP_WEIGHTS_PATH env var) at a copy to include them.
+    The reference ships the HP-filter weights as precomputed data
+    (data/hpfilter_trend.asc); here they are computed directly
+    (`ops.filters.hp_trend_weight`, matches the file to its 6-decimal
+    precision).  Pass hp_weight_path (or set DFM_HP_WEIGHTS_PATH) to use a
+    weight file instead.
     """
     maxlag = 100
     wvec = np.linspace(0.0, np.pi, 500)
@@ -87,16 +90,11 @@ def figure2(hp_weight_path: str | None = None):
         "ma40": np.asarray(ma_weight(maxlag, 40)),
         "bandpass": np.asarray(baxter_king_lowpass_weight(maxlag)),
     }
-    if hp_weight_path is None:
-        hp_weight_path = os.environ.get(
-            "DFM_HP_WEIGHTS_PATH", "/root/reference/data/hpfilter_trend.asc"
-        )
-        try:
-            weights["hp"] = np.loadtxt(hp_weight_path)
-        except OSError:
-            pass  # optional: reference data absent/unreadable on this machine
-    else:
+    hp_weight_path = hp_weight_path or os.environ.get("DFM_HP_WEIGHTS_PATH")
+    if hp_weight_path is not None:
         weights["hp"] = np.loadtxt(hp_weight_path)
+    else:
+        weights["hp"] = np.asarray(hp_trend_weight(maxlag))
     gains = {
         k: np.asarray(compute_gain(jnp.asarray(w), jnp.asarray(wvec)))
         for k, w in weights.items()

@@ -65,3 +65,18 @@ def test_observed_factor_validation():
         estimate_factor(
             x, np.ones(x.shape[1]), 0, x.shape[0] - 1, cfg, observed_factor=fo_nan
         )
+
+
+def test_observed_factor_shape_validation():
+    x, fo, _ = _dgp()
+    cfg = DFMConfig(nfac_o=1, nfac_u=1)
+    with pytest.raises(ValueError, match="2-D"):
+        estimate_factor(
+            x, np.ones(x.shape[1]), 0, x.shape[0] - 1, cfg,
+            observed_factor=fo[:, 0],  # 1-D slice: clear error, not IndexError
+        )
+    with pytest.raises(ValueError, match="full-length"):
+        estimate_factor(
+            x, np.ones(x.shape[1]), 5, x.shape[0] - 1, cfg,
+            observed_factor=fo[5:],  # window-length instead of full-length
+        )

@@ -66,3 +66,22 @@ def test_checkpoint_rejects_mismatched_template(tmp_path):
     save_pytree(str(tmp_path / "x.npz"), {"a": np.ones(3)})
     with pytest.raises(ValueError, match="leaves"):
         load_pytree(str(tmp_path / "x.npz"), {"a": np.ones(3), "b": np.ones(2)})
+
+
+def test_hp_trend_weight_matches_reference_file():
+    # computed HP smoother weights vs the data file the reference ships
+    # (6 printed decimals => tolerance 5e-7); skip if the file is absent
+    import os
+
+    from dynamic_factor_models_tpu.ops.filters import hp_trend_weight
+
+    path = "/root/reference/data/hpfilter_trend.asc"
+    if not os.path.exists(path):
+        pytest.skip("reference HP weight file not present")
+    ref = np.loadtxt(path)
+    w = np.asarray(hp_trend_weight(100))
+    assert w.shape == ref.shape
+    np.testing.assert_allclose(w, ref, atol=5.1e-7)
+    # and the analytic properties: symmetric, sums to 1
+    np.testing.assert_allclose(w, w[::-1], rtol=1e-10)
+    np.testing.assert_allclose(w.sum(), 1.0, rtol=1e-10)
