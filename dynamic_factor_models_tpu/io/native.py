@@ -60,13 +60,17 @@ def _build_and_load():
             # per-process temp name: concurrent first-use builds must not
             # interleave writes to the same file before the atomic rename
             tmp = f"{so_path}.tmp.{os.getpid()}"
-            subprocess.run(
-                ["g++", "-O3", "-march=native", "-funroll-loops", "-shared",
-                 "-fPIC", "-o", tmp, src],
-                check=True,
-                capture_output=True,
-            )
-            os.replace(tmp, so_path)
+            try:
+                subprocess.run(
+                    ["g++", "-O3", "-march=native", "-funroll-loops", "-shared",
+                     "-fPIC", "-o", tmp, src],
+                    check=True,
+                    capture_output=True,
+                )
+                os.replace(tmp, so_path)
+            finally:
+                if os.path.exists(tmp):  # failed build: no orphaned artifacts
+                    os.remove(tmp)
         lib = ctypes.CDLL(so_path)
         lib.biweight_trend.argtypes = [
             ctypes.POINTER(ctypes.c_double),

@@ -26,6 +26,7 @@ from .ssm import (
     kalman_filter,
     kalman_smoother,
 )
-from .favar import BootstrapIRFs, wild_bootstrap_irfs
+from .favar import BootstrapIRFs, wild_bootstrap_irfs, wild_bootstrap_irfs_resumable
 from .dynpca import DynamicPCAResults, dynamic_pca, spectral_density
 from .multilevel import MultilevelResults, estimate_multilevel_dfm
+from .forecast import DFMForecast, forecast_factors, forecast_series, nowcast_ssm

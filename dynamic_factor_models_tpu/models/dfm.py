@@ -85,6 +85,7 @@ class DFMResults(NamedTuple):
     r2: jnp.ndarray  # (ns,) loading-regression R^2
     fes: FactorEstimateStats
     var: VARResults | None  # factor-evolution VAR
+    lam_const: jnp.ndarray | None = None  # (ns,) loading-regression intercepts
 
 
 # ---------------------------------------------------------------------------
@@ -322,7 +323,8 @@ def _loading_core(
     r2 = jnp.where(ok, r2, jnp.nan)
     coef = jnp.where(ok[:, None], coef, jnp.nan)
     ser = jnp.where(ok, ser, jnp.nan)
-    return lam, r2, coef, ser
+    const = jnp.where(ok, b[:, -1], jnp.nan)
+    return lam, r2, coef, ser, const
 
 
 def estimate_factor_loading(
@@ -337,7 +339,8 @@ def estimate_factor_loading(
     """Full-sample loadings + idiosyncratic AR(n_uarlag) per series (cell 21).
 
     Runs over ALL panel columns (not just inclcode==1).  Returns
-    (lam, r2, uar_coef, uar_ser).
+    (lam, r2, uar_coef, uar_ser, const) with const the regression intercepts
+    (the level term the forecasting layer needs).
     """
     with on_backend(backend):
         data = jnp.asarray(data)
@@ -388,13 +391,13 @@ def estimate_dfm(
             constraint_factor,
             observed_factor=observed_factor,
         )
-        lam, r2, uar_coef, uar_ser = estimate_factor_loading(
+        lam, r2, uar_coef, uar_ser, lam_const = estimate_factor_loading(
             data, factor, initperiod, lastperiod, config, constraint_loading
         )
         var = estimate_var(
             factor, config.n_factorlag, initperiod, lastperiod, withconst=True
         )
-        return DFMResults(factor, lam, uar_coef, uar_ser, r2, fes, var)
+        return DFMResults(factor, lam, uar_coef, uar_ser, r2, fes, var, lam_const)
 
 
 def compute_series(results: DFMResults, series_idx) -> jnp.ndarray:

@@ -1,0 +1,163 @@
+"""DFM forecasting and ragged-edge nowcasting.
+
+New capability beyond the reference (which estimates factors and IRFs but
+never forecasts): the standard Stock-Watson diffusion-index forecasting
+recipe on top of the non-parametric DFM, and Kalman-prediction nowcasting on
+top of the state-space DFM (Banbura-Modugno style: the masked filter walks
+through a ragged-edge panel — series released at different delays — and the
+state prediction fills the missing tail).
+
+TPU design: both horizons are ``lax.scan`` recursions over static shapes;
+per-series idiosyncratic AR forecasts are one vmapped scan.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.masking import fillz, mask_of
+from ..utils.backend import on_backend
+from .dfm import DFMResults
+from .ssm import SSMParams, _companion, _filter_scan
+from .var import VARResults
+
+__all__ = ["DFMForecast", "forecast_factors", "forecast_series", "nowcast_ssm"]
+
+
+class DFMForecast(NamedTuple):
+    factor: jnp.ndarray  # (h, nfac) factor forecasts
+    common: jnp.ndarray  # (h, ns) common-component forecasts lam f + const
+    idio: jnp.ndarray  # (h, ns) idiosyncratic AR forecasts
+    series: jnp.ndarray  # (h, ns) common + idio
+
+
+def forecast_factors(var: VARResults, factor, h: int) -> jnp.ndarray:
+    """h-step factor forecasts by iterating the estimated companion form.
+
+    `factor` is the (T, nfac) factor matrix (NaN outside the estimation
+    window); the last `nlag` complete rows seed the recursion.
+    """
+    f = jnp.asarray(factor)
+    nfac = f.shape[1]
+    nlag = var.nlag
+    complete = np.asarray(mask_of(f).all(axis=1))
+    last = int(np.max(np.nonzero(complete)[0]))
+    if last + 1 < nlag or not complete[last - nlag + 1 : last + 1].all():
+        raise ValueError(f"need {nlag} complete trailing factor rows to forecast")
+    lags = f[last - nlag + 1 : last + 1][::-1]  # (nlag, nfac), most recent first
+
+    if var.betahat.shape[0] != 1 + nfac * nlag:
+        raise ValueError(
+            f"betahat has {var.betahat.shape[0]} rows; forecast_factors needs "
+            f"the const-first layout 1 + nfac*nlag = {1 + nfac * nlag} "
+            "(fit the VAR with withconst=True)"
+        )
+    const = var.betahat[0]
+    blocks = [var.betahat[1 + i * nfac : 1 + (i + 1) * nfac].T for i in range(nlag)]
+
+    def step(lags, _):
+        f_next = const
+        for i in range(nlag):
+            f_next = f_next + blocks[i] @ lags[i]
+        return jnp.concatenate([f_next[None], lags[:-1]], axis=0), f_next
+
+    _, path = jax.lax.scan(step, lags, None, length=h)
+    return path
+
+
+def _forecast_idio(resid_hist, coef, h: int):
+    """Per-series AR(p) forecasts from the residual history (vmapped scan).
+
+    resid_hist: (p, ns) most-recent-first residuals; coef: (ns, p).
+    Series with NaN coefficients (below nt_min, or zeroed degenerate fits)
+    forecast zero.
+    """
+    coef = jnp.nan_to_num(coef)
+    hist = jnp.nan_to_num(resid_hist)
+
+    def step(hist, _):
+        e_next = (coef * hist.T).sum(axis=1)  # (ns,)
+        return jnp.concatenate([e_next[None], hist[:-1]], axis=0), e_next
+
+    _, path = jax.lax.scan(step, hist, None, length=h)
+    return path
+
+
+def forecast_series(
+    results: DFMResults,
+    data,
+    initperiod: int,
+    lastperiod: int,
+    h: int,
+    backend: str | None = None,
+) -> DFMForecast:
+    """Diffusion-index h-step forecasts for every series in the panel.
+
+    series = (lam f_{T+h} + const) + AR(n_uarlag) idiosyncratic forecast,
+    with the idiosyncratic history rebuilt from the estimation window.
+    Requires `results` from `estimate_dfm` (needs var + lam_const).
+    """
+    if results.var is None or results.lam_const is None:
+        raise ValueError("forecast_series needs DFMResults from estimate_dfm")
+    with on_backend(backend):
+        fpath = forecast_factors(results.var, results.factor, h)
+        lam = jnp.nan_to_num(results.lam)
+        const = jnp.nan_to_num(results.lam_const)
+        common = fpath @ lam.T + const[None, :]
+
+        # idiosyncratic residual history over the window tail
+        data = jnp.asarray(data)
+        yw = data[initperiod : lastperiod + 1]
+        fw = jnp.asarray(results.factor)[initperiod : lastperiod + 1]
+        e = jnp.where(
+            mask_of(yw) & mask_of(fw).all(axis=1)[:, None],
+            fillz(yw) - (fillz(fw) @ lam.T + const[None, :]),
+            0.0,
+        )
+        p = results.uar_coef.shape[1]
+        hist = e[-p:][::-1]  # most recent first
+        idio = _forecast_idio(hist, results.uar_coef, h)
+        # series whose loadings were never estimated (below nt_min_loading)
+        # must forecast NaN, not a silent 0 in raw data units
+        no_loading = jnp.isnan(results.lam).any(axis=1)[None, :]
+        common = jnp.where(no_loading, jnp.nan, common)
+        idio = jnp.where(no_loading, jnp.nan, idio)
+        return DFMForecast(fpath, common, idio, common + idio)
+
+
+class Nowcast(NamedTuple):
+    x_hat: jnp.ndarray  # (T + h, N) fitted/predicted panel in input units
+    factor: jnp.ndarray  # (T + h, r) filtered then predicted factors
+    filled: jnp.ndarray  # (T, N) input with missing entries replaced by x_hat
+
+
+def nowcast_ssm(params: SSMParams, x, h: int = 0, backend: str | None = None) -> Nowcast:
+    """Ragged-edge nowcast: masked Kalman filter through the panel, state
+    prediction h steps past the end, observation map applied throughout.
+
+    x is a (T, N) panel with NaN at unreleased observations (the masked
+    filter skips them — no balancing or truncation needed); the returned
+    `filled` panel replaces exactly those entries with model predictions.
+    """
+    with on_backend(backend):
+        x = jnp.asarray(x)
+        mask = mask_of(x)
+        filt = _filter_scan(params, fillz(x), mask)
+        r = params.r
+        fit = filt.means[:, :r] @ params.lam.T  # (T, N)
+
+        Tm, _ = _companion(params)
+
+        def step(s, _):
+            s2 = Tm @ s
+            return s2, s2
+
+        _, future = jax.lax.scan(step, filt.means[-1], None, length=h)
+        f_all = jnp.concatenate([filt.means[:, :r], future[:, :r]], axis=0)
+        x_hat = jnp.concatenate([fit, future[:, :r] @ params.lam.T], axis=0)
+        filled = jnp.where(mask, x, fit)
+        return Nowcast(x_hat, f_all, filled)

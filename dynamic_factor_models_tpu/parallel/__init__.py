@@ -1,1 +1,3 @@
 from .mesh import Mesh, NamedSharding, P, make_mesh, replicate, shard_over
+from .distributed import global_mesh, initialize_distributed
+from .timescan import sharded_scan, time_sharding

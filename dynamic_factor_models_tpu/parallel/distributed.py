@@ -1,0 +1,77 @@
+"""Multi-host initialization and global meshes (ICI + DCN).
+
+The reference has no distributed communication backend at all (SURVEY.md
+section 2.6); the TPU-native equivalent is JAX's built-in runtime: one
+process per host, `jax.distributed.initialize` over DCN, then a single
+global `Mesh` whose inner axes ride ICI (fast, within a slice) and whose
+outer axis spans hosts.  XLA emits every collective; there is no NCCL/MPI
+analogue to wrap.
+
+Layout guidance (the scaling-book recipe): put the embarrassing axis
+(bootstrap replications, panels) on the outer/DCN axis — its only
+collective is the final quantile/moment aggregation — and keep
+series/tensor sharding (`sp`, psum-heavy) on inner/ICI axes.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+__all__ = ["initialize_distributed", "global_mesh"]
+
+
+def initialize_distributed(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> bool:
+    """Initialize the multi-host JAX runtime; returns True if distributed.
+
+    Pass the coordinator explicitly or set JAX_COORDINATOR_ADDRESS /
+    JAX_NUM_PROCESSES / JAX_PROCESS_ID (on TPU pods num_processes and
+    process_id are then auto-detected from the metadata server).
+    Single-process runs (no coordinator configured) are a no-op so the same
+    entry point works from a laptop to a pod.
+    """
+    coordinator_address = coordinator_address or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS"
+    )
+    env_np = os.environ.get("JAX_NUM_PROCESSES")
+    num_processes = num_processes if num_processes is not None else (
+        int(env_np) if env_np else None
+    )
+    env_pid = os.environ.get("JAX_PROCESS_ID")
+    process_id = process_id if process_id is not None else (
+        int(env_pid) if env_pid else None
+    )
+    if coordinator_address is None:
+        return False  # single-process
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    return jax.process_count() > 1
+
+
+def global_mesh(axis_names=("rep",), shape=None, devices=None) -> Mesh:
+    """Mesh over all global devices (every process's chips).
+
+    Default: 1-D mesh over everything.  Pass `shape` to factor the device
+    count into named axes, e.g. shape=(n_hosts, chips_per_host) with
+    axis_names=("dp", "sp") to pin the outer axis to DCN and the inner to
+    ICI (jax.devices() orders devices process-major, so the outer axis
+    strides across hosts).
+    """
+    devs = list(jax.devices()) if devices is None else list(devices)
+    if shape is None:
+        shape = (len(devs),)
+    if int(np.prod(shape)) != len(devs):
+        raise ValueError(f"shape {shape} does not tile {len(devs)} devices")
+    if len(shape) != len(axis_names):
+        raise ValueError("axis_names and shape must have the same length")
+    return Mesh(np.asarray(devs).reshape(shape), axis_names)

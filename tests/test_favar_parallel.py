@@ -95,3 +95,19 @@ def test_bootstrap_resumable_discards_stale_checkpoint(factors, tmp_path):
         factors, nlag=4, checkpoint_path=str(tmp_path / "b2.npz"), **kw
     )
     np.testing.assert_array_equal(np.asarray(again.draws), np.asarray(fresh.draws))
+
+
+def test_distributed_helpers_single_process():
+    from dynamic_factor_models_tpu.parallel.distributed import (
+        global_mesh,
+        initialize_distributed,
+    )
+
+    # no coordinator configured: must be a no-op returning False
+    assert initialize_distributed() is False
+    mesh = global_mesh()
+    assert mesh.devices.size == len(jax.devices())
+    mesh2 = global_mesh(axis_names=("dp", "sp"), shape=(4, 2))
+    assert mesh2.shape == {"dp": 4, "sp": 2}
+    with pytest.raises(ValueError, match="tile"):
+        global_mesh(axis_names=("dp",), shape=(3,))

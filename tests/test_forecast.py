@@ -1,0 +1,107 @@
+"""Forecasting/nowcasting layer: factor-VAR forecasts, diffusion-index series
+forecasts, and ragged-edge Kalman nowcasts."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dynamic_factor_models_tpu.models.dfm import DFMConfig, estimate_dfm
+from dynamic_factor_models_tpu.models.forecast import (
+    forecast_factors,
+    forecast_series,
+    nowcast_ssm,
+)
+from dynamic_factor_models_tpu.models.ssm import SSMParams, estimate_dfm_em
+
+
+def _ar1_factor_panel(T=300, N=30, rho=0.8, seed=0):
+    rng = np.random.default_rng(seed)
+    f = np.zeros((T, 1))
+    for t in range(1, T):
+        f[t] = rho * f[t - 1] + rng.standard_normal()
+    lam = rng.standard_normal((N, 1))
+    x = f @ lam.T + 0.3 * rng.standard_normal((T, N))
+    return x, f, lam, rho
+
+
+def test_forecast_factors_ar1_decay():
+    x, f, lam, rho = _ar1_factor_panel()
+    cfg = DFMConfig(nfac_u=1, n_factorlag=1, n_uarlag=1)
+    res = estimate_dfm(x, np.ones(x.shape[1]), 0, x.shape[0] - 1, cfg)
+    h = 12
+    fpath = np.asarray(forecast_factors(res.var, res.factor, h))
+    assert fpath.shape == (h, 1)
+    # AR(1) factor forecasts decay geometrically toward the mean at rate
+    # ~rho: successive forecast ratios approach the estimated persistence
+    dev = fpath[:, 0] - fpath[:, 0][-1]
+    b1 = float(res.var.betahat[1, 0])
+    assert abs(b1) < 1.0
+    ratios = dev[1:6] / dev[:5]
+    np.testing.assert_allclose(ratios, b1, atol=0.15)
+
+
+def test_forecast_series_shapes_and_consistency():
+    x, *_ = _ar1_factor_panel(seed=1)
+    cfg = DFMConfig(nfac_u=1, n_factorlag=2, n_uarlag=2)
+    res = estimate_dfm(x, np.ones(x.shape[1]), 0, x.shape[0] - 1, cfg)
+    fc = forecast_series(res, x, 0, x.shape[0] - 1, h=8)
+    assert fc.series.shape == (8, x.shape[1])
+    np.testing.assert_allclose(
+        np.asarray(fc.series), np.asarray(fc.common + fc.idio), rtol=1e-12
+    )
+    assert np.isfinite(np.asarray(fc.series)).all()
+    # forecasts stay within a sane multiple of the sample range
+    assert np.abs(np.asarray(fc.series)).max() < 10 * np.abs(x).max()
+
+
+def test_nowcast_fills_ragged_edge():
+    x, f, lam, rho = _ar1_factor_panel(T=200, N=20, seed=2)
+    cfg = DFMConfig(nfac_u=1, n_factorlag=1, n_uarlag=1)
+    # ragged edge: last 2 periods of the second half of series unreleased
+    x_ragged = x.copy()
+    x_ragged[-2:, 10:] = np.nan
+    em = estimate_dfm_em(x_ragged, np.ones(x.shape[1]), 0, x.shape[0] - 1,
+                         cfg, max_em_iter=30)
+    # nowcast on the standardized panel the EM model was fitted to
+    xw = (x_ragged - np.nanmean(x_ragged, axis=0)) / np.asarray(em.stds)
+    nc = nowcast_ssm(em.params, xw, h=2)
+    assert nc.x_hat.shape == (202, 20)
+    filled = np.asarray(nc.filled)
+    assert np.isfinite(filled).all()
+    # the filled ragged corner correlates with the truth it never saw
+    truth = ((x - np.nanmean(x_ragged, axis=0)) / np.asarray(em.stds))[-2:, 10:]
+    pred = filled[-2:, 10:]
+    corr = np.corrcoef(truth.ravel(), pred.ravel())[0, 1]
+    assert corr > 0.5, f"nowcast uninformative: corr={corr}"
+
+
+def test_forecast_requires_full_results():
+    x, *_ = _ar1_factor_panel(T=120, N=10)
+    cfg = DFMConfig(nfac_u=1, n_factorlag=1, n_uarlag=1)
+    res = estimate_dfm(x, np.ones(x.shape[1]), 0, x.shape[0] - 1, cfg)
+    bad = res._replace(var=None)
+    with pytest.raises(ValueError, match="estimate_dfm"):
+        forecast_series(bad, x, 0, x.shape[0] - 1, h=2)
+
+
+def test_forecast_nan_for_unestimated_series():
+    # a series too short for a loading must forecast NaN, not a silent 0
+    x, *_ = _ar1_factor_panel(T=200, N=12, seed=4)
+    x[:-20, 5] = np.nan  # only 20 obs < nt_min_loading=40
+    cfg = DFMConfig(nfac_u=1, n_factorlag=1, n_uarlag=1)
+    res = estimate_dfm(x, np.ones(x.shape[1]), 0, x.shape[0] - 1, cfg)
+    assert np.isnan(np.asarray(res.lam)[5]).all()
+    fc = forecast_series(res, x, 0, x.shape[0] - 1, h=4)
+    s = np.asarray(fc.series)
+    assert np.isnan(s[:, 5]).all()
+    other = np.delete(s, 5, axis=1)
+    assert np.isfinite(other).all()
+
+
+def test_forecast_factors_rejects_noconst_var():
+    from dynamic_factor_models_tpu.models.var import estimate_var
+
+    x, f, _, _ = _ar1_factor_panel(T=150, N=8)
+    var_nc = estimate_var(jnp.asarray(f), 1, 0, f.shape[0] - 1, withconst=False)
+    with pytest.raises(ValueError, match="withconst"):
+        forecast_factors(var_nc, f, 4)
