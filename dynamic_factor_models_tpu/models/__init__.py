@@ -29,4 +29,10 @@ from .ssm import (
 from .favar import BootstrapIRFs, wild_bootstrap_irfs, wild_bootstrap_irfs_resumable
 from .dynpca import DynamicPCAResults, dynamic_pca, spectral_density
 from .multilevel import MultilevelResults, estimate_multilevel_dfm
-from .forecast import DFMForecast, forecast_factors, forecast_series, nowcast_ssm
+from .forecast import (
+    DFMForecast,
+    forecast_factors,
+    forecast_series,
+    nowcast_em,
+    nowcast_ssm,
+)

@@ -22,10 +22,16 @@ import numpy as np
 from ..ops.masking import fillz, mask_of
 from ..utils.backend import on_backend
 from .dfm import DFMResults
-from .ssm import SSMParams, _companion, _filter_scan
+from .ssm import EMResults, SSMParams, _companion, kalman_filter
 from .var import VARResults
 
-__all__ = ["DFMForecast", "forecast_factors", "forecast_series", "nowcast_ssm"]
+__all__ = [
+    "DFMForecast",
+    "forecast_factors",
+    "forecast_series",
+    "nowcast_ssm",
+    "nowcast_em",
+]
 
 
 class DFMForecast(NamedTuple):
@@ -45,6 +51,8 @@ def forecast_factors(var: VARResults, factor, h: int) -> jnp.ndarray:
     nfac = f.shape[1]
     nlag = var.nlag
     complete = np.asarray(mask_of(f).all(axis=1))
+    if not complete.any():
+        raise ValueError("factor matrix has no complete rows to forecast from")
     last = int(np.max(np.nonzero(complete)[0]))
     if last + 1 < nlag or not complete[last - nlag + 1 : last + 1].all():
         raise ValueError(f"need {nlag} complete trailing factor rows to forecast")
@@ -109,17 +117,24 @@ def forecast_series(
         const = jnp.nan_to_num(results.lam_const)
         common = fpath @ lam.T + const[None, :]
 
-        # idiosyncratic residual history over the window tail
+        # idiosyncratic residual history: per series, the p most RECENT
+        # observed residuals — positional tail rows would seed the AR with
+        # fabricated zeros for ragged-edge series (released with a delay)
         data = jnp.asarray(data)
         yw = data[initperiod : lastperiod + 1]
         fw = jnp.asarray(results.factor)[initperiod : lastperiod + 1]
-        e = jnp.where(
-            mask_of(yw) & mask_of(fw).all(axis=1)[:, None],
-            fillz(yw) - (fillz(fw) @ lam.T + const[None, :]),
-            0.0,
-        )
+        W = mask_of(yw) & mask_of(fw).all(axis=1)[:, None]
+        e = jnp.where(W, fillz(yw) - (fillz(fw) @ lam.T + const[None, :]), 0.0)
         p = results.uar_coef.shape[1]
-        hist = e[-p:][::-1]  # most recent first
+        Tw = e.shape[0]
+
+        def last_p(e_i, w_i):
+            score = jnp.where(w_i, jnp.arange(Tw), -1)
+            idx, _ = jax.lax.top_k(score, p)  # most recent observed first
+            vals = e_i[jnp.clip(idx, 0)]
+            return jnp.where(idx >= 0, vals, 0.0)
+
+        hist = jax.vmap(last_p, in_axes=(1, 1), out_axes=1)(e, W)  # (p, ns)
         idio = _forecast_idio(hist, results.uar_coef, h)
         # series whose loadings were never estimated (below nt_min_loading)
         # must forecast NaN, not a silent 0 in raw data units
@@ -146,7 +161,8 @@ def nowcast_ssm(params: SSMParams, x, h: int = 0, backend: str | None = None) ->
     with on_backend(backend):
         x = jnp.asarray(x)
         mask = mask_of(x)
-        filt = _filter_scan(params, fillz(x), mask)
+        # public filter: applies the PSD floor on Q and the NaN prefill
+        filt = kalman_filter(params, x)
         r = params.r
         fit = filt.means[:, :r] @ params.lam.T  # (T, N)
 
@@ -161,3 +177,39 @@ def nowcast_ssm(params: SSMParams, x, h: int = 0, backend: str | None = None) ->
         x_hat = jnp.concatenate([fit, future[:, :r] @ params.lam.T], axis=0)
         filled = jnp.where(mask, x, fit)
         return Nowcast(x_hat, f_all, filled)
+
+
+def nowcast_em(
+    em: EMResults,
+    data,
+    inclcode,
+    initperiod: int,
+    lastperiod: int,
+    h: int = 0,
+    backend: str | None = None,
+) -> Nowcast:
+    """Ragged-edge nowcast in ORIGINAL data units, from `estimate_dfm_em`.
+
+    Handles the bookkeeping `nowcast_ssm` leaves to the caller: subsets to
+    the inclcode==1 columns the EM model was fitted on, standardizes with the
+    fit's per-series means/stds, filters + predicts, and rescales every
+    output back to input units.
+    """
+    with on_backend(backend):
+        data = jnp.asarray(data)
+        inclcode = np.asarray(inclcode)
+        xw = data[initperiod : lastperiod + 1][:, inclcode == 1]
+        if xw.shape[1] != em.params.lam.shape[0]:
+            raise ValueError(
+                f"panel has {xw.shape[1]} included columns but the EM model "
+                f"was fitted on {em.params.lam.shape[0]}"
+            )
+        xz = (xw - em.means[None, :]) / em.stds[None, :]
+        nc = nowcast_ssm(em.params, xz, h=h)
+        scale = em.stds[None, :]
+        shift = em.means[None, :]
+        return Nowcast(
+            x_hat=nc.x_hat * scale + shift,
+            factor=nc.factor,
+            filled=jnp.where(mask_of(xw), xw, nc.filled * scale + shift),
+        )

@@ -1,0 +1,140 @@
+"""Render the Stock-Watson replication figures to PNG.
+
+Covers the reference's plot helpers (S13: `plot_skipmissing`,
+`compare_series!`, Stock_Watson.ipynb cells 21-22) the array-first way: the
+figure*() functions in `stock_watson.py` return data; this module draws it
+with matplotlib when a rendered artifact is wanted.  NaN gaps are native to
+matplotlib lines, which is exactly what `plot_skipmissing` hand-rolled.
+
+Styling: categorical series colors in fixed order from a CVD-validated
+palette; one y-axis per panel; thin (2px) lines; recessive grid; legends
+whenever a panel has >= 2 series.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+# fixed-order categorical palette (validated default; see docs/PARITY.md)
+SERIES_COLORS = ["#2a78d6", "#eb6834", "#1baf7a", "#eda100"]
+TEXT_PRIMARY = "#0b0b0b"
+TEXT_SECONDARY = "#52514e"
+SURFACE = "#fcfcfb"
+GRID = "#e4e3df"
+
+__all__ = ["render_all", "line_panel"]
+
+
+def _style_axis(ax, title):
+    ax.set_facecolor(SURFACE)
+    ax.grid(True, color=GRID, linewidth=0.8, zorder=0)
+    for side in ("top", "right"):
+        ax.spines[side].set_visible(False)
+    for side in ("left", "bottom"):
+        ax.spines[side].set_color(GRID)
+    ax.tick_params(colors=TEXT_SECONDARY, labelsize=8)
+    ax.set_title(title, color=TEXT_PRIMARY, fontsize=10, loc="left")
+
+
+def line_panel(ax, x, series: dict, title: str):
+    """One panel of NaN-gapped 2px lines, fixed-order colors, legend if >=2."""
+    for i, (name, y) in enumerate(series.items()):
+        ax.plot(
+            x,
+            np.asarray(y, float),
+            label=name,
+            color=SERIES_COLORS[i % len(SERIES_COLORS)],
+            linewidth=2.0,
+            zorder=2 + i,
+        )
+    _style_axis(ax, title)
+    if len(series) >= 2:
+        ax.legend(
+            loc="upper left",
+            frameon=False,
+            fontsize=8,
+            labelcolor=TEXT_SECONDARY,
+        )
+
+
+def render_all(out_dir: str, fast: bool = True, path: str | None = None) -> list[str]:
+    """Compute (stock_watson.run_all) and render every figure; returns paths."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    from . import stock_watson as sw
+
+    os.makedirs(out_dir, exist_ok=True)
+    ds_real, ds_all = sw.load_datasets(path)
+    written = []
+
+    def save(fig, name):
+        p = os.path.join(out_dir, name)
+        fig.savefig(p, dpi=150, facecolor=SURFACE, bbox_inches="tight")
+        plt.close(fig)
+        written.append(p)
+
+    # Figure 1: per-series detrended 4q growth vs 1-factor common component
+    f1 = sw.figure1(ds_real)
+    fig, axes = plt.subplots(2, 2, figsize=(10, 6))
+    for ax, (name, d) in zip(axes.ravel(), f1["series"].items()):
+        line_panel(
+            ax, f1["year"], {"actual": d["actual"], "common": d["common"]}, name
+        )
+    save(fig, "figure1.png")
+
+    # Figure 2: filter weights and spectral gains (4 filters, fixed order)
+    f2 = sw.figure2()
+    fig, (ax1, ax2) = plt.subplots(1, 2, figsize=(10, 4))
+    line_panel(ax1, f2["laglead"], f2["weights"], "filter weights")
+    line_panel(ax2, f2["frequencies"], f2["gains"], "spectral gains")
+    save(fig, "figure2.png")
+
+    # Figure 4: GDP growth vs common component for r in {1, 3, 5}
+    f4 = sw.figure4(ds_real)
+    fig, ax = plt.subplots(figsize=(10, 4))
+    series = {"GDP": f4["gdp_growth"]}
+    series.update(
+        {k.replace("common_", ""): v for k, v in f4.items()
+         if k.startswith("common_")}
+    )
+    line_panel(ax, f4["year"], series, "GDP 4q growth vs common component")
+    save(fig, "figure4.png")
+
+    # Figure 5: first factor, full vs pre-84 vs post-84
+    f5 = sw.figure5(ds_real)
+    fig, ax = plt.subplots(figsize=(10, 4))
+    line_panel(
+        ax,
+        f5["year"],
+        {k: f5[k] for k in ("full", "pre", "post")},
+        "first factor: full vs split samples",
+    )
+    save(fig, "figure5.png")
+
+    # Figure 6: cumulative trace R2 by r, three samples
+    f6 = sw.figure6(ds_all, max_r=15 if fast else 60)
+    fig, ax = plt.subplots(figsize=(10, 4))
+    r_grid = 1 + np.arange(len(f6["all"]))
+    line_panel(ax, r_grid, f6, "cumulative trace R2 by number of factors")
+    save(fig, "figure6.png")
+
+    # Figure 7: oil price vs unit-loading constrained common component
+    f7 = sw.figure7(ds_all)
+    fig, ax = plt.subplots(figsize=(10, 4))
+    line_panel(
+        ax,
+        f7["year"],
+        {
+            f7["names"][0]: f7["oil_prices"][:, 0],
+            "common component": f7["common_component"],
+        },
+        "oil-price inflation vs constrained common component",
+    )
+    save(fig, "figure7.png")
+
+    return written

@@ -105,3 +105,44 @@ def test_forecast_factors_rejects_noconst_var():
     var_nc = estimate_var(jnp.asarray(f), 1, 0, f.shape[0] - 1, withconst=False)
     with pytest.raises(ValueError, match="withconst"):
         forecast_factors(var_nc, f, 4)
+
+
+def test_nowcast_em_original_units():
+    # the high-level wrapper standardizes/rescales itself: filled values for
+    # a blanked corner land near the raw truth, and observed cells pass through
+    x, f, lam, rho = _ar1_factor_panel(T=200, N=20, seed=5)
+    x = x * 7.0 + 3.0  # far from standardized units
+    from dynamic_factor_models_tpu.models.forecast import nowcast_em
+
+    x_ragged = x.copy()
+    x_ragged[-2:, 10:] = np.nan
+    cfg = DFMConfig(nfac_u=1, n_factorlag=1, n_uarlag=1)
+    em = estimate_dfm_em(x_ragged, np.ones(x.shape[1]), 0, x.shape[0] - 1,
+                         cfg, max_em_iter=30)
+    nc = nowcast_em(em, x_ragged, np.ones(x.shape[1]), 0, x.shape[0] - 1, h=1)
+    filled = np.asarray(nc.filled)
+    # observed entries untouched
+    obs = np.isfinite(x_ragged)
+    np.testing.assert_allclose(filled[obs], x_ragged[obs])
+    # blanked corner predicted in raw units, correlated with the truth
+    pred, truth = filled[-2:, 10:].ravel(), x[-2:, 10:].ravel()
+    assert np.corrcoef(pred, truth)[0, 1] > 0.5
+    assert abs(np.mean(pred) - np.mean(truth)) < 5.0  # right scale, not z-units
+
+
+def test_forecast_ragged_edge_seeds_from_observed_residuals():
+    # a series with a 3-period release delay must seed its AR history from
+    # its last OBSERVED residual, not from fabricated zeros
+    x, *_ = _ar1_factor_panel(T=200, N=10, seed=6)
+    x[-3:, 4] = np.nan
+    cfg = DFMConfig(nfac_u=1, n_factorlag=1, n_uarlag=1)
+    res = estimate_dfm(x, np.ones(x.shape[1]), 0, x.shape[0] - 1, cfg)
+    fc = forecast_series(res, x, 0, x.shape[0] - 1, h=1)
+    # AR(1) idio forecast = coef * last observed residual; compute it by hand
+    lam = np.asarray(res.lam)[4]
+    const = float(np.asarray(res.lam_const)[4])
+    f_last = np.asarray(res.factor)[196]  # last row where series 4 observed
+    e_last = x[196, 4] - (f_last @ lam + const)
+    expected = float(np.asarray(res.uar_coef)[4, 0]) * e_last
+    np.testing.assert_allclose(float(np.asarray(fc.idio)[0, 4]), expected,
+                               rtol=1e-8)

@@ -171,3 +171,29 @@ def test_em_step_singular_q_stays_finite(rng):
     assert np.isfinite(float(ll))
     for v in newp:
         assert np.isfinite(np.asarray(v)).all()
+
+
+def test_kalman_f32_f64_parity():
+    # north-star parity bound (BASELINE.md): low-precision backend results
+    # within 1e-5 of the f64 reference on smoothed factors
+    rng2 = np.random.default_rng(7)
+    T, N, r = 150, 40, 3
+    f = np.zeros((T, r))
+    for t in range(1, T):
+        f[t] = 0.6 * f[t - 1] + rng2.standard_normal(r)
+    lam = rng2.standard_normal((N, r))
+    x = f @ lam.T + rng2.standard_normal((T, N))
+    x[rng2.random((T, N)) < 0.1] = np.nan
+
+    def run(dtype):
+        pr = SSMParams(
+            jnp.asarray(lam, dtype),
+            jnp.ones(N, dtype),
+            jnp.asarray(0.6 * np.eye(r)[None], dtype),
+            jnp.eye(r, dtype=dtype),
+        )
+        m, c, ll = kalman_smoother(pr, jnp.asarray(x, dtype))
+        return np.asarray(m[:, :r], np.float64)
+
+    drift = np.abs(run(jnp.float64) - run(jnp.float32)).max()
+    assert drift < 1e-5, f"f32 smoother drift {drift} exceeds parity bound"
