@@ -117,24 +117,28 @@ def forecast_series(
         const = jnp.nan_to_num(results.lam_const)
         common = fpath @ lam.T + const[None, :]
 
-        # idiosyncratic residual history: per series, the p most RECENT
-        # observed residuals — positional tail rows would seed the AR with
-        # fabricated zeros for ragged-edge series (released with a delay)
+        # idiosyncratic residual lag state at the forecast origin: walk the
+        # window once, substituting the AR's conditional expectation at
+        # missing rows.  This keeps lag slots aligned (no treating a lag-3
+        # residual as lag-1) and discounts release gaps correctly: a series
+        # last observed d periods ago contributes coef-iterated-(d) times,
+        # not its stale residual at full weight.
         data = jnp.asarray(data)
         yw = data[initperiod : lastperiod + 1]
         fw = jnp.asarray(results.factor)[initperiod : lastperiod + 1]
         W = mask_of(yw) & mask_of(fw).all(axis=1)[:, None]
         e = jnp.where(W, fillz(yw) - (fillz(fw) @ lam.T + const[None, :]), 0.0)
         p = results.uar_coef.shape[1]
-        Tw = e.shape[0]
+        coef = jnp.nan_to_num(results.uar_coef)  # (ns, p)
 
-        def last_p(e_i, w_i):
-            score = jnp.where(w_i, jnp.arange(Tw), -1)
-            idx, _ = jax.lax.top_k(score, p)  # most recent observed first
-            vals = e_i[jnp.clip(idx, 0)]
-            return jnp.where(idx >= 0, vals, 0.0)
+        def walk(lags, inp):
+            e_obs, w = inp  # (ns,), (ns,)
+            e_pred = jnp.einsum("ik,ki->i", coef, lags)
+            e_t = jnp.where(w, e_obs, e_pred)
+            return jnp.concatenate([e_t[None], lags[:-1]], axis=0), None
 
-        hist = jax.vmap(last_p, in_axes=(1, 1), out_axes=1)(e, W)  # (p, ns)
+        lags0 = jnp.zeros((p, e.shape[1]), e.dtype)
+        hist, _ = jax.lax.scan(walk, lags0, (e, W))
         idio = _forecast_idio(hist, results.uar_coef, h)
         # series whose loadings were never estimated (below nt_min_loading)
         # must forecast NaN, not a silent 0 in raw data units

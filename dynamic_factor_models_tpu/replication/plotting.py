@@ -60,7 +60,13 @@ def line_panel(ax, x, series: dict, title: str):
 
 
 def render_all(out_dir: str, fast: bool = True, path: str | None = None) -> list[str]:
-    """Compute (stock_watson.run_all) and render every figure; returns paths."""
+    """Compute and render Figures 1-7 to PNG; returns the written paths.
+
+    Calls the `stock_watson.figure*` / `table2` functions directly with
+    rendering-friendly settings (table2 without the O(r^2) AW refits;
+    figure6 max_r=15 when fast) — NOT the `run_all` bundle, whose dict uses
+    its own fast/full settings and also computes Tables 3-5.
+    """
     import matplotlib
 
     matplotlib.use("Agg")
@@ -93,6 +99,26 @@ def render_all(out_dir: str, fast: bool = True, path: str | None = None) -> list
     line_panel(ax1, f2["laglead"], f2["weights"], "filter weights")
     line_panel(ax2, f2["frequencies"], f2["gains"], "spectral gains")
     save(fig, "figure2.png")
+
+    # Figure 3: factor-number statistics (the scree view of Table 2)
+    t2 = sw.table2(ds_real, ds_all, dynamic=False)
+    fig, axes = plt.subplots(1, 3, figsize=(12, 3.5))
+    for ax, stat, title in zip(
+        axes,
+        ("trace_r2", "bn_icp", "ah_er"),
+        ("trace R2", "Bai-Ng ICp2", "Ahn-Horenstein ER"),
+    ):
+        series = {
+            "Real": np.asarray(t2["A"][stat]),
+            "All": np.asarray(t2["B"][stat]),
+        }
+        line_panel(
+            ax, 1 + np.arange(len(series["All"])),
+            {k: np.pad(v.astype(float), (0, len(series["All"]) - len(v)),
+                       constant_values=np.nan) for k, v in series.items()},
+            title,
+        )
+    save(fig, "figure3.png")
 
     # Figure 4: GDP growth vs common component for r in {1, 3, 5}
     f4 = sw.figure4(ds_real)

@@ -130,19 +130,20 @@ def test_nowcast_em_original_units():
     assert abs(np.mean(pred) - np.mean(truth)) < 5.0  # right scale, not z-units
 
 
-def test_forecast_ragged_edge_seeds_from_observed_residuals():
-    # a series with a 3-period release delay must seed its AR history from
-    # its last OBSERVED residual, not from fabricated zeros
+def test_forecast_ragged_edge_discounts_release_gap():
+    # a series with a 3-period release delay: the AR(1) idio forecast must be
+    # the conditional expectation coef^(d+1) * e_last — the last observed
+    # residual iterated through the 3 missing periods plus the forecast step —
+    # not coef * e_last at full weight, and not a fabricated zero
     x, *_ = _ar1_factor_panel(T=200, N=10, seed=6)
     x[-3:, 4] = np.nan
     cfg = DFMConfig(nfac_u=1, n_factorlag=1, n_uarlag=1)
     res = estimate_dfm(x, np.ones(x.shape[1]), 0, x.shape[0] - 1, cfg)
     fc = forecast_series(res, x, 0, x.shape[0] - 1, h=1)
-    # AR(1) idio forecast = coef * last observed residual; compute it by hand
     lam = np.asarray(res.lam)[4]
     const = float(np.asarray(res.lam_const)[4])
     f_last = np.asarray(res.factor)[196]  # last row where series 4 observed
     e_last = x[196, 4] - (f_last @ lam + const)
-    expected = float(np.asarray(res.uar_coef)[4, 0]) * e_last
-    np.testing.assert_allclose(float(np.asarray(fc.idio)[0, 4]), expected,
+    c = float(np.asarray(res.uar_coef)[4, 0])
+    np.testing.assert_allclose(float(np.asarray(fc.idio)[0, 4]), c**4 * e_last,
                                rtol=1e-8)
