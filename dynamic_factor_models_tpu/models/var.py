@@ -19,7 +19,15 @@ from ..ops.lags import lagmat
 from ..ops.linalg import solve_normal
 from ..ops.masking import fillz, mask_of
 
-__all__ = ["VARResults", "estimate_var", "impulse_response", "companion_matrices"]
+__all__ = [
+    "VARResults",
+    "estimate_var",
+    "impulse_response",
+    "companion_matrices",
+    "long_run_impact",
+    "impulse_response_longrun",
+    "fevd",
+]
 
 
 class VARResults(NamedTuple):
@@ -129,3 +137,52 @@ def impulse_response(var: VARResults, shock_ids, T: int) -> jnp.ndarray:
     if isinstance(shock_ids, int):
         return irfs[:, :, shock_ids]
     return irfs[:, :, jnp.asarray(shock_ids)]
+
+
+def long_run_impact(var: VARResults) -> jnp.ndarray:
+    """Blanchard-Quah long-run identification: impact matrix B with
+    C(1) B lower-triangular, B B' = seps.
+
+    New capability (the reference identifies only recursively via Cholesky,
+    cell 24): C(1) = (I - A_1 - ... - A_p)^{-1} is the long-run cumulative
+    response; B = C(1)^{-1} chol(C(1) seps C(1)') makes long-run responses of
+    earlier-ordered variables invariant to later-ordered shocks.
+    Returns the (ns, ns) structural impact in observation space.
+    """
+    ns = var.seps.shape[0]
+    # lag blocks from the companion top rows: correct for both withconst
+    # layouts (betahat's const row is padded only when withconst=True)
+    A_sum = sum(var.M[:ns, i * ns : (i + 1) * ns] for i in range(var.nlag))
+    K = jnp.eye(ns, dtype=var.seps.dtype) - A_sum
+    C1 = jnp.linalg.inv(K)
+    S = C1 @ var.seps @ C1.T
+    # B = C1^{-1} chol(S) = K chol(S): matmul, no second factorization (K is
+    # the better-conditioned operand in the near-unit-root regime)
+    return K @ jnp.linalg.cholesky(0.5 * (S + S.T))
+
+
+def _lift_impact(var: VARResults, B: jnp.ndarray) -> jnp.ndarray:
+    """(ns, ns) observation-space impact -> companion-space G."""
+    ns = var.seps.shape[0]
+    return jnp.zeros_like(var.G).at[:ns, :].set(B)
+
+
+def impulse_response_longrun(var: VARResults, T: int) -> jnp.ndarray:
+    """IRFs to long-run-identified shocks: (ns, T, nshock)."""
+    return _irf_all(var.M, var.Q, _lift_impact(var, long_run_impact(var)), T)
+
+
+def fevd(var: VARResults, T: int, impact=None) -> jnp.ndarray:
+    """Forecast-error variance decomposition over horizons 1..T.
+
+    Returns (ns, T, nshock): share of variable i's h-step forecast-error
+    variance attributed to structural shock j (rows sum to 1 over shocks at
+    every horizon).  Cholesky identification by default; pass an (ns, ns)
+    observation-space `impact` (e.g. `long_run_impact(var)`) to decompose
+    under a different identification — it is lifted to companion space here.
+    """
+    Gm = var.G if impact is None else _lift_impact(var, jnp.asarray(impact))
+    irfs = _irf_all(var.M, var.Q, Gm, T)  # (ns, T, nshock)
+    cum = jnp.cumsum(irfs**2, axis=1)  # sum over horizons of squared IRFs
+    total = cum.sum(axis=2, keepdims=True)
+    return cum / total

@@ -67,3 +67,63 @@ def test_var_lag4_companion_shape(rng):
     assert res.G.shape == (8, 2)
     # companion lower block is the shifted identity
     np.testing.assert_allclose(np.asarray(res.M)[2:, :6], np.eye(6), atol=0)
+
+
+def test_long_run_identification_properties(rng):
+    # B B' = seps, and the cumulative long-run response C(1) B is
+    # lower-triangular (the Blanchard-Quah restriction)
+    from dynamic_factor_models_tpu.models.var import (
+        estimate_var,
+        impulse_response_longrun,
+        long_run_impact,
+    )
+
+    T, ns = 400, 3
+    y = np.zeros((T, ns))
+    A1 = np.array([[0.5, 0.1, 0.0], [0.0, 0.4, 0.1], [0.1, 0.0, 0.3]])
+    for t in range(1, T):
+        y[t] = y[t - 1] @ A1.T + rng.standard_normal(ns)
+    var = estimate_var(jnp.asarray(y), 1, 0, T - 1)
+    B = np.asarray(long_run_impact(var))
+    np.testing.assert_allclose(B @ B.T, np.asarray(var.seps), atol=1e-8)
+    b = np.asarray(var.betahat)[1:].T
+    C1 = np.linalg.inv(np.eye(ns) - b)
+    lr = C1 @ B
+    assert np.abs(np.triu(lr, 1)).max() < 1e-8, "C(1)B not lower-triangular"
+    # long-run IRFs converge: cumulative response approaches C(1)B
+    irfs = np.asarray(impulse_response_longrun(var, 400))
+    np.testing.assert_allclose(irfs.sum(axis=1), lr, atol=1e-3)
+
+
+def test_fevd_shares_sum_to_one(rng):
+    from dynamic_factor_models_tpu.models.var import estimate_var, fevd
+
+    T, ns = 300, 3
+    y = np.cumsum(rng.standard_normal((T, ns)), axis=0) * 0.05 + rng.standard_normal((T, ns))
+    var = estimate_var(jnp.asarray(y), 2, 0, T - 1)
+    shares = np.asarray(fevd(var, 12))
+    assert shares.shape == (ns, 12, ns)
+    np.testing.assert_allclose(shares.sum(axis=2), 1.0, atol=1e-10)
+    assert (shares >= -1e-12).all()
+    # horizon-1 FEVD under Cholesky: first variable loaded only by shock 1
+    np.testing.assert_allclose(shares[0, 0], [1.0, 0.0, 0.0], atol=1e-10)
+
+
+def test_long_run_impact_noconst_var(rng):
+    # layout independence: withconst=False must give the same B as
+    # withconst=True on centered data (both read lag blocks from companion M)
+    from dynamic_factor_models_tpu.models.var import estimate_var, fevd, long_run_impact
+
+    T, ns = 500, 2
+    y = np.zeros((T, ns))
+    A1 = np.array([[0.5, 0.1], [0.0, 0.4]])
+    for t in range(1, T):
+        y[t] = y[t - 1] @ A1.T + rng.standard_normal(ns)
+    y = y - y.mean(axis=0)
+    B_c = np.asarray(long_run_impact(estimate_var(jnp.asarray(y), 1, withconst=True)))
+    B_nc = np.asarray(long_run_impact(estimate_var(jnp.asarray(y), 1, withconst=False)))
+    np.testing.assert_allclose(B_c, B_nc, atol=5e-3)
+    # fevd under long-run identification still sums to one
+    var = estimate_var(jnp.asarray(y), 1)
+    sh = np.asarray(fevd(var, 8, impact=long_run_impact(var)))
+    np.testing.assert_allclose(sh.sum(axis=2), 1.0, atol=1e-10)
