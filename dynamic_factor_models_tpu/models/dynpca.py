@@ -26,6 +26,7 @@ from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
+import jax.scipy.linalg as jsl
 import numpy as np
 
 from ..ops.linalg import standardize_data
@@ -37,6 +38,7 @@ __all__ = [
     "spectral_density",
     "dynamic_pca",
     "dynamic_eigenvalue_shares",
+    "one_sided_common_component",
 ]
 
 
@@ -176,3 +178,71 @@ def dynamic_eigenvalue_shares(results: DynamicPCAResults) -> np.ndarray:
     tot = ev.sum(axis=1, keepdims=True)
     cum = np.cumsum(ev, axis=1) / tot
     return cum.mean(axis=0)
+
+
+def one_sided_common_component(
+    x,
+    q: int,
+    r: int,
+    M: int = 20,
+    backend: str | None = None,
+):
+    """One-sided (real-time) common component via generalized PCA.
+
+    The two-sided filter of `dynamic_pca` is non-causal — useless at the
+    sample edge, which is where nowcasting lives.  The FHLR (2005) one-sided
+    estimator fixes this: with the common/idiosyncratic covariances from the
+    spectral step, take the r generalized eigenvectors W of
+    (Gamma_chi(0), Gamma_xi(0)) — linear combinations maximizing the
+    common/idio variance ratio — form static factors Z_t = W' x_t from
+    CURRENT observations only, and project:
+
+        chi_t|t = Gamma_chi(0) W (W' Gamma_x(0) W)^{-1} Z_t.
+
+    Returns (chi_onesided (T, N), W (N, r), proj (N, r), results): the
+    estimate is EXACTLY the contemporaneous map chi_t = proj (W' xz_t) of the
+    standardized panel — row t never touches other rows (the causality
+    guarantee, pinned by tests) — and `results` is the underlying two-sided
+    DynamicPCAResults.
+    """
+    with on_backend(backend):
+        x = jnp.asarray(x)
+        if M >= x.shape[0]:
+            raise ValueError(
+                f"lag-window half-width M={M} must be smaller than T={x.shape[0]}"
+            )
+        if not 1 <= q <= x.shape[1]:
+            raise ValueError(f"q={q} out of range for an N={x.shape[1]} panel")
+        if not 1 <= r <= x.shape[1]:
+            raise ValueError(f"r={r} static factors out of range for N={x.shape[1]}")
+        # one standardization + one spectral pass, shared with the two-sided
+        # results we also return (only the cheap lag-0 moment is recomputed)
+        xstd, _ = standardize_data(x)
+        m = mask_of(xstd).astype(xstd.dtype)
+        xz = fillz(xstd)
+        freqs, evals, cspec, cacov, chi2s, share = _dynpca_core(xz, m, M, q)
+        res = DynamicPCAResults(freqs, evals, cspec, cacov, chi2s, share, q, M)
+
+        gamma_x0 = _masked_autocovariances(xz, m, 0)[0]
+        gamma_x0 = 0.5 * (gamma_x0 + gamma_x0.T)
+        gamma_chi0 = res.common_autocov[0]
+        gamma_chi0 = 0.5 * (gamma_chi0 + gamma_chi0.T)
+        gamma_xi0 = gamma_x0 - gamma_chi0
+
+        # generalized symmetric eigenproblem via the idio Cholesky transform;
+        # floor Gamma_xi to keep it PD (it is an estimate, PSD up to error)
+        e, v = jnp.linalg.eigh(gamma_xi0)
+        eps = jnp.asarray(jnp.finfo(e.dtype).eps, e.dtype)
+        e = jnp.maximum(e, jnp.maximum(e[-1] * 16.0 * eps, eps))
+        gamma_xi0 = (v * e) @ v.T
+        L = jnp.linalg.cholesky(gamma_xi0)
+        # A = L^{-1} Gamma_chi L^{-T} via two triangular solves
+        A = jsl.solve_triangular(L, gamma_chi0, lower=True)
+        A = jsl.solve_triangular(L, A.T, lower=True).T
+        ew, U = jnp.linalg.eigh(0.5 * (A + A.T))
+        W = jsl.solve_triangular(L, U[:, ::-1][:, :r], lower=True, trans=1)  # L^{-T} U
+
+        Z = xz @ W  # (T, r) static factors, current observations only
+        proj = gamma_chi0 @ W @ jnp.linalg.pinv(W.T @ gamma_x0 @ W)
+        chi = Z @ proj.T  # (T, N)
+        return chi, W, proj, res
