@@ -29,6 +29,7 @@ from .ssm import (
 from .favar import BootstrapIRFs, wild_bootstrap_irfs, wild_bootstrap_irfs_resumable
 from .dynpca import DynamicPCAResults, dynamic_pca, spectral_density
 from .multilevel import MultilevelResults, estimate_multilevel_dfm
+from .ssm_ar import EMARResults, SSMARParams, em_step_ar, estimate_dfm_em_ar
 from .forecast import (
     DFMForecast,
     forecast_factors,

@@ -106,56 +106,79 @@ def _init_state(params: SSMParams):
     return jnp.zeros(k, params.lam.dtype), 1e2 * jnp.eye(k, dtype=params.lam.dtype)
 
 
-@jax.jit
-def _filter_scan(params: SSMParams, x, mask):
-    """Masked Kalman filter; x (T, N) NaN-free (pre-filled), mask (T, N)."""
-    Tm, Qs = _companion(params)
+def _info_filter_scan(Tm, Qs, x, mask, obs_step, s0, P0):
+    """Generic masked information-form Kalman filter (shared scan body).
+
+    `obs_step(xt, mt, sp) -> (C, rhs, ld_R, quad0, n_obs)` supplies the
+    model-specific measurement update: information matrix C = H'R⁻¹H, gain
+    right-hand side rhs = H'R⁻¹(x - H sp), the observed-rows log|R|, the
+    observation quadratic Σ (x - H sp)'R⁻¹(x - H sp), and the count.  The
+    prediction, Cholesky updates, and determinant-lemma log-likelihood are
+    identical across models (ssm.py restricted-loading form; ssm_ar.py dense
+    observation map) and live only here.
+    """
     k = Tm.shape[0]
-    r = params.r
-    lam = params.lam  # (N, r) — state loadings are [lam, 0, ..., 0]
-    s0, P0 = _init_state(params)
     dtype = x.dtype
     log2pi = jnp.asarray(np.log(2.0 * np.pi), dtype)
-
     eye_k = jnp.eye(k, dtype=dtype)
 
     def step(carry, inp):
         s, P = carry
         xt, mt = inp
-        # predict
         sp = Tm @ s
         Pp = Tm @ P @ Tm.T + Qs
         Pp = 0.5 * (Pp + Pp.T)
-        # masked information-form update (Woodbury): only first r state dims
-        # load on observations
-        rinv = mt / params.R  # (N,), 0 at missing
-        lam_r = lam * rinv[:, None]  # (N, r)
-        C = jnp.zeros((k, k), dtype).at[:r, :r].set(lam.T @ lam_r)
-        v = xt - lam @ sp[:r]  # innovation (garbage at missing; weighted by 0)
-        gain_rhs = jnp.zeros(k, dtype).at[:r].set(lam_r.T @ v)
-        # Pp is PD (Q PD ⇒ the companion prediction keeps full rank), so
-        # Cholesky replaces the eigh-based pinv and yields log-dets for free
+        C, rhs, ld_R, quad0, n_obs = obs_step(xt, mt, sp)
+        # Pp is PD (Q PD ⇒ the prediction keeps full rank), so Cholesky
+        # replaces the eigh-based pinv and yields log-dets for free
         Lp = jnp.linalg.cholesky(Pp)
         Ppinv = jsl.cho_solve((Lp, True), eye_k)
         M = Ppinv + C
         Lm = jnp.linalg.cholesky(0.5 * (M + M.T))
         Pu = jsl.cho_solve((Lm, True), eye_k)
         Pu = 0.5 * (Pu + Pu.T)
-        su = sp + Pu @ gain_rhs
+        su = sp + Pu @ rhs
         # log-likelihood via matrix determinant lemma:
-        # log|S| = sum_obs log R_ii + log|Pp| - log|Pu|
-        n_obs = mt.sum()
+        # log|S| = log|R|_obs + log|Pp| - log|Pu|
         ld_pp = 2.0 * jnp.log(jnp.diagonal(Lp)).sum()
         ld_pu = -2.0 * jnp.log(jnp.diagonal(Lm)).sum()
-        ld_R = (mt * jnp.log(params.R)).sum()
-        quad = (rinv * v * v).sum() - gain_rhs @ Pu @ gain_rhs
+        quad = quad0 - rhs @ Pu @ rhs
         ll = -0.5 * (n_obs * log2pi + ld_R + ld_pp - ld_pu + quad)
         return (su, Pu), (su, Pu, sp, Pp, ll)
 
     (_, _), (means, covs, pmeans, pcovs, lls) = jax.lax.scan(
         step, (s0, P0), (x, mask.astype(dtype))
     )
-    return KalmanResult(lls.sum(), means, covs, pmeans, pcovs)
+    return means, covs, pmeans, pcovs, lls.sum()
+
+
+@jax.jit
+def _filter_scan(params: SSMParams, x, mask):
+    """Masked Kalman filter; x (T, N) NaN-free (pre-filled), mask (T, N).
+
+    Only the first r state dims load on observations, so the measurement
+    update is the Woodbury-restricted obs_step below.
+    """
+    Tm, Qs = _companion(params)
+    k = Tm.shape[0]
+    r = params.r
+    lam = params.lam  # (N, r) — state loadings are [lam, 0, ..., 0]
+    s0, P0 = _init_state(params)
+    dtype = x.dtype
+
+    def obs_step(xt, mt, sp):
+        rinv = mt / params.R  # (N,), 0 at missing
+        lam_r = lam * rinv[:, None]  # (N, r)
+        C = jnp.zeros((k, k), dtype).at[:r, :r].set(lam.T @ lam_r)
+        v = xt - lam @ sp[:r]  # innovation (garbage at missing; weighted by 0)
+        rhs = jnp.zeros(k, dtype).at[:r].set(lam_r.T @ v)
+        ld_R = (mt * jnp.log(params.R)).sum()
+        return C, rhs, ld_R, (rinv * v * v).sum(), mt.sum()
+
+    means, covs, pmeans, pcovs, ll = _info_filter_scan(
+        Tm, Qs, x, mask, obs_step, s0, P0
+    )
+    return KalmanResult(ll, means, covs, pmeans, pcovs)
 
 
 def kalman_filter(
@@ -182,10 +205,9 @@ def kalman_filter(
         return _filter_scan(params, fillz(x), mask)
 
 
-@jax.jit
-def _smoother_scan(params: SSMParams, filt: KalmanResult):
-    """Rauch-Tung-Striebel backward pass; also returns lag-one covariances."""
-    Tm, _ = _companion(params)
+def _rts_scan(Tm, means, covs, pmeans, pcovs):
+    """Rauch-Tung-Striebel backward pass (shared scan body); also returns
+    lag-one covariances lag1[t] = Cov(s_{t+1}, s_t | T) for t = 0..T-2."""
 
     def step(carry, inp):
         s_next, P_next = carry
@@ -195,23 +217,23 @@ def _smoother_scan(params: SSMParams, filt: KalmanResult):
         J = jsl.cho_solve((jnp.linalg.cholesky(Pp_next), True), Tm @ Pu).T
         s_sm = su + J @ (s_next - sp_next)
         P_sm = Pu + J @ (P_next - Pp_next) @ J.T
-        # Cov(s_{t+1}, s_t | T) = P_{t+1|T} J_t'
         lag1 = P_next @ J.T
         return (s_sm, P_sm), (s_sm, P_sm, lag1)
 
     # iterate t = T-2 .. 0 pairing (filtered_t, predicted_{t+1}, smoothed_{t+1})
-    last = (filt.means[-1], filt.covs[-1])
-    inputs = (
-        filt.means[:-1],
-        filt.covs[:-1],
-        filt.pred_means[1:],
-        filt.pred_covs[1:],
-    )
+    last = (means[-1], covs[-1])
+    inputs = (means[:-1], covs[:-1], pmeans[1:], pcovs[1:])
     (_, _), (s_sm, P_sm, lag1) = jax.lax.scan(step, last, inputs, reverse=True)
-    means = jnp.concatenate([s_sm, filt.means[-1:]], axis=0)
-    covs = jnp.concatenate([P_sm, filt.covs[-1:]], axis=0)
-    # lag1[t] = Cov(s_{t+1}, s_t | T) for t = 0..T-2
-    return means, covs, lag1
+    s_all = jnp.concatenate([s_sm, means[-1:]], axis=0)
+    P_all = jnp.concatenate([P_sm, covs[-1:]], axis=0)
+    return s_all, P_all, lag1
+
+
+@jax.jit
+def _smoother_scan(params: SSMParams, filt: KalmanResult):
+    """RTS backward pass for the SSMParams model (shared body: _rts_scan)."""
+    Tm, _ = _companion(params)
+    return _rts_scan(Tm, filt.means, filt.covs, filt.pred_means, filt.pred_covs)
 
 
 def kalman_smoother(
