@@ -29,7 +29,13 @@ from .ssm import (
 from .favar import BootstrapIRFs, wild_bootstrap_irfs, wild_bootstrap_irfs_resumable
 from .dynpca import DynamicPCAResults, dynamic_pca, spectral_density
 from .multilevel import MultilevelResults, estimate_multilevel_dfm
-from .ssm_ar import EMARResults, SSMARParams, em_step_ar, estimate_dfm_em_ar
+from .ssm_ar import (
+    EMARResults,
+    SSMARParams,
+    em_step_ar,
+    estimate_dfm_em_ar,
+    nowcast_em_ar,
+)
 from .forecast import (
     DFMForecast,
     forecast_factors,

@@ -154,6 +154,40 @@ class Nowcast(NamedTuple):
     filled: jnp.ndarray  # (T, N) input with missing entries replaced by x_hat
 
 
+def _predict_and_fill(
+    x_units, mask, state_means, H, Tm, r: int, h: int, scale, shift
+) -> Nowcast:
+    """Shared nowcast core: observation map over the filtered states, h-step
+    state prediction, rescale to input units, fill the missing entries.
+
+    Serves all three entry points (`nowcast_ssm`, `nowcast_em`,
+    `ssm_ar.nowcast_em_ar`); only the filter and the observation map differ
+    per model.  state_means are filtered means in standardized units.
+    """
+    fit = state_means @ H.T  # (T, N) standardized fitted values
+
+    def step(s, _):
+        s2 = Tm @ s
+        return s2, s2
+
+    _, future = jax.lax.scan(step, state_means[-1], None, length=h)
+    x_hat_z = jnp.concatenate([fit, future @ H.T], axis=0)
+    f_all = jnp.concatenate([state_means[:, :r], future[:, :r]], axis=0)
+    return Nowcast(
+        x_hat=x_hat_z * scale + shift,
+        factor=f_all,
+        filled=jnp.where(mask, x_units, fit * scale + shift),
+    )
+
+
+def _check_included_columns(xw, n_model: int) -> None:
+    if xw.shape[1] != n_model:
+        raise ValueError(
+            f"panel has {xw.shape[1]} included columns but the model was "
+            f"fitted on {n_model}"
+        )
+
+
 def nowcast_ssm(params: SSMParams, x, h: int = 0, backend: str | None = None) -> Nowcast:
     """Ragged-edge nowcast: masked Kalman filter through the panel, state
     prediction h steps past the end, observation map applied throughout.
@@ -161,26 +195,19 @@ def nowcast_ssm(params: SSMParams, x, h: int = 0, backend: str | None = None) ->
     x is a (T, N) panel with NaN at unreleased observations (the masked
     filter skips them — no balancing or truncation needed); the returned
     `filled` panel replaces exactly those entries with model predictions.
+    Works in the model's (standardized) units; `nowcast_em` handles units.
     """
     with on_backend(backend):
         x = jnp.asarray(x)
-        mask = mask_of(x)
         # public filter: applies the PSD floor on Q and the NaN prefill
         filt = kalman_filter(params, x)
-        r = params.r
-        fit = filt.means[:, :r] @ params.lam.T  # (T, N)
-
         Tm, _ = _companion(params)
-
-        def step(s, _):
-            s2 = Tm @ s
-            return s2, s2
-
-        _, future = jax.lax.scan(step, filt.means[-1], None, length=h)
-        f_all = jnp.concatenate([filt.means[:, :r], future[:, :r]], axis=0)
-        x_hat = jnp.concatenate([fit, future[:, :r] @ params.lam.T], axis=0)
-        filled = jnp.where(mask, x, fit)
-        return Nowcast(x_hat, f_all, filled)
+        H = jnp.zeros((params.lam.shape[0], Tm.shape[0]), params.lam.dtype)
+        H = H.at[:, : params.r].set(params.lam)
+        one = jnp.ones((), x.dtype)
+        return _predict_and_fill(
+            x, mask_of(x), filt.means, H, Tm, params.r, h, one, 0.0 * one
+        )
 
 
 def nowcast_em(
@@ -203,17 +230,14 @@ def nowcast_em(
         data = jnp.asarray(data)
         inclcode = np.asarray(inclcode)
         xw = data[initperiod : lastperiod + 1][:, inclcode == 1]
-        if xw.shape[1] != em.params.lam.shape[0]:
-            raise ValueError(
-                f"panel has {xw.shape[1]} included columns but the EM model "
-                f"was fitted on {em.params.lam.shape[0]}"
-            )
+        _check_included_columns(xw, em.params.lam.shape[0])
         xz = (xw - em.means[None, :]) / em.stds[None, :]
-        nc = nowcast_ssm(em.params, xz, h=h)
-        scale = em.stds[None, :]
-        shift = em.means[None, :]
-        return Nowcast(
-            x_hat=nc.x_hat * scale + shift,
-            factor=nc.factor,
-            filled=jnp.where(mask_of(xw), xw, nc.filled * scale + shift),
+        params = em.params
+        filt = kalman_filter(params, xz)
+        Tm, _ = _companion(params)
+        H = jnp.zeros((params.lam.shape[0], Tm.shape[0]), params.lam.dtype)
+        H = H.at[:, : params.r].set(params.lam)
+        return _predict_and_fill(
+            xw, mask_of(xw), filt.means, H, Tm, params.r, h,
+            em.stds[None, :], em.means[None, :],
         )

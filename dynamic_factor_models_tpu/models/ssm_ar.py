@@ -25,13 +25,19 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..ops.linalg import solve_normal, standardize_data
+from ..ops.linalg import solve_normal
 from ..ops.masking import fillz, mask_of
 from ..utils.backend import on_backend
 from .dfm import DFMConfig
 from .ssm import _info_filter_scan, _psd_floor, _rts_scan, estimate_dfm_em
 
-__all__ = ["SSMARParams", "em_step_ar", "estimate_dfm_em_ar", "EMARResults"]
+__all__ = [
+    "SSMARParams",
+    "em_step_ar",
+    "estimate_dfm_em_ar",
+    "EMARResults",
+    "nowcast_em_ar",
+]
 
 # Measurement-noise floor: the idio dynamics live in the state, so kappa is
 # a numerical regularizer, not a model parameter.  1e-3 (std ~3% of a
@@ -204,18 +210,16 @@ def estimate_dfm_em_ar(
     with on_backend(backend):
         data = jnp.asarray(data)
         inclcode = np.asarray(inclcode)
-        est = data[:, inclcode == 1]
-        xw = est[initperiod : lastperiod + 1]
-        xstd, stds = standardize_data(xw)
-        m_arr = mask_of(xstd)
-        xz = fillz(xstd)
-        mw = mask_of(xw)
-        n_mean = (fillz(xw) * mw).sum(axis=0) / mw.sum(axis=0)
-
         em0 = estimate_dfm_em(
             data, inclcode, initperiod, lastperiod, config,
             max_em_iter=25, tol=tol,
         )
+        # standardize with the init fit's own means/stds (one convention)
+        xw = data[:, inclcode == 1][initperiod : lastperiod + 1]
+        xz_nan = (xw - em0.means[None, :]) / em0.stds[None, :]
+        m_arr = mask_of(xz_nan)
+        xz = fillz(xz_nan)
+        stds, n_mean = em0.stds, em0.means
         params = SSMARParams(
             lam=em0.params.lam,
             phi=jnp.zeros(em0.params.lam.shape[0], xz.dtype),
@@ -246,4 +250,37 @@ def estimate_dfm_em_ar(
             n_iter=it,
             stds=stds,
             means=n_mean,
+        )
+
+
+def nowcast_em_ar(
+    em: EMARResults,
+    data,
+    inclcode,
+    initperiod: int,
+    lastperiod: int,
+    h: int = 0,
+    backend: str | None = None,
+):
+    """Ragged-edge nowcast in ORIGINAL units from the BM-AR fit.
+
+    Unlike the iid-noise model (forecast.nowcast_em), the filtered AR(1)
+    idiosyncratic state carries each series' persistent deviation into its
+    unreleased periods: x_hat = Lam f + e with e evolved by phi.  Returns a
+    forecast.Nowcast (x_hat (T+h, N_incl), factor, filled).
+    """
+    from .forecast import _check_included_columns, _predict_and_fill
+
+    with on_backend(backend):
+        data = jnp.asarray(data)
+        inclcode = np.asarray(inclcode)
+        xw = data[initperiod : lastperiod + 1][:, inclcode == 1]
+        _check_included_columns(xw, em.params.N)
+        xz = (xw - em.means[None, :]) / em.stds[None, :]
+        m = mask_of(xz)
+        means, _, _, _, _ = _filter_ar(em.params, fillz(xz), m)
+        Tm, _ = _transition(em.params)
+        return _predict_and_fill(
+            xw, m, means, _obs_matrix(em.params), Tm, em.params.r, h,
+            em.stds[None, :], em.means[None, :],
         )
