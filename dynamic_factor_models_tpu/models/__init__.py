@@ -36,6 +36,7 @@ from .ssm_ar import (
     estimate_dfm_em_ar,
     nowcast_em_ar,
 )
+from .mixed_freq import MFResults, MixedFreqParams, estimate_mixed_freq_dfm
 from .forecast import (
     DFMForecast,
     forecast_factors,

@@ -188,6 +188,13 @@ def _check_included_columns(xw, n_model: int) -> None:
         )
 
 
+def _iid_obs(params: SSMParams):
+    """(H, Tm) for the iid-noise model: only the first r state dims load."""
+    Tm, _ = _companion(params)
+    H = jnp.zeros((params.lam.shape[0], Tm.shape[0]), params.lam.dtype)
+    return H.at[:, : params.r].set(params.lam), Tm
+
+
 def nowcast_ssm(params: SSMParams, x, h: int = 0, backend: str | None = None) -> Nowcast:
     """Ragged-edge nowcast: masked Kalman filter through the panel, state
     prediction h steps past the end, observation map applied throughout.
@@ -201,9 +208,7 @@ def nowcast_ssm(params: SSMParams, x, h: int = 0, backend: str | None = None) ->
         x = jnp.asarray(x)
         # public filter: applies the PSD floor on Q and the NaN prefill
         filt = kalman_filter(params, x)
-        Tm, _ = _companion(params)
-        H = jnp.zeros((params.lam.shape[0], Tm.shape[0]), params.lam.dtype)
-        H = H.at[:, : params.r].set(params.lam)
+        H, Tm = _iid_obs(params)
         one = jnp.ones((), x.dtype)
         return _predict_and_fill(
             x, mask_of(x), filt.means, H, Tm, params.r, h, one, 0.0 * one
@@ -234,9 +239,7 @@ def nowcast_em(
         xz = (xw - em.means[None, :]) / em.stds[None, :]
         params = em.params
         filt = kalman_filter(params, xz)
-        Tm, _ = _companion(params)
-        H = jnp.zeros((params.lam.shape[0], Tm.shape[0]), params.lam.dtype)
-        H = H.at[:, : params.r].set(params.lam)
+        H, Tm = _iid_obs(params)
         return _predict_and_fill(
             xw, mask_of(xw), filt.means, H, Tm, params.r, h,
             em.stds[None, :], em.means[None, :],

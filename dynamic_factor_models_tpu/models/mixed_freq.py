@@ -1,0 +1,240 @@
+"""Mixed-frequency DFM: monthly factors, quarterly series as lag aggregates.
+
+The canonical nowcasting setup (Giannone-Reichlin-Small 2008 /
+Banbura-Rünstler 2011; aggregation per Mariano-Murasawa 2003) that the
+reference side-steps by averaging monthly data to quarterly in ingest
+(readin_functions.jl:83-96).  Here the panel stays at MONTHLY frequency:
+
+    monthly series:    x_it = lam_i' f_t + eps_it
+    quarterly series:  x_it = lam_i' (w_0 f_t + ... + w_4 f_{t-4}) + eps_it
+                       observed only in quarter-end months (NaN elsewhere)
+
+with w = (1, 2, 3, 2, 1)/3 the Mariano-Murasawa growth-rate aggregation
+weights and f_t a monthly VAR(p) factor process, p >= 5 so the five factor
+lags live in the state s_t = [f_t .. f_{t-p+1}].
+
+TPU design: the per-series observation rows h_i = sum_j W_ij [0..lam_i..0]
+make H dense over the first 5r state dims; the filter reuses
+ssm._info_filter_scan, and every EM M-step moment is one einsum over the
+smoothed state second moments — the cross-lag covariances E[f_{t-j} f_{t-l}']
+are just blocks of E[s s'], so no extra smoother passes are needed.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.linalg import solve_normal, standardize_data
+from ..ops.masking import fillz, mask_of
+from ..utils.backend import on_backend
+from .ssm import _companion, _info_filter_scan, _psd_floor, _rts_scan, SSMParams
+
+__all__ = ["MixedFreqParams", "em_step_mf", "estimate_mixed_freq_dfm", "MFResults"]
+
+_MM_WEIGHTS = np.array([1.0, 2.0, 3.0, 2.0, 1.0]) / 3.0  # Mariano-Murasawa
+_N_AGG = 5
+
+
+class MixedFreqParams(NamedTuple):
+    """lam: (N, r); R: (N,) idio variances; A: (p, r, r) with p >= 5;
+    Q: (r, r); agg: (N, 5) per-series aggregation weights over factor lags
+    ((1,0,0,0,0) for monthly series, Mariano-Murasawa for quarterly)."""
+
+    lam: jnp.ndarray
+    R: jnp.ndarray
+    A: jnp.ndarray
+    Q: jnp.ndarray
+    agg: jnp.ndarray
+
+    @property
+    def r(self) -> int:
+        return self.lam.shape[1]
+
+    @property
+    def p(self) -> int:
+        return self.A.shape[0]
+
+
+def _as_ssm(params: MixedFreqParams) -> SSMParams:
+    return SSMParams(params.lam, params.R, params.A, params.Q)
+
+
+def _obs_matrix(params: MixedFreqParams) -> jnp.ndarray:
+    """H (N, k): series i loads lam_i on each of the first 5 factor-lag
+    blocks, scaled by its aggregation weight."""
+    r, p = params.r, params.p
+    N = params.lam.shape[0]
+    k = r * p
+    H = jnp.zeros((N, k), params.lam.dtype)
+    for j in range(_N_AGG):
+        H = H.at[:, j * r : (j + 1) * r].set(params.agg[:, j : j + 1] * params.lam)
+    return H
+
+
+@jax.jit
+def _filter_mf(params: MixedFreqParams, x, mask):
+    Tm, Qs = _companion(_as_ssm(params))
+    H = _obs_matrix(params)
+    dtype = x.dtype
+    k = Tm.shape[0]
+    s0 = jnp.zeros(k, dtype)
+    P0 = 1e2 * jnp.eye(k, dtype=dtype)
+
+    def obs_step(xt, mt, sp):
+        rinv = mt / params.R
+        Hr = H * rinv[:, None]
+        C = H.T @ Hr
+        v = xt - H @ sp
+        rhs = Hr.T @ v
+        return C, rhs, (mt * jnp.log(params.R)).sum(), (rinv * v * v).sum(), mt.sum()
+
+    return _info_filter_scan(Tm, Qs, x, mask, obs_step, s0, P0)
+
+
+@jax.jit
+def em_step_mf(params: MixedFreqParams, x, mask):
+    """One EM iteration; returns (new_params, loglik of current params).
+
+    The aggregated regressor of series i is g_it = sum_j agg_ij f_{t-j};
+    its second moments come from the first 5r x 5r block of E[s s' | T].
+    """
+    r, p = params.r, params.p
+    rp = r * p
+    q5 = _N_AGG * r
+    m = mask.astype(x.dtype)
+    Tn = x.shape[0]
+
+    params = params._replace(Q=_psd_floor(params.Q), R=jnp.maximum(params.R, 1e-8))
+    means, covs, pmeans, pcovs, ll = _filter_mf(params, x, mask)
+    Tm, _ = _companion(_as_ssm(params))
+    s_sm, P_sm, lag1 = _rts_scan(Tm, means, covs, pmeans, pcovs)
+
+    # E[s s'] over the 5-lag factor block, reshaped to (T, 5, r, 5, r)
+    s5 = s_sm[:, :q5]
+    Ess = (
+        jnp.einsum("tk,tl->tkl", s5, s5) + P_sm[:, :q5, :q5]
+    ).reshape(Tn, _N_AGG, r, _N_AGG, r)
+    # per-series aggregated-regressor moments via the weight profile
+    # Egg_i (r, r) = sum_jl agg_ij agg_il E[f_{t-j} f_{t-l}']
+    Egg = jnp.einsum("ij,tjrls,il->tirs", params.agg, Ess, params.agg)
+    g = jnp.einsum("ij,tjr->tir", params.agg, s5.reshape(Tn, _N_AGG, r))  # E[g]
+
+    Sgg = jnp.einsum("ti,tirs->irs", m, Egg)
+    Sxg = jnp.einsum("ti,tir->ir", m * x, g)
+    lam = jax.vmap(solve_normal)(Sgg, Sxg)
+
+    resid = x - jnp.einsum("ir,tir->ti", lam, g)
+    extra = jnp.einsum("ir,tirs,is->ti", lam, Egg, lam) - jnp.einsum(
+        "ir,tir->ti", lam, g
+    ) ** 2
+    n_i = m.sum(axis=0)
+    R = ((m * (resid**2 + extra)).sum(axis=0)) / n_i
+    R = jnp.maximum(R, 1e-8)
+
+    # factor VAR + Q from the full state moments (as in ssm.em_step)
+    S11 = jnp.einsum("tr,ts->rs", s_sm[1:, :r], s_sm[1:, :r]) + P_sm[1:, :r, :r].sum(0)
+    S00 = jnp.einsum("tk,tl->kl", s_sm[:-1], s_sm[:-1]) + P_sm[:-1].sum(0)
+    S10 = jnp.einsum("tr,tk->rk", s_sm[1:, :r], s_sm[:-1]) + lag1[:, :r, :].sum(0)
+    Ak = S10 @ jnp.linalg.pinv(S00, hermitian=True)
+    Q = _psd_floor((S11 - Ak @ S10.T) / (Tn - 1))
+    A = jnp.stack([Ak[:, i * r : (i + 1) * r] for i in range(p)])
+    return MixedFreqParams(lam, R, A, Q, params.agg), ll
+
+
+class MFResults(NamedTuple):
+    params: MixedFreqParams
+    factors: jnp.ndarray  # (T, r) smoothed MONTHLY factors
+    x_hat: jnp.ndarray  # (T, N) smoothed fitted panel (standardized units)
+    loglik_path: np.ndarray
+    n_iter: int
+    stds: jnp.ndarray
+    means: jnp.ndarray
+
+
+def estimate_mixed_freq_dfm(
+    x,
+    is_quarterly,
+    r: int = 1,
+    p: int = 5,
+    max_em_iter: int = 100,
+    tol: float = 1e-6,
+    backend: str | None = None,
+) -> MFResults:
+    """Fit the mixed-frequency DFM on a MONTHLY-frequency (T, N) panel.
+
+    x: monthly panel; quarterly series carry values in quarter-end months and
+    NaN elsewhere (any extra missingness is fine — the filter masks it).
+    is_quarterly: (N,) bool.  p >= 5 is required for the aggregation lags.
+
+    `x_hat` gives the model's smoothed value of every cell — including the
+    monthly path of each quarterly series (the nowcasting readout).
+    """
+    if p < _N_AGG:
+        raise ValueError(f"p={p} must be >= {_N_AGG} for Mariano-Murasawa lags")
+    with on_backend(backend):
+        x = jnp.asarray(x)
+        is_q = np.asarray(is_quarterly, bool)
+        if is_q.shape != (x.shape[1],):
+            raise ValueError("is_quarterly must have one flag per column")
+        xstd, stds = standardize_data(x)
+        m_arr = mask_of(xstd)
+        xz = fillz(xstd)
+        mw = mask_of(x)
+        n_mean = (fillz(x) * mw).sum(axis=0) / mw.sum(axis=0)
+
+        N = x.shape[1]
+        agg = np.zeros((N, _N_AGG))
+        agg[~is_q, 0] = 1.0
+        agg[is_q] = _MM_WEIGHTS
+        dtype = xz.dtype
+
+        # init: PCA-style factor from the monthly block, zero-lag loadings
+        from ..ops.linalg import pca_score
+
+        monthly = np.nonzero(~is_q)[0]
+        if monthly.size < r:
+            raise ValueError("need at least r monthly series to initialize")
+        f0 = pca_score(jnp.where(m_arr, xz, 0.0)[:, monthly], r)
+        f0 = f0 / jnp.maximum(f0.std(axis=0), 1e-8)
+        W = m_arr.astype(dtype)
+        Sff = jnp.einsum("ti,tr,ts->irs", W, f0, f0)
+        Sxf = jnp.einsum("ti,tr->ir", W * xz, f0)
+        lam0 = jax.vmap(solve_normal)(Sff, Sxf)
+        params = MixedFreqParams(
+            lam=lam0,
+            R=jnp.ones(N, dtype),
+            A=jnp.concatenate(
+                [0.7 * jnp.eye(r, dtype=dtype)[None], jnp.zeros((p - 1, r, r), dtype)]
+            ),
+            Q=jnp.eye(r, dtype=dtype),
+            agg=jnp.asarray(agg, dtype),
+        )
+
+        llpath = []
+        ll_prev = -jnp.inf
+        it = 0
+        for it in range(1, max_em_iter + 1):
+            params, ll = em_step_mf(params, xz, m_arr)
+            ll = float(ll)
+            llpath.append(ll)
+            if it > 1 and abs(ll - ll_prev) < tol * (1.0 + abs(ll_prev)):
+                break
+            ll_prev = ll
+
+        means, covs, pmeans, pcovs, _ = _filter_mf(params, xz, m_arr)
+        Tm, _ = _companion(_as_ssm(params))
+        s_sm, _, _ = _rts_scan(Tm, means, covs, pmeans, pcovs)
+        x_hat = s_sm[:, : _N_AGG * params.r] @ _obs_matrix(params)[:, : _N_AGG * params.r].T
+        return MFResults(
+            params=params,
+            factors=s_sm[:, :r],
+            x_hat=x_hat,
+            loglik_path=np.asarray(llpath),
+            n_iter=it,
+            stds=stds,
+            means=n_mean,
+        )

@@ -278,9 +278,15 @@ def nowcast_em_ar(
         _check_included_columns(xw, em.params.N)
         xz = (xw - em.means[None, :]) / em.stds[None, :]
         m = mask_of(xz)
-        means, _, _, _, _ = _filter_ar(em.params, fillz(xz), m)
-        Tm, _ = _transition(em.params)
+        # same guard the public kalman_filter applies: a checkpoint-round-
+        # tripped or hand-built params with singular Q/sigv2 must degrade
+        # gracefully, not NaN the whole nowcast
+        params = em.params._replace(
+            Q=_psd_floor(em.params.Q), sigv2=jnp.maximum(em.params.sigv2, 1e-8)
+        )
+        means, _, _, _, _ = _filter_ar(params, fillz(xz), m)
+        Tm, _ = _transition(params)
         return _predict_and_fill(
-            xw, m, means, _obs_matrix(em.params), Tm, em.params.r, h,
+            xw, m, means, _obs_matrix(params), Tm, params.r, h,
             em.stds[None, :], em.means[None, :],
         )
