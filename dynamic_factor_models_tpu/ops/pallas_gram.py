@@ -11,17 +11,20 @@ Chow/QLR instability scans all compute, for each series n,
 The XLA path (`ops/linalg.ols_batched_series`) materializes the (T, K, K)
 outer-product tensor and the (T, N) masked panel in HBM between two
 contractions.  This kernel fuses the whole reduction: for each (series-tile,
-time-tile) grid cell it forms the outer products on the VPU in VMEM and
-feeds two MXU matmuls
+time-tile) grid cell it forms the regressor products on the VPU in VMEM and
+feeds two MXU matmuls with the series dimension in the MXU lanes
 
-    A[i]  += W_tile' (Nt x Tt) @ P_tile (Tt x K^2)
-    b[i]  += (W_tile * Y_tile)' (Nt x Tt) @ X_tile (Tt x K)
+    A[i] (K^2 x Nt)  += P_tile (K^2 x Tt) @ W_tile (Tt x Nt)
+    b[i] (K   x Nt)  += X_tile' (K x Tt) @ (W_tile * Y_tile) (Tt x Nt)
 
 accumulating in VMEM across the time grid — one pass over X, Y, W in HBM
-and no intermediate tensors.  This is the bandwidth-optimal layout for the
-large-panel regime (T, N in the thousands) the framework targets beyond the
-reference's 224 x 233 panel; at reference sizes the XLA path is already
-fine, so `masked_gram` auto-dispatches by problem size and platform.
+and no intermediate tensors.  Keeping N in the lanes matters: the transposed
+layout (series in sublanes, K^2 in lanes) measured 4-5x slower on a v5e
+because each matmul then has only K=8 useful lanes.  This is the
+bandwidth-optimal layout for the large-panel regime (T, N in the thousands)
+the framework targets beyond the reference's 224 x 233 panel; at reference
+sizes the XLA path is already fine, so `masked_gram` auto-dispatches by
+problem size and platform.
 
 Estimation code never differentiates through the normal equations, so no
 custom VJP is provided; the kernel is forward-only by design.
@@ -48,15 +51,16 @@ def _gram_kernel(x_ref, y_ref, w_ref, a_ref, b_ref):
         a_ref[:] = jnp.zeros_like(a_ref)
         b_ref[:] = jnp.zeros_like(b_ref)
 
-    x = x_ref[:]  # (Tt, K)
+    xT = x_ref[:].T  # (K, Tt)
     w = w_ref[:]  # (Tt, Nt)
     wy = w * y_ref[:]  # (Tt, Nt)
-    tt, k = x.shape
-    # outer products x_t x_t' flattened to (Tt, K*K) — VPU elementwise
-    p = (x[:, :, None] * x[:, None, :]).reshape(tt, k * k)
-    # two MXU contractions over the time tile
-    a_ref[:] += jnp.dot(w.T, p, preferred_element_type=a_ref.dtype)
-    b_ref[:] += jnp.dot(wy.T, x, preferred_element_type=b_ref.dtype)
+    k = xT.shape[0]
+    # regressor-pair products (K*K, Tt), built by concatenation — a 3D→2D
+    # reshape of the outer-product tensor is rejected by Mosaic's vector
+    # layout pass on TPU, row-broadcast products are not
+    p = jnp.concatenate([xT * xT[kk][None, :] for kk in range(k)], axis=0)
+    a_ref[:] += jnp.dot(p, w, preferred_element_type=a_ref.dtype)
+    b_ref[:] += jnp.dot(xT, wy, preferred_element_type=b_ref.dtype)
 
 
 @functools.partial(jax.jit, static_argnames=("tile_t", "tile_n", "interpret"))
@@ -66,7 +70,7 @@ def masked_gram_pallas(
     W: jnp.ndarray,
     *,
     tile_t: int = 256,
-    tile_n: int = 256,
+    tile_n: int = 512,
     interpret: bool = False,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Fused masked Gram: returns (A (N, K, K), rhs (N, K)).
@@ -94,12 +98,12 @@ def masked_gram_pallas(
             pl.BlockSpec((tile_t, tile_n), lambda i, j: (j, i), memory_space=pltpu.VMEM),
         ],
         out_specs=[
-            pl.BlockSpec((tile_n, K * K), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((tile_n, K), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((K * K, tile_n), lambda i, j: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((K, tile_n), lambda i, j: (0, i), memory_space=pltpu.VMEM),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((Np, K * K), dtype),
-            jax.ShapeDtypeStruct((Np, K), dtype),
+            jax.ShapeDtypeStruct((K * K, Np), dtype),
+            jax.ShapeDtypeStruct((K, Np), dtype),
         ],
         cost_estimate=pl.CostEstimate(
             flops=2 * Tp * Np * K * (K + 1) + Tp * K * K,
@@ -108,7 +112,7 @@ def masked_gram_pallas(
         ),
         interpret=interpret,
     )(Xp, Yp, Wp)
-    return a[:N].reshape(N, K, K), b[:N]
+    return a[:, :N].T.reshape(N, K, K), b[:, :N].T
 
 
 def masked_gram_xla(
@@ -121,9 +125,10 @@ def masked_gram_xla(
     return A, rhs
 
 
-# dispatch: the fused kernel pays off once the (T, N) panel no longer fits
-# the reduction in cache-friendly XLA fusions; tiny problems keep XLA.
-_PALLAS_MIN_CELLS = 1 << 20
+# dispatch: measured v5e crossover (bench.py harness, K=8, f32) is near
+# 512 x 512 = 2^18 cells — XLA wins 1.7x at 224x256, parity at 512x512,
+# the kernel wins 1.4-1.7x from 1024x2048 up.  1<<19 sits safely past it.
+_PALLAS_MIN_CELLS = 1 << 19
 _TPU_PLATFORMS = ("tpu", "axon")  # axon = tunneled TPU plugin
 
 

@@ -2,8 +2,13 @@
 
 Runs the kernel in interpreter mode on the CPU test mesh (SURVEY.md
 section 4: TPU kernels must be testable without TPU hardware); the compiled
-path is exercised on the real chip by bench.py.
+path runs on the real chip via a clean subprocess when one is present
+(`test_pallas_compiled_on_tpu`) and in bench.py.
 """
+
+import os
+import subprocess
+import sys
 
 import jax.numpy as jnp
 import numpy as np
@@ -38,6 +43,57 @@ def test_pallas_padding_exact(rng):
     A1, b1 = masked_gram_pallas(X, Y, W, tile_t=128, tile_n=128, interpret=True)
     np.testing.assert_allclose(np.asarray(A1), np.asarray(A0), rtol=1e-10)
     np.testing.assert_allclose(np.asarray(b1), np.asarray(b0), rtol=1e-10)
+
+
+_COMPILED_CHECK = """
+import jax, jax.numpy as jnp, numpy as np
+if jax.default_backend() not in ("tpu", "axon"):
+    print("NO_TPU"); raise SystemExit(0)
+from dynamic_factor_models_tpu.ops.pallas_gram import masked_gram_pallas, masked_gram_xla
+rng = np.random.default_rng(0)
+T, N, K = 512, 384, 8
+Xn = rng.standard_normal((T, K)); Yn = rng.standard_normal((T, N))
+Wn = (rng.random((T, N)) > 0.2).astype(float)
+X, Y, W = (jnp.asarray(a, jnp.float32) for a in (Xn, Yn, Wn))
+A, b = masked_gram_pallas(X, Y, W)   # compiled, not interpret
+jax.block_until_ready((A, b))
+A64 = np.einsum("tk,tn,tl->nkl", Xn, Wn, Xn)
+b64 = np.einsum("tk,tn->nk", Xn, Wn * Yn)
+Ax, bx = masked_gram_xla(X, Y, W)
+# the kernel must be no less accurate than the chip's own XLA einsum
+err_pallas = np.abs(np.asarray(A, np.float64) - A64).max()
+err_xla = np.abs(np.asarray(Ax, np.float64) - A64).max()
+assert err_pallas <= 4 * max(err_xla, 1e-6), (err_pallas, err_xla)
+assert np.abs(np.asarray(b, np.float64) - b64).max() <= 4 * max(
+    np.abs(np.asarray(bx, np.float64) - b64).max(), 1e-6)
+print("COMPILED_OK", err_pallas, err_xla)
+"""
+
+
+def test_pallas_compiled_on_tpu():
+    """Compiled (non-interpret) kernel correctness on real TPU hardware.
+
+    The suite itself pins JAX to CPU (conftest), so the compiled check runs
+    in a clean subprocess with the session's default platform; skipped when
+    no TPU is reachable."""
+    env = {
+        k: v
+        for k, v in os.environ.items()
+        if k not in ("JAX_PLATFORMS", "XLA_FLAGS", "JAX_ENABLE_X64")
+    }
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, "-c", _COMPILED_CHECK],
+        cwd=repo,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=1200,  # jax import + first compile is slow under suite load
+    )
+    if "NO_TPU" in proc.stdout:
+        pytest.skip("no TPU reachable in this environment")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "COMPILED_OK" in proc.stdout, proc.stdout + proc.stderr
 
 
 def test_gram_feeds_batched_ols(rng):
