@@ -30,9 +30,17 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ops.lags import uar
-from ..ops.linalg import ols_batched_series, pca_score, solve_normal, standardize_data
+from ..ops.linalg import (
+    ols_batched_series,
+    pca_score,
+    pca_score_np,
+    solve_normal,
+    standardize_data,
+    standardize_data_np,
+)
 from ..ops.masking import compact, fillz, mask_of
 from ..utils.backend import on_backend
+from ..utils.profiling import annotate
 from .constraints import LambdaConstraint, apply_constraint_batch
 from .var import VARResults, estimate_var
 
@@ -41,6 +49,7 @@ __all__ = [
     "FactorEstimateStats",
     "DFMResults",
     "estimate_factor",
+    "estimate_factor_batch",
     "estimate_factor_loading",
     "estimate_dfm",
     "compute_series",
@@ -251,24 +260,194 @@ def estimate_factor(
                 c_R=constraint.R,
                 c_r=constraint.standardized(stds),
             )
-        f, lam, ssr, n_iter = _als_core(
-            xz,
-            m,
-            lam_ok,
-            f0,
-            config.tol * Tw * ns,
-            nfac,
-            max_iter if max_iter is not None else config.max_iter,
-            n_constr,
-            **kwargs,
-            **fo_kwargs,
-        )
+        with annotate("als_core"):
+            f, lam, ssr, n_iter = _als_core(
+                xz,
+                m,
+                lam_ok,
+                f0,
+                config.tol * Tw * ns,
+                nfac,
+                max_iter if max_iter is not None else config.max_iter,
+                n_constr,
+                **kwargs,
+                **fo_kwargs,
+            )
 
         R2 = _r2_pass(xz, m, f, lam_ok) if compute_R2 else jnp.full(ns, jnp.nan)
         factor = jnp.full((data.shape[0], config.nfac_t), jnp.nan, data.dtype)
         factor = factor.at[initperiod : lastperiod + 1].set(f)
         fes = FactorEstimateStats(Tw, ns, nobs, tss, ssr, R2, n_iter)
         return factor, fes
+
+
+# ---------------------------------------------------------------------------
+# batched factor extraction: many ALS fits in one vmapped while_loop
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnames=("rmax", "max_iter", "compute_R2"))
+def _als_core_batch(
+    xz, m, lam_ok, f0, tol_scaled, rmax: int, max_iter: int, compute_R2: bool = True
+):
+    """vmap of `_als_core` over a leading batch axis.
+
+    Inert-padding semantics make heterogeneous fits batchable with one
+    static shape: factor columns beyond an element's r are exactly zero in
+    f0 and stay zero through the iteration (a zero column produces zero
+    rows/cols in every Gram matrix, and the eigh-based pinv of
+    `solve_normal` zeroes those components of the solution), and rows
+    outside an element's sample window carry zero weight, so they drop out
+    of every contraction.  JAX's while_loop batching rule freezes elements
+    whose own tolerance test has passed, so per-element convergence matches
+    the sequential runs.
+    """
+
+    def one(xz_i, m_i, ok_i, f0_i, tol_i):
+        f, lam, ssr, n_iter = _als_core(
+            xz_i, m_i, ok_i, f0_i, tol_i, rmax, max_iter
+        )
+        r2 = (
+            _r2_pass(xz_i, m_i, f, ok_i)
+            if compute_R2
+            else jnp.full(xz_i.shape[1], jnp.nan, xz_i.dtype)
+        )
+        return f, lam, ssr, n_iter, r2
+
+    return jax.vmap(one)(xz, m, lam_ok, f0, tol_scaled)
+
+
+class BatchFactorResults(NamedTuple):
+    """Stacked outputs of `estimate_factor_batch` (leading axis = element)."""
+
+    factor: jnp.ndarray  # (B, T, rmax), NaN outside window / beyond r
+    lam: jnp.ndarray  # (B, ns, rmax)
+    ssr: jnp.ndarray  # (B,)
+    tss: jnp.ndarray  # (B,)
+    nobs: jnp.ndarray  # (B,)
+    Tw: np.ndarray  # (B,) window lengths
+    n_iter: jnp.ndarray  # (B,)
+    R2: jnp.ndarray  # (B, ns)
+    nfac: np.ndarray  # (B,) active factor counts
+
+
+def estimate_factor_batch(
+    panels,
+    config: DFMConfig,
+    max_iter: int | None = None,
+    backend: str | None = None,
+    mesh=None,
+    compute_R2: bool = True,
+) -> BatchFactorResults:
+    """Run many independent ALS factor extractions as ONE vmapped while_loop.
+
+    `panels` is a sequence of (data, inclcode, initperiod, lastperiod, nfac)
+    tuples that share the panel shape after inclcode selection.  This is the
+    fan-out the reference runs serially — `estimate_factor_numbers`'s
+    O(max_nfac^2) refit loop and the Figure 3/6 sample-window sweeps
+    (SURVEY.md section 3.3: "embarrassingly parallel across nfac") — turned
+    into a single batched program: elements are padded to a common
+    (T, ns, rmax) shape with inert zero factor columns and zero-weight
+    out-of-window rows (see `_als_core_batch`), standardization/PCA
+    initialization happen per element on host, and one jit covers every fit.
+
+    Pass `mesh` (a 1-D jax.sharding.Mesh, any axis name) to shard the batch
+    axis across its devices: each chip runs its shard of the fits with no
+    cross-chip traffic until the results gather — the sweep-fan-out design
+    of SURVEY.md section 3.3.  The batch is padded to a device-count
+    multiple with duplicates of the first element (dropped on return).
+
+    Observed factors and loading constraints are not supported in the batch
+    path; use the serial `estimate_factor` for those fits.
+    """
+    if config.nfac_o:
+        raise ValueError(
+            "estimate_factor_batch does not support observed factors "
+            "(config.nfac_o > 0); use estimate_factor per fit"
+        )
+    rmax = max(int(p[4]) for p in panels)
+    B_real = len(panels)
+    if mesh is not None:
+        n_dev = int(np.prod(mesh.devices.shape))
+        pad = (-B_real) % n_dev
+        panels = list(panels) + [panels[0]] * pad
+    xzs, ms, oks, f0s, tols, Tws, nfacs = [], [], [], [], [], [], []
+    for data, inclcode, initperiod, lastperiod, nfac in panels:
+        est = np.asarray(data)[:, np.asarray(inclcode) == 1]
+        T, ns = est.shape
+        xw = np.full_like(est, np.nan)
+        xw[initperiod : lastperiod + 1] = est[initperiod : lastperiod + 1]
+        # population-std standardization (quirk 2.5-6) + PCA init via the
+        # NumPy twins of the jitted kernels (ops/linalg.py)
+        xz, m, _ = standardize_data_np(xw)
+        lam_ok = m.sum(axis=0) >= config.nt_min_factor
+        Tw = lastperiod - initperiod + 1
+        balanced = m[initperiod : lastperiod + 1].all(axis=0)
+        if int(balanced.sum()) < nfac:
+            raise ValueError(
+                f"nfac={nfac} exceeds the {int(balanced.sum())} fully-observed "
+                "series available for PCA initialization in this window"
+            )
+        xb = xz[initperiod : lastperiod + 1][:, balanced]
+        f0 = np.zeros((T, rmax), est.dtype)
+        f0[initperiod : lastperiod + 1, :nfac] = pca_score_np(xb, nfac)
+        xzs.append(xz)
+        ms.append(m.astype(est.dtype))
+        oks.append(lam_ok)
+        f0s.append(f0)
+        tols.append(config.tol * Tw * ns)
+        Tws.append(Tw)
+        nfacs.append(nfac)
+
+    with on_backend(backend):
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            axis = mesh.axis_names[0]
+            put = lambda a, nd: jax.device_put(
+                a, NamedSharding(mesh, PartitionSpec(axis, *([None] * (nd - 1))))
+            )
+        else:
+            put = lambda a, nd: jnp.asarray(a)
+        xz_b = put(np.stack(xzs), 3)
+        m_b = put(np.stack(ms), 3)
+        ok_b = put(np.stack(oks), 2)
+        f0_b = put(np.stack(f0s), 3)
+        tol_b = put(np.stack(tols).astype(xzs[0].dtype), 1)
+        with annotate("als_core_batch"):
+            f, lam, ssr, n_iter, r2 = _als_core_batch(
+                xz_b,
+                m_b,
+                ok_b,
+                f0_b,
+                tol_b,
+                rmax,
+                max_iter if max_iter is not None else config.max_iter,
+                compute_R2,
+            )
+        # NaN outside each element's window and beyond its active r
+        active = jnp.asarray(np.arange(rmax)[None, :] < np.asarray(nfacs)[:, None])
+        rows = []
+        for data, inclcode, initperiod, lastperiod, nfac in panels:
+            row = np.zeros(xz_b.shape[1], bool)
+            row[initperiod : lastperiod + 1] = True
+            rows.append(row)
+        in_window = jnp.asarray(np.stack(rows))
+        f = jnp.where(in_window[:, :, None] & active[:, None, :], f, jnp.nan)
+        lam = jnp.where(active[:, None, :], lam, jnp.nan)
+        tss = (xz_b**2 * m_b).sum(axis=(1, 2))
+        nobs = m_b.sum(axis=(1, 2))
+        return BatchFactorResults(
+            f[:B_real],
+            lam[:B_real],
+            ssr[:B_real],
+            tss[:B_real],
+            nobs[:B_real],
+            np.asarray(Tws)[:B_real],
+            n_iter[:B_real],
+            r2[:B_real],
+            np.asarray(nfacs)[:B_real],
+        )
 
 
 # ---------------------------------------------------------------------------

@@ -1,9 +1,12 @@
 """Factor-number selection: Bai-Ng ICp2, Amengual-Watson, Ahn-Horenstein.
 
 Rewrite of reference cells 35-40.  The reference's O(max_nfac^2) loop of full
-DFM refits (SURVEY.md section 3.3) is kept serial per r (each fit is already
-one jitted while-loop; the fits for different r have different shapes), but
-every inner regression is batched.
+DFM refits (SURVEY.md section 3.3, "embarrassingly parallel across nfac") is
+fanned out here: all static fits for r = 1..max_nfac run as ONE vmapped
+batched ALS (`estimate_factor_batch`), the per-r residualizations are one
+vmapped masked-OLS, and all max_nfac*(max_nfac+1)/2 Amengual-Watson refits
+run as a second single batched ALS — three jitted programs total instead of
+O(max_nfac^2) sequential while-loops.
 """
 
 from __future__ import annotations
@@ -12,13 +15,14 @@ import dataclasses
 
 from typing import NamedTuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
 from ..ops.lags import lagmat
 from ..ops.linalg import ols_batched_series
 from ..ops.masking import fillz, mask_of
-from .dfm import DFMConfig, FactorEstimateStats, estimate_factor
+from .dfm import DFMConfig, FactorEstimateStats, estimate_factor, estimate_factor_batch
 
 __all__ = [
     "bai_ng_criterion",
@@ -111,6 +115,13 @@ def amengual_watson_test(
     return aw, ssr, r2
 
 
+def _bai_ng(ssr, nobs, T, nfac_t):
+    """Bai-Ng ICp2 from raw bookkeeping scalars (cell 35 formula)."""
+    nbar = nobs / T
+    g = np.log(min(nbar, T)) * (nbar + T) / nobs
+    return np.log(ssr / nobs) + nfac_t * g
+
+
 def estimate_factor_numbers(
     data,
     inclcode,
@@ -119,11 +130,18 @@ def estimate_factor_numbers(
     config: DFMConfig,
     max_nfac: int,
     dynamic: bool = True,
+    backend: str | None = None,
 ) -> FactorNumberEstimateStats:
     """Fit DFMs for r = 1..max_nfac and collect selection statistics
     (reference cell 39).  Set dynamic=False to skip the O(r^2)
-    Amengual-Watson refits."""
+    Amengual-Watson refits.
+
+    All static fits run as one `estimate_factor_batch` call; with
+    dynamic=True the per-r residualizations are one vmapped masked OLS and
+    all r*(r+1)/2 Amengual-Watson refits a second batched call.
+    """
     inclcode = np.asarray(inclcode)
+    data = np.asarray(data)
     ns = int((inclcode == 1).sum())
     bn = np.full(max_nfac, np.nan)
     ssr_s = np.full(max_nfac, np.nan)
@@ -131,16 +149,66 @@ def estimate_factor_numbers(
     aw = np.full((max_nfac, max_nfac), np.nan)
     ssr_d = np.full((max_nfac, max_nfac), np.nan)
     R2_d = np.full((ns, max_nfac, max_nfac), np.nan)
-    tss = nobs = T = None
+
+    panels = [
+        (data, inclcode, initperiod, lastperiod, r) for r in range(1, max_nfac + 1)
+    ]
+    batch = estimate_factor_batch(panels, config, backend=backend)
+    ssr_b = np.asarray(batch.ssr)
+    nobs_b = np.asarray(batch.nobs)
+    tss_b = np.asarray(batch.tss)
     for i, nfac in enumerate(range(1, max_nfac + 1)):
-        cfg = dataclasses.replace(config, nfac_u=nfac)
-        factor, fes = estimate_factor(data, inclcode, initperiod, lastperiod, cfg)
-        bn[i] = float(bai_ng_criterion(fes, nfac))
-        ssr_s[i] = float(fes.ssr)
-        R2_s[:, i] = np.asarray(fes.R2)
-        if dynamic:
-            aw[: nfac, i], ssr_d[: nfac, i], R2_d[:, : nfac, i] = amengual_watson_test(
-                data, inclcode, factor, initperiod, lastperiod, cfg, nfac
+        bn[i] = _bai_ng(ssr_b[i], nobs_b[i], int(batch.Tw[i]), nfac)
+        ssr_s[i] = ssr_b[i]
+        R2_s[:, i] = np.asarray(batch.R2[i])
+    tss, nobs, T = float(tss_b[-1]), float(nobs_b[-1]), int(batch.Tw[-1])
+
+    if dynamic:
+        est = jnp.asarray(data[:, inclcode == 1])
+        Tfull = est.shape[0]
+        nlag = config.n_factorlag
+        kmax = 1 + nlag * max_nfac
+        X_b = np.zeros((max_nfac, Tfull, kmax), data.dtype)
+        W_b = np.zeros((max_nfac, Tfull, ns), data.dtype)
+        k_real = np.zeros(max_nfac, int)
+        est_mask = ~np.isnan(np.asarray(est))
+        for i, r in enumerate(range(1, max_nfac + 1)):
+            f_r = np.asarray(batch.factor[i])[:, :r]
+            x = np.concatenate(
+                [
+                    np.ones((Tfull, 1), data.dtype),
+                    np.asarray(lagmat(jnp.asarray(f_r), range(1, nlag + 1))),
+                ],
+                axis=1,
             )
-        tss, nobs, T = float(fes.tss), float(fes.nobs), fes.T
+            k_real[i] = x.shape[1]
+            xm = ~np.isnan(x).any(axis=1)
+            X_b[i, :, : x.shape[1]] = np.nan_to_num(x)
+            W_b[i] = (est_mask & xm[:, None]).astype(data.dtype)
+
+        resid_b = jax.vmap(
+            lambda Xi, Wi: ols_batched_series(est, Xi, Wi)[1]
+        )(jnp.asarray(X_b), jnp.asarray(W_b))
+        ndf = W_b.sum(axis=1) - k_real[:, None]
+        keep = ndf >= config.nt_min_factor
+        resid_np = np.where(keep[:, None, :], np.asarray(resid_b), np.nan)
+
+        ones = np.ones(ns, dtype=inclcode.dtype)
+        pairs = [
+            (r, d) for r in range(1, max_nfac + 1) for d in range(1, r + 1)
+        ]
+        aw_panels = [
+            (resid_np[r - 1], ones, initperiod + nlag, lastperiod, d)
+            for r, d in pairs
+        ]
+        aw_batch = estimate_factor_batch(aw_panels, config, backend=backend)
+        aw_ssr = np.asarray(aw_batch.ssr)
+        aw_nobs = np.asarray(aw_batch.nobs)
+        for j, (r, d) in enumerate(pairs):
+            aw[d - 1, r - 1] = _bai_ng(
+                aw_ssr[j], aw_nobs[j], int(aw_batch.Tw[j]), d
+            )
+            ssr_d[d - 1, r - 1] = aw_ssr[j]
+            R2_d[:, d - 1, r - 1] = np.asarray(aw_batch.R2[j])
+
     return FactorNumberEstimateStats(bn, ssr_s, R2_s, aw, ssr_d, R2_d, tss, nobs, T)

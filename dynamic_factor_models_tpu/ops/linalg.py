@@ -25,7 +25,9 @@ __all__ = [
     "ols_masked",
     "ols_batched_series",
     "pca_score",
+    "pca_score_np",
     "standardize_data",
+    "standardize_data_np",
     "compute_r2",
 ]
 
@@ -105,6 +107,33 @@ def standardize_data(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
     std = jnp.sqrt(var_sample) * jnp.sqrt((n - 1) / n)
     out = jnp.where(m, (xz - mean) / std, jnp.nan)
     return out, std
+
+
+def standardize_data_np(x):
+    """NumPy twin of `standardize_data` for host-side batch preparation
+    (models.dfm.estimate_factor_batch) — same population-std convention
+    (quirk 2.5-6); kept adjacent so the two implementations stay in sync
+    (pinned equal by tests/test_ops.py).
+
+    Returns (standardized with 0 at missing, mask, std-row)."""
+    import numpy as np
+
+    m = ~np.isnan(x)
+    n = m.sum(axis=0)
+    with np.errstate(invalid="ignore", divide="ignore"):
+        mean = np.where(m, x, 0.0).sum(axis=0) / n
+        dev = np.where(m, x - mean, 0.0)
+        std = np.sqrt((dev**2).sum(axis=0) / (n - 1)) * np.sqrt((n - 1) / n)
+        xz = np.where(m, (x - mean) / std, 0.0).astype(x.dtype, copy=False)
+    return xz, m, std
+
+
+def pca_score_np(X, nfac: int):
+    """NumPy twin of `pca_score` (host-side PCA initialization)."""
+    import numpy as np
+
+    _, _, Vt = np.linalg.svd(X, full_matrices=False)
+    return X @ Vt[:nfac].T
 
 
 def compute_r2(y: jnp.ndarray, e: jnp.ndarray, w=None) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:

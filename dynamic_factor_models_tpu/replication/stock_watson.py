@@ -20,7 +20,13 @@ import numpy as np
 from ..io import find_row_number
 from ..io.cache import benchmark_ingest, cached_dataset
 from ..models.constraints import construct_constraint
-from ..models.dfm import DFMConfig, compute_series, estimate_dfm, estimate_factor
+from ..models.dfm import (
+    DFMConfig,
+    compute_series,
+    estimate_dfm,
+    estimate_factor,
+    estimate_factor_batch,
+)
 from ..models.favar_instruments import choose_stepwise, favar_instrument_table
 from ..models.instability import instability_scan
 from ..models.selection import ahn_horenstein_er, estimate_factor_numbers
@@ -168,34 +174,49 @@ def figure5(ds, config: DFMConfig = BENCHMARK_CONFIG):
 
 def figure6(ds_all, config: DFMConfig = BENCHMARK_CONFIG, max_r: int = 60):
     """Cumulative trace R^2 for r = 1..max_r, single ALS iteration
-    (cells 49-53; 180 model fits in the reference)."""
+    (cells 49-53; 180 model fits in the reference — here one batched ALS
+    per sample window via `estimate_factor_batch`)."""
     out = {}
+    incl = np.asarray(ds_all.inclcode)
+    data = np.asarray(ds_all.bpdata)
     for label, periods in (("all", PERIODS_ALL), ("pre", PERIODS_PRE),
                            ("post", PERIODS_POST)):
         i0, i1 = _window(ds_all, periods)
-        tr = []
-        for r in range(1, max_r + 1):
-            try:
-                _, fes = estimate_factor(
-                    ds_all.bpdata, ds_all.inclcode, i0, i1,
-                    dataclasses.replace(config, nfac_u=r),
-                    max_iter=1, compute_R2=False,
-                )
-                tr.append(1.0 - float(fes.ssr) / float(fes.tss))
-            except ValueError:  # r exceeds balanced block in a subsample
-                tr.append(np.nan)
-        out[label] = np.asarray(tr)
+        est = data[:, incl == 1][i0 : i1 + 1]
+        nbal = int((~np.isnan(est)).all(axis=0).sum())
+        rs = [r for r in range(1, max_r + 1) if r <= nbal]
+        tr = np.full(max_r, np.nan)  # r beyond the balanced block stays NaN
+        if rs:
+            batch = estimate_factor_batch(
+                [(data, incl, i0, i1, r) for r in rs], config, max_iter=1,
+                compute_R2=False,
+            )
+            tr[np.asarray(rs) - 1] = 1.0 - np.asarray(batch.ssr) / np.asarray(
+                batch.tss
+            )
+        out[label] = tr
     return out
 
 
 def table3(ds_all, config: DFMConfig = BENCHMARK_CONFIG, nfac_max: int = 10):
-    """Per-series R^2 vs number of factors (cell 55; 207 x 10)."""
+    """Per-series R^2 vs number of factors (cell 55; 207 x 10).
+
+    Factors for every r come from one batched ALS; the (cheap, already
+    series-batched) loading regressions then run per r."""
+    from ..models.dfm import estimate_factor_loading
+
     i0, i1 = _window(ds_all, PERIODS_ALL)
+    batch = estimate_factor_batch(
+        [(ds_all.bpdata, ds_all.inclcode, i0, i1, r) for r in range(1, nfac_max + 1)],
+        config,
+    )
     r2 = np.full((len(ds_all.inclcode), nfac_max), np.nan)
-    for nfac in range(1, nfac_max + 1):
-        res = estimate_dfm(ds_all.bpdata, ds_all.inclcode, i0, i1,
-                           dataclasses.replace(config, nfac_u=nfac))
-        r2[:, nfac - 1] = np.asarray(res.r2)
+    for i, nfac in enumerate(range(1, nfac_max + 1)):
+        _, r2_i, _, _, _ = estimate_factor_loading(
+            ds_all.bpdata, batch.factor[i][:, :nfac], i0, i1,
+            dataclasses.replace(config, nfac_u=nfac),
+        )
+        r2[:, i] = np.asarray(r2_i)
     return r2
 
 

@@ -182,3 +182,30 @@ def test_virtual_cpu_mesh_available():
     import jax
 
     assert len(jax.devices()) == 8
+
+
+def test_standardize_np_twin_matches_jax(rng):
+    """standardize_data_np / pca_score_np (host-side batch prep) must stay
+    in sync with the jitted kernels they mirror."""
+    import jax.numpy as jnp
+
+    from dynamic_factor_models_tpu.ops.linalg import (
+        pca_score,
+        pca_score_np,
+        standardize_data,
+        standardize_data_np,
+    )
+
+    x = rng.standard_normal((50, 7))
+    x[rng.random((50, 7)) < 0.15] = np.nan
+    out_j, std_j = standardize_data(jnp.asarray(x))
+    xz_n, m_n, std_n = standardize_data_np(x)
+    np.testing.assert_allclose(np.nan_to_num(np.asarray(out_j)), xz_n, atol=1e-12)
+    np.testing.assert_allclose(np.asarray(std_j), std_n, atol=1e-12)
+    xb = np.nan_to_num(x)
+    s_j = np.asarray(pca_score(jnp.asarray(xb), 3))
+    s_n = pca_score_np(xb, 3)
+    # scores agree up to per-component sign
+    for k in range(3):
+        sgn = np.sign(s_j[:, k] @ s_n[:, k]) or 1.0
+        np.testing.assert_allclose(s_j[:, k], sgn * s_n[:, k], atol=1e-8)
