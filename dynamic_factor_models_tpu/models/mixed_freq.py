@@ -153,6 +153,7 @@ class MFResults(NamedTuple):
     n_iter: int
     stds: jnp.ndarray
     means: jnp.ndarray
+    trace: object | None = None  # ConvergenceTrace when collect_path=True
 
 
 def estimate_mixed_freq_dfm(
@@ -163,6 +164,7 @@ def estimate_mixed_freq_dfm(
     max_em_iter: int = 100,
     tol: float = 1e-6,
     backend: str | None = None,
+    collect_path: bool = False,
 ) -> MFResults:
     """Fit the mixed-frequency DFM on a MONTHLY-frequency (T, N) panel.
 
@@ -214,16 +216,12 @@ def estimate_mixed_freq_dfm(
             agg=jnp.asarray(agg, dtype),
         )
 
-        llpath = []
-        ll_prev = -jnp.inf
-        it = 0
-        for it in range(1, max_em_iter + 1):
-            params, ll = em_step_mf(params, xz, m_arr)
-            ll = float(ll)
-            llpath.append(ll)
-            if it > 1 and abs(ll - ll_prev) < tol * (1.0 + abs(ll_prev)):
-                break
-            ll_prev = ll
+        from .emloop import run_em_loop
+
+        params, llpath, it, trace = run_em_loop(
+            em_step_mf, params, (xz, m_arr), tol, max_em_iter,
+            collect_path=collect_path, trace_name="em_mixed_freq",
+        )
 
         means, covs, pmeans, pcovs, _ = _filter_mf(params, xz, m_arr)
         Tm, _ = _companion(_as_ssm(params))
@@ -233,8 +231,9 @@ def estimate_mixed_freq_dfm(
             params=params,
             factors=s_sm[:, :r],
             x_hat=x_hat,
-            loglik_path=np.asarray(llpath),
+            loglik_path=llpath,
             n_iter=it,
             stds=stds,
             means=n_mean,
+            trace=trace,
         )

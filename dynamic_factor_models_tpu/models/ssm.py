@@ -39,6 +39,7 @@ __all__ = [
     "kalman_filter",
     "kalman_smoother",
     "em_step",
+    "em_step_assoc",
     "estimate_dfm_em",
     "EMResults",
 ]
@@ -267,21 +268,10 @@ def kalman_smoother(
 # ---------------------------------------------------------------------------
 
 
-@jax.jit
-def em_step(params: SSMParams, x, mask):
-    """One EM iteration (E-step scans + closed-form M-step); returns
-    (new_params, loglik of the *current* params)."""
+def _em_m_step(params: SSMParams, x, m, s_sm, P_sm, lag1):
+    """Closed-form M-step from smoothed first/second moments (shared by the
+    sequential-scan and associative E-steps)."""
     r, p = params.r, params.p
-    dtype = x.dtype
-    m = mask.astype(dtype)
-
-    # guard caller-supplied params the same way kalman_filter does: the
-    # Cholesky recursions need Q strictly PD (M-step outputs are pre-floored,
-    # so for internal EM loops this is a no-op re-floor)
-    params = params._replace(Q=_psd_floor(params.Q))
-    filt = _filter_scan(params, x, mask)
-    s_sm, P_sm, lag1 = _smoother_scan(params, filt)
-
     f = s_sm[:, :r]  # E[f_t | T]
     Pf = P_sm[:, :r, :r]  # Var(f_t | T)
 
@@ -307,7 +297,35 @@ def em_step(params: SSMParams, x, mask):
     Tn = x.shape[0]
     Q = _psd_floor((S11 - Ak @ S10.T) / (Tn - 1))
     A = jnp.stack([Ak[:, i * r : (i + 1) * r] for i in range(p)])
-    return SSMParams(lam, R, A, Q), filt.loglik
+    return SSMParams(lam, R, A, Q)
+
+
+@jax.jit
+def em_step(params: SSMParams, x, mask):
+    """One EM iteration (sequential-scan E-step + closed-form M-step);
+    returns (new_params, loglik of the *current* params)."""
+    m = mask.astype(x.dtype)
+    # guard caller-supplied params the same way kalman_filter does: the
+    # Cholesky recursions need Q strictly PD (M-step outputs are pre-floored,
+    # so for internal EM loops this is a no-op re-floor)
+    params = params._replace(Q=_psd_floor(params.Q))
+    filt = _filter_scan(params, x, mask)
+    s_sm, P_sm, lag1 = _smoother_scan(params, filt)
+    return _em_m_step(params, x, m, s_sm, P_sm, lag1), filt.loglik
+
+
+@jax.jit
+def em_step_assoc(params: SSMParams, x, mask):
+    """`em_step` with the parallel-in-time (associative-scan) E-step
+    (models.pkalman): log-depth instead of T-depth recursions — the
+    TPU-friendly shape when the sequential scan's per-step latency
+    dominates."""
+    from .pkalman import kalman_smoother_associative
+
+    m = mask.astype(x.dtype)
+    params = params._replace(Q=_psd_floor(params.Q))
+    s_sm, P_sm, ll, lag1 = kalman_smoother_associative(params, x, mask)
+    return _em_m_step(params, x, m, s_sm, P_sm, lag1), ll
 
 
 class EMResults(NamedTuple):
@@ -318,6 +336,7 @@ class EMResults(NamedTuple):
     n_iter: int
     stds: jnp.ndarray  # per-series standardization scale
     means: jnp.ndarray
+    trace: object | None = None  # ConvergenceTrace when collect_path=True
 
 
 def _init_params_from_als(
@@ -351,12 +370,22 @@ def estimate_dfm_em(
     max_em_iter: int = 200,
     tol: float = 1e-6,
     backend: str | None = None,
+    collect_path: bool = False,
+    method: str = "sequential",
 ) -> EMResults:
     """State-space DFM via EM on the standardized included panel
     (BASELINE.json config 2: `State-space DFM via EM + Kalman smoother`).
 
     Converges when the relative log-likelihood improvement drops below tol.
+    The convergence loop runs on device (`emloop.run_em_loop`);
+    collect_path=True switches to a host loop whose per-iteration wall
+    clock is recorded in EMResults.trace.  method="associative" swaps the
+    E-step for the parallel-in-time scans (`em_step_assoc`).
     """
+    if method not in ("sequential", "associative"):
+        raise ValueError(
+            f"method must be 'sequential' or 'associative', got {method!r}"
+        )
     with on_backend(backend):
         data = jnp.asarray(data)
         inclcode = np.asarray(inclcode)
@@ -374,24 +403,22 @@ def estimate_dfm_em(
             data, inclcode, initperiod, lastperiod, config, xz, m_arr
         )
 
-        llpath = []
-        ll_prev = -jnp.inf
-        it = 0
-        for it in range(1, max_em_iter + 1):
-            params, ll = em_step(params, xz, m_arr)
-            ll = float(ll)
-            llpath.append(ll)
-            if it > 1 and abs(ll - ll_prev) < tol * (1.0 + abs(ll_prev)):
-                break
-            ll_prev = ll
+        from .emloop import run_em_loop
+
+        step = em_step if method == "sequential" else em_step_assoc
+        params, llpath, n_iter, trace = run_em_loop(
+            step, params, (xz, m_arr), tol, max_em_iter,
+            collect_path=collect_path, trace_name=f"em_dfm_{method}",
+        )
 
         means, covs, _ = kalman_smoother(params, jnp.where(m_arr, xz, jnp.nan))
         return EMResults(
             params=params,
             factors=means[:, :r],
             factor_covs=covs[:, :r, :r],
-            loglik_path=np.asarray(llpath),
-            n_iter=it,
+            loglik_path=llpath,
+            n_iter=n_iter,
             stds=stds,
             means=n_mean,
+            trace=trace,
         )

@@ -190,6 +190,7 @@ class EMARResults(NamedTuple):
     n_iter: int
     stds: jnp.ndarray
     means: jnp.ndarray
+    trace: object | None = None  # ConvergenceTrace when collect_path=True
 
 
 def estimate_dfm_em_ar(
@@ -201,6 +202,7 @@ def estimate_dfm_em_ar(
     max_em_iter: int = 100,
     tol: float = 1e-6,
     backend: str | None = None,
+    collect_path: bool = False,
 ) -> EMARResults:
     """Full Banbura-Modugno EM: factors + AR(1) idiosyncratic states.
 
@@ -228,16 +230,12 @@ def estimate_dfm_em_ar(
             Q=em0.params.Q,
         )
 
-        llpath = []
-        ll_prev = -jnp.inf
-        it = 0
-        for it in range(1, max_em_iter + 1):
-            params, ll = em_step_ar(params, xz, m_arr)
-            ll = float(ll)
-            llpath.append(ll)
-            if it > 1 and abs(ll - ll_prev) < tol * (1.0 + abs(ll_prev)):
-                break
-            ll_prev = ll
+        from .emloop import run_em_loop
+
+        params, llpath, it, trace = run_em_loop(
+            em_step_ar, params, (xz, m_arr), tol, max_em_iter,
+            collect_path=collect_path, trace_name="em_dfm_ar",
+        )
 
         means, covs, pmeans, pcovs, _ = _filter_ar(params, xz, m_arr)
         s_sm, _, _ = _smoother_ar(params, means, covs, pmeans, pcovs)
@@ -246,10 +244,11 @@ def estimate_dfm_em_ar(
             params=params,
             factors=s_sm[:, :r],
             idio=s_sm[:, rp:],
-            loglik_path=np.asarray(llpath),
+            loglik_path=llpath,
             n_iter=it,
             stds=stds,
             means=n_mean,
+            trace=trace,
         )
 
 

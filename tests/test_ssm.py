@@ -197,3 +197,38 @@ def test_kalman_f32_f64_parity():
 
     drift = np.abs(run(jnp.float64) - run(jnp.float32)).max()
     assert drift < 1e-5, f"f32 smoother drift {drift} exceeds parity bound"
+
+
+def test_em_step_assoc_matches_sequential(rng):
+    """em_step_assoc (parallel-in-time E-step) == em_step to numerical
+    precision: shared M-step, E-steps already pinned at 1e-10 parity."""
+    import jax.numpy as jnp
+
+    from dynamic_factor_models_tpu.models.ssm import (
+        SSMParams,
+        em_step,
+        em_step_assoc,
+    )
+
+    T, N, r, p = 60, 8, 2, 2
+    f = np.zeros((T, r))
+    for t in range(1, T):
+        f[t] = 0.6 * f[t - 1] + rng.standard_normal(r)
+    lam = rng.standard_normal((N, r))
+    x = f @ lam.T + 0.5 * rng.standard_normal((T, N))
+    mask = rng.random((T, N)) > 0.1
+    xz = jnp.asarray(np.where(mask, x, 0.0))
+    m = jnp.asarray(mask)
+    params = SSMParams(
+        lam=jnp.asarray(lam * 0.5),
+        R=jnp.ones(N),
+        A=jnp.concatenate([0.5 * jnp.eye(r)[None], jnp.zeros((p - 1, r, r))]),
+        Q=jnp.eye(r),
+    )
+    p1, ll1 = em_step(params, xz, m)
+    p2, ll2 = em_step_assoc(params, xz, m)
+    np.testing.assert_allclose(float(ll1), float(ll2), rtol=1e-8)
+    np.testing.assert_allclose(np.asarray(p1.lam), np.asarray(p2.lam), atol=1e-7)
+    np.testing.assert_allclose(np.asarray(p1.A), np.asarray(p2.A), atol=1e-7)
+    np.testing.assert_allclose(np.asarray(p1.Q), np.asarray(p2.Q), atol=1e-7)
+    np.testing.assert_allclose(np.asarray(p1.R), np.asarray(p2.R), atol=1e-7)
