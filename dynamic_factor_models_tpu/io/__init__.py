@@ -3,9 +3,11 @@ from .ingest import (
     Dataset,
     Mean,
     MonthlyData,
+    MonthlyDataset,
     NoDetrend,
     QuarterlyData,
     default_data_path,
     find_row_number,
     readin_data,
+    readin_data_monthly,
 )

@@ -11,7 +11,15 @@ import os
 
 import numpy as np
 
-from .ingest import BiWeight, Dataset, MonthlyData, QuarterlyData, readin_data
+from .ingest import (
+    BiWeight,
+    Dataset,
+    MonthlyData,
+    MonthlyDataset,
+    QuarterlyData,
+    readin_data,
+    readin_data_monthly,
+)
 
 _ARRAY_FIELDS = [
     "bpdata_raw",
@@ -54,10 +62,7 @@ def benchmark_ingest(datatype: str = "Real", path: str | None = None) -> Dataset
 def cached_dataset(datatype: str = "Real", cache_dir: str | None = None) -> Dataset:
     """Load the standard BiWeight(100) dataset, building the cache if needed."""
     if cache_dir is None:
-        cache_dir = os.path.join(
-            os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
-            "data",
-        )
+        cache_dir = _default_cache_dir()
     os.makedirs(cache_dir, exist_ok=True)
     path = os.path.join(cache_dir, f"sw_panel_{datatype.lower()}.npz")
     if not os.path.exists(path):
@@ -65,3 +70,45 @@ def cached_dataset(datatype: str = "Real", cache_dir: str | None = None) -> Data
         save_dataset(ds, path)
         return ds
     return load_dataset(path)
+
+
+def _default_cache_dir() -> str:
+    return os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+        "data",
+    )
+
+
+def cached_monthly_dataset(
+    datatype: str = "All", cache_dir: str | None = None
+) -> MonthlyDataset:
+    """Monthly-frequency panel for the mixed-frequency DFM, cached like
+    `cached_dataset`."""
+    cache_dir = cache_dir or _default_cache_dir()
+    os.makedirs(cache_dir, exist_ok=True)
+    path = os.path.join(cache_dir, f"sw_monthly_{datatype.lower()}.npz")
+    if not os.path.exists(path):
+        md = MonthlyData.from_range((1959, 1), (2014, 12), 148)
+        qd = QuarterlyData.from_range((1959, 1), (2014, 4), 85)
+        ds = readin_data_monthly(md, qd, datatype)
+        np.savez_compressed(
+            path,
+            data=ds.data,
+            is_quarterly=ds.is_quarterly,
+            catcode=ds.catcode,
+            inclcode=ds.inclcode,
+            names=np.array(ds.names),
+            calmds=np.array(ds.calmds),
+            calvec=ds.calvec,
+        )
+        return ds
+    z = np.load(path, allow_pickle=False)
+    return MonthlyDataset(
+        data=z["data"],
+        is_quarterly=z["is_quarterly"],
+        catcode=z["catcode"],
+        inclcode=z["inclcode"],
+        names=[str(s) for s in z["names"]],
+        calmds=[(int(y), int(m)) for y, m in z["calmds"]],
+        calvec=z["calvec"],
+    )

@@ -124,3 +124,30 @@ def test_outlier_adjustment_idempotent(rng):
         twice = once.copy()
         _adjust_outlier(twice, 1, io_method)
         np.testing.assert_array_equal(once, twice, err_msg=f"io_method={io_method}")
+
+
+def test_monthly_frequency_ingest():
+    """readin_data_monthly: monthly panel with quarter-end-placed quarterly
+    series (the mixed-frequency DFM's input; replaces readin_functions.jl's
+    monthly->quarterly averaging for this path)."""
+    import numpy as np
+
+    from dynamic_factor_models_tpu.io.cache import cached_monthly_dataset
+
+    ds = cached_monthly_dataset("All")
+    assert ds.data.shape == (672, 207)  # 56 years x 12 months; :All panel
+    assert ds.calmds[0] == (1959, 1) and ds.calmds[-1] == (2014, 12)
+    months = np.array([m for _, m in ds.calmds])
+    qcols = np.nonzero(ds.is_quarterly)[0]
+    assert qcols.size > 0
+    # quarterly series: NaN everywhere except quarter-end months
+    off_quarter = ~np.isin(months, (3, 6, 9, 12))
+    assert np.isnan(ds.data[off_quarter][:, qcols]).all()
+    gdp = ds.names.index("GDPC96")
+    assert ds.is_quarterly[gdp]
+    # GDP growth observed in 223 of 224 quarters (one lost to the transform)
+    assert np.isfinite(ds.data[:, gdp]).sum() == 223
+    # monthly series stay monthly: PAYEMS nearly fully observed
+    payems = ds.names.index("PAYEMS")
+    assert not ds.is_quarterly[payems]
+    assert np.isfinite(ds.data[:, payems]).sum() >= 660
