@@ -23,7 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 from scipy import stats as sps
 
-from ..ops.hac import form_kernel
+from ..ops.hac import compute_chow
 from ..ops.linalg import solve_normal
 from ..ops.masking import fillz, mask_of
 
@@ -59,29 +59,11 @@ def _compact_series(y: np.ndarray, X: np.ndarray):
     return yc, Xc, int(m.sum())
 
 
-def _chow_padded(y, X, q: int, n_pre, count):
-    """Chow Wald statistic on a zero-padded compacted series.
-
-    The break dummy is zeroed beyond the live prefix so pad rows stay inert.
-    """
-    T, k = X.shape
-    live = jnp.arange(T) < count
-    D = ((jnp.arange(T) >= n_pre) & live).astype(X.dtype)
-    Xf = jnp.hstack([X, X * D[:, None]])
-    A = Xf.T @ Xf
-    beta = solve_normal(A, Xf.T @ y)
-    u = jnp.where(live, y - Xf @ beta, 0.0)
-    z = Xf * u[:, None]
-    kernel = form_kernel(q)
-    v = kernel[0] * z.T @ z
-    for i in range(1, q + 1):
-        gamma = z[i:].T @ z[: T - i]
-        v = v + kernel[i] * (gamma + gamma.T)
-    XXinv = jnp.linalg.pinv(A, hermitian=True)
-    vbeta = XXinv @ v @ XXinv
-    g = beta[k:]
-    v1 = vbeta[k:, k:]
-    return g @ solve_normal(v1, g)
+# On zero-padded compacted series, `ops.hac.compute_chow` is exact as-is:
+# pad rows have y = 0 and X = 0, so their residuals, break-dummy
+# interactions, and every Gram/autocovariance contribution vanish — no
+# padded re-implementation of the HAC-Wald sandwich is needed.
+_chow_vmapped = jax.vmap(compute_chow, in_axes=(0, 0, None, None))
 
 
 @partial(jax.jit, static_argnames=("q", "ccut", "compute_q0"))
@@ -99,15 +81,13 @@ def _scan_qlr(Y, X, counts, q: int, ccut: float, compute_q0: bool = False):
     n1t = jnp.floor(ccut * counts).astype(jnp.int32)
     n2t = counts - n1t
 
-    chow_b = jax.vmap(_chow_padded, in_axes=(0, 0, None, None, 0))
-
     def body(carry, b):
         lm0, lmq = carry
         valid = (b >= n1t) & (b <= n2t)
-        sq = chow_b(Y, X, q, b, counts)
+        sq = _chow_vmapped(Y, X, q, b)
         lmq = jnp.where(valid, jnp.maximum(lmq, sq), lmq)
         if compute_q0:
-            s0 = chow_b(Y, X, 0, b, counts)
+            s0 = _chow_vmapped(Y, X, 0, b)
             lm0 = jnp.where(valid, jnp.maximum(lm0, s0), lm0)
         return (lm0, lmq), None
 
@@ -117,8 +97,8 @@ def _scan_qlr(Y, X, counts, q: int, ccut: float, compute_q0: bool = False):
 
 
 @partial(jax.jit, static_argnames=("q",))
-def _chow_fixed(Y, X, counts, n_pre, q: int):
-    return jax.vmap(_chow_padded, in_axes=(0, 0, None, None, 0))(Y, X, q, n_pre, counts)
+def _chow_fixed(Y, X, n_pre, q: int):
+    return _chow_vmapped(Y, X, q, n_pre)
 
 
 def split_sample_fitted_correlations(data, factor_full, factor_pre, factor_post):
@@ -188,7 +168,7 @@ def instability_scan(
         eligible[i] = (pre_obs >= min_obs) and (post_obs >= min_obs)
         Yc[i], Xc[i], counts[i] = _compact_series(y, F)
 
-    chow = np.asarray(_chow_fixed(jnp.asarray(Yc), jnp.asarray(Xc), jnp.asarray(counts), n_pre_break, q))
+    chow = np.asarray(_chow_fixed(jnp.asarray(Yc), jnp.asarray(Xc), n_pre_break, q))
     _, qlr = _scan_qlr(jnp.asarray(Yc), jnp.asarray(Xc), jnp.asarray(counts), q, ccut)
     qlr = np.asarray(qlr)
     chow = np.where(eligible, chow, np.nan)
