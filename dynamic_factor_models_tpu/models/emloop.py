@@ -27,35 +27,55 @@ __all__ = ["run_em_loop"]
 
 
 @partial(jax.jit, static_argnames=("step", "max_em_iter"))
-def _em_while(step, params, args, tol, max_em_iter: int):
+def _em_while(step, carry, args, tol, max_em_iter: int, stop_at):
     """On-device EM loop.  Semantics match the host loop exactly: iterate
     `params, ll = step(params, *args)`; after iteration it >= 2, stop when
-    |ll - ll_prev| < tol * (1 + |ll_prev|); always stop at max_em_iter."""
+    |ll - ll_prev| < tol * (1 + |ll_prev|); always stop at max_em_iter.
+    `stop_at` <= max_em_iter (a traced scalar, so chunked checkpointing
+    reuses one compilation) bounds this invocation so a checkpointing
+    driver can run the loop in chunks without changing its semantics."""
     dtype = jnp.result_type(tol)
-    neg_inf = jnp.asarray(-jnp.inf, dtype)
 
-    def cond(carry):
-        _, ll_prev, ll, it, _ = carry
+    def cond(c):
+        _, ll_prev, ll, it, _ = c
         unconverged = (it <= 1) | (
             jnp.abs(ll - ll_prev) >= tol * (1.0 + jnp.abs(ll_prev))
         )
-        return unconverged & (it < max_em_iter)
+        return unconverged & (it < stop_at)
 
-    def body(carry):
-        params, _, ll, it, path = carry
+    def body(c):
+        params, _, ll, it, path = c
         new_params, ll_new = step(params, *args)
         path = path.at[it].set(ll_new.astype(dtype))
         return new_params, ll, ll_new.astype(dtype), it + 1, path
 
-    init = (
+    return jax.lax.while_loop(cond, body, carry)
+
+
+def _fresh_carry(params, tol, max_em_iter):
+    dtype = jnp.result_type(tol)
+    return (
         params,
-        neg_inf,
+        jnp.asarray(-jnp.inf, dtype),
         jnp.asarray(jnp.nan, dtype),
         jnp.asarray(0, jnp.int32),
         jnp.full(max_em_iter, jnp.nan, dtype),
     )
-    params, _, _, n_iter, path = jax.lax.while_loop(cond, body, init)
-    return params, n_iter, path
+
+
+def _fingerprint(args, tol, max_em_iter: int) -> str:
+    """Digest tying a checkpoint to its run: data bytes, shapes/dtypes,
+    tolerance, and iteration cap — a resume against different inputs is an
+    error, not a silent override."""
+    import hashlib
+
+    h = hashlib.sha256()
+    h.update(repr((float(tol), int(max_em_iter))).encode())
+    for leaf in jax.tree.leaves(args):
+        a = np.asarray(leaf)
+        h.update(repr((a.shape, str(a.dtype))).encode())
+        h.update(a.tobytes())
+    return h.hexdigest()
 
 
 def run_em_loop(
@@ -66,13 +86,30 @@ def run_em_loop(
     max_em_iter: int,
     collect_path: bool = False,
     trace_name: str = "em",
+    checkpoint_path: str | None = None,
+    checkpoint_every: int = 25,
 ):
     """Run an EM loop to convergence; returns (params, loglik_path, n_iter,
     trace).  `step(params, *args) -> (new_params, loglik-of-current-params)`
     must be a module-level jitted function (it is a static jit argument).
 
     trace is a ConvergenceTrace when collect_path=True, else None.
+
+    `checkpoint_path` makes a long run preemption-safe: the on-device loop
+    executes in chunks of `checkpoint_every` iterations, persisting
+    (params, convergence state, loglik path) to one .npz after each chunk
+    (utils.checkpoint pytree round-trip, atomic rename); a rerun with the
+    same path AND the same inputs (data/tol/max_em_iter, fingerprint-
+    checked) resumes from the last completed chunk and produces the same
+    final state as an uninterrupted run.
     """
+    if checkpoint_path is not None and collect_path:
+        raise ValueError(
+            "collect_path=True uses a host-synced loop that does not "
+            "checkpoint; drop checkpoint_path or collect_path"
+        )
+    if checkpoint_path is not None and checkpoint_every < 1:
+        raise ValueError(f"checkpoint_every must be >= 1, got {checkpoint_every}")
     if collect_path:
         trace = ConvergenceTrace(trace_name)
         llpath = []
@@ -90,7 +127,45 @@ def run_em_loop(
         return params, np.asarray(llpath), it, trace
 
     tol_arr = jnp.asarray(tol, jnp.result_type(float))
-    with annotate(trace_name):
-        params, n_iter, path = _em_while(step, params, args, tol_arr, max_em_iter)
-        n_iter = int(n_iter)
+    carry = _fresh_carry(params, tol_arr, max_em_iter)
+
+    if checkpoint_path is None:
+        with annotate(trace_name):
+            carry = _em_while(
+                step, carry, args, tol_arr, max_em_iter,
+                jnp.asarray(max_em_iter, jnp.int32),
+            )
+    else:
+        import os
+
+        from ..utils.checkpoint import load_pytree, save_pytree
+
+        fp = _fingerprint(args, tol, max_em_iter)
+        if os.path.exists(checkpoint_path):
+            stored = load_pytree(checkpoint_path, {"carry": carry, "fp": ""})
+            if str(stored["fp"]) != fp:
+                raise ValueError(
+                    f"checkpoint {checkpoint_path!r} was written for "
+                    "different inputs (data/tol/max_em_iter fingerprint "
+                    "mismatch); delete it or use another path"
+                )
+            carry = jax.tree.map(jnp.asarray, stored["carry"])
+        with annotate(trace_name):
+            while True:
+                it = int(carry[3])
+                if it >= max_em_iter:
+                    break
+                stop_at = jnp.asarray(
+                    min(it + checkpoint_every, max_em_iter), jnp.int32
+                )
+                new_carry = _em_while(step, carry, args, tol_arr, max_em_iter, stop_at)
+                if int(new_carry[3]) == it:  # converged (cond false on entry)
+                    break
+                carry = new_carry
+                tmp = checkpoint_path + ".tmp.npz"
+                save_pytree(tmp, {"carry": carry, "fp": fp})
+                os.replace(tmp, checkpoint_path)
+
+    params, _, _, n_iter, path = carry
+    n_iter = int(n_iter)
     return params, np.asarray(path)[:n_iter], n_iter, None

@@ -165,6 +165,8 @@ def estimate_mixed_freq_dfm(
     tol: float = 1e-6,
     backend: str | None = None,
     collect_path: bool = False,
+    checkpoint_path: str | None = None,
+    checkpoint_every: int = 25,
 ) -> MFResults:
     """Fit the mixed-frequency DFM on a MONTHLY-frequency (T, N) panel.
 
@@ -221,6 +223,7 @@ def estimate_mixed_freq_dfm(
         params, llpath, it, trace = run_em_loop(
             em_step_mf, params, (xz, m_arr), tol, max_em_iter,
             collect_path=collect_path, trace_name="em_mixed_freq",
+            checkpoint_path=checkpoint_path, checkpoint_every=checkpoint_every,
         )
 
         means, covs, pmeans, pcovs, _ = _filter_mf(params, xz, m_arr)

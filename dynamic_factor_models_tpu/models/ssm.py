@@ -372,6 +372,8 @@ def estimate_dfm_em(
     backend: str | None = None,
     collect_path: bool = False,
     method: str = "sequential",
+    checkpoint_path: str | None = None,
+    checkpoint_every: int = 25,
 ) -> EMResults:
     """State-space DFM via EM on the standardized included panel
     (BASELINE.json config 2: `State-space DFM via EM + Kalman smoother`).
@@ -409,6 +411,7 @@ def estimate_dfm_em(
         params, llpath, n_iter, trace = run_em_loop(
             step, params, (xz, m_arr), tol, max_em_iter,
             collect_path=collect_path, trace_name=f"em_dfm_{method}",
+            checkpoint_path=checkpoint_path, checkpoint_every=checkpoint_every,
         )
 
         means, covs, _ = kalman_smoother(params, jnp.where(m_arr, xz, jnp.nan))

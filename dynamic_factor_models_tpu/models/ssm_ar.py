@@ -203,6 +203,8 @@ def estimate_dfm_em_ar(
     tol: float = 1e-6,
     backend: str | None = None,
     collect_path: bool = False,
+    checkpoint_path: str | None = None,
+    checkpoint_every: int = 25,
 ) -> EMARResults:
     """Full Banbura-Modugno EM: factors + AR(1) idiosyncratic states.
 
@@ -235,6 +237,7 @@ def estimate_dfm_em_ar(
         params, llpath, it, trace = run_em_loop(
             em_step_ar, params, (xz, m_arr), tol, max_em_iter,
             collect_path=collect_path, trace_name="em_dfm_ar",
+            checkpoint_path=checkpoint_path, checkpoint_every=checkpoint_every,
         )
 
         means, covs, pmeans, pcovs, _ = _filter_ar(params, xz, m_arr)

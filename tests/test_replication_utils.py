@@ -105,3 +105,25 @@ def test_plotting_line_panel_and_figure2_render(tmp_path):
     assert out.stat().st_size > 10_000
     # legend present for multi-series panels (accessibility rule)
     assert ax1.get_legend() is not None and ax2.get_legend() is not None
+
+
+@pytest.mark.slow
+def test_run_all_fast_bundle():
+    """The full replication driver wiring end-to-end (fast mode: trimmed
+    sweeps). Shape/content sanity of every figure/table in the bundle."""
+    from dynamic_factor_models_tpu.replication.stock_watson import run_all
+
+    out = run_all(fast=True)
+    assert set(out) == {
+        "figure1", "figure2", "figure4", "figure5", "figure6", "figure7",
+        "table2", "table3", "table4", "table5",
+    }
+    assert set(out["figure1"]["series"]) == {"GDPC96", "INDPRO", "PAYEMS", "A0M057"}
+    assert out["table2"]["A"]["trace_r2"].shape == (6,)
+    assert np.isfinite(out["table2"]["B"]["bn_icp"]).all()
+    assert out["table3"].shape[1] == 4
+    assert out["figure6"]["all"].shape == (10,)
+    assert 4 in out["table4"]
+    for key in ("A", "B", "O"):
+        assert np.isfinite(out["table5"][key]["residual_cca"]).all()
+    assert np.isfinite(out["figure7"]["common_component"]).sum() > 100

@@ -232,3 +232,80 @@ def test_em_step_assoc_matches_sequential(rng):
     np.testing.assert_allclose(np.asarray(p1.A), np.asarray(p2.A), atol=1e-7)
     np.testing.assert_allclose(np.asarray(p1.Q), np.asarray(p2.Q), atol=1e-7)
     np.testing.assert_allclose(np.asarray(p1.R), np.asarray(p2.R), atol=1e-7)
+
+
+def test_em_loop_checkpoint_resume(tmp_path, rng):
+    """Chunked+checkpointed EM == uninterrupted EM, and a rerun resumes
+    from the persisted state instead of starting over."""
+    import jax.numpy as jnp
+
+    from dynamic_factor_models_tpu.models.emloop import run_em_loop
+    from dynamic_factor_models_tpu.models.ssm import SSMParams, em_step
+
+    T, N, r, p = 50, 6, 2, 1
+    x = rng.standard_normal((T, N))
+    xz = jnp.asarray(x)
+    m = jnp.ones((T, N), bool)
+    params = SSMParams(
+        lam=jnp.asarray(rng.standard_normal((N, r)) * 0.5),
+        R=jnp.ones(N),
+        A=0.4 * jnp.eye(r)[None],
+        Q=jnp.eye(r),
+    )
+    ck = str(tmp_path / "em_ck.npz")
+    p_plain, path_plain, n_plain, _ = run_em_loop(
+        em_step, params, (xz, m), 1e-8, 30
+    )
+    p_ck, path_ck, n_ck, _ = run_em_loop(
+        em_step, params, (xz, m), 1e-8, 30,
+        checkpoint_path=ck, checkpoint_every=7,
+    )
+    assert n_ck == n_plain
+    np.testing.assert_allclose(path_ck, path_plain, rtol=1e-10)
+    np.testing.assert_allclose(
+        np.asarray(p_ck.lam), np.asarray(p_plain.lam), atol=1e-10
+    )
+    # resume: a fresh call with the same path starts from the saved state
+    # (params argument is ignored in favor of the checkpoint) and returns
+    # the identical converged state
+    p_res, path_res, n_res, _ = run_em_loop(
+        em_step, params, (xz, m), 1e-8, 30,
+        checkpoint_path=ck, checkpoint_every=7,
+    )
+    assert n_res == n_ck
+    np.testing.assert_allclose(
+        np.asarray(p_res.lam), np.asarray(p_ck.lam), atol=1e-12
+    )
+
+
+def test_em_loop_checkpoint_guards(tmp_path, rng):
+    """Checkpoint misuse fails loudly: wrong-inputs resume, bad chunk size,
+    collect_path combination."""
+    import jax.numpy as jnp
+    import pytest
+
+    from dynamic_factor_models_tpu.models.emloop import run_em_loop
+    from dynamic_factor_models_tpu.models.ssm import SSMParams, em_step
+
+    T, N, r = 30, 5, 1
+    xz = jnp.asarray(rng.standard_normal((T, N)))
+    m = jnp.ones((T, N), bool)
+    params = SSMParams(
+        lam=jnp.ones((N, r)) * 0.5, R=jnp.ones(N),
+        A=0.4 * jnp.eye(r)[None], Q=jnp.eye(r),
+    )
+    ck = str(tmp_path / "ck.npz")
+    run_em_loop(em_step, params, (xz, m), 1e-8, 10, checkpoint_path=ck)
+    # different data -> fingerprint mismatch
+    xz2 = xz + 1.0
+    with pytest.raises(ValueError, match="fingerprint"):
+        run_em_loop(em_step, params, (xz2, m), 1e-8, 10, checkpoint_path=ck)
+    # different max_em_iter -> also a mismatch (path length differs)
+    with pytest.raises(ValueError, match="fingerprint"):
+        run_em_loop(em_step, params, (xz, m), 1e-8, 20, checkpoint_path=ck)
+    with pytest.raises(ValueError, match="checkpoint_every"):
+        run_em_loop(em_step, params, (xz, m), 1e-8, 10,
+                    checkpoint_path=ck, checkpoint_every=0)
+    with pytest.raises(ValueError, match="collect_path"):
+        run_em_loop(em_step, params, (xz, m), 1e-8, 10,
+                    checkpoint_path=ck, collect_path=True)
