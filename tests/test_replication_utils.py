@@ -127,3 +127,19 @@ def test_run_all_fast_bundle():
     for key in ("A", "B", "O"):
         assert np.isfinite(out["table5"][key]["residual_cca"]).all()
     assert np.isfinite(out["figure7"]["common_component"]).sum() > 100
+
+
+def test_cli_driver_help_and_json():
+    """CLI module parses args and its JSON encoder handles the bundle types."""
+    import subprocess
+    import sys
+
+    from dynamic_factor_models_tpu.replication.__main__ import _to_jsonable
+
+    out = subprocess.run(
+        [sys.executable, "-m", "dynamic_factor_models_tpu.replication", "--help"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert out.returncode == 0 and "--full" in out.stdout
+    enc = _to_jsonable({"a": np.array([1.0, np.nan]), "b": (np.int64(2), "s")})
+    assert enc == {"a": [1.0, None], "b": [2, "s"]}
