@@ -82,14 +82,44 @@ def test_pallas_compiled_on_tpu():
         if k not in ("JAX_PLATFORMS", "XLA_FLAGS", "JAX_ENABLE_X64")
     }
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    proc = subprocess.run(
-        [sys.executable, "-c", _COMPILED_CHECK],
-        cwd=repo,
-        env=env,
-        capture_output=True,
-        text=True,
-        timeout=1200,  # jax import + first compile is slow under suite load
+    # cheap probe first: a hung device query means the chip/tunnel is
+    # unreachable (an environment condition, not a kernel failure) — bound
+    # that case to ~2 min instead of stalling the whole suite
+    probe = (
+        "import jax, jax.numpy as jnp\n"
+        "print('NO_TPU' if jax.default_backend() not in ('tpu', 'axon')\n"
+        "      else ('TPU_OK', float(jnp.ones((8, 8)).sum())))\n"
     )
+    try:
+        pr = subprocess.run(
+            [sys.executable, "-c", probe],
+            cwd=repo, env=env, capture_output=True, text=True, timeout=120,
+        )
+    except subprocess.TimeoutExpired:
+        pytest.skip("TPU unresponsive (device probe timed out)")
+    if "NO_TPU" in pr.stdout or "TPU_OK" not in pr.stdout:
+        pytest.skip(
+            "no TPU reachable in this environment "
+            f"(rc={pr.returncode}, stdout={pr.stdout[-100:]!r}, "
+            f"stderr={pr.stderr[-300:]!r})"
+        )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _COMPILED_CHECK],
+            cwd=repo,
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=1200,  # jax import + first compile is slow under load
+        )
+    except subprocess.TimeoutExpired as e:
+        # the probe just proved the chip responsive, so a hang HERE is the
+        # regression class this test exists to catch (kernel/compile
+        # deadlock) — fail, don't skip
+        pytest.fail(
+            f"compiled Pallas check hung (>1200s) on a responsive TPU: "
+            f"stdout={(e.stdout or b'')[-300:]!r}"
+        )
     if "NO_TPU" in proc.stdout:
         pytest.skip("no TPU reachable in this environment")
     assert proc.returncode == 0, proc.stdout + proc.stderr
