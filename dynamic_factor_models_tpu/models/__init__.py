@@ -1,11 +1,15 @@
 from .dfm import (
+    BatchFactorResults,
+    RollingFactorResults,
     DFMConfig,
     DFMResults,
     FactorEstimateStats,
     compute_series,
     estimate_dfm,
     estimate_factor,
+    estimate_factor_batch,
     estimate_factor_loading,
+    rolling_factor_estimates,
 )
 from .var import VARResults, estimate_var, impulse_response
 from .selection import (

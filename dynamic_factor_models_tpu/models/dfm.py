@@ -48,9 +48,12 @@ __all__ = [
     "DFMConfig",
     "FactorEstimateStats",
     "DFMResults",
+    "BatchFactorResults",
+    "RollingFactorResults",
     "estimate_factor",
     "estimate_factor_batch",
     "estimate_factor_loading",
+    "rolling_factor_estimates",
     "estimate_dfm",
     "compute_series",
 ]
@@ -448,6 +451,56 @@ def estimate_factor_batch(
             r2[:B_real],
             np.asarray(nfacs)[:B_real],
         )
+
+
+class RollingFactorResults(NamedTuple):
+    starts: np.ndarray  # (B,) first panel row of each window
+    window: int
+    batch: BatchFactorResults  # factor rows are WINDOW-relative (window, rmax)
+
+
+def rolling_factor_estimates(
+    data,
+    inclcode,
+    window: int,
+    nfac: int,
+    config: DFMConfig = DFMConfig(),
+    step: int = 1,
+    initperiod: int = 0,
+    lastperiod: int | None = None,
+    backend: str | None = None,
+    mesh=None,
+) -> RollingFactorResults:
+    """Rolling-window factor estimation: every window is one element of a
+    single `estimate_factor_batch` call.
+
+    The reference studies parameter instability only through one 1984Q4
+    split (Stock_Watson.ipynb cell 57); rolling windows are the
+    continuous-time version of that exercise — trace R^2 / SSR per window
+    tracks how factor structure evolves — and here they cost one batched
+    while_loop regardless of the number of windows (shard the batch over a
+    mesh for multi-chip).  Window i covers panel rows
+    [starts[i], starts[i] + window - 1]; batch elements are SLICED to the
+    window (so memory/compute scale with `window`, not the panel length)
+    and `batch.factor[i]` rows are window-relative.
+    """
+    data = np.asarray(data)
+    T = data.shape[0]
+    last = T - 1 if lastperiod is None else lastperiod
+    if not 0 <= initperiod <= last < T:
+        raise ValueError(
+            f"invalid rows {initperiod}..{last} for a {T}-row panel"
+        )
+    if not 1 <= window <= last - initperiod + 1:
+        raise ValueError(
+            f"window={window} does not fit in rows {initperiod}..{last}"
+        )
+    starts = np.arange(initperiod, last - window + 2, step)
+    panels = [
+        (data[s : s + window], inclcode, 0, window - 1, nfac) for s in starts
+    ]
+    batch = estimate_factor_batch(panels, config, backend=backend, mesh=mesh)
+    return RollingFactorResults(starts, window, batch)
 
 
 # ---------------------------------------------------------------------------
