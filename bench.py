@@ -110,6 +110,31 @@ def parity_checks(ds):
     }
 
 
+def _guarded_device(timeout_s: int = 240):
+    """First device touch behind the shared subprocess liveness probe
+    (utils.backend.probe_default_device): emit a parseable JSON line +
+    nonzero exit instead of stalling the caller's whole run when the
+    tunnel is wedged."""
+    from dynamic_factor_models_tpu.utils.backend import probe_default_device
+
+    ok, detail = probe_default_device(timeout_s)
+    if not ok:
+        print(
+            json.dumps(
+                {
+                    "metric": "favar_irf_wild_bootstrap_1000rep_wallclock",
+                    "value": None,
+                    "unit": "s",
+                    "vs_baseline": None,
+                    "error": f"TPU unreachable — {detail}; no numbers produced",
+                }
+            ),
+            flush=True,
+        )
+        sys.exit(3)
+    return jax.devices()[0]
+
+
 def main():
     from dynamic_factor_models_tpu.io.cache import cached_dataset
     from dynamic_factor_models_tpu.models.dfm import DFMConfig, estimate_factor
@@ -117,7 +142,7 @@ def main():
     from dynamic_factor_models_tpu.models.ssm import em_step, SSMParams
     from dynamic_factor_models_tpu.ops.masking import fillz, mask_of
 
-    dev = jax.devices()[0]
+    dev = _guarded_device()
     ds = cached_dataset("Real")
 
     # factors via ALS (f32-safe tolerance; parity is covered below)

@@ -11,7 +11,49 @@ import contextlib
 
 import jax
 
-__all__ = ["resolve_device", "on_backend"]
+__all__ = ["resolve_device", "on_backend", "probe_default_device"]
+
+
+def probe_default_device(timeout_s: int = 240):
+    """Liveness-check the default jax device in a killable subprocess.
+
+    A wedged TPU tunnel hangs backend init inside native code where
+    in-process watchdogs (signals, alarms) never fire — only a separate
+    process can be bounded.  The child mirrors this process's config-level
+    ``jax_platforms`` (env vars alone lose to the axon sitecustomize, which
+    force-sets the config at import).  Returns (ok, detail); a CPU-only
+    platform config short-circuits to ok — there is no tunnel to wedge.
+    """
+    import os
+    import subprocess
+    import sys
+
+    plat = jax.config.jax_platforms or ""
+    if plat and all(p.strip() == "cpu" for p in plat.split(",")):
+        return True, "cpu-only platform config; no probe needed"
+    env = dict(os.environ)
+    if plat:
+        env["_DFM_PROBE_PLATFORMS"] = plat
+    probe = (
+        "import os, jax, jax.numpy as jnp\n"
+        "p = os.environ.get('_DFM_PROBE_PLATFORMS')\n"
+        "if p: jax.config.update('jax_platforms', p)\n"
+        "jax.block_until_ready(jnp.ones(8).sum())\n"
+        "print('DEVICE_OK', jax.devices()[0])\n"
+    )
+    try:
+        pr = subprocess.run(
+            [sys.executable, "-c", probe],
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+            env=env,
+        )
+    except subprocess.TimeoutExpired:
+        return False, f"device probe exceeded {timeout_s}s (tunnel wedged?)"
+    if pr.returncode != 0 or "DEVICE_OK" not in pr.stdout:
+        return False, f"rc={pr.returncode}, stderr={pr.stderr[-300:]!r}"
+    return True, pr.stdout.strip()
 
 _ALIASES = {"tpu": ("tpu", "axon"), "cpu": ("cpu",), "gpu": ("gpu", "cuda", "rocm")}
 
