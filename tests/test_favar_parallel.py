@@ -111,3 +111,16 @@ def test_distributed_helpers_single_process():
     assert mesh2.shape == {"dp": 4, "sp": 2}
     with pytest.raises(ValueError, match="tile"):
         global_mesh(axis_names=("dp",), shape=(3,))
+
+
+def test_probe_default_device_cpu_short_circuit():
+    """Under the suite's cpu-only platform config the liveness probe must
+    short-circuit without spawning a subprocess-visible delay."""
+    import time
+
+    from dynamic_factor_models_tpu.utils.backend import probe_default_device
+
+    t0 = time.perf_counter()
+    ok, detail = probe_default_device(5)
+    assert ok and "cpu-only" in detail
+    assert time.perf_counter() - t0 < 1.0
