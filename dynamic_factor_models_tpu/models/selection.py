@@ -22,7 +22,7 @@ import numpy as np
 from ..ops.lags import lagmat
 from ..ops.linalg import ols_batched_series
 from ..ops.masking import fillz, mask_of
-from .dfm import DFMConfig, FactorEstimateStats, estimate_factor, estimate_factor_batch
+from .dfm import DFMConfig, FactorEstimateStats, estimate_factor_batch
 
 __all__ = [
     "bai_ng_criterion",
@@ -100,19 +100,25 @@ def amengual_watson_test(
     keep = ndf >= config.nt_min_factor
     resid = jnp.where(keep[None, :], resid, jnp.nan)
 
-    aw = np.full(nfac_static, np.nan)
-    ssr = np.full(nfac_static, np.nan)
-    r2 = np.full((ns, nfac_static), np.nan)
     ones = np.ones(ns, dtype=inclcode.dtype)
-    for nfac_d in range(1, nfac_static + 1):
-        cfg_d = dataclasses.replace(config, nfac_u=nfac_d, nfac_o=0)
-        _, fes = estimate_factor(
-            resid, ones, initperiod + nlag, lastperiod, cfg_d
-        )
-        aw[nfac_d - 1] = float(bai_ng_criterion(fes, nfac_d))
-        ssr[nfac_d - 1] = float(fes.ssr)
-        r2[:, nfac_d - 1] = np.asarray(fes.R2)
-    return aw, ssr, r2
+    resid_np = np.asarray(resid)
+    cfg_d = dataclasses.replace(config, nfac_o=0)
+    batch = estimate_factor_batch(
+        [
+            (resid_np, ones, initperiod + nlag, lastperiod, d)
+            for d in range(1, nfac_static + 1)
+        ],
+        cfg_d,
+    )
+    ssr_np = np.asarray(batch.ssr)
+    nobs_np = np.asarray(batch.nobs)
+    aw = np.array(
+        [
+            _bai_ng(ssr_np[i], nobs_np[i], int(batch.Tw[i]), i + 1)
+            for i in range(nfac_static)
+        ]
+    )
+    return aw, ssr_np, np.asarray(batch.R2).T
 
 
 def _bai_ng(ssr, nobs, T, nfac_t):
