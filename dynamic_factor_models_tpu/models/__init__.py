@@ -41,6 +41,18 @@ from .ssm_ar import (
     nowcast_em_ar,
 )
 from .mixed_freq import MFResults, MixedFreqParams, estimate_mixed_freq_dfm
+from .svar import (
+    LocalProjection,
+    ProxyBootstrapIRFs,
+    ProxyImpact,
+    SignRestriction,
+    SignRestrictionIRFs,
+    local_projection,
+    proxy_bootstrap_irfs,
+    proxy_impact,
+    proxy_irfs,
+    sign_restriction_irfs,
+)
 from .forecast import (
     DFMForecast,
     forecast_factors,

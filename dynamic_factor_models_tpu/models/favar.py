@@ -53,31 +53,36 @@ def _fit_dense_var(y, nlag: int):
     return betahat, ehat, seps
 
 
+def _wild_recursion(y_init, betahat, eta, nlag: int) -> jnp.ndarray:
+    """Rebuild a resampled panel y* by the VAR recursion: y_init (nlag, ns)
+    seed rows, betahat (1+ns*nlag, ns) with const first, eta (T-nlag, ns)
+    resampled residuals.  Shared by the FAVAR and proxy-SVAR wild bootstraps."""
+    ns = y_init.shape[1]
+    const = betahat[0]
+    blocks = [betahat[1 + i * ns : 1 + (i + 1) * ns].T for i in range(nlag)]
+
+    def recurse(lags, e_t):
+        # lags: (nlag, ns), most recent first
+        y_t = const + e_t
+        for i in range(nlag):
+            y_t = y_t + blocks[i] @ lags[i]
+        return jnp.concatenate([y_t[None], lags[:-1]], axis=0), y_t
+
+    _, tail = jax.lax.scan(recurse, y_init[::-1], eta)
+    return jnp.concatenate([y_init, tail], axis=0)
+
+
 @partial(jax.jit, static_argnames=("nlag", "horizon", "n_reps"))
 def _bootstrap_core(yw, key, nlag: int, horizon: int, n_reps: int):
     Tw, ns = yw.shape
     betahat, ehat, _ = _fit_dense_var(yw, nlag)
-    const = betahat[0]
-    blocks = [betahat[1 + i * ns : 1 + (i + 1) * ns].T for i in range(nlag)]
     y_init = yw[:nlag]
 
     def one_rep(k):
         # wild bootstrap: one Rademacher sign per period, shared across
         # equations — preserves the cross-equation residual correlation
         signs = jax.random.rademacher(k, (Tw - nlag,), dtype=yw.dtype)
-        eta = ehat * signs[:, None]
-
-        def recurse(lags, e_t):
-            # lags: (nlag, ns), most recent first
-            y_t = const + e_t
-            for i in range(nlag):
-                y_t = y_t + blocks[i] @ lags[i]
-            new_lags = jnp.concatenate([y_t[None], lags[:-1]], axis=0)
-            return new_lags, y_t
-
-        init = y_init[::-1]
-        _, ystar_tail = jax.lax.scan(recurse, init, eta)
-        ystar = jnp.concatenate([y_init, ystar_tail], axis=0)
+        ystar = _wild_recursion(y_init, betahat, ehat * signs[:, None], nlag)
 
         b_star, _, seps_star = _fit_dense_var(ystar, nlag)
         M, Q, G = companion_matrices(b_star, seps_star, nlag)
