@@ -41,6 +41,14 @@ from .ssm_ar import (
     nowcast_em_ar,
 )
 from .mixed_freq import MFResults, MixedFreqParams, estimate_mixed_freq_dfm
+from .bayes import (
+    BayesPriors,
+    BayesResults,
+    estimate_dfm_bayes,
+    posterior_irfs,
+    rhat,
+    simulation_smoother,
+)
 from .svar import (
     LocalProjection,
     ProxyBootstrapIRFs,

@@ -1,0 +1,380 @@
+"""Bayesian state-space DFM: Gibbs sampling with a Carter-Kohn simulation
+smoother, chains ``vmap``-ed (and mesh-shardable) on device.
+
+New capability (no counterpart in the reference, which is entirely
+frequentist — dfm_functions.ipynb implements only the non-parametric ALS
+path, SURVEY.md section 0): full posterior inference for the state-space DFM
+
+    x_t = Lam f_t + eps_t,   eps_t ~ N(0, diag(R))
+    f_t = A_1 f_{t-1} + ... + A_p f_{t-p} + u_t,   u_t ~ N(0, Q)
+
+with conjugate priors (Normal-InverseGamma rows of Lam/R, Matrix-Normal-
+InverseWishart factor VAR).  The sampler is the Kim-Nelson variant of
+Carter-Kohn for the singular companion transition: the masked information-
+form Kalman filter (ssm._filter_scan) runs forward, then the backward pass
+conditions each state only on the drawn *new* factor block f_{t+1} — the
+only stochastic innovation of the companion — and draws f_t.
+
+TPU-first design: one Gibbs iteration (filter scan + backward sampling scan
++ three conjugate blocks) is a single jitted function; the iteration loop is
+a ``lax.scan``; independent chains are one ``vmap`` whose chain axis shards
+over a device mesh exactly like bootstrap replications (models/favar.py).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import jax.scipy.linalg as jsl
+import numpy as np
+
+from ..ops.linalg import solve_normal, standardize_data
+from ..ops.masking import fillz, mask_of
+from ..parallel.mesh import NamedSharding, P
+from ..utils.backend import on_backend
+from .dfm import DFMConfig
+from .ssm import SSMParams, _companion, _filter_scan, _init_params_from_als, _psd_floor
+
+__all__ = [
+    "BayesPriors",
+    "BayesResults",
+    "estimate_dfm_bayes",
+    "simulation_smoother",
+    "posterior_irfs",
+    "rhat",
+]
+
+
+class BayesPriors(NamedTuple):
+    """Conjugate prior hyperparameters (diffuse defaults).
+
+    lam_scale: prior sd tau of each loading, lam_i ~ N(0, tau^2 I);
+    r_shape/r_rate: R_i ~ InvGamma(a0, b0);
+    q_df_extra: IW degrees of freedom nu0 = r + 1 + q_df_extra;
+    q_scale: IW scale matrix S0 = q_scale * I.
+    """
+
+    lam_scale: float = 10.0
+    r_shape: float = 0.01
+    r_rate: float = 0.01
+    q_df_extra: float = 1.0
+    q_scale: float = 0.01
+
+
+class BayesResults(NamedTuple):
+    factor_draws: jnp.ndarray  # (chains, keep, T, r)
+    lam_draws: jnp.ndarray  # (chains, keep, N, r)
+    r_draws: jnp.ndarray  # (chains, keep, N)
+    a_draws: jnp.ndarray  # (chains, keep, p, r, r)
+    q_draws: jnp.ndarray  # (chains, keep, r, r)
+    loglik_path: np.ndarray  # (chains, total_iters) filter loglik per sweep
+    rhat_loglik: float  # split-R-hat of the post-burn loglik path
+    stds: jnp.ndarray  # per-series standardization scale
+    means: jnp.ndarray  # per-series means (original units)
+
+
+def _draw_mvn(key, mean, cov):
+    """One draw from N(mean, cov) via Cholesky with a jitter floor scaled to
+    the covariance magnitude (cf. ssm._psd_floor): backward-pass downdates on
+    O(1e2) filtered covariances carry rounding error far above absolute eps,
+    and a NaN Cholesky here would silently poison the whole chain scan."""
+    d = mean.shape[0]
+    eps = jnp.asarray(jnp.finfo(cov.dtype).eps, cov.dtype)
+    scale = jnp.maximum(jnp.diagonal(cov).max(), 1.0)
+    L = jnp.linalg.cholesky(
+        0.5 * (cov + cov.T) + 16.0 * eps * scale * jnp.eye(d, dtype=cov.dtype)
+    )
+    return mean + L @ jax.random.normal(key, (d,), dtype=cov.dtype)
+
+
+def _simulation_smoother_core(params: SSMParams, x, mask, key):
+    """Draw a factor path f_{0:T-1} | x, params (Kim-Nelson backward pass on
+    the filtered moments).  Returns (f_draws (T, r), filter loglik)."""
+    filt = _filter_scan(params, x, mask)
+    r = params.r
+    Tm, _ = _companion(params)
+    H = Tm[:r]  # f_{t+1} = H s_t + u_{t+1}
+    Q = params.Q
+
+    key, klast = jax.random.split(key)
+    f_last = _draw_mvn(klast, filt.means[-1][:r], filt.covs[-1][:r, :r])
+
+    T = x.shape[0]
+    keys = jax.random.split(key, T - 1)
+
+    def back_step(f_next, inp):
+        su, Pu, kt = inp
+        # condition the filtered state on the drawn new-factor block only:
+        # s_{t+1}'s remaining blocks are deterministic given s_t
+        S = H @ Pu @ H.T + Q
+        Ls = jnp.linalg.cholesky(0.5 * (S + S.T))
+        J = jsl.cho_solve((Ls, True), H @ Pu).T  # (k, r)
+        su_c = su + J @ (f_next - H @ su)
+        Pu_c = Pu - J @ (H @ Pu)
+        f_t = _draw_mvn(kt, su_c[:r], Pu_c[:r, :r])
+        return f_t, f_t
+
+    _, f_rest = jax.lax.scan(
+        back_step, f_last, (filt.means[:-1], filt.covs[:-1], keys), reverse=True
+    )
+    f = jnp.concatenate([f_rest, f_last[None]], axis=0)
+    return f, filt.loglik
+
+
+def simulation_smoother(
+    params: SSMParams, x, seed: int = 0, backend: str | None = None
+):
+    """Public entry: one posterior factor-path draw f | x, params.
+
+    x: (T, N) panel with NaN missing.  Returns ((T, r) draw, loglik).
+    vmap over seeds (via jax.random.split outside) for multiple draws.
+    """
+    with on_backend(backend):
+        params = params._replace(Q=_psd_floor(params.Q))
+        x = jnp.asarray(x)
+        return _simulation_smoother_core(
+            params, fillz(x), mask_of(x), jax.random.PRNGKey(seed)
+        )
+
+
+def _gibbs_sweep(carry, xz, m, p: int, priors: tuple):
+    """One full Gibbs sweep: f | params  ->  (lam, R) | f  ->  (A, Q) | f."""
+    key, params = carry
+    lam_scale, a0, b0, q_df_extra, q_scale = priors
+    dtype = xz.dtype
+    T, N = xz.shape
+    r = params.r
+
+    key, kf, klamr, kvar = jax.random.split(key, 4)
+
+    # --- factors ---
+    f, ll = _simulation_smoother_core(params, xz, m, kf)
+
+    # --- loadings + idiosyncratic variances (batched over series) ---
+    Fg = jnp.einsum("ti,tr,ts->irs", m, f, f)
+    Fx = jnp.einsum("ti,tr->ir", m * xz, f)
+    n_i = m.sum(axis=0)
+    klam, kr = jax.random.split(klamr)
+    lam_keys = jax.random.split(klam, N)
+
+    def draw_lam_i(Fg_i, Fx_i, R_i, k_i):
+        prec = Fg_i + (R_i / lam_scale**2) * jnp.eye(r, dtype=dtype)
+        pinv = jnp.linalg.pinv(prec, hermitian=True)
+        return _draw_mvn(k_i, pinv @ Fx_i, R_i * pinv)
+
+    lam = jax.vmap(draw_lam_i)(Fg, Fx, params.R, lam_keys)
+    resid = jnp.where(m.astype(bool), xz - f @ lam.T, 0.0)
+    ssr = (resid**2).sum(axis=0)
+    # R_i ~ InvGamma(a0 + n_i/2, b0 + ssr_i/2) = (b0 + ssr/2) / Gamma(shape)
+    gshape = a0 + 0.5 * n_i
+    g = jax.random.gamma(kr, gshape, dtype=dtype)
+    R = jnp.maximum((b0 + 0.5 * ssr) / g, 1e-8)
+
+    # --- factor VAR (Matrix-Normal-Inverse-Wishart) ---
+    Z = jnp.concatenate([f[p - 1 - i : T - 1 - i] for i in range(p)], axis=1)
+    Y = f[p:]
+    ZZ = Z.T @ Z + 1e-8 * jnp.eye(r * p, dtype=dtype)
+    Ahat = solve_normal(ZZ, Z.T @ Y)  # (r*p, r)
+    E0 = Y - Z @ Ahat
+    S = q_scale * jnp.eye(r, dtype=dtype) + E0.T @ E0
+    nu = (r + 1.0 + q_df_extra) + (T - p)
+
+    kq, ka = jax.random.split(kvar)
+    # Q ~ IW(nu, S): Q = inv(W), W ~ Wishart(nu, S^{-1}) by Bartlett
+    Ls_inv = jnp.linalg.cholesky(jnp.linalg.pinv(0.5 * (S + S.T), hermitian=True))
+    kchi, knorm = jax.random.split(kq)
+    chi = jnp.sqrt(
+        2.0 * jax.random.gamma(kchi, 0.5 * (nu - jnp.arange(r, dtype=dtype)), dtype=dtype)
+    )
+    Bl = jnp.tril(jax.random.normal(knorm, (r, r), dtype=dtype), -1) + jnp.diag(chi)
+    Wc = Ls_inv @ Bl  # chol factor of W
+    W = Wc @ Wc.T
+    Q = _psd_floor(jnp.linalg.pinv(W, hermitian=True))
+
+    # vec(A) | Q ~ N(vec(Ahat), Q kron ZZ^{-1}): A = Ahat + Lzz^{-T} E Lq'
+    Lzz = jnp.linalg.cholesky(0.5 * (ZZ + ZZ.T))
+    Eg = jax.random.normal(ka, (r * p, r), dtype=dtype)
+    Adraw = Ahat + jsl.solve_triangular(Lzz.T, Eg, lower=False) @ jnp.linalg.cholesky(Q).T
+    A = jnp.stack([Adraw[i * r : (i + 1) * r].T for i in range(p)])
+
+    new_params = SSMParams(lam=lam, R=R, A=A, Q=Q)
+    return (key, new_params), (f, lam, R, A, Q, ll)
+
+
+@partial(jax.jit, static_argnames=("n_burn", "n_keep", "thin", "p"))
+def _chain(
+    key,
+    params0: SSMParams,
+    xz,
+    m,
+    n_burn: int,
+    n_keep: int,
+    thin: int,
+    p: int,
+    priors: tuple,
+):
+    """One Gibbs chain: a carry-only burn-in scan, then a keep-phase scan
+    that materializes only every thin-th sweep — device memory holds n_keep
+    draws, not n_burn + n_keep*thin.  Returns ((f, lam, R, A, Q) kept draws,
+    loglik of every sweep in order)."""
+
+    def sweep_ll(carry, _):
+        carry, outs = _gibbs_sweep(carry, xz, m, p, priors)
+        return carry, outs[5]
+
+    def keep_body(carry, _):
+        carry, lls_thin = jax.lax.scan(sweep_ll, carry, None, length=thin - 1)
+        carry, outs = _gibbs_sweep(carry, xz, m, p, priors)
+        return carry, (outs[:5], jnp.concatenate([lls_thin, outs[5][None]]))
+
+    carry = (key, params0)
+    carry, ll_burn = jax.lax.scan(sweep_ll, carry, None, length=n_burn)
+    _, (kept, ll_keep) = jax.lax.scan(keep_body, carry, None, length=n_keep)
+    lls = jnp.concatenate([ll_burn, ll_keep.reshape(-1)])
+    return kept + (lls,)  # (f, lam, R, A, Q, lls)
+
+
+def _sign_normalize(f, lam, A, Q):
+    """Per-draw sign normalization: flip each factor so its loading column
+    sums positive (factors are identified up to sign; without this, chain
+    draws mix over the 2^r sign orbit and posterior means collapse to 0)."""
+    s = jnp.sign(lam.sum(axis=-2))  # (..., r)
+    s = jnp.where(s == 0, 1.0, s)
+    f_n = f * s[..., None, :]
+    lam_n = lam * s[..., None, :]
+    A_n = A * s[..., None, :, None] * s[..., None, None, :]
+    Q_n = Q * s[..., :, None] * s[..., None, :]
+    return f_n, lam_n, A_n, Q_n
+
+
+def rhat(draws) -> float:
+    """Split-R-hat (Gelman-Rubin) of a (chains, draws) scalar sample."""
+    x = np.asarray(draws, np.float64)
+    c, n = x.shape
+    half = n // 2
+    x = x[:, : 2 * half].reshape(2 * c, half)
+    cm = x.mean(axis=1)
+    W = x.var(axis=1, ddof=1).mean()
+    B = half * cm.var(ddof=1)
+    var_plus = (half - 1) / half * W + B / half
+    return float(np.sqrt(var_plus / W))
+
+
+def estimate_dfm_bayes(
+    data,
+    inclcode,
+    initperiod: int,
+    lastperiod: int,
+    config: DFMConfig = DFMConfig(nfac_u=4),
+    n_keep: int = 500,
+    n_burn: int = 500,
+    thin: int = 1,
+    n_chains: int = 2,
+    seed: int = 0,
+    priors: BayesPriors = BayesPriors(),
+    mesh=None,
+    backend: str | None = None,
+) -> BayesResults:
+    """Posterior sampling of the state-space DFM by Gibbs, chains in
+    parallel on device.
+
+    Same data path as `estimate_dfm_em` (standardized included panel,
+    NaN-masked), initialized from the non-parametric ALS fit, with the
+    chain axis ``vmap``-ed — pass a 1-axis `mesh` to shard chains across
+    devices like bootstrap replications.  Returns sign-normalized posterior
+    draws (post burn-in, thinned) and a split-R-hat convergence diagnostic
+    on the log-likelihood path.
+    """
+    with on_backend(backend):
+        data = jnp.asarray(data)
+        inclcode = np.asarray(inclcode)
+        est = data[:, inclcode == 1]
+        xw = est[initperiod : lastperiod + 1]
+        xstd, stds = standardize_data(xw)
+        m_arr = mask_of(xstd)
+        xz = fillz(xstd)
+        mw = mask_of(xw)
+        n_mean = (fillz(xw) * mw).sum(axis=0) / mw.sum(axis=0)
+
+        params0 = _init_params_from_als(
+            data, inclcode, initperiod, lastperiod, config, xz, m_arr
+        )
+        p = config.n_factorlag
+        prior_t = (
+            float(priors.lam_scale),
+            float(priors.r_shape),
+            float(priors.r_rate),
+            float(priors.q_df_extra),
+            float(priors.q_scale),
+        )
+
+        keys = jax.random.split(jax.random.PRNGKey(seed), n_chains)
+        if mesh is not None:
+            # shard the chain axis over the mesh's (single) axis, whatever
+            # its name — make_mesh() defaults to "rep"
+            keys = jax.device_put(
+                keys, NamedSharding(mesh, P(mesh.axis_names[0]))
+            )
+
+        run = jax.vmap(
+            lambda k: _chain(
+                k, params0, xz, m_arr.astype(xz.dtype),
+                n_burn, n_keep, thin, p, prior_t,
+            )
+        )
+        f_k, lam_k, r_k, a_k, q_k, ll_all = run(keys)
+
+        f_k, lam_k, a_k, q_k = _sign_normalize(f_k, lam_k, a_k, q_k)
+        ll_np = np.asarray(ll_all)
+        return BayesResults(
+            factor_draws=f_k,
+            lam_draws=lam_k,
+            r_draws=r_k,
+            a_draws=a_k,
+            q_draws=q_k,
+            loglik_path=ll_np,
+            rhat_loglik=rhat(ll_np[:, n_burn:]),
+            stds=stds,
+            means=n_mean,
+        )
+
+
+def posterior_irfs(
+    results: BayesResults,
+    horizon: int = 24,
+    quantile_levels=(0.05, 0.16, 0.5, 0.84, 0.95),
+):
+    """Posterior IRF bands of the factor VAR under recursive identification:
+    each kept (A, Q) draw maps to Cholesky-identified IRFs (models/var.py
+    companion machinery), vmapped over the flattened chain x draw axis.
+
+    Returns (quantiles (nq, r, horizon, r), draws (n, r, horizon, r))."""
+    from .var import companion_matrices
+
+    a = results.a_draws.reshape((-1,) + results.a_draws.shape[2:])
+    q = results.q_draws.reshape((-1,) + results.q_draws.shape[2:])
+    p, r = a.shape[1], a.shape[2]
+
+    def one(a_i, q_i):
+        beta = jnp.concatenate(
+            [jnp.zeros((1, r), a_i.dtype)]
+            + [a_i[j].T for j in range(p)],
+            axis=0,
+        )
+        M, Qs, G = companion_matrices(beta, _psd_floor(q_i), p)
+
+        def step(x, _):
+            return M @ x, Qs @ x
+
+        def one_shock(g):
+            _, out = jax.lax.scan(step, g, None, length=horizon)
+            return out.T
+
+        return jax.vmap(one_shock, in_axes=1, out_axes=2)(G)
+
+    draws = jax.jit(jax.vmap(one))(a, q)
+    qs = jnp.quantile(draws, jnp.asarray(quantile_levels), axis=0)
+    return qs, draws
