@@ -49,6 +49,7 @@ from .bayes import (
     rhat,
     simulation_smoother,
 )
+from .sv import SVPriors, SVResults, estimate_dfm_sv
 from .svar import (
     LocalProjection,
     ProxyBootstrapIRFs,

@@ -1,5 +1,5 @@
-"""Bayesian state-space DFM: Gibbs sampling with a Carter-Kohn simulation
-smoother, chains ``vmap``-ed (and mesh-shardable) on device.
+"""Bayesian state-space DFM: Gibbs sampling with a Durbin-Koopman
+simulation smoother, chains ``vmap``-ed (and mesh-shardable) on device.
 
 New capability (no counterpart in the reference, which is entirely
 frequentist — dfm_functions.ipynb implements only the non-parametric ALS
@@ -9,16 +9,17 @@ path, SURVEY.md section 0): full posterior inference for the state-space DFM
     f_t = A_1 f_{t-1} + ... + A_p f_{t-p} + u_t,   u_t ~ N(0, Q)
 
 with conjugate priors (Normal-InverseGamma rows of Lam/R, Matrix-Normal-
-InverseWishart factor VAR).  The sampler is the Kim-Nelson variant of
-Carter-Kohn for the singular companion transition: the masked information-
-form Kalman filter (ssm._filter_scan) runs forward, then the backward pass
-conditions each state only on the drawn *new* factor block f_{t+1} — the
-only stochastic innovation of the companion — and draws f_t.
+InverseWishart factor VAR).  Factor paths are drawn with the Durbin-Koopman
+(2002) mean-correction simulation smoother on the masked information-form
+Kalman filter (ssm._filter_scan) — exact for any factor-lag order p, unlike
+a backward pass that conditions only on the drawn f_{t+1}, and built from
+two filter+RTS scans with no sequential conditional draws.
 
-TPU-first design: one Gibbs iteration (filter scan + backward sampling scan
-+ three conjugate blocks) is a single jitted function; the iteration loop is
-a ``lax.scan``; independent chains are one ``vmap`` whose chain axis shards
-over a device mesh exactly like bootstrap replications (models/favar.py).
+TPU-first design: one Gibbs iteration (two filter+RTS scans for the factor
+draw + three conjugate blocks) is a single jitted function; the iteration
+loop is a ``lax.scan``; independent chains are one ``vmap`` whose chain axis
+shards over a device mesh exactly like bootstrap replications
+(models/favar.py).
 """
 
 from __future__ import annotations
@@ -36,7 +37,15 @@ from ..ops.masking import fillz, mask_of
 from ..parallel.mesh import NamedSharding, P
 from ..utils.backend import on_backend
 from .dfm import DFMConfig
-from .ssm import SSMParams, _companion, _filter_scan, _init_params_from_als, _psd_floor
+from .ssm import (
+    SSMParams,
+    _companion,
+    _filter_scan,
+    _init_params_from_als,
+    _init_state,
+    _psd_floor,
+    _smoother_scan,
+)
 
 __all__ = [
     "BayesPriors",
@@ -90,37 +99,52 @@ def _draw_mvn(key, mean, cov):
     return mean + L @ jax.random.normal(key, (d,), dtype=cov.dtype)
 
 
-def _simulation_smoother_core(params: SSMParams, x, mask, key):
-    """Draw a factor path f_{0:T-1} | x, params (Kim-Nelson backward pass on
-    the filtered moments).  Returns (f_draws (T, r), filter loglik)."""
-    filt = _filter_scan(params, x, mask)
+def _simulation_smoother_core(params: SSMParams, x, mask, key, qdiag=None):
+    """Draw a factor path f_{0:T-1} | x, params by the Durbin-Koopman (2002)
+    mean-correction simulation smoother.  Returns (f_draw (T, r), loglik).
+
+    Forward-simulate an unconditional path (s+, x+) from the model, smooth
+    both the real and the simulated data with the shared RTS machinery, and
+    return f+ + E[f|x] - E[f|x+].  Exact for ANY factor-lag order p — a
+    Carter-Kohn backward pass that conditions only on the drawn f_{t+1}
+    (the seemingly natural choice for the singular companion transition) is
+    exact only for p=1, because for p>=2 the future f_{t+2:t+p} loads on
+    f_t directly through the companion state.  Two filter+smoother scans
+    per draw, no sequential conditional sampling — the TPU-friendly shape.
+
+    `qdiag` (T, r) switches the factor innovations to time-varying diagonal
+    variances (stochastic-volatility models, models/sv.py).
+    """
     r = params.r
     Tm, _ = _companion(params)
-    H = Tm[:r]  # f_{t+1} = H s_t + u_{t+1}
-    Q = params.Q
-
-    key, klast = jax.random.split(key)
-    f_last = _draw_mvn(klast, filt.means[-1][:r], filt.covs[-1][:r, :r])
-
+    k = Tm.shape[0]
     T = x.shape[0]
-    keys = jax.random.split(key, T - 1)
+    dtype = x.dtype
 
-    def back_step(f_next, inp):
-        su, Pu, kt = inp
-        # condition the filtered state on the drawn new-factor block only:
-        # s_{t+1}'s remaining blocks are deterministic given s_t
-        S = H @ Pu @ H.T + Q
-        Ls = jnp.linalg.cholesky(0.5 * (S + S.T))
-        J = jsl.cho_solve((Ls, True), H @ Pu).T  # (k, r)
-        su_c = su + J @ (f_next - H @ su)
-        Pu_c = Pu - J @ (H @ Pu)
-        f_t = _draw_mvn(kt, su_c[:r], Pu_c[:r, :r])
-        return f_t, f_t
+    k0, ku, ke = jax.random.split(key, 3)
+    s0_mean, P0 = _init_state(params)
+    s0 = s0_mean + jnp.linalg.cholesky(P0) @ jax.random.normal(k0, (k,), dtype)
+    if qdiag is None:
+        Lq = jnp.linalg.cholesky(_psd_floor(params.Q))
+        u = jax.random.normal(ku, (T, r), dtype) @ Lq.T
+    else:
+        u = jnp.sqrt(qdiag) * jax.random.normal(ku, (T, r), dtype)
 
-    _, f_rest = jax.lax.scan(
-        back_step, f_last, (filt.means[:-1], filt.covs[:-1], keys), reverse=True
-    )
-    f = jnp.concatenate([f_rest, f_last[None]], axis=0)
+    def sim_step(s_prev, u_t):
+        s_t = (Tm @ s_prev).at[:r].add(u_t)
+        return s_t, s_t
+
+    _, s_plus = jax.lax.scan(sim_step, s0, u)
+    f_plus = s_plus[:, :r]
+    eps = jax.random.normal(ke, x.shape, dtype) * jnp.sqrt(params.R)
+    mb = mask.astype(bool)
+    x_plus = jnp.where(mb, f_plus @ params.lam.T + eps, 0.0)
+
+    filt = _filter_scan(params, x, mask, qdiag)
+    filt_p = _filter_scan(params, x_plus, mask, qdiag)
+    sm, _, _ = _smoother_scan(params, filt)
+    sm_p, _, _ = _smoother_scan(params, filt_p)
+    f = f_plus + sm[:, :r] - sm_p[:, :r]
     return f, filt.loglik
 
 
@@ -140,6 +164,50 @@ def simulation_smoother(
         )
 
 
+def _prepare_panel(data, inclcode, initperiod: int, lastperiod: int):
+    """Shared sampler data path (same as estimate_dfm_em): standardized
+    included panel over the window, with mask and original-unit moments.
+
+    Returns (data, inclcode, xz, m_arr, stds, n_mean)."""
+    data = jnp.asarray(data)
+    inclcode = np.asarray(inclcode)
+    est = data[:, inclcode == 1]
+    xw = est[initperiod : lastperiod + 1]
+    xstd, stds = standardize_data(xw)
+    m_arr = mask_of(xstd)
+    xz = fillz(xstd)
+    mw = mask_of(xw)
+    n_mean = (fillz(xw) * mw).sum(axis=0) / mw.sum(axis=0)
+    return data, inclcode, xz, m_arr, stds, n_mean
+
+
+def _draw_lam_r_block(key, f, xz, m, R_prev, lam_scale, a0, b0):
+    """Conjugate (lam_i | R_i) then (R_i | lam_i) draws, batched over series
+    (shared by the homoskedastic and stochastic-volatility samplers).
+
+    R_i ~ InvGamma(a0 + n_i/2, b0 + ssr_i/2) drawn as (b0 + ssr/2)/Gamma."""
+    dtype = xz.dtype
+    N = xz.shape[1]
+    r = f.shape[1]
+    Fg = jnp.einsum("ti,tr,ts->irs", m, f, f)
+    Fx = jnp.einsum("ti,tr->ir", m * xz, f)
+    n_i = m.sum(axis=0)
+    klam, kr = jax.random.split(key)
+    lam_keys = jax.random.split(klam, N)
+
+    def draw_lam_i(Fg_i, Fx_i, R_i, k_i):
+        prec = Fg_i + (R_i / lam_scale**2) * jnp.eye(r, dtype=dtype)
+        pinv = jnp.linalg.pinv(prec, hermitian=True)
+        return _draw_mvn(k_i, pinv @ Fx_i, R_i * pinv)
+
+    lam = jax.vmap(draw_lam_i)(Fg, Fx, R_prev, lam_keys)
+    resid = jnp.where(m.astype(bool), xz - f @ lam.T, 0.0)
+    ssr = (resid**2).sum(axis=0)
+    g = jax.random.gamma(kr, a0 + 0.5 * n_i, dtype=dtype)
+    R = jnp.maximum((b0 + 0.5 * ssr) / g, 1e-8)
+    return lam, R
+
+
 def _gibbs_sweep(carry, xz, m, p: int, priors: tuple):
     """One full Gibbs sweep: f | params  ->  (lam, R) | f  ->  (A, Q) | f."""
     key, params = carry
@@ -154,24 +222,7 @@ def _gibbs_sweep(carry, xz, m, p: int, priors: tuple):
     f, ll = _simulation_smoother_core(params, xz, m, kf)
 
     # --- loadings + idiosyncratic variances (batched over series) ---
-    Fg = jnp.einsum("ti,tr,ts->irs", m, f, f)
-    Fx = jnp.einsum("ti,tr->ir", m * xz, f)
-    n_i = m.sum(axis=0)
-    klam, kr = jax.random.split(klamr)
-    lam_keys = jax.random.split(klam, N)
-
-    def draw_lam_i(Fg_i, Fx_i, R_i, k_i):
-        prec = Fg_i + (R_i / lam_scale**2) * jnp.eye(r, dtype=dtype)
-        pinv = jnp.linalg.pinv(prec, hermitian=True)
-        return _draw_mvn(k_i, pinv @ Fx_i, R_i * pinv)
-
-    lam = jax.vmap(draw_lam_i)(Fg, Fx, params.R, lam_keys)
-    resid = jnp.where(m.astype(bool), xz - f @ lam.T, 0.0)
-    ssr = (resid**2).sum(axis=0)
-    # R_i ~ InvGamma(a0 + n_i/2, b0 + ssr_i/2) = (b0 + ssr/2) / Gamma(shape)
-    gshape = a0 + 0.5 * n_i
-    g = jax.random.gamma(kr, gshape, dtype=dtype)
-    R = jnp.maximum((b0 + 0.5 * ssr) / g, 1e-8)
+    lam, R = _draw_lam_r_block(klamr, f, xz, m, params.R, lam_scale, a0, b0)
 
     # --- factor VAR (Matrix-Normal-Inverse-Wishart) ---
     Z = jnp.concatenate([f[p - 1 - i : T - 1 - i] for i in range(p)], axis=1)
@@ -289,16 +340,9 @@ def estimate_dfm_bayes(
     on the log-likelihood path.
     """
     with on_backend(backend):
-        data = jnp.asarray(data)
-        inclcode = np.asarray(inclcode)
-        est = data[:, inclcode == 1]
-        xw = est[initperiod : lastperiod + 1]
-        xstd, stds = standardize_data(xw)
-        m_arr = mask_of(xstd)
-        xz = fillz(xstd)
-        mw = mask_of(xw)
-        n_mean = (fillz(xw) * mw).sum(axis=0) / mw.sum(axis=0)
-
+        data, inclcode, xz, m_arr, stds, n_mean = _prepare_panel(
+            data, inclcode, initperiod, lastperiod
+        )
         params0 = _init_params_from_als(
             data, inclcode, initperiod, lastperiod, config, xz, m_arr
         )

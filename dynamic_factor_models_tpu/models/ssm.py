@@ -107,7 +107,7 @@ def _init_state(params: SSMParams):
     return jnp.zeros(k, params.lam.dtype), 1e2 * jnp.eye(k, dtype=params.lam.dtype)
 
 
-def _info_filter_scan(Tm, Qs, x, mask, obs_step, s0, P0):
+def _info_filter_scan(Tm, Qs, x, mask, obs_step, s0, P0, qdiag=None):
     """Generic masked information-form Kalman filter (shared scan body).
 
     `obs_step(xt, mt, sp) -> (C, rhs, ld_R, quad0, n_obs)` supplies the
@@ -117,18 +117,29 @@ def _info_filter_scan(Tm, Qs, x, mask, obs_step, s0, P0):
     prediction, Cholesky updates, and determinant-lemma log-likelihood are
     identical across models (ssm.py restricted-loading form; ssm_ar.py dense
     observation map) and live only here.
+
+    `qdiag` (T, r) optionally supplies time-varying transition-noise
+    variances for the leading r state dims (stochastic-volatility models);
+    it is ADDED to the constant Qs, so pass Qs with a zero top-left block
+    when the variances are fully time-varying.
     """
     k = Tm.shape[0]
     dtype = x.dtype
     log2pi = jnp.asarray(np.log(2.0 * np.pi), dtype)
     eye_k = jnp.eye(k, dtype=dtype)
+    r_tv = 0 if qdiag is None else qdiag.shape[1]
 
     def step(carry, inp):
         s, P = carry
-        xt, mt = inp
+        if qdiag is None:
+            xt, mt = inp
+        else:
+            xt, mt, qt = inp
         sp = Tm @ s
         Pp = Tm @ P @ Tm.T + Qs
         Pp = 0.5 * (Pp + Pp.T)
+        if qdiag is not None:
+            Pp = Pp.at[jnp.arange(r_tv), jnp.arange(r_tv)].add(qt)
         C, rhs, ld_R, quad0, n_obs = obs_step(xt, mt, sp)
         # Pp is PD (Q PD ⇒ the prediction keeps full rank), so Cholesky
         # replaces the eigh-based pinv and yields log-dets for free
@@ -147,20 +158,29 @@ def _info_filter_scan(Tm, Qs, x, mask, obs_step, s0, P0):
         ll = -0.5 * (n_obs * log2pi + ld_R + ld_pp - ld_pu + quad)
         return (su, Pu), (su, Pu, sp, Pp, ll)
 
+    inputs = (
+        (x, mask.astype(dtype))
+        if qdiag is None
+        else (x, mask.astype(dtype), qdiag)
+    )
     (_, _), (means, covs, pmeans, pcovs, lls) = jax.lax.scan(
-        step, (s0, P0), (x, mask.astype(dtype))
+        step, (s0, P0), inputs
     )
     return means, covs, pmeans, pcovs, lls.sum()
 
 
 @jax.jit
-def _filter_scan(params: SSMParams, x, mask):
+def _filter_scan(params: SSMParams, x, mask, qdiag=None):
     """Masked Kalman filter; x (T, N) NaN-free (pre-filled), mask (T, N).
 
     Only the first r state dims load on observations, so the measurement
-    update is the Woodbury-restricted obs_step below.
+    update is the Woodbury-restricted obs_step below.  `qdiag` (T, r)
+    replaces params.Q with time-varying diagonal factor-innovation
+    variances (stochastic-volatility models).
     """
     Tm, Qs = _companion(params)
+    if qdiag is not None:
+        Qs = jnp.zeros_like(Qs)  # fully time-varying top block
     k = Tm.shape[0]
     r = params.r
     lam = params.lam  # (N, r) — state loadings are [lam, 0, ..., 0]
@@ -177,7 +197,7 @@ def _filter_scan(params: SSMParams, x, mask):
         return C, rhs, ld_R, (rinv * v * v).sum(), mt.sum()
 
     means, covs, pmeans, pcovs, ll = _info_filter_scan(
-        Tm, Qs, x, mask, obs_step, s0, P0
+        Tm, Qs, x, mask, obs_step, s0, P0, qdiag=qdiag
     )
     return KalmanResult(ll, means, covs, pmeans, pcovs)
 

@@ -1,0 +1,338 @@
+"""Stochastic-volatility DFM: factor innovations with AR(1) log-volatility,
+sampled by Gibbs with the Kim-Shephard-Chib auxiliary mixture.
+
+New capability (the reference is entirely homoskedastic — its factor VAR
+carries one constant `seps`, dfm_functions.ipynb cell 23): time-varying
+macro volatility (Great Moderation, crisis spikes) is the canonical
+extension of the Stock-Watson DFM (Del Negro-Otrok 2008).  Model:
+
+    x_t = Lam f_t + eps_t,            eps_t ~ N(0, diag(R))
+    f_t = A_1 f_{t-1} + ... + A_p f_{t-p} + u_t,
+    u_{j,t} ~ N(0, exp(h_{j,t}))
+    h_{j,t} = mu_j + phi_j (h_{j,t-1} - mu_j) + sig_j eta_{j,t}
+
+Gibbs blocks, all scans/vmaps on device:
+1. f | rest     — Durbin-Koopman simulation smoother on the masked
+                  information-form filter with time-varying
+                  Q_t = diag(exp(h_t)) (shared core, models/bayes.py);
+2. Lam, R | f   — conjugate block shared with models/bayes.py;
+3. A | f, h     — the diagonal Q_t decouples the VAR rows: per-factor
+                  weighted least squares with weights exp(-h_{j,t}), vmapped;
+4. s | h, u     — KSC 7-component mixture indicators for log u^2
+                  (categorical draw per (t, j));
+5. h | s, u     — univariate linear-Gaussian simulation smoother per factor
+                  (scalar Kalman + backward draw, vmapped over factors);
+6. mu, phi, sig — conjugate AR(1) regression draws on the h path.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..parallel.mesh import NamedSharding, P
+from ..utils.backend import on_backend
+from .bayes import (
+    _draw_lam_r_block,
+    _draw_mvn,
+    _prepare_panel,
+    _simulation_smoother_core,
+    rhat,
+)
+from .dfm import DFMConfig
+from .ssm import SSMParams, _init_params_from_als
+
+__all__ = ["SVPriors", "SVResults", "estimate_dfm_sv"]
+
+# Kim-Shephard-Chib (1998, Table 4) 7-component normal mixture for log eps^2
+_KSC_P = np.array([0.00730, 0.10556, 0.00002, 0.04395, 0.34001, 0.24566, 0.25750])
+_KSC_M = np.array(
+    [-11.40039, -5.24321, -9.83726, 1.50746, -0.65098, 0.52478, -2.35859]
+)
+_KSC_V2 = np.array([5.79596, 2.61369, 5.17950, 0.16735, 0.64009, 0.34023, 1.26261])
+
+
+class SVPriors(NamedTuple):
+    """Hyperparameters: loading/variance block as BayesPriors; AR(1)
+    log-volatility with Normal (c, phi) prior and IG sigma^2 prior."""
+
+    lam_scale: float = 10.0
+    r_shape: float = 0.01
+    r_rate: float = 0.01
+    a_scale: float = 10.0  # prior sd of each VAR coefficient
+    h_coef_scale: float = 2.0  # prior sd of the h-AR intercept and slope
+    h_sig_shape: float = 2.5
+    h_sig_rate: float = 0.1
+    phi_max: float = 0.99  # stationarity clip for the volatility AR
+
+
+class SVResults(NamedTuple):
+    factor_draws: jnp.ndarray  # (chains, keep, T, r)
+    vol_draws: jnp.ndarray  # (chains, keep, T, r) innovation sds exp(h/2)
+    lam_draws: jnp.ndarray  # (chains, keep, N, r)
+    r_draws: jnp.ndarray  # (chains, keep, N)
+    a_draws: jnp.ndarray  # (chains, keep, p, r, r)
+    mu_draws: jnp.ndarray  # (chains, keep, r)
+    phi_draws: jnp.ndarray  # (chains, keep, r)
+    sig_draws: jnp.ndarray  # (chains, keep, r)
+    loglik_path: np.ndarray  # (chains, iters) conditional filter loglik
+    rhat_loglik: float
+    stds: jnp.ndarray
+    means: jnp.ndarray
+
+
+# ---------------------------------------------------------------------------
+# VAR rows by WLS, volatilities by KSC
+# ---------------------------------------------------------------------------
+
+
+def _draw_var_rows(key, f, h, p: int, a_scale):
+    """A | f, h: diagonal Q_t decouples equations; per-factor WLS draw."""
+    T, r = f.shape
+    dtype = f.dtype
+    Z = jnp.concatenate([f[p - 1 - i : T - 1 - i] for i in range(p)], axis=1)
+    Y = f[p:]
+    w = jnp.exp(-h[p:])  # (T-p, r) precision weights per equation
+    keys = jax.random.split(key, r)
+
+    def one_row(y_j, w_j, k_j):
+        Zw = Z * w_j[:, None]
+        prec = Zw.T @ Z + jnp.eye(r * p, dtype=dtype) / a_scale**2
+        pinv = jnp.linalg.pinv(0.5 * (prec + prec.T), hermitian=True)
+        return _draw_mvn(k_j, pinv @ (Zw.T @ y_j), pinv)
+
+    rows = jax.vmap(one_row, in_axes=(1, 1, 0))(Y, w, keys)  # (r, r*p)
+    A = jnp.stack([rows[:, i * r : (i + 1) * r] for i in range(p)])
+    u = Y - Z @ rows.T  # (T-p, r) innovations for the h blocks
+    return A, u
+
+
+def _draw_h_block(key, u, h_prev, mu, phi, sig, priors: tuple):
+    """KSC mixture indicators + univariate simulation smoother + AR(1)
+    hyperparameter draws, vmapped over factors.
+
+    u: (Tu, r) VAR innovations; h_prev: (Tu, r) current log-variances
+    (aligned with u).  Returns (h, mu, phi, sig) draws."""
+    h_coef_scale, h_sig_shape, h_sig_rate, phi_max = priors
+    Tu, r = u.shape
+    dtype = u.dtype
+    c_off = jnp.asarray(1e-6, dtype)
+    ystar = jnp.log(u**2 + c_off)  # (Tu, r)
+
+    pk = jnp.asarray(_KSC_P, dtype)
+    mk = jnp.asarray(_KSC_M, dtype)
+    v2k = jnp.asarray(_KSC_V2, dtype)
+
+    ks, kh, kcoef, ksig = jax.random.split(key, 4)
+
+    # --- mixture indicators: categorical over 7 components per (t, j) ---
+    resid = ystar[:, :, None] - h_prev[:, :, None] - mk[None, None, :]
+    logits = (
+        jnp.log(pk)[None, None, :]
+        - 0.5 * jnp.log(v2k)[None, None, :]
+        - 0.5 * resid**2 / v2k[None, None, :]
+    )
+    s = jax.random.categorical(ks, logits, axis=-1)  # (Tu, r)
+    ms, v2s = mk[s], v2k[s]
+
+    # --- h | s: scalar Kalman forward + backward sampling per factor ---
+    def one_factor(y_j, ms_j, v2_j, mu_j, phi_j, sig_j, k_j):
+        sig2 = sig_j**2
+        p0 = sig2 / jnp.maximum(1.0 - phi_j**2, 1e-4)
+
+        def fstep(carry, inp):
+            hf, Pf = carry
+            yt, mt, vt = inp
+            hp = mu_j + phi_j * (hf - mu_j)
+            Pp = phi_j**2 * Pf + sig2
+            K = Pp / (Pp + vt)
+            hf_n = hp + K * (yt - mt - hp)
+            return (hf_n, (1.0 - K) * Pp), (hf_n, (1.0 - K) * Pp)
+
+        (_, _), (hf, Pf) = jax.lax.scan(
+            fstep, (mu_j, p0), (y_j, ms_j, v2_j)
+        )
+
+        kl, kb = jax.random.split(k_j)
+        h_last = hf[-1] + jnp.sqrt(jnp.maximum(Pf[-1], 1e-12)) * jax.random.normal(
+            kl, dtype=dtype
+        )
+        keys_b = jax.random.split(kb, Tu - 1)
+
+        def bstep(h_next, inp):
+            hf_t, Pf_t, kt = inp
+            denom = phi_j**2 * Pf_t + sig2
+            J = phi_j * Pf_t / denom
+            mean = hf_t + J * (h_next - mu_j - phi_j * (hf_t - mu_j))
+            var = Pf_t - J * phi_j * Pf_t
+            h_t = mean + jnp.sqrt(jnp.maximum(var, 1e-12)) * jax.random.normal(
+                kt, dtype=dtype
+            )
+            return h_t, h_t
+
+        _, h_rest = jax.lax.scan(
+            bstep, h_last, (hf[:-1], Pf[:-1], keys_b), reverse=True
+        )
+        return jnp.concatenate([h_rest, h_last[None]])
+
+    mu_a, phi_a, sig_a = mu, phi, sig
+    hkeys = jax.random.split(kh, r)
+    h = jax.vmap(one_factor, in_axes=(1, 1, 1, 0, 0, 0, 0), out_axes=1)(
+        ystar, ms, v2s, mu_a, phi_a, sig_a, hkeys
+    )
+
+    # --- (c, phi, sig) | h: conjugate AR(1) regression per factor ---
+    ckeys = jax.random.split(kcoef, r)
+    skeys = jax.random.split(ksig, r)
+
+    def one_ar(h_j, sig_j, kc, ks_):
+        y = h_j[1:]
+        Zr = jnp.stack([jnp.ones(Tu - 1, dtype), h_j[:-1]], axis=1)
+        prec = Zr.T @ Zr / sig_j**2 + jnp.eye(2, dtype=dtype) / h_coef_scale**2
+        pinv = jnp.linalg.pinv(0.5 * (prec + prec.T), hermitian=True)
+        beta = _draw_mvn(kc, pinv @ (Zr.T @ y) / sig_j**2, pinv)
+        phi_n = jnp.clip(beta[1], -phi_max, phi_max)
+        mu_n = beta[0] / (1.0 - phi_n)
+        e = y - beta[0] - phi_n * h_j[:-1]
+        g = jax.random.gamma(ks_, h_sig_shape + 0.5 * (Tu - 1), dtype=dtype)
+        sig2_n = (h_sig_rate + 0.5 * (e**2).sum()) / g
+        return mu_n, phi_n, jnp.sqrt(sig2_n)
+
+    mu_n, phi_n, sig_n = jax.vmap(one_ar, in_axes=(1, 0, 0, 0))(
+        h, sig_a, ckeys, skeys
+    )
+    return h, mu_n, phi_n, sig_n
+
+
+# ---------------------------------------------------------------------------
+# sweep / chain / entry
+# ---------------------------------------------------------------------------
+
+
+def _sv_sweep(carry, xz, m, p: int, priors: tuple):
+    key, params, h, mu, phi, sig = carry
+    (lam_scale, a0, b0, a_scale, h_coef_scale, h_sig_shape, h_sig_rate,
+     phi_max) = priors
+
+    key, kf, klamr, kvar, kh = jax.random.split(key, 5)
+
+    f, ll = _simulation_smoother_core(params, xz, m, kf, qdiag=jnp.exp(h))
+    lam, R = _draw_lam_r_block(klamr, f, xz, m, params.R, lam_scale, a0, b0)
+    A, u = _draw_var_rows(kvar, f, h, p, a_scale)
+    h_u, mu_n, phi_n, sig_n = _draw_h_block(
+        kh, u, h[p:], mu, phi, sig, (h_coef_scale, h_sig_shape, h_sig_rate, phi_max)
+    )
+    # extend the drawn h (aligned with u, t = p..T-1) back over the seed rows
+    h_new = jnp.concatenate([jnp.repeat(h_u[:1], p, axis=0), h_u], axis=0)
+
+    # Q in params is unused by the tv filter but kept coherent for init reuse
+    new_params = SSMParams(lam=lam, R=R, A=A, Q=jnp.diag(jnp.exp(mu_n)))
+    return (key, new_params, h_new, mu_n, phi_n, sig_n), (
+        f, jnp.exp(0.5 * h_new), lam, R, A, mu_n, phi_n, sig_n, ll,
+    )
+
+
+@partial(jax.jit, static_argnames=("n_burn", "n_keep", "thin", "p"))
+def _sv_chain(key, init_carry_tail, xz, m, n_burn, n_keep, thin, p, priors):
+    def sweep_ll(carry, _):
+        carry, outs = _sv_sweep(carry, xz, m, p, priors)
+        return carry, outs[-1]
+
+    def keep_body(carry, _):
+        carry, lls_thin = jax.lax.scan(sweep_ll, carry, None, length=thin - 1)
+        carry, outs = _sv_sweep(carry, xz, m, p, priors)
+        return carry, (outs[:-1], jnp.concatenate([lls_thin, outs[-1][None]]))
+
+    carry = (key,) + init_carry_tail
+    carry, ll_burn = jax.lax.scan(sweep_ll, carry, None, length=n_burn)
+    _, (kept, ll_keep) = jax.lax.scan(keep_body, carry, None, length=n_keep)
+    return kept + (jnp.concatenate([ll_burn, ll_keep.reshape(-1)]),)
+
+
+def estimate_dfm_sv(
+    data,
+    inclcode,
+    initperiod: int,
+    lastperiod: int,
+    config: DFMConfig = DFMConfig(nfac_u=4),
+    n_keep: int = 500,
+    n_burn: int = 500,
+    thin: int = 1,
+    n_chains: int = 2,
+    seed: int = 0,
+    priors: SVPriors = SVPriors(),
+    mesh=None,
+    backend: str | None = None,
+) -> SVResults:
+    """Stochastic-volatility DFM posterior by Gibbs (Del Negro-Otrok style),
+    chains vmapped on device and shardable over a 1-axis mesh.
+
+    Same data path and ALS initialization as `estimate_dfm_bayes`; the
+    log-volatility state starts at the ALS factor-VAR innovation variances.
+    Returns sign-normalized factor draws, the volatility paths
+    exp(h/2), and per-factor (mu, phi, sig) hyperparameter draws.
+    """
+    from .bayes import _sign_normalize
+
+    with on_backend(backend):
+        data, inclcode, xz, m_arr, stds, n_mean = _prepare_panel(
+            data, inclcode, initperiod, lastperiod
+        )
+        params0 = _init_params_from_als(
+            data, inclcode, initperiod, lastperiod, config, xz, m_arr
+        )
+        p = config.n_factorlag
+        r = config.nfac_u
+        Tw = xz.shape[0]
+
+        h0_level = jnp.log(jnp.maximum(jnp.diagonal(params0.Q), 1e-4))
+        init_tail = (
+            params0,
+            jnp.broadcast_to(h0_level, (Tw, r)).astype(xz.dtype),
+            h0_level.astype(xz.dtype),
+            jnp.full((r,), 0.95, xz.dtype),
+            jnp.full((r,), 0.2, xz.dtype),
+        )
+        prior_t = (
+            float(priors.lam_scale), float(priors.r_shape), float(priors.r_rate),
+            float(priors.a_scale), float(priors.h_coef_scale),
+            float(priors.h_sig_shape), float(priors.h_sig_rate),
+            float(priors.phi_max),
+        )
+
+        keys = jax.random.split(jax.random.PRNGKey(seed), n_chains)
+        if mesh is not None:
+            keys = jax.device_put(
+                keys, NamedSharding(mesh, P(mesh.axis_names[0]))
+            )
+
+        run = jax.vmap(
+            lambda k: _sv_chain(
+                k, init_tail, xz, m_arr.astype(xz.dtype),
+                n_burn, n_keep, thin, p, prior_t,
+            )
+        )
+        f_k, vol_k, lam_k, r_k, a_k, mu_k, phi_k, sig_k, ll_all = run(keys)
+
+        f_k, lam_k, a_k, _ = _sign_normalize(
+            f_k, lam_k, a_k, jnp.eye(r, dtype=xz.dtype)
+        )
+        ll_np = np.asarray(ll_all)
+        return SVResults(
+            factor_draws=f_k,
+            vol_draws=vol_k,  # volatilities are sign-invariant
+            lam_draws=lam_k,
+            r_draws=r_k,
+            a_draws=a_k,
+            mu_draws=mu_k,
+            phi_draws=phi_k,
+            sig_draws=sig_k,
+            loglik_path=ll_np,
+            rhat_loglik=rhat(ll_np[:, n_burn:]),
+            stds=stds,
+            means=n_mean,
+        )

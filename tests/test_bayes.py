@@ -103,6 +103,36 @@ class TestSimulationSmoother:
         ratio = draws.std(axis=0)[:, 0].mean() / sd.mean()
         assert 0.7 < ratio < 1.3
 
+    def test_exact_for_higher_lag_order(self):
+        """Draw mean matches the RTS smoothed mean for p=2 — a backward pass
+        conditioning only on f_{t+1} is biased here (up to ~0.2 posterior
+        sd); the Durbin-Koopman construction is exact for any p."""
+        rng = np.random.default_rng(3)
+        T, N = 80, 8
+        a1, a2 = 0.5, 0.3
+        f = np.zeros(T)
+        for t in range(2, T):
+            f[t] = a1 * f[t - 1] + a2 * f[t - 2] + rng.standard_normal()
+        lam = rng.standard_normal((N, 1))
+        x = f[:, None] @ lam.T + 0.5 * rng.standard_normal((T, N))
+        params = SSMParams(
+            lam=jnp.asarray(lam),
+            R=0.25 * jnp.ones(N),
+            A=jnp.asarray(np.array([[[a1]], [[a2]]])),
+            Q=jnp.eye(1),
+        )
+        n_draws = 120
+        draws = np.stack(
+            [np.asarray(simulation_smoother(params, jnp.asarray(x), seed=s)[0])
+             for s in range(n_draws)]
+        )[:, :, 0]
+        sm_means, sm_covs, _ = kalman_smoother(params, jnp.asarray(x))
+        sm = np.asarray(sm_means)[:, 0]
+        sd = np.sqrt(np.asarray(sm_covs)[:, 0, 0])
+        # MC error of the mean is sd/sqrt(n); allow 4x + slack
+        tol = 4.0 * sd / np.sqrt(n_draws) + 0.02
+        assert (np.abs(draws.mean(axis=0) - sm) < tol).all()
+
     def test_rhat_sane(self):
         rng = np.random.default_rng(2)
         same = rng.standard_normal((4, 500))
