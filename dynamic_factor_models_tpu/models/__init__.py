@@ -11,7 +11,13 @@ from .dfm import (
     estimate_factor_loading,
     rolling_factor_estimates,
 )
-from .var import VARResults, estimate_var, impulse_response
+from .var import (
+    HistoricalDecomposition,
+    VARResults,
+    estimate_var,
+    historical_decomposition,
+    impulse_response,
+)
 from .selection import (
     FactorNumberEstimateStats,
     ahn_horenstein_er,
@@ -63,7 +69,9 @@ from .svar import (
     sign_restriction_irfs,
 )
 from .forecast import (
+    ConditionalForecast,
     DFMForecast,
+    conditional_forecast,
     forecast_factors,
     forecast_series,
     nowcast_em,

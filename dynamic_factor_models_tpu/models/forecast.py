@@ -22,11 +22,13 @@ import numpy as np
 from ..ops.masking import fillz, mask_of
 from ..utils.backend import on_backend
 from .dfm import DFMResults
-from .ssm import EMResults, SSMParams, _companion, kalman_filter
+from .ssm import EMResults, SSMParams, _companion, kalman_filter, kalman_smoother
 from .var import VARResults
 
 __all__ = [
     "DFMForecast",
+    "ConditionalForecast",
+    "conditional_forecast",
     "forecast_factors",
     "forecast_series",
     "nowcast_ssm",
@@ -244,3 +246,57 @@ def nowcast_em(
             xw, mask_of(xw), filt.means, H, Tm, params.r, h,
             em.stds[None, :], em.means[None, :],
         )
+
+
+class ConditionalForecast(NamedTuple):
+    mean: jnp.ndarray  # (h, N) predictive mean of every series
+    sd: jnp.ndarray  # (h, N) predictive sd (common-component + idio)
+    factor_mean: jnp.ndarray  # (h, r) smoothed factor path over the horizon
+    factor_cov: jnp.ndarray  # (h, r, r)
+
+
+def conditional_forecast(
+    params: SSMParams,
+    x,
+    horizon: int,
+    conditions=None,
+    backend: str | None = None,
+) -> ConditionalForecast:
+    """Scenario / conditional forecasts from the state-space DFM.
+
+    New capability (central-bank scenario analysis; Banbura-Giannone-Lenza
+    style conditional forecasting): append `horizon` future rows to the
+    panel in which `conditions` (horizon, N; NaN = unconstrained) pins the
+    assumed paths of a subset of series, and run the masked Kalman smoother
+    over the extended panel — the machinery that already handles arbitrary
+    missing patterns does conditioning for free.  Unconditional forecasts
+    are the conditions=None special case.
+
+    x: (T, N) standardized panel the params were fitted on (NaN missing).
+    Conditioned entries are treated as observed through their measurement
+    equation, so their predictive mean tracks the assumed path up to the
+    idiosyncratic noise weighting.
+    """
+    if horizon < 1:
+        raise ValueError(f"horizon must be >= 1, got {horizon}")
+    with on_backend(backend):
+        x = jnp.asarray(x)
+        N = x.shape[1]
+        if conditions is None:
+            cond = jnp.full((horizon, N), jnp.nan, x.dtype)
+        else:
+            cond = jnp.asarray(conditions, x.dtype)
+            if cond.shape != (horizon, N):
+                raise ValueError(
+                    f"conditions must be (horizon, N) = ({horizon}, {N}), "
+                    f"got {cond.shape}"
+                )
+        x_ext = jnp.concatenate([x, cond], axis=0)
+        means, covs, _ = kalman_smoother(params, x_ext)
+        r = params.r
+        f = means[-horizon:, :r]
+        Pf = covs[-horizon:, :r, :r]
+        mean = f @ params.lam.T
+        var_common = jnp.einsum("nr,hrs,ns->hn", params.lam, Pf, params.lam)
+        sd = jnp.sqrt(var_common + params.R[None, :])
+        return ConditionalForecast(mean, sd, f, Pf)

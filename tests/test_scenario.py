@@ -1,0 +1,129 @@
+"""Conditional forecasts (models/forecast.py) and historical decomposition
+(models/var.py): exact identities and scenario behavior."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dynamic_factor_models_tpu.models.forecast import conditional_forecast
+from dynamic_factor_models_tpu.models.ssm import SSMParams
+from dynamic_factor_models_tpu.models.var import (
+    estimate_var,
+    historical_decomposition,
+)
+
+
+def _var_data(T=400, seed=0):
+    rng = np.random.default_rng(seed)
+    B0 = np.array([[1.0, 0.0, 0.0], [0.5, 0.8, 0.0], [-0.4, 0.3, 0.6]])
+    A1 = np.array([[0.5, 0.1, 0.0], [0.0, 0.4, 0.1], [0.1, 0.0, 0.3]])
+    eps = rng.standard_normal((T, 3))
+    y = np.zeros((T, 3))
+    for t in range(1, T):
+        y[t] = 0.3 + A1 @ y[t - 1] + B0 @ eps[t]
+    return y, eps
+
+
+class TestHistoricalDecomposition:
+    def test_exact_reconstruction(self):
+        """baseline + sum of contributions == y on the estimation window."""
+        y, eps = _var_data()
+        var = estimate_var(jnp.asarray(y), 1, 5, y.shape[0] - 1)
+        hd = historical_decomposition(var, jnp.asarray(y))
+        recon = np.asarray(hd.baseline) + np.asarray(hd.contributions).sum(axis=2)
+        assert np.abs(recon - y[hd.rows]).max() < 1e-10
+
+    def test_recovers_structural_shocks(self):
+        """B0 is lower-triangular, so recursive identification recovers the
+        true shocks up to estimation noise."""
+        y, eps = _var_data()
+        var = estimate_var(jnp.asarray(y), 1, 5, y.shape[0] - 1)
+        hd = historical_decomposition(var, jnp.asarray(y))
+        for j in range(3):
+            c = np.corrcoef(np.asarray(hd.shocks)[:, j], eps[hd.rows][:, j])[0, 1]
+            assert c > 0.95
+
+    def test_lag2_window(self):
+        y, _ = _var_data(seed=1)
+        var = estimate_var(jnp.asarray(y), 2, 10, y.shape[0] - 1)
+        hd = historical_decomposition(var, jnp.asarray(y))
+        recon = np.asarray(hd.baseline) + np.asarray(hd.contributions).sum(axis=2)
+        assert np.abs(recon - y[hd.rows]).max() < 1e-10
+
+    def test_no_constant_layout(self):
+        """withconst=False betahat has no const row; the identity must still
+        hold (const treated as zero, not as the first lag row)."""
+        y, _ = _var_data(seed=3)
+        y = y - y.mean(axis=0)
+        var = estimate_var(jnp.asarray(y), 1, 5, y.shape[0] - 1, withconst=False)
+        hd = historical_decomposition(var, jnp.asarray(y))
+        recon = np.asarray(hd.baseline) + np.asarray(hd.contributions).sum(axis=2)
+        assert np.abs(recon - y[hd.rows]).max() < 1e-10
+
+    def test_rejects_ragged_window(self):
+        y, _ = _var_data(T=100, seed=2)
+        y[50] = np.nan  # hole inside the window
+        var = estimate_var(jnp.asarray(y), 1, 5, 99)
+        with pytest.raises(ValueError, match="contiguous"):
+            historical_decomposition(var, jnp.asarray(y))
+
+
+class TestConditionalForecast:
+    @pytest.fixture(scope="class")
+    def setup(self):
+        rng = np.random.default_rng(0)
+        T, N = 150, 8
+        f = np.zeros((T, 1))
+        for t in range(1, T):
+            f[t] = 0.8 * f[t - 1] + rng.standard_normal(1)
+        lam = np.ones((N, 1))
+        lam[4:] = 0.8
+        x = f @ lam.T + 0.3 * rng.standard_normal((T, N))
+        params = SSMParams(
+            lam=jnp.asarray(lam), R=0.09 * jnp.ones(N),
+            A=0.8 * jnp.eye(1)[None], Q=jnp.eye(1),
+        )
+        return params, x
+
+    def test_unconditional_decays_to_mean(self, setup):
+        params, x = setup
+        fc = conditional_forecast(params, jnp.asarray(x), 12)
+        fpath = np.asarray(fc.factor_mean)[:, 0]
+        # AR(0.8) forecast: |f_{h+1}| < |f_h|, geometric decay toward 0
+        assert (np.abs(fpath[1:]) < np.abs(fpath[:-1]) + 1e-12).all()
+        assert np.allclose(fpath[1:] / fpath[:-1], 0.8, atol=0.02)
+
+    def test_conditioning_moves_correlated_series(self, setup):
+        params, x = setup
+        h, N = 8, x.shape[1]
+        unc = conditional_forecast(params, jnp.asarray(x), h)
+        cond = np.full((h, N), np.nan)
+        cond[:, 0] = 3.0
+        con = conditional_forecast(params, jnp.asarray(x), h, conditions=cond)
+        # loading-1 series pulled up toward the conditioned path
+        assert (np.asarray(con.mean)[:, 1] > np.asarray(unc.mean)[:, 1]).all()
+        assert np.asarray(con.mean)[2:, 1].mean() > 2.0
+        # conditioning reduces predictive uncertainty everywhere
+        assert (np.asarray(con.sd) <= np.asarray(unc.sd) + 1e-12).all()
+
+    def test_neutral_conditioning_is_noop(self, setup):
+        """Conditioning a series ON its own unconditional mean path leaves
+        the other forecasts (nearly) unchanged."""
+        params, x = setup
+        h, N = 6, x.shape[1]
+        unc = conditional_forecast(params, jnp.asarray(x), h)
+        cond = np.full((h, N), np.nan)
+        cond[:, 0] = np.asarray(unc.mean)[:, 0]
+        con = conditional_forecast(params, jnp.asarray(x), h, conditions=cond)
+        assert np.allclose(
+            np.asarray(con.mean)[:, 1:], np.asarray(unc.mean)[:, 1:], atol=1e-6
+        )
+
+    def test_shape_validation(self, setup):
+        params, x = setup
+        with pytest.raises(ValueError, match="conditions must be"):
+            conditional_forecast(
+                params, jnp.asarray(x), 4, conditions=np.zeros((3, 2))
+            )
+        with pytest.raises(ValueError, match="horizon"):
+            conditional_forecast(params, jnp.asarray(x), 0)
