@@ -1,0 +1,150 @@
+"""Time-varying factor loadings: per-series random-walk TVP regressions on
+the estimated factors, vmapped across the panel.
+
+New capability: the reference *tests* for loading instability (Table 4 Chow/
+QLR scans, Stock_Watson.ipynb cell 57) but has no model that lets loadings
+move.  This module models the instability the tests detect (Stock-Watson
+TVP tradition, Cogley-Sargent style random-walk drift):
+
+    x_{i,t} = lam_{i,t}' F_t + e_{i,t},      e_{i,t} ~ N(0, sig2_i)
+    lam_{i,t} = lam_{i,t-1} + v_{i,t},       v_{i,t} ~ N(0, q_i sig2_i I)
+
+Given factors (ALS or EM point estimates — the standard two-step), each
+series is an r-state univariate-observation Kalman problem with missing
+observations masked.  TPU-first shape: ONE series' filter/smoother is a
+``lax.scan``; the panel is a ``vmap`` over series; the signal-to-noise
+ratio q_i is chosen per series by prediction-error likelihood over a grid —
+a second ``vmap`` over grid points, so model selection is a (series x grid)
+batch of scans with an argmax, no host loop.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.masking import fillz, mask_of
+from ..utils.backend import on_backend
+from .ssm import _rts_scan
+
+__all__ = ["TVPLoadings", "tvp_loadings"]
+
+_DEFAULT_GRID = (0.0, 1e-5, 1e-4, 1e-3, 1e-2, 1e-1)
+
+
+class TVPLoadings(NamedTuple):
+    lam_path: jnp.ndarray  # (T, N, r) smoothed loading paths
+    lam_var: jnp.ndarray  # (T, N, r) smoothed loading variances (diagonal)
+    sigma2: jnp.ndarray  # (N,) measurement variances
+    q: jnp.ndarray  # (N,) selected signal-to-noise ratios tau2/sig2
+    loglik: jnp.ndarray  # (N,) prediction-error loglik at the selected q
+    grid_loglik: jnp.ndarray  # (N, n_grid) loglik over the whole grid
+    drift: jnp.ndarray  # (N,) total smoothed loading movement per series
+
+
+def _tvp_filter(y, F, m, lam0, P0_diag, sig2, tau2):
+    """Masked random-walk-coefficient Kalman filter for ONE series.
+
+    Returns filtered (lam, P) paths, predicted (lam, P) paths, loglik."""
+    r = F.shape[1]
+    dtype = y.dtype
+    log2pi = jnp.asarray(np.log(2.0 * np.pi), dtype)
+    eye_r = jnp.eye(r, dtype=dtype)
+
+    def step(carry, inp):
+        lam, P = carry
+        y_t, f_t, m_t = inp
+        Pp = P + tau2 * eye_r  # random-walk prediction
+        v = y_t - f_t @ lam
+        S = f_t @ Pp @ f_t + sig2
+        K = (Pp @ f_t) / S
+        lam_u = lam + m_t * K * v
+        P_u = Pp - m_t * jnp.outer(K, f_t) @ Pp
+        P_u = 0.5 * (P_u + P_u.T)
+        ll = -0.5 * m_t * (log2pi + jnp.log(S) + v * v / S)
+        return (lam_u, P_u), (lam_u, P_u, lam, Pp, ll)
+
+    init = (lam0, jnp.diag(P0_diag))
+    (_, _), (lams, Ps, lams_p, Ps_p, lls) = jax.lax.scan(step, init, (y, F, m))
+    return lams, Ps, lams_p, Ps_p, lls.sum()
+
+
+@jax.jit
+def _tvp_panel(xz, W, F, grid):
+    """Grid-select q per series, then smooth at the winner; all vmapped."""
+    dtype = xz.dtype
+
+    # per-series OLS init: loading lam0 and residual variance sig2
+    Fg = jnp.einsum("ti,tr,ts->irs", W, F, F)
+    Fx = jnp.einsum("ti,tr->ir", W * xz, F)
+    lam0 = jax.vmap(
+        lambda A, b: jnp.linalg.pinv(A, hermitian=True) @ b
+    )(Fg, Fx)  # (N, r)
+    resid = jnp.where(W.astype(bool), xz - jnp.einsum("tr,ir->ti", F, lam0), 0.0)
+    n_i = jnp.maximum(W.sum(axis=0), 1.0)
+    sig2 = jnp.maximum((resid**2).sum(axis=0) / n_i, 1e-10)
+
+    P0 = 10.0 * jnp.ones(F.shape[1], dtype)
+
+    def series_grid_ll(y_i, w_i, lam0_i, sig2_i):
+        def at_q(qv):
+            *_, ll = _tvp_filter(y_i, F, w_i, lam0_i, P0, sig2_i, qv * sig2_i)
+            return ll
+
+        return jax.vmap(at_q)(grid)  # (n_grid,)
+
+    grid_ll = jax.vmap(series_grid_ll, in_axes=(1, 1, 0, 0))(
+        xz, W, lam0, sig2
+    )  # (N, n_grid)
+    best = jnp.argmax(grid_ll, axis=1)
+    q_sel = grid[best]
+
+    def series_smooth(y_i, w_i, lam0_i, sig2_i, q_i):
+        lams, Ps, lams_p, Ps_p, ll = _tvp_filter(
+            y_i, F, w_i, lam0_i, P0, sig2_i, q_i * sig2_i
+        )
+        # shared RTS body (ssm._rts_scan) with the identity transition of
+        # the random-walk state; lag-one covariances discarded
+        lam_s, P_s, _ = _rts_scan(
+            jnp.eye(F.shape[1], dtype=dtype), lams, Ps, lams_p, Ps_p
+        )
+        return lam_s, jnp.diagonal(P_s, axis1=1, axis2=2), ll
+
+    lam_path, lam_var, ll_sel = jax.vmap(
+        series_smooth, in_axes=(1, 1, 0, 0, 0), out_axes=(1, 1, 0)
+    )(xz, W, lam0, sig2, q_sel)
+    drift = jnp.abs(jnp.diff(lam_path, axis=0)).sum(axis=(0, 2))
+    return lam_path, lam_var, sig2, q_sel, ll_sel, grid_ll, drift
+
+
+def tvp_loadings(
+    x,
+    F,
+    grid=_DEFAULT_GRID,
+    backend: str | None = None,
+) -> TVPLoadings:
+    """Random-walk time-varying loadings of every series on the factors.
+
+    x: (T, N) panel (NaN missing) — typically standardized, the units the
+    factors were estimated in; F: (T, r) factor point estimates (rows with
+    NaN factors are masked out of every series).  `grid` is the candidate
+    signal-to-noise set for q = tau2/sig2; q=0 reproduces constant-loading
+    GLS, so series whose loadings are stable select ~0 and series the
+    Table-4 scans flag as unstable select larger q.
+
+    Returns smoothed loading paths with variances, selected q per series,
+    and the per-series total loading drift (a scalar instability measure).
+    """
+    with on_backend(backend):
+        x = jnp.asarray(x)
+        F = jnp.asarray(F)
+        f_ok = mask_of(F).all(axis=1)
+        W = (mask_of(x) & f_ok[:, None]).astype(x.dtype)
+        xz = fillz(x)
+        Fz = fillz(F)
+        grid_arr = jnp.asarray(grid, x.dtype)
+        out = _tvp_panel(xz, W, Fz, grid_arr)
+        return TVPLoadings(*out)

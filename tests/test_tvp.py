@@ -1,0 +1,79 @@
+"""Time-varying loadings (models/tvp.py): break tracking, stability
+selection, and the q=0 constant-loading limit."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dynamic_factor_models_tpu.models.tvp import tvp_loadings
+
+
+def _break_panel(T=300, N=10, r=2, seed=0):
+    rng = np.random.default_rng(seed)
+    F = rng.standard_normal((T, r))
+    lam_a = rng.standard_normal((N, r))
+    lam_b = lam_a.copy()
+    lam_b[N // 2 :, 0] += 2.0  # second half of series: loading break
+    lam_t = np.where(np.arange(T)[:, None, None] < T // 2, lam_a, lam_b)
+    x = np.einsum("tr,tnr->tn", F, lam_t) + 0.3 * rng.standard_normal((T, N))
+    x[rng.random((T, N)) < 0.05] = np.nan
+    return x, F, lam_a, lam_b
+
+
+@pytest.fixture(scope="module")
+def tvp_fit():
+    x, F, lam_a, lam_b = _break_panel()
+    res = tvp_loadings(jnp.asarray(x), jnp.asarray(F))
+    return x, F, lam_a, lam_b, res
+
+
+class TestTVPLoadings:
+    def test_tracks_loading_break(self, tvp_fit):
+        x, F, lam_a, lam_b, res = tvp_fit
+        T, N = x.shape
+        lp = np.asarray(res.lam_path)
+        for i in range(N // 2, N):
+            early = lp[: T // 2 - 20, i, 0].mean()
+            late = lp[T // 2 + 20 :, i, 0].mean()
+            assert abs(early - lam_a[i, 0]) < 0.3
+            assert abs(late - lam_b[i, 0]) < 0.3
+
+    def test_stable_series_select_small_q(self, tvp_fit):
+        *_, res = tvp_fit
+        N = res.q.shape[0]
+        q = np.asarray(res.q)
+        drift = np.asarray(res.drift)
+        assert (q[: N // 2] <= 1e-4).all()  # stable half
+        assert (q[N // 2 :] >= 1e-3).all()  # breaking half
+        assert drift[N // 2 :].min() > 5 * max(drift[: N // 2].max(), 0.05)
+
+    def test_variances_positive_loglik_best(self, tvp_fit):
+        *_, res = tvp_fit
+        assert (np.asarray(res.lam_var) > -1e-12).all()
+        assert (np.asarray(res.sigma2) > 0).all()
+        # selected loglik equals the grid max
+        assert np.allclose(
+            np.asarray(res.loglik), np.asarray(res.grid_loglik).max(axis=1),
+            atol=1e-6,
+        )
+
+    def test_q_zero_matches_constant_regression(self):
+        """With the grid forced to {0}, the smoothed path is time-constant
+        and equals the (masked) OLS loading."""
+        rng = np.random.default_rng(1)
+        T, r = 400, 2
+        F = rng.standard_normal((T, r))
+        lam = np.array([1.5, -0.7])
+        y = F @ lam + 0.2 * rng.standard_normal(T)
+        res = tvp_loadings(jnp.asarray(y[:, None]), jnp.asarray(F), grid=(0.0,))
+        lp = np.asarray(res.lam_path)[:, 0, :]
+        assert lp.std(axis=0).max() < 0.02  # near-constant path
+        assert np.allclose(lp[-1], lam, atol=0.05)
+
+    def test_masks_missing_factor_rows(self):
+        x, F, *_ = _break_panel(T=200, seed=2)
+        F = F.copy()
+        F[:10] = np.nan  # factor burn-in rows (e.g. ALS window offset)
+        res = tvp_loadings(jnp.asarray(x), jnp.asarray(F))
+        assert np.isfinite(np.asarray(res.lam_path)).all()
+        assert np.isfinite(np.asarray(res.loglik)).all()
