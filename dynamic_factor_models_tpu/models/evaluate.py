@@ -1,0 +1,188 @@
+"""Pseudo-out-of-sample forecast evaluation: the diffusion-index horse race.
+
+New capability (the reference never forecasts; SURVEY.md section 0): the
+standard evaluation exercise of the Stock-Watson diffusion-index literature.
+For every rolling origin the factors are re-estimated on that window only
+(ONE batched ALS across all origins — `rolling_factor_estimates`), then for
+every (origin, series, horizon) the direct h-step regressions
+
+    DFM:  y_{i,t+h} = c + beta' F_t + gamma(L) y_{i,t} + e   (diffusion index)
+    AR :  y_{i,t+h} = c + gamma(L) y_{i,t} + e               (benchmark)
+
+are fit within the window by masked least squares and forecast at the
+origin; errors against the realized values give per-series RMSEs and the
+relative MSE that headlines every paper in this literature.
+
+TPU-first shape: the per-(origin, series) regressions share a design-tensor
+layout, so each horizon is ONE einsum pair + one vmapped solve over the
+(origins x series) batch — no loops over windows or series; the AR
+benchmark reuses the same design tensor with the factor columns dropped.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.linalg import solve_normal
+from ..utils.backend import on_backend
+from .dfm import DFMConfig, rolling_factor_estimates
+
+__all__ = ["ForecastEvaluation", "evaluate_forecasts"]
+
+
+class ForecastEvaluation(NamedTuple):
+    origins: np.ndarray  # (W,) panel row of each forecast origin
+    horizons: np.ndarray  # (H,)
+    errors_dfm: jnp.ndarray  # (H, W, N) forecast errors, NaN where undefined
+    errors_ar: jnp.ndarray  # (H, W, N)
+    rmse_dfm: jnp.ndarray  # (H, N)
+    rmse_ar: jnp.ndarray  # (H, N)
+    rel_mse: jnp.ndarray  # (H, N) DFM / AR mean-squared-error ratio
+    n_forecasts: jnp.ndarray  # (H, N) origins entering each RMSE
+
+
+@partial(jax.jit, static_argnames=("h", "y_lags", "r"))
+def _direct_forecasts(Yw, Fw, y_next, h: int, y_lags: int, r: int):
+    """One horizon: fit the direct regressions inside every window and
+    forecast at the window end.
+
+    Yw: (W, win, N) raw window panels; Fw: (W, win, r) window factors;
+    y_next: (W, N) realized values at origin + h.  Returns (err_dfm,
+    err_ar): (W, N) forecast errors (NaN when the regression or the
+    realized value is unavailable)."""
+    W, win, N = Yw.shape
+    dtype = Fw.dtype
+    t_idx = jnp.arange(win)
+
+    # per-series lag stack: lags[w, t, i, j] = y_{i, t-j}
+    lags = jnp.stack(
+        [jnp.roll(Yw, j, axis=1) for j in range(y_lags)], axis=3
+    )  # (W, win, N, y_lags); rows t < j are wrapped garbage -> masked below
+
+    ones = jnp.ones((W, win, N, 1), dtype)
+    # sanitize like the lag block: NaN * zero-weight is NaN in the Gram
+    # einsums, so the isfinite mask terms only work on zero-filled inputs
+    Fb = jnp.broadcast_to(jnp.nan_to_num(Fw)[:, :, None, :], (W, win, N, r))
+    X = jnp.concatenate([ones, Fb, jnp.nan_to_num(lags)], axis=3)
+    K = 1 + r + y_lags
+
+    # training target: y_{i, t+h} (window-relative)
+    targ = jnp.roll(Yw, -h, axis=1)  # rows >= win-h wrap -> masked below
+    valid = (
+        (t_idx[None, :, None] >= y_lags - 1)
+        & (t_idx[None, :, None] < win - h)
+        & jnp.isfinite(lags).all(axis=3)
+        & jnp.isfinite(targ)
+        & jnp.isfinite(Fw).all(axis=2)[:, :, None]
+    )
+    M = valid.astype(dtype)
+    tz = jnp.nan_to_num(targ)
+
+    def fit_and_forecast(cols):
+        Xc = X[..., cols]
+        A = jnp.einsum("wtnk,wtn,wtnl->wnkl", Xc, M, Xc)
+        b = jnp.einsum("wtnk,wtn,wtn->wnk", Xc, M, tz)
+        beta = jax.vmap(jax.vmap(solve_normal))(A, b)  # (W, N, K')
+        x_end = Xc[:, -1]  # (W, N, K') design row at the origin
+        ok_end = jnp.isfinite(lags[:, -1]).all(axis=2) & jnp.isfinite(
+            Fw[:, -1]
+        ).all(axis=1)[:, None]
+        enough = M.sum(axis=1) > 2.0 * len(cols)
+        fc = jnp.einsum("wnk,wnk->wn", x_end, beta)
+        return jnp.where(ok_end & enough, fc, jnp.nan)
+
+    cols_dfm = np.arange(K)
+    cols_ar = np.r_[0, np.arange(1 + r, K)]  # drop the factor block
+    fc_dfm = fit_and_forecast(cols_dfm)
+    fc_ar = fit_and_forecast(cols_ar)
+    return fc_dfm - y_next, fc_ar - y_next
+
+
+def evaluate_forecasts(
+    data,
+    inclcode,
+    window: int,
+    nfac: int = 4,
+    horizons=(1, 2, 4),
+    y_lags: int = 4,
+    step: int = 1,
+    initperiod: int = 0,
+    lastperiod: int | None = None,
+    config: DFMConfig = DFMConfig(),
+    backend: str | None = None,
+    mesh=None,
+) -> ForecastEvaluation:
+    """Rolling pseudo-out-of-sample evaluation of diffusion-index forecasts
+    against direct-AR benchmarks, for every included series and horizon.
+
+    Factors are re-estimated on each length-`window` rolling window (one
+    batched ALS — shardable over `mesh`); forecasts are evaluated on the
+    TRANSFORMED panel units (the units the reference's tcodes produce).
+    rel_mse < 1 means the factors improve on the series' own lags.
+    """
+    with on_backend(backend):
+        data_np = np.asarray(data)
+        T = data_np.shape[0]
+        last = T - 1 if lastperiod is None else lastperiod
+        horizons = np.asarray(sorted(horizons), np.int64)
+        hmax = int(horizons[-1])
+        if last - hmax - initperiod + 1 < window:
+            raise ValueError(
+                f"window={window} with max horizon {hmax} does not fit in "
+                f"rows {initperiod}..{last}"
+            )
+
+        rolling = rolling_factor_estimates(
+            data_np, inclcode, window, nfac, config,
+            step=step, initperiod=initperiod, lastperiod=last - hmax,
+            backend=backend, mesh=mesh,
+        )
+        starts = rolling.starts
+        origins = starts + window - 1
+        Fw = rolling.batch.factor[:, :, :nfac]  # (W, win, r) window-relative
+
+        incl = np.asarray(inclcode) == 1
+        y = data_np[:, incl]  # evaluate the included series
+        Yw = jnp.asarray(
+            np.stack([y[s : s + window] for s in starts])
+        )  # (W, win, N)
+
+        errs_dfm, errs_ar = [], []
+        for h in horizons:
+            y_next = jnp.asarray(y[origins + int(h)])  # (W, N)
+            e_dfm, e_ar = _direct_forecasts(
+                Yw, Fw, y_next, int(h), y_lags, nfac
+            )
+            errs_dfm.append(e_dfm)
+            errs_ar.append(e_ar)
+        E_dfm = jnp.stack(errs_dfm)  # (H, W, N)
+        E_ar = jnp.stack(errs_ar)
+
+        # RMSEs over the origins where BOTH forecasts exist (fair horse
+        # race); series with no usable origin report NaN, not a spurious 0
+        both = jnp.isfinite(E_dfm) & jnp.isfinite(E_ar)
+        n = both.sum(axis=1)
+        none = n == 0
+        mse_dfm = jnp.where(
+            none, jnp.nan,
+            jnp.where(both, E_dfm**2, 0.0).sum(axis=1) / jnp.maximum(n, 1),
+        )
+        mse_ar = jnp.where(
+            none, jnp.nan,
+            jnp.where(both, E_ar**2, 0.0).sum(axis=1) / jnp.maximum(n, 1),
+        )
+        return ForecastEvaluation(
+            origins=origins,
+            horizons=horizons,
+            errors_dfm=E_dfm,
+            errors_ar=E_ar,
+            rmse_dfm=jnp.sqrt(mse_dfm),
+            rmse_ar=jnp.sqrt(mse_ar),
+            rel_mse=mse_dfm / jnp.maximum(mse_ar, 1e-12),
+            n_forecasts=n,
+        )

@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..ops.linalg import solve_normal
 from ..ops.masking import fillz, mask_of
 from ..utils.backend import on_backend
 from .ssm import _rts_scan
@@ -80,9 +81,7 @@ def _tvp_panel(xz, W, F, grid):
     # per-series OLS init: loading lam0 and residual variance sig2
     Fg = jnp.einsum("ti,tr,ts->irs", W, F, F)
     Fx = jnp.einsum("ti,tr->ir", W * xz, F)
-    lam0 = jax.vmap(
-        lambda A, b: jnp.linalg.pinv(A, hermitian=True) @ b
-    )(Fg, Fx)  # (N, r)
+    lam0 = jax.vmap(solve_normal)(Fg, Fx)  # (N, r)
     resid = jnp.where(W.astype(bool), xz - jnp.einsum("tr,ir->ti", F, lam0), 0.0)
     n_i = jnp.maximum(W.sum(axis=0), 1.0)
     sig2 = jnp.maximum((resid**2).sum(axis=0) / n_i, 1e-10)
