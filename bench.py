@@ -14,6 +14,11 @@ Auxiliary fields:
   (north star: <= 1e-5 in f64; both backends run f32 here — TPU has no f64
   — so the enforced thresholds below are the documented f32 equivalents).
   Exits nonzero if any parity threshold is exceeded.
+
+If the TPU tunnel is unreachable (liveness probe times out), the bench
+falls back to the CPU platform and still reports the bootstrap/EM numbers
+with "tpu_unreachable": true; the Pallas and parity sections (TPU-only)
+report null.  DFM_BENCH_FORCE_CPU=1 forces this path for testing.
 """
 
 import json
@@ -112,27 +117,33 @@ def parity_checks(ds):
 
 def _guarded_device(timeout_s: int = 240):
     """First device touch behind the shared subprocess liveness probe
-    (utils.backend.probe_default_device): emit a parseable JSON line +
-    nonzero exit instead of stalling the caller's whole run when the
-    tunnel is wedged."""
+    (utils.backend.probe_default_device).  When the tunnel is wedged
+    (round-2 observation: the axon terminal can hang for hours), fall back
+    to the CPU platform and produce real — clearly labeled — numbers
+    instead of none: the TPU-only sections (Pallas kernel, CPU<->TPU
+    parity) are skipped and the JSON carries "tpu_unreachable": true.
+
+    Returns (device, tpu_ok).  DFM_BENCH_FORCE_CPU=1 exercises the
+    fallback deterministically (tests/test_replication_utils.py covers the
+    branch; the full fallback run is driven manually)."""
+    import os
+
     from dynamic_factor_models_tpu.utils.backend import probe_default_device
 
-    ok, detail = probe_default_device(timeout_s)
+    forced = os.environ.get("DFM_BENCH_FORCE_CPU") == "1"
+    ok, detail = (False, "forced CPU fallback") if forced else (
+        probe_default_device(timeout_s)
+    )
     if not ok:
         print(
-            json.dumps(
-                {
-                    "metric": "favar_irf_wild_bootstrap_1000rep_wallclock",
-                    "value": None,
-                    "unit": "s",
-                    "vs_baseline": None,
-                    "error": f"TPU unreachable — {detail}; no numbers produced",
-                }
-            ),
+            f"bench: TPU unreachable ({detail}); falling back to CPU — "
+            "Pallas/parity sections skipped",
+            file=sys.stderr,
             flush=True,
         )
-        sys.exit(3)
-    return jax.devices()[0]
+        jax.config.update("jax_platforms", "cpu")
+        return jax.devices()[0], False
+    return jax.devices()[0], True
 
 
 def main():
@@ -142,7 +153,7 @@ def main():
     from dynamic_factor_models_tpu.models.ssm import em_step, SSMParams
     from dynamic_factor_models_tpu.ops.masking import fillz, mask_of
 
-    dev = _guarded_device()
+    dev, tpu_ok = _guarded_device()
     ds = cached_dataset("Real")
 
     # factors via ALS (f32-safe tolerance; parity is covered below)
@@ -191,58 +202,66 @@ def main():
     # auxiliary: fused Pallas masked-Gram vs XLA einsum at large-panel scale
     # (the regime beyond the 224 x 233 reference panel the kernel targets).
     # No exception guard: if the compiled kernel cannot run on this chip the
-    # bench must fail visibly (round-1 lesson), not report null.
-    from dynamic_factor_models_tpu.ops.pallas_gram import (
-        masked_gram_pallas,
-        masked_gram_xla,
-    )
-    from jax import lax
+    # bench must fail visibly (round-1 lesson), not report null.  Skipped
+    # entirely in the CPU fallback (the kernel is a TPU Mosaic program).
+    if tpu_ok:
+        from dynamic_factor_models_tpu.ops.pallas_gram import (
+            masked_gram_pallas,
+            masked_gram_xla,
+        )
+        from jax import lax
 
-    rng = np.random.default_rng(0)
-    Tbig, Nbig, K = 2048, 4096, 8
-    Xb = jnp.asarray(rng.standard_normal((Tbig, K)), jnp.float32)
-    Yb = jnp.asarray(rng.standard_normal((Tbig, Nbig)), jnp.float32)
-    Wb = jnp.asarray((rng.random((Tbig, Nbig)) > 0.2), jnp.float32)
+        rng = np.random.default_rng(0)
+        Tbig, Nbig, K = 2048, 4096, 8
+        Xb = jnp.asarray(rng.standard_normal((Tbig, K)), jnp.float32)
+        Yb = jnp.asarray(rng.standard_normal((Tbig, Nbig)), jnp.float32)
+        Wb = jnp.asarray((rng.random((Tbig, Nbig)) > 0.2), jnp.float32)
 
-    def _loop_time(body, n):
-        """Total wall time of an on-device fori_loop (best of 5)."""
+        def _loop_time(body, n):
+            """Total wall time of an on-device fori_loop (best of 5)."""
 
-        @jax.jit
-        def loop():
-            return lax.fori_loop(0, n, body, jnp.float32(0.0))
+            @jax.jit
+            def loop():
+                return lax.fori_loop(0, n, body, jnp.float32(0.0))
 
-        loop().block_until_ready()  # compile
-        best = float("inf")
-        for _ in range(5):
-            t = time.perf_counter()
-            loop().block_until_ready()
-            best = min(best, time.perf_counter() - t)
-        return best
+            loop().block_until_ready()  # compile
+            best = float("inf")
+            for _ in range(5):
+                t = time.perf_counter()
+                loop().block_until_ready()
+                best = min(best, time.perf_counter() - t)
+            return best
 
-    def _gram_body(fn):
-        # the carry must feed an input EVERY output depends on (W feeds both
-        # the A and rhs contractions): perturbing only Y lets XLA hoist the
-        # Y-independent A-einsum out of the loop (LICM), and anything less
-        # than full output dependence lets it dead-code-eliminate the op —
-        # either way the XLA side would be under-timed vs the opaque kernel
-        def body(i, carry):
-            A, b = fn(Xb, Yb, Wb + carry * 1e-30)
-            return A.sum() * 1e-30 + b.sum() * 1e-30
+        def _gram_body(fn):
+            # the carry must feed an input EVERY output depends on (W feeds
+            # both the A and rhs contractions): perturbing only Y lets XLA
+            # hoist the Y-independent A-einsum out of the loop (LICM), and
+            # anything less than full output dependence lets it dead-code-
+            # eliminate the op — either way the XLA side would be
+            # under-timed vs the opaque kernel
+            def body(i, carry):
+                A, b = fn(Xb, Yb, Wb + carry * 1e-30)
+                return A.sum() * 1e-30 + b.sum() * 1e-30
 
-        return body
+            return body
 
-    # n large enough that kernel time (~250us/call) swamps the ~30ms fixed
-    # dispatch cost of one remote loop launch
-    n_gram = 1000
-    t_pallas = _loop_time(_gram_body(masked_gram_pallas), n_gram) / n_gram
-    t_xla = _loop_time(_gram_body(masked_gram_xla), n_gram) / n_gram
-    gram_speedup = round(t_xla / t_pallas, 2)
+        # n large enough that kernel time (~250us/call) swamps the ~30ms
+        # fixed dispatch cost of one remote loop launch
+        n_gram = 1000
+        t_pallas = _loop_time(_gram_body(masked_gram_pallas), n_gram) / n_gram
+        t_xla = _loop_time(_gram_body(masked_gram_xla), n_gram) / n_gram
+        gram_speedup = round(t_xla / t_pallas, 2)
+        pallas_us = round(t_pallas * 1e6, 1)
 
-    with jax.default_matmul_precision("highest"):
-        parity = parity_checks(ds)
-    parity_ok = all(
-        parity[k] <= thresh for k, thresh in PARITY_THRESHOLDS.items()
-    )
+        with jax.default_matmul_precision("highest"):
+            parity = parity_checks(ds)
+        parity_ok = all(
+            parity[k] <= thresh for k, thresh in PARITY_THRESHOLDS.items()
+        )
+    else:
+        gram_speedup = pallas_us = None
+        parity = {k: None for k in PARITY_THRESHOLDS}
+        parity_ok = None  # not checked — requires both backends
 
     print(
         json.dumps(
@@ -252,16 +271,20 @@ def main():
                 "unit": "s",
                 "vs_baseline": round(10.0 / dt, 2),
                 "device": str(dev),
+                "tpu_unreachable": not tpu_ok,
                 "em_iters_per_sec": round(em_ips, 2),
                 "em_iters_per_sec_host_sync": round(em_ips_host, 2),
                 "pallas_gram_speedup_large_panel": gram_speedup,
-                "pallas_gram_us_per_call": round(t_pallas * 1e6, 1),
-                **{k: round(v, 8) for k, v in parity.items()},
+                "pallas_gram_us_per_call": pallas_us,
+                **{
+                    k: (round(v, 8) if v is not None else None)
+                    for k, v in parity.items()
+                },
                 "parity_ok": parity_ok,
             }
         )
     )
-    if not parity_ok:
+    if parity_ok is False:
         print(
             f"PARITY FAILURE: {parity} exceeds {PARITY_THRESHOLDS}",
             file=sys.stderr,

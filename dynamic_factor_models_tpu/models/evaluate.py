@@ -83,15 +83,20 @@ def _direct_forecasts(Yw, Fw, y_next, h: int, y_lags: int, r: int):
     M = valid.astype(dtype)
     tz = jnp.nan_to_num(targ)
 
+    # one Gram/rhs pair over the FULL design; the AR benchmark's normal
+    # equations are the factor-free sub-block, sliced instead of recomputed
+    # (the einsums are the dominant O(W*win*N*K^2) cost)
+    A = jnp.einsum("wtnk,wtn,wtnl->wnkl", X, M, X)
+    b = jnp.einsum("wtnk,wtn,wtn->wnk", X, M, tz)
+    ok_end = jnp.isfinite(lags[:, -1]).all(axis=2) & jnp.isfinite(
+        Fw[:, -1]
+    ).all(axis=1)[:, None]
+
     def fit_and_forecast(cols):
-        Xc = X[..., cols]
-        A = jnp.einsum("wtnk,wtn,wtnl->wnkl", Xc, M, Xc)
-        b = jnp.einsum("wtnk,wtn,wtn->wnk", Xc, M, tz)
-        beta = jax.vmap(jax.vmap(solve_normal))(A, b)  # (W, N, K')
-        x_end = Xc[:, -1]  # (W, N, K') design row at the origin
-        ok_end = jnp.isfinite(lags[:, -1]).all(axis=2) & jnp.isfinite(
-            Fw[:, -1]
-        ).all(axis=1)[:, None]
+        Ac = A[:, :, np.ix_(cols, cols)[0], np.ix_(cols, cols)[1]]
+        bc = b[..., cols]
+        beta = jax.vmap(jax.vmap(solve_normal))(Ac, bc)  # (W, N, K')
+        x_end = X[:, -1][..., cols]  # (W, N, K') design row at the origin
         enough = M.sum(axis=1) > 2.0 * len(cols)
         fc = jnp.einsum("wnk,wnk->wn", x_end, beta)
         return jnp.where(ok_end & enough, fc, jnp.nan)
@@ -129,6 +134,8 @@ def evaluate_forecasts(
         data_np = np.asarray(data)
         T = data_np.shape[0]
         last = T - 1 if lastperiod is None else lastperiod
+        if not 0 <= last <= T - 1:
+            raise ValueError(f"lastperiod={last} outside the {T}-row panel")
         horizons = np.asarray(sorted(horizons), np.int64)
         hmax = int(horizons[-1])
         if last - hmax - initperiod + 1 < window:

@@ -143,3 +143,23 @@ def test_cli_driver_help_and_json():
     assert out.returncode == 0 and "--full" in out.stdout
     enc = _to_jsonable({"a": np.array([1.0, np.nan]), "b": (np.int64(2), "s")})
     assert enc == {"a": [1.0, None], "b": [2, "s"]}
+
+
+def test_bench_guarded_device_cpu_fallback(monkeypatch):
+    """DFM_BENCH_FORCE_CPU=1 takes the fallback branch: CPU device,
+    tpu_ok=False, and no probe subprocess spawned."""
+    import importlib.util
+    import os
+    import sys as _sys
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_under_test",
+        os.path.join(os.path.dirname(os.path.dirname(__file__)), "bench.py"),
+    )
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+
+    monkeypatch.setenv("DFM_BENCH_FORCE_CPU", "1")
+    dev, tpu_ok = bench._guarded_device(timeout_s=1)
+    assert tpu_ok is False
+    assert dev.platform == "cpu"
