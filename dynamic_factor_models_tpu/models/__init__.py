@@ -56,7 +56,12 @@ from .bayes import (
     simulation_smoother,
 )
 from .sv import SVPriors, SVResults, estimate_dfm_sv
-from .evaluate import ForecastEvaluation, evaluate_forecasts
+from .evaluate import (
+    DieboldMariano,
+    ForecastEvaluation,
+    diebold_mariano,
+    evaluate_forecasts,
+)
 from .tvp import TVPLoadings, tvp_loadings
 from .svar import (
     LocalProjection,

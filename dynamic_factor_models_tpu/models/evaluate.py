@@ -32,7 +32,7 @@ from ..ops.linalg import solve_normal
 from ..utils.backend import on_backend
 from .dfm import DFMConfig, rolling_factor_estimates
 
-__all__ = ["ForecastEvaluation", "evaluate_forecasts"]
+__all__ = ["ForecastEvaluation", "evaluate_forecasts", "DieboldMariano", "diebold_mariano"]
 
 
 class ForecastEvaluation(NamedTuple):
@@ -193,3 +193,58 @@ def evaluate_forecasts(
             rel_mse=mse_dfm / jnp.maximum(mse_ar, 1e-12),
             n_forecasts=n,
         )
+
+
+class DieboldMariano(NamedTuple):
+    stat: jnp.ndarray  # (H, N) DM statistics (negative = DFM better)
+    pvalue: jnp.ndarray  # (H, N) two-sided p-values (normal approximation)
+    n: jnp.ndarray  # (H, N) loss-differential observations
+
+
+def diebold_mariano(ev: ForecastEvaluation) -> DieboldMariano:
+    """Diebold-Mariano (1995) equal-predictive-accuracy tests for the horse
+    race, with the Harvey-Leybourne-Newbold small-sample correction.
+
+    For each (horizon h, series): d_t = e_dfm^2 - e_ar^2 over the common
+    origins; DM = mean(d) / sqrt(LRV(d)/n) with a Bartlett long-run
+    variance at lag h-1 (direct h-step errors are MA(h-1) by construction).
+    Negative statistics mean the diffusion-index forecast beats the AR
+    benchmark; p-values use the normal approximation.
+    """
+    from jax.scipy.stats import norm
+
+    from ..ops.hac import form_kernel
+
+    e1, e2 = ev.errors_dfm, ev.errors_ar  # (H, W, N)
+    both = jnp.isfinite(e1) & jnp.isfinite(e2)
+    d = jnp.where(both, jnp.nan_to_num(e1) ** 2 - jnp.nan_to_num(e2) ** 2, 0.0)
+    m = both.astype(d.dtype)
+    n = m.sum(axis=1)  # (H, N)
+    nn = jnp.maximum(n, 1.0)
+    dbar = d.sum(axis=1) / nn
+    dc = (d - dbar[:, None, :]) * m
+
+    stats, pvals = [], []
+    for i, h in enumerate(ev.horizons):
+        q = max(int(h) - 1, 0)
+        kern = form_kernel(q)
+        v = kern[0] * (dc[i] * dc[i]).sum(axis=0)
+        W = dc.shape[1]
+        for j in range(1, q + 1):
+            gam = (dc[i, j:] * dc[i, : W - j]).sum(axis=0)
+            v = v + 2.0 * kern[j] * gam
+        lrv = v / nn[i]
+        # Harvey-Leybourne-Newbold factor for h-step forecasts
+        hh = float(h)
+        corr = jnp.sqrt(
+            jnp.maximum(nn[i] + 1 - 2 * hh + hh * (hh - 1) / nn[i], 1.0) / nn[i]
+        )
+        # dtype-aware floor: a fixed 1e-300 underflows to 0 in f32 and a
+        # zero loss differential would become NaN instead of 0
+        floor = jnp.finfo(d.dtype).tiny
+        dm = corr * dbar[i] / jnp.sqrt(jnp.maximum(lrv / nn[i], floor))
+        dm = jnp.where(n[i] > 2 * hh, dm, jnp.nan)
+        stats.append(dm)
+        # survival function, not 1-cdf: keeps precision for |dm| > 8
+        pvals.append(2.0 * norm.sf(jnp.abs(dm)))
+    return DieboldMariano(jnp.stack(stats), jnp.stack(pvals), n)

@@ -7,6 +7,7 @@ from .linalg import (
     pca_score,
     standardize_data,
     compute_r2,
+    varimax,
 )
 from .lags import lagmat, uar, detrended_year_growth
 from .hac import form_kernel, hac, regress_hac, compute_chow, compute_qlr

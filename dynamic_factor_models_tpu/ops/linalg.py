@@ -29,6 +29,7 @@ __all__ = [
     "standardize_data",
     "standardize_data_np",
     "compute_r2",
+    "varimax",
 ]
 
 
@@ -145,3 +146,38 @@ def compute_r2(y: jnp.ndarray, e: jnp.ndarray, w=None) -> tuple[jnp.ndarray, jnp
     ssr = (fillz(e) ** 2 * w).sum()
     tss = ((fillz(y) - ybar) ** 2 * w).sum()
     return 1.0 - ssr / tss, ssr, tss
+
+
+def varimax(lam: jnp.ndarray, n_iter: int = 100, tol: float = 1e-8):
+    """Varimax rotation of a loading matrix (Kaiser 1958, SVD algorithm).
+
+    Factors from PCA/ALS are identified only up to rotation (SURVEY.md
+    section 7.3); varimax picks the orthogonal rotation maximizing the
+    variance of squared loadings, the standard interpretability aid the
+    reference leaves to the reader.  Returns (rotated loadings, R) with
+    lam_rot = lam @ R, R orthogonal; apply F @ R to keep F lam' invariant.
+
+    Implemented as a jitted ``lax.while_loop`` of SVD steps.
+    """
+    lam = jnp.asarray(lam)
+    N, r = lam.shape
+    if r == 1:
+        return lam, jnp.eye(1, dtype=lam.dtype)
+
+    def body(state):
+        R, d_prev, _, i = state
+        L = lam @ R
+        mid = L**3 - L * (L**2).sum(axis=0) / N
+        u, s, vt = jnp.linalg.svd(lam.T @ mid)
+        d = s.sum()
+        return u @ vt, d, jnp.abs(d - d_prev), i + 1
+
+    def cond(state):
+        _, _, delta, i = state
+        return (delta > tol) & (i < n_iter)
+
+    R0 = jnp.eye(r, dtype=lam.dtype)
+    R, *_ = jax.lax.while_loop(
+        cond, body, (R0, jnp.asarray(0.0, lam.dtype), jnp.asarray(jnp.inf, lam.dtype), 0)
+    )
+    return lam @ R, R

@@ -99,3 +99,34 @@ class TestEvaluateForecasts:
                 jnp.asarray(x), np.ones(x.shape[1], np.int64), window=99,
                 nfac=1, horizons=(4,), config=CFG,
             )
+
+
+class TestDieboldMariano:
+    def test_dm_on_horse_race(self, horse_race):
+        from dynamic_factor_models_tpu.models.evaluate import diebold_mariano
+
+        dm = diebold_mariano(horse_race)
+        stat, p = np.asarray(dm.stat), np.asarray(dm.pvalue)
+        assert stat.shape == p.shape == (2, 16)
+        assert np.isfinite(stat).all()
+        assert ((p >= 0) & (p <= 1)).all()
+        # factor DGP: loss differentials lean negative (DFM better)
+        assert np.median(stat[0]) < 0
+
+    def test_dm_identical_forecasts_give_nan_or_zero(self):
+        """Degenerate case: identical errors -> zero differential; the
+        statistic must not blow up."""
+        from dynamic_factor_models_tpu.models.evaluate import (
+            ForecastEvaluation, diebold_mariano,
+        )
+        import jax.numpy as jnp
+
+        e = jnp.asarray(np.random.default_rng(0).standard_normal((1, 30, 4)))
+        ev = ForecastEvaluation(
+            origins=np.arange(30), horizons=np.array([1]),
+            errors_dfm=e, errors_ar=e,
+            rmse_dfm=None, rmse_ar=None, rel_mse=None,
+            n_forecasts=jnp.full((1, 4), 30),
+        )
+        dm = diebold_mariano(ev)
+        assert np.allclose(np.asarray(dm.stat), 0.0)

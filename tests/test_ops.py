@@ -209,3 +209,39 @@ def test_standardize_np_twin_matches_jax(rng):
     for k in range(3):
         sgn = np.sign(s_j[:, k] @ s_n[:, k]) or 1.0
         np.testing.assert_allclose(s_j[:, k], sgn * s_n[:, k], atol=1e-8)
+
+
+def test_varimax_recovers_simple_structure():
+    from dynamic_factor_models_tpu.ops.linalg import varimax
+
+    rng_local = np.random.default_rng(7)  # own stream: the shared session
+    # fixture's state depends on test order
+    lam_true = np.zeros((20, 2))
+    lam_true[:10, 0] = 1.0
+    lam_true[10:, 1] = 1.0
+    lam_true += 0.05 * rng_local.standard_normal((20, 2))
+    c = np.cos(np.pi / 4)
+    q = np.array([[c, -c], [c, c]])  # 45 degrees: maximally mixed blocks
+    lam_rot, R = varimax(jnp.asarray(lam_true @ q))
+    R = np.asarray(R)
+    assert np.allclose(R.T @ R, np.eye(2), atol=1e-10)
+    L = np.asarray(lam_rot)
+
+    def vscore(M):
+        return (M**2).var(axis=0).sum()
+
+    assert vscore(L) > vscore(lam_true @ q) + 0.1
+    # each rotated factor loads on exactly one block (up to sign/order)
+    top = np.sort(np.abs(L[:10]).mean(axis=0))
+    bot = np.sort(np.abs(L[10:]).mean(axis=0))
+    assert top[0] < 0.15 < 0.85 < top[1]
+    assert bot[0] < 0.15 < 0.85 < bot[1]
+
+
+def test_varimax_r1_identity():
+    from dynamic_factor_models_tpu.ops.linalg import varimax
+
+    lam = jnp.asarray(np.random.default_rng(1).standard_normal((8, 1)))
+    out, R = varimax(lam)
+    assert np.allclose(np.asarray(out), np.asarray(lam))
+    assert float(R[0, 0]) == 1.0
