@@ -50,7 +50,9 @@ from .mixed_freq import MFResults, MixedFreqParams, estimate_mixed_freq_dfm
 from .bayes import (
     BayesPriors,
     BayesResults,
+    PosteriorForecast,
     estimate_dfm_bayes,
+    posterior_forecast,
     posterior_irfs,
     rhat,
     simulation_smoother,

@@ -139,3 +139,54 @@ class TestSimulationSmoother:
         assert rhat(same) < 1.05
         shifted = same + np.arange(4)[:, None] * 5.0
         assert rhat(shifted) > 2.0
+
+
+class TestPosteriorForecast:
+    def test_predictive_bands_cover_future(self):
+        """Fit on the first part of a synthetic sample, forecast the rest:
+        the 5-95% predictive band should cover ~90% of realized values."""
+        from dynamic_factor_models_tpu.models.bayes import posterior_forecast
+
+        rng = np.random.default_rng(10)
+        T, N, h = 160, 10, 8
+        f = np.zeros((T + h, 1))
+        for t in range(1, T + h):
+            f[t] = 0.7 * f[t - 1] + rng.standard_normal(1)
+        lam = rng.standard_normal((N, 1))
+        x_all = f @ lam.T + 0.5 * rng.standard_normal((T + h, N))
+        x_fit = x_all[:T]
+
+        res = estimate_dfm_bayes(
+            jnp.asarray(x_fit), np.ones(N, np.int64), 0, T - 1,
+            DFMConfig(nfac_u=1, n_factorlag=1, tol=1e-6, max_iter=200),
+            n_keep=60, n_burn=60, n_chains=2, seed=0,
+        )
+        # raw panel in, original units out: standardization is internal
+        fc = posterior_forecast(
+            res, jnp.asarray(x_fit), np.ones(N, np.int64), 0, T - 1,
+            horizon=h, seed=1,
+        )
+        assert fc.draws.shape == (120, h, N)
+        assert np.isfinite(np.asarray(fc.draws)).all()
+        lo, hi = fc.quantiles[0], fc.quantiles[-1]
+        actual = x_all[T:]  # original units
+        cover = ((actual >= lo) & (actual <= hi)).mean()
+        assert 0.75 < cover <= 1.0
+        # monotone quantiles and a sane mean (original units)
+        assert (np.diff(fc.quantiles, axis=0) >= -1e-9).all()
+        assert np.abs(np.asarray(fc.mean)).max() < 5.0 * np.nanstd(x_fit)
+
+    def test_horizon_validation(self, posterior):
+        from dynamic_factor_models_tpu.models.bayes import posterior_forecast
+
+        x, *_, res = posterior
+        ones = np.ones(x.shape[1], np.int64)
+        with pytest.raises(ValueError, match="horizon"):
+            posterior_forecast(
+                res, jnp.asarray(x), ones, 0, x.shape[0] - 1, horizon=0
+            )
+        with pytest.raises(ValueError, match="included series"):
+            posterior_forecast(
+                res, jnp.asarray(x[:, :5]), ones[:5], 0, x.shape[0] - 1,
+                horizon=2,
+            )
