@@ -48,9 +48,12 @@ from .ssm_ar import (
 )
 from .mixed_freq import MFResults, MixedFreqParams, estimate_mixed_freq_dfm
 from .bayes import (
+    BayesModelComparison,
     BayesPriors,
     BayesResults,
     PosteriorForecast,
+    dic,
+    select_nfac_bayes,
     estimate_dfm_bayes,
     posterior_forecast,
     posterior_irfs,
