@@ -128,20 +128,20 @@ def _guarded_device(timeout_s: int = 240):
     branch; the full fallback run is driven manually)."""
     import os
 
-    from dynamic_factor_models_tpu.utils.backend import probe_default_device
+    from dynamic_factor_models_tpu.utils.backend import (
+        fall_back_to_cpu,
+        probe_default_device,
+    )
 
     forced = os.environ.get("DFM_BENCH_FORCE_CPU") == "1"
     ok, detail = (False, "forced CPU fallback") if forced else (
         probe_default_device(timeout_s)
     )
     if not ok:
-        print(
-            f"bench: TPU unreachable ({detail}); falling back to CPU — "
-            "Pallas/parity sections skipped",
-            file=sys.stderr,
-            flush=True,
-        )
-        jax.config.update("jax_platforms", "cpu")
+        # shared guard: raises instead of pinning when a backend is already
+        # initialized (the pin would silently not take effect and the next
+        # array touch would hang on the wedged device)
+        fall_back_to_cpu(detail, caller="bench")
         return jax.devices()[0], False
     return jax.devices()[0], True
 

@@ -86,3 +86,44 @@ def on_backend(backend: str | None):
     else:
         with jax.default_device(dev):
             yield dev
+
+
+def fall_back_to_cpu(detail: str, caller: str = "caller") -> None:
+    """Pin jax to the CPU platform after a failed device-liveness probe
+    (shared by bench.py and __graft_entry__.entry()).
+
+    The config-level platform pin only takes effect while no jax backend is
+    initialized; if one already is, the pin would be a silent no-op and the
+    next array creation would hang inside native code on the wedged device
+    — so that case raises instead.  Fails CLOSED: if jax's private
+    initialized-backend registry cannot be found (internals moved in an
+    upgrade), raise rather than risk the unbounded hang.
+    """
+    import sys
+
+    try:
+        from jax._src import xla_bridge
+
+        backends = getattr(xla_bridge, "_backends")
+    except (ImportError, AttributeError) as exc:
+        raise RuntimeError(
+            f"{caller}: default device unusable — {detail} — and jax's "
+            "backend registry could not be inspected to prove a CPU "
+            f"fallback is safe ({exc!r}); failing fast instead of risking "
+            "a hang on the wedged device"
+        )
+    if backends:
+        if jax.default_backend() == "cpu":
+            return  # already CPU-only (e.g. test conftest): nothing to pin
+        raise RuntimeError(
+            f"{caller}: default device unusable — {detail} — and a jax "
+            "backend is already initialized, so a CPU fallback cannot "
+            "take effect in this process"
+        )
+    print(
+        f"{caller}: TPU unreachable ({detail}); falling back to the CPU "
+        "platform",
+        file=sys.stderr,
+        flush=True,
+    )
+    jax.config.update("jax_platforms", "cpu")

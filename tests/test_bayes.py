@@ -221,3 +221,20 @@ class TestModelComparison:
         assert comp.best_nfac in (2, 3)
         # effective parameters grow with r
         assert comp.p_d[2] > comp.p_d[0]
+
+
+def test_chain_mesh_sharding():
+    """Chains shard over a 1-axis mesh (any axis name) and match shapes."""
+    from jax.sharding import Mesh
+
+    x, *_ = _synthetic(T=60, N=8)
+    devs = np.array(jax.devices()[:2])
+    mesh = Mesh(devs, ("rep",))  # make_mesh's default axis name
+    res = estimate_dfm_bayes(
+        jnp.asarray(x), np.ones(8, np.int64), 0, 59,
+        DFMConfig(nfac_u=1, n_factorlag=1, tol=1e-6, max_iter=100),
+        n_keep=10, n_burn=10, n_chains=2, seed=0, mesh=mesh,
+    )
+    assert res.factor_draws.shape == (2, 10, 60, 1)
+    assert np.isfinite(np.asarray(res.factor_draws)).all()
+    assert np.isfinite(res.loglik_path).all()
