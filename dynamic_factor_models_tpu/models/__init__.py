@@ -37,7 +37,7 @@ from .ssm import (
     kalman_smoother,
 )
 from .favar import BootstrapIRFs, wild_bootstrap_irfs, wild_bootstrap_irfs_resumable
-from .dynpca import DynamicPCAResults, dynamic_pca, spectral_density
+from .dynpca import DynamicPCAResults, coherence, dynamic_pca, spectral_density
 from .multilevel import MultilevelResults, estimate_multilevel_dfm
 from .ssm_ar import (
     EMARResults,

@@ -39,6 +39,7 @@ __all__ = [
     "dynamic_pca",
     "dynamic_eigenvalue_shares",
     "one_sided_common_component",
+    "coherence",
 ]
 
 
@@ -246,3 +247,23 @@ def one_sided_common_component(
         proj = gamma_chi0 @ W @ jnp.linalg.pinv(W.T @ gamma_x0 @ W)
         chi = Z @ proj.T  # (T, N)
         return chi, W, proj, res
+
+
+def coherence(x, M: int = 20, backend: str | None = None):
+    """Squared coherence and phase spectra between every pair of series.
+
+    Frequency-domain comovement diagnostics on the shared lag-window
+    spectral estimate: coh2[h, i, j] = |S_ij|^2 / (S_ii S_jj) in [0, 1]
+    measures how strongly series i and j comove at frequency theta_h
+    (business-cycle comovement lives at low frequencies); phase[h, i, j]
+    = arg S_ij is the lead-lag relationship in radians (positive = i leads
+    j at that frequency, by phase/theta periods).
+
+    Returns (frequencies (H,), coh2 (H, N, N) real, phase (H, N, N) real).
+    """
+    freqs, spec = spectral_density(x, M, backend=backend)
+    diag = jnp.maximum(jnp.diagonal(spec, axis1=1, axis2=2).real, 1e-12)
+    denom = diag[:, :, None] * diag[:, None, :]
+    coh2 = jnp.clip((jnp.abs(spec) ** 2) / denom, 0.0, 1.0)
+    phase = jnp.angle(spec)
+    return freqs, coh2, phase

@@ -172,3 +172,31 @@ def test_multilevel_real_panel_category_blocks(dataset_all):
     # shares are computed from non-orthogonalized components, so they sum
     # to ~1 with overlap slack (same convention as the synthetic test)
     assert abs(vd["global"] + vd["block"] + vd["idiosyncratic"] - 1.0) < 0.15
+
+
+class TestCoherence:
+    def test_coherent_and_independent_pairs(self):
+        from dynamic_factor_models_tpu.models.dynpca import coherence
+
+        rng = np.random.default_rng(0)
+        T = 2000
+        f = np.zeros(T)
+        for t in range(1, T):
+            f[t] = 0.9 * f[t - 1] + rng.standard_normal()
+        x = np.column_stack([
+            f + 0.3 * rng.standard_normal(T),
+            np.r_[np.zeros(2), f[:-2]] + 0.3 * rng.standard_normal(T),
+            rng.standard_normal(T),
+        ])
+        freqs, coh2, phase = coherence(jnp.asarray(x), M=40)
+        freqs, coh2 = np.asarray(freqs), np.asarray(coh2)
+        assert ((coh2 >= 0) & (coh2 <= 1)).all()
+        low = freqs <= 0.5
+        # series 0 and 1 share the slow factor; series 2 is independent
+        assert coh2[low, 0, 1].mean() > 0.8
+        assert coh2[low, 0, 2].mean() < 0.2
+        # the 2-period lag shows as a positive low-frequency phase slope
+        slope = (np.asarray(phase)[1:6, 0, 1] / freqs[1:6]).mean()
+        assert 1.0 < slope < 3.0
+        # diagonal coherence is exactly 1
+        assert np.allclose(coh2[:, 0, 0], 1.0, atol=1e-8)
