@@ -24,6 +24,7 @@ from .selection import (
     amengual_watson_test,
     bai_ng_criterion,
     estimate_factor_numbers,
+    onatski_ed,
 )
 from .constraints import LambdaConstraint, construct_constraint
 from .instability import InstabilityResults, instability_scan

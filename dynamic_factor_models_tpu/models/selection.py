@@ -29,6 +29,7 @@ __all__ = [
     "amengual_watson_test",
     "estimate_factor_numbers",
     "ahn_horenstein_er",
+    "onatski_ed",
     "FactorNumberEstimateStats",
 ]
 
@@ -218,3 +219,55 @@ def estimate_factor_numbers(
             R2_d[:, d - 1, r - 1] = np.asarray(aw_batch.R2[j])
 
     return FactorNumberEstimateStats(bn, ssr_s, R2_s, aw, ssr_d, R2_d, tss, nobs, T)
+
+
+def onatski_ed(x, rmax: int = 10, n_iter: int = 4):
+    """Onatski (2010) eigenvalue-differences estimator of the number of
+    static factors.
+
+    New capability (complements the reference's Bai-Ng ICp2 and the
+    Ahn-Horenstein ER, cells 35/37): r_hat = max{ j <= rmax :
+    lambda_j - lambda_{j+1} >= delta } where delta is calibrated from the
+    near-linear tail of the scree plot — OLS of the eigenvalues
+    lambda_{rmax+1..rmax+5} on (j-1)^{2/3}, delta = 2 |slope|, iterated to
+    a fixed point.  Robust to weak cross-sectional/serial correlation in
+    the idiosyncratic terms, where ratio criteria over-select.
+
+    x: (T, N) panel (NaN missing — masked pairwise moments).  The panel is
+    standardized per series first (`ops.linalg.standardize_data_np`, the
+    same population-std convention as the ALS/EM pipeline): on raw
+    heterogeneous-unit data the leading eigenvalues just rank series
+    variances.  `n_iter` caps the delta/r_hat recursion; it stops early at
+    a fixed point (the recursion can 2-cycle on borderline spectra, in
+    which case the n_iter-th iterate is returned).
+    Returns (r_hat, eigenvalues, delta).
+    """
+    from ..ops.linalg import standardize_data_np
+
+    if n_iter < 1:
+        raise ValueError(f"n_iter must be >= 1, got {n_iter}")
+    x = np.asarray(x, float)
+    xc, m, _ = standardize_data_np(x)
+    xc = np.nan_to_num(xc)  # constant series standardize to NaN; drop them
+    n_pair = np.maximum(m.T.astype(float) @ m.astype(float), 1.0)
+    S = (xc.T @ xc) / n_pair
+    lam = np.linalg.eigvalsh(0.5 * (S + S.T))[::-1]  # descending
+
+    # the tail regression reads eigenvalues rmax .. rmax+4 (0-based)
+    if rmax + 5 > lam.size:
+        raise ValueError(
+            f"rmax={rmax} needs at least rmax+5 <= N={lam.size} eigenvalues"
+        )
+    j0 = rmax + 1
+    for _ in range(n_iter):
+        js = np.arange(j0, j0 + 5)
+        Z = np.column_stack([np.ones(5), (js - 1.0) ** (2.0 / 3.0)])
+        beta = np.linalg.lstsq(Z, lam[js - 1], rcond=None)[0]
+        delta = 2.0 * abs(beta[1])
+        diffs = lam[:rmax] - lam[1 : rmax + 1]
+        above = np.flatnonzero(diffs >= delta)
+        r_hat = int(above[-1] + 1) if above.size else 0
+        if r_hat + 1 == j0:  # fixed point
+            break
+        j0 = r_hat + 1
+    return r_hat, lam, float(delta)

