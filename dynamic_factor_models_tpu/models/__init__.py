@@ -48,6 +48,7 @@ from .ssm_ar import (
     nowcast_em_ar,
 )
 from .mixed_freq import MFResults, MixedFreqParams, estimate_mixed_freq_dfm
+from .news import NowcastNews, nowcast_news
 from .bayes import (
     BayesModelComparison,
     BayesPriors,

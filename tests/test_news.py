@@ -1,0 +1,83 @@
+"""Nowcast news decomposition (models/news.py)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dynamic_factor_models_tpu.models.news import nowcast_news
+from dynamic_factor_models_tpu.models.ssm import SSMParams
+
+
+@pytest.fixture(scope="module")
+def setup():
+    rng = np.random.default_rng(0)
+    T, N = 80, 6
+    f = np.zeros(T)
+    for t in range(1, T):
+        f[t] = 0.8 * f[t - 1] + rng.standard_normal()
+    lam = np.array([1.0, 0.9, 0.8, 0.7, 0.6, 1.1])
+    x = f[:, None] * lam[None, :] + 0.3 * rng.standard_normal((T, N))
+    params = SSMParams(
+        lam=jnp.asarray(lam[:, None]), R=0.09 * jnp.ones(N),
+        A=0.8 * jnp.eye(1)[None], Q=jnp.eye(1),
+    )
+    x_old = x.copy()
+    x_old[-1, :] = np.nan
+    x_new = x.copy()
+    x_new[-1, 0] = np.nan  # the nowcast target stays unreleased
+    x_new[-1, 4] = np.nan
+    return params, x_old, x_new
+
+
+class TestNowcastNews:
+    def test_news_telescopes_exactly(self, setup):
+        params, x_old, x_new = setup
+        res = nowcast_news(
+            params, jnp.asarray(x_old), jnp.asarray(x_new), target=(79, 0)
+        )
+        assert res.releases.shape == (4, 2)
+        assert abs(float(np.asarray(res.news).sum()) - res.total_revision) < 1e-10
+        assert abs(res.total_revision - (res.new_nowcast - res.old_nowcast)) < 1e-10
+        assert res.nowcast_path.shape == (5,)
+
+    def test_positive_surprise_gives_positive_news(self, setup):
+        params, x_old, x_new = setup
+        x_pos = x_new.copy()
+        x_pos[-1, 5] = 5.0  # large positive surprise, loading 1.1
+        res = nowcast_news(
+            params, jnp.asarray(x_old), jnp.asarray(x_pos), target=(79, 0)
+        )
+        j5 = [k for k, (t, i) in enumerate(res.releases) if i == 5][0]
+        assert float(res.news[j5]) > 0.5
+
+    def test_order_changes_attribution_not_total(self, setup):
+        params, x_old, x_new = setup
+        a = nowcast_news(
+            params, jnp.asarray(x_old), jnp.asarray(x_new), target=(79, 0)
+        )
+        b = nowcast_news(
+            params, jnp.asarray(x_old), jnp.asarray(x_new), target=(79, 0),
+            order=[3, 2, 1, 0],
+        )
+        assert abs(a.total_revision - b.total_revision) < 1e-10
+        # reversed order lists the same releases reversed
+        assert (b.releases == a.releases[::-1]).all()
+
+    def test_vintage_validation(self, setup):
+        params, x_old, x_new = setup
+        # non-nested vintages
+        x_bad = x_new.copy()
+        x_bad[10, 0] = np.nan
+        with pytest.raises(ValueError, match="nested"):
+            nowcast_news(params, jnp.asarray(x_old), jnp.asarray(x_bad),
+                         target=(79, 0))
+        # revised overlapping value
+        x_rev = x_new.copy()
+        x_rev[10, 0] += 1.0
+        with pytest.raises(ValueError, match="pure releases"):
+            nowcast_news(params, jnp.asarray(x_old), jnp.asarray(x_rev),
+                         target=(79, 0))
+        # observed target
+        with pytest.raises(ValueError, match="observed in the new vintage"):
+            nowcast_news(params, jnp.asarray(x_old), jnp.asarray(x_new),
+                         target=(79, 1))
