@@ -52,6 +52,10 @@ def main(argv=None) -> int:
                     help="enable float64 (recommended on CPU for parity)")
     ap.add_argument("--no-figures", action="store_true",
                     help="skip PNG rendering, write only tables.json")
+    ap.add_argument("--extras", action="store_true",
+                    help="also render the beyond-reference capability "
+                         "panels (SV volatility, posterior IRFs, TVP "
+                         "loadings, coherence) — adds a few minutes")
     args = ap.parse_args(argv)
 
     import jax
@@ -76,13 +80,17 @@ def main(argv=None) -> int:
     # raises if none is reachable; "cpu" is handled by the platform
     # restriction above
     with on_backend(args.backend if args.backend == "tpu" else None):
+        ds_real, ds_all = sw.load_datasets(args.xlsx)
         if not args.no_figures:
             # render_all computes every figure itself — don't recompute them
             # for the JSON; only the tables are fit below
             from .plotting import render_all
 
             written += render_all(args.out, fast=not full, path=args.xlsx)
-        ds_real, ds_all = sw.load_datasets(args.xlsx)
+            if args.extras:
+                from .plotting import render_extras
+
+                written += render_extras(args.out, ds_real=ds_real)
         tables = {
             "table2": sw.table2(ds_real, ds_all,
                                 max_nfac_b=11 if full else 6, dynamic=full),

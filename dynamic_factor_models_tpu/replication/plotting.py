@@ -24,7 +24,7 @@ TEXT_SECONDARY = "#52514e"
 SURFACE = "#fcfcfb"
 GRID = "#e4e3df"
 
-__all__ = ["render_all", "line_panel"]
+__all__ = ["render_all", "render_extras", "line_panel"]
 
 
 def _style_axis(ax, title):
@@ -77,12 +77,7 @@ def render_all(out_dir: str, fast: bool = True, path: str | None = None) -> list
     os.makedirs(out_dir, exist_ok=True)
     ds_real, ds_all = sw.load_datasets(path)
     written = []
-
-    def save(fig, name):
-        p = os.path.join(out_dir, name)
-        fig.savefig(p, dpi=150, facecolor=SURFACE, bbox_inches="tight")
-        plt.close(fig)
-        written.append(p)
+    save = _make_saver(out_dir, plt, written)
 
     # Figure 1: per-series detrended 4q growth vs 1-factor common component
     f1 = sw.figure1(ds_real)
@@ -162,5 +157,112 @@ def render_all(out_dir: str, fast: bool = True, path: str | None = None) -> list
         "oil-price inflation vs constrained common component",
     )
     save(fig, "figure7.png")
+
+    return written
+
+
+def _make_saver(out_dir, plt, written):
+    """Shared PNG writer (render_all and render_extras must not drift)."""
+
+    def save(fig, name):
+        p = os.path.join(out_dir, name)
+        fig.savefig(p, dpi=150, facecolor=SURFACE, bbox_inches="tight")
+        plt.close(fig)
+        written.append(p)
+
+    return save
+
+
+def render_extras(
+    out_dir: str,
+    path: str | None = None,
+    ds_real=None,
+    n_keep: int = 40,
+    n_burn: int = 40,
+    n_chains: int = 2,
+) -> list[str]:
+    """Render the beyond-reference capability panels to PNG: stochastic-
+    volatility path, posterior IRF fan, TVP loading drift, and coherence
+    spectra.  Small default chain sizes keep this a minutes-scale CPU run;
+    raise n_keep/n_burn for production-quality bands.
+    """
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+    import jax.numpy as jnp
+
+    from ..models import (
+        DFMConfig,
+        coherence,
+        estimate_dfm,
+        estimate_dfm_bayes,
+        estimate_dfm_sv,
+        posterior_irfs,
+        tvp_loadings,
+    )
+    from ..ops.linalg import standardize_data
+    from . import stock_watson as sw
+
+    os.makedirs(out_dir, exist_ok=True)
+    if ds_real is None:
+        ds_real, _ = sw.load_datasets(path)
+    cfg = DFMConfig(nfac_u=4)
+    incl = np.asarray(ds_real.inclcode) == 1
+    # benchmark sample window, derived like every stock_watson figure (the
+    # row offsets shift if a revised/extended panel is passed via `path`)
+    i0, i1 = sw._window(ds_real, sw.PERIODS_ALL)
+    year = np.asarray(ds_real.calvec)[i0 : i1 + 1]
+    written = []
+    save = _make_saver(out_dir, plt, written)
+
+    # stochastic volatility: posterior mean +/- band of factor-1 innovation sd
+    sv = estimate_dfm_sv(ds_real.bpdata, ds_real.inclcode, i0, i1, cfg,
+                         n_keep=n_keep, n_burn=n_burn, n_chains=n_chains)
+    vol = np.asarray(sv.vol_draws)[..., 0].reshape(-1, sv.vol_draws.shape[2])
+    lo, mid, hi = np.quantile(vol, [0.16, 0.5, 0.84], axis=0)
+    fig, ax = plt.subplots(figsize=(10, 4))
+    line_panel(ax, year, {"median": mid, "16%": lo, "84%": hi},
+               "factor-1 innovation volatility (SV-DFM posterior)")
+    save(fig, "extra_sv_volatility.png")
+
+    # posterior IRF fan of factor 1 to its own shock
+    post = estimate_dfm_bayes(ds_real.bpdata, ds_real.inclcode, i0, i1, cfg,
+                              n_keep=n_keep, n_burn=n_burn, n_chains=n_chains)
+    qs, _ = posterior_irfs(post, horizon=16)
+    qs = np.asarray(qs)  # (nq, r, H, r)
+    fig, ax = plt.subplots(figsize=(8, 4))
+    h = np.arange(qs.shape[2])
+    line_panel(ax, h, {lbl: qs[k, 0, :, 0] for k, lbl in
+                       enumerate(("5%", "16%", "median", "84%", "95%"))},
+               "factor-1 IRF to own shock (posterior bands)")
+    save(fig, "extra_posterior_irf.png")
+
+    # TVP loading drift: the most unstable series' loading path on factor 1
+    res = estimate_dfm(ds_real.bpdata, ds_real.inclcode, i0, i1, cfg)
+    data = np.asarray(ds_real.bpdata)[i0 : i1 + 1][:, incl]
+    xz, _ = standardize_data(jnp.asarray(data))
+    F = jnp.asarray(np.asarray(res.factor)[i0 : i1 + 1])
+    tvp = tvp_loadings(xz, F)
+    names = [n for n, i in zip(ds_real.bpnamevec, incl) if i]
+    top = np.argsort(-np.asarray(tvp.drift))[:3]
+    fig, ax = plt.subplots(figsize=(10, 4))
+    line_panel(ax, year,
+               {names[i]: np.asarray(tvp.lam_path)[:, i, 0] for i in top},
+               "factor-1 loadings of the most unstable series (TVP paths)")
+    save(fig, "extra_tvp_loadings.png")
+
+    # coherence with the first included series across frequencies
+    freqs, coh2, _ = coherence(ds_real.bpdata, M=24)
+    freqs, coh2 = np.asarray(freqs), np.asarray(coh2)
+    half = freqs <= np.pi
+    full_names = list(ds_real.bpnamevec)
+    j0 = int(np.flatnonzero(incl)[0])
+    others = np.flatnonzero(incl)[1:4]
+    fig, ax = plt.subplots(figsize=(8, 4))
+    line_panel(ax, freqs[half],
+               {full_names[j]: coh2[half, j0, j] for j in others},
+               f"squared coherence with {full_names[j0]}")
+    save(fig, "extra_coherence.png")
 
     return written

@@ -1,5 +1,7 @@
 """Replication-driver smoke tests + checkpoint round-trip."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -163,3 +165,20 @@ def test_bench_guarded_device_cpu_fallback(monkeypatch):
     dev, tpu_ok = bench._guarded_device(timeout_s=1)
     assert tpu_ok is False
     assert dev.platform == "cpu"
+
+
+@pytest.mark.slow
+def test_render_extras_writes_capability_panels(tmp_path):
+    """The beyond-reference panels (SV volatility, posterior IRF fan, TVP
+    loadings, coherence) render to non-trivial PNGs with tiny chains."""
+    from dynamic_factor_models_tpu.replication.plotting import render_extras
+
+    written = render_extras(str(tmp_path), n_keep=8, n_burn=8, n_chains=2)
+    names = sorted(os.path.basename(p) for p in written)
+    assert names == [
+        "extra_coherence.png",
+        "extra_posterior_irf.png",
+        "extra_sv_volatility.png",
+        "extra_tvp_loadings.png",
+    ]
+    assert all(os.path.getsize(p) > 10_000 for p in written)
