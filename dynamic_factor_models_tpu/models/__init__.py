@@ -37,7 +37,12 @@ from .ssm import (
     kalman_filter,
     kalman_smoother,
 )
-from .favar import BootstrapIRFs, wild_bootstrap_irfs, wild_bootstrap_irfs_resumable
+from .favar import (
+    BootstrapIRFs,
+    block_bootstrap_irfs,
+    wild_bootstrap_irfs,
+    wild_bootstrap_irfs_resumable,
+)
 from .dynpca import DynamicPCAResults, coherence, dynamic_pca, spectral_density
 from .multilevel import MultilevelResults, estimate_multilevel_dfm
 from .ssm_ar import (

@@ -30,7 +30,12 @@ from ..parallel.mesh import NamedSharding, P, make_mesh
 from ..utils.backend import on_backend
 from .var import VARResults, companion_matrices, estimate_var, impulse_response
 
-__all__ = ["BootstrapIRFs", "wild_bootstrap_irfs", "wild_bootstrap_irfs_resumable"]
+__all__ = [
+    "BootstrapIRFs",
+    "block_bootstrap_irfs",
+    "wild_bootstrap_irfs",
+    "wild_bootstrap_irfs_resumable",
+]
 
 
 class BootstrapIRFs(NamedTuple):
@@ -72,17 +77,40 @@ def _wild_recursion(y_init, betahat, eta, nlag: int) -> jnp.ndarray:
     return jnp.concatenate([y_init, tail], axis=0)
 
 
-@partial(jax.jit, static_argnames=("nlag", "horizon", "n_reps"))
-def _bootstrap_core(yw, key, nlag: int, horizon: int, n_reps: int):
-    Tw, ns = yw.shape
+def _resample_wild(k, ehat):
+    """Wild resampling: one Rademacher sign per period, shared across
+    equations — preserves the cross-equation residual correlation."""
+    signs = jax.random.rademacher(k, (ehat.shape[0],), dtype=ehat.dtype)
+    return ehat * signs[:, None]
+
+
+@lru_cache(maxsize=16)
+def _block_resampler(block: int):
+    """Moving-block resampler (Kuensch 1989 MBB): blocks of `block`
+    consecutive residual rows, preserving the serial dependence the wild
+    bootstrap's independent sign flips destroy.  (No centering: OLS
+    residuals with an intercept already have exact zero column means.)
+    Cached per block size so the jitted core's static arg keeps a stable
+    identity across calls."""
+
+    def resample(k, ehat):
+        Te = ehat.shape[0]
+        n_blocks = -(-Te // block)
+        starts = jax.random.randint(k, (n_blocks,), 0, Te - block + 1)
+        idx = (starts[:, None] + jnp.arange(block)[None, :]).reshape(-1)[:Te]
+        return ehat[idx]
+
+    return resample
+
+
+@partial(jax.jit, static_argnames=("nlag", "horizon", "n_reps", "resample"))
+def _bootstrap_core(yw, key, nlag: int, horizon: int, n_reps: int,
+                    resample=_resample_wild):
     betahat, ehat, _ = _fit_dense_var(yw, nlag)
     y_init = yw[:nlag]
 
     def one_rep(k):
-        # wild bootstrap: one Rademacher sign per period, shared across
-        # equations — preserves the cross-equation residual correlation
-        signs = jax.random.rademacher(k, (Tw - nlag,), dtype=yw.dtype)
-        ystar = _wild_recursion(y_init, betahat, ehat * signs[:, None], nlag)
+        ystar = _wild_recursion(y_init, betahat, resample(k, ehat), nlag)
 
         b_star, _, seps_star = _fit_dense_var(ystar, nlag)
         M, Q, G = companion_matrices(b_star, seps_star, nlag)
@@ -106,7 +134,7 @@ def _sharded_core(out_sharding):
     (and bench warm-up) hit the compile cache instead of re-wrapping."""
     return jax.jit(
         _bootstrap_core,
-        static_argnames=("nlag", "horizon", "n_reps"),
+        static_argnames=("nlag", "horizon", "n_reps", "resample"),
         out_shardings=out_sharding,
     )
 
@@ -125,14 +153,14 @@ def _prepare_window(y, initperiod: int, lastperiod: int) -> jnp.ndarray:
     return yw[first:]
 
 
-def _run_core(yw, key, nlag, horizon, n_reps, mesh):
+def _run_core(yw, key, nlag, horizon, n_reps, mesh, resample=_resample_wild):
     """Dispatch one batch of replications, mesh-sharded when a mesh is given."""
     if mesh is not None:
         n_dev = mesh.devices.size
         n_padded = ((n_reps + n_dev - 1) // n_dev) * n_dev
         core = _sharded_core(NamedSharding(mesh, P("rep")))
-        return core(yw, key, nlag, horizon, n_padded)[:n_reps]
-    return _bootstrap_core(yw, key, nlag, horizon, n_reps)
+        return core(yw, key, nlag, horizon, n_padded, resample)[:n_reps]
+    return _bootstrap_core(yw, key, nlag, horizon, n_reps, resample)
 
 
 def wild_bootstrap_irfs(
@@ -246,5 +274,43 @@ def wild_bootstrap_irfs_resumable(
             os.replace(tmp, checkpoint_path)
 
         draws = jnp.asarray(np.concatenate(done, axis=0)[:n_reps])
+        q = jnp.quantile(draws, jnp.asarray(quantile_levels), axis=0)
+        return BootstrapIRFs(point, draws, q, np.asarray(quantile_levels))
+
+
+def block_bootstrap_irfs(
+    y,
+    nlag: int,
+    initperiod: int,
+    lastperiod: int,
+    horizon: int = 24,
+    n_reps: int = 1000,
+    block: int = 8,
+    seed: int = 0,
+    quantile_levels=(0.05, 0.16, 0.5, 0.84, 0.95),
+    mesh=None,
+    backend: str | None = None,
+) -> BootstrapIRFs:
+    """Moving-block bootstrap of Cholesky-identified VAR IRFs.
+
+    Complement to `wild_bootstrap_irfs`: the wild bootstrap is robust to
+    heteroskedasticity but whitens residual serial dependence; resampling
+    blocks of `block` consecutive residual rows preserves it (Kuensch 1989
+    MBB).  Shares the vmapped/mesh-sharded replication core — only the
+    resampler differs.
+    """
+    with on_backend(backend):
+        yw = _prepare_window(y, initperiod, lastperiod)
+        Te = yw.shape[0] - nlag
+        if not 1 <= block <= Te:
+            raise ValueError(f"block={block} must be in [1, {Te}]")
+        var = estimate_var(yw, nlag, 0, yw.shape[0] - 1, withconst=True)
+        point = impulse_response(var, "all", horizon)
+        if mesh is None and len(jax.devices()) > 1:
+            mesh = make_mesh()
+        draws = _run_core(
+            yw, jax.random.PRNGKey(seed), nlag, horizon, n_reps, mesh,
+            _block_resampler(int(block)),
+        )
         q = jnp.quantile(draws, jnp.asarray(quantile_levels), axis=0)
         return BootstrapIRFs(point, draws, q, np.asarray(quantile_levels))

@@ -124,3 +124,29 @@ def test_probe_default_device_cpu_short_circuit():
     ok, detail = probe_default_device(5)
     assert ok and "cpu-only" in detail
     assert time.perf_counter() - t0 < 1.0
+
+
+class TestBlockBootstrap:
+    def test_block_bootstrap_brackets_point(self):
+        from dynamic_factor_models_tpu.models.favar import block_bootstrap_irfs
+
+        rng = np.random.default_rng(0)
+        y = np.zeros((300, 3))
+        A1 = np.array([[0.5, 0.1, 0.0], [0.0, 0.4, 0.1], [0.1, 0.0, 0.3]])
+        for t in range(1, 300):
+            y[t] = A1 @ y[t - 1] + rng.standard_normal(3)
+        bs = block_bootstrap_irfs(
+            jnp.asarray(y), 1, 0, 299, horizon=8, n_reps=64, block=8, seed=0
+        )
+        assert bs.draws.shape == (64, 3, 8, 3)
+        assert np.isfinite(np.asarray(bs.draws)).all()
+        lo, hi = np.asarray(bs.quantiles[0]), np.asarray(bs.quantiles[-1])
+        frac = np.mean((np.asarray(bs.point) >= lo) & (np.asarray(bs.point) <= hi))
+        assert frac > 0.9
+
+    def test_block_validation(self):
+        from dynamic_factor_models_tpu.models.favar import block_bootstrap_irfs
+
+        y = np.random.default_rng(1).standard_normal((50, 2))
+        with pytest.raises(ValueError, match="block"):
+            block_bootstrap_irfs(jnp.asarray(y), 1, 0, 49, n_reps=4, block=0)
