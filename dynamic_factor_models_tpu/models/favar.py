@@ -88,17 +88,25 @@ def _resample_wild(k, ehat):
 def _block_resampler(block: int):
     """Moving-block resampler (Kuensch 1989 MBB): blocks of `block`
     consecutive residual rows, preserving the serial dependence the wild
-    bootstrap's independent sign flips destroy.  (No centering: OLS
-    residuals with an intercept already have exact zero column means.)
-    Cached per block size so the jitted core's static arg keeps a stable
-    identity across calls."""
+    bootstrap's independent sign flips destroy.  Each slot is centered by
+    its conditional expectation over the random start (Brueggemann-Jentsch-
+    Trenkler): edge rows are undersampled by the sliding window, so the
+    full-sample zero mean of OLS residuals is NOT enough to make the
+    resampled innovations mean-zero.  Cached per block size so the jitted
+    core's static arg keeps a stable identity across calls."""
 
     def resample(k, ehat):
         Te = ehat.shape[0]
         n_blocks = -(-Te // block)
-        starts = jax.random.randint(k, (n_blocks,), 0, Te - block + 1)
-        idx = (starts[:, None] + jnp.arange(block)[None, :]).reshape(-1)[:Te]
-        return ehat[idx]
+        n_st = Te - block + 1
+        starts = jax.random.randint(k, (n_blocks,), 0, n_st)
+        idx = starts[:, None] + jnp.arange(block)[None, :]  # (n_blocks, block)
+        # E*[draw at slot s] = mean of ehat[s : s + n_st]
+        slot_means = jnp.stack(
+            [ehat[s : s + n_st].mean(axis=0) for s in range(block)]
+        )
+        eta = ehat[idx] - slot_means[None, :, :]
+        return eta.reshape(-1, ehat.shape[1])[:Te]
 
     return resample
 
@@ -163,6 +171,30 @@ def _run_core(yw, key, nlag, horizon, n_reps, mesh, resample=_resample_wild):
     return _bootstrap_core(yw, key, nlag, horizon, n_reps, resample)
 
 
+def _bootstrap_driver(
+    y, nlag, initperiod, lastperiod, horizon, n_reps, seed,
+    quantile_levels, mesh, backend, resample,
+) -> BootstrapIRFs:
+    """Shared bootstrap frame: window prep -> point IRFs -> mesh default ->
+    vmapped replications (`resample` picks the scheme) -> quantiles."""
+    with on_backend(backend):
+        # drop leading incomplete rows (factor windows start with NaN lags)
+        yw = _prepare_window(y, initperiod, lastperiod)
+
+        var = estimate_var(yw, nlag, 0, yw.shape[0] - 1, withconst=True)
+        point = impulse_response(var, "all", horizon)
+
+        key = jax.random.PRNGKey(seed)
+        if mesh is None and len(jax.devices()) > 1:
+            mesh = make_mesh()
+        # the replication program is embarrassingly parallel: GSPMD shards the
+        # vmapped body over the mesh's "rep" axis
+        draws = _run_core(yw, key, nlag, horizon, n_reps, mesh, resample)
+
+        q = jnp.quantile(draws, jnp.asarray(quantile_levels), axis=0)
+        return BootstrapIRFs(point, draws, q, np.asarray(quantile_levels))
+
+
 def wild_bootstrap_irfs(
     y,
     nlag: int,
@@ -185,22 +217,10 @@ def wild_bootstrap_irfs(
     default); on TPU hardware the only cross-chip traffic is the final
     quantile all-gather.
     """
-    with on_backend(backend):
-        # drop leading incomplete rows (factor windows start with NaN lags)
-        yw = _prepare_window(y, initperiod, lastperiod)
-
-        var = estimate_var(yw, nlag, 0, yw.shape[0] - 1, withconst=True)
-        point = impulse_response(var, "all", horizon)
-
-        key = jax.random.PRNGKey(seed)
-        if mesh is None and len(jax.devices()) > 1:
-            mesh = make_mesh()
-        # the replication program is embarrassingly parallel: GSPMD shards the
-        # vmapped body over the mesh's "rep" axis
-        draws = _run_core(yw, key, nlag, horizon, n_reps, mesh)
-
-        q = jnp.quantile(draws, jnp.asarray(quantile_levels), axis=0)
-        return BootstrapIRFs(point, draws, q, np.asarray(quantile_levels))
+    return _bootstrap_driver(
+        y, nlag, initperiod, lastperiod, horizon, n_reps, seed,
+        quantile_levels, mesh, backend, _resample_wild,
+    )
 
 
 def wild_bootstrap_irfs_resumable(
@@ -296,21 +316,14 @@ def block_bootstrap_irfs(
     Complement to `wild_bootstrap_irfs`: the wild bootstrap is robust to
     heteroskedasticity but whitens residual serial dependence; resampling
     blocks of `block` consecutive residual rows preserves it (Kuensch 1989
-    MBB).  Shares the vmapped/mesh-sharded replication core — only the
+    MBB).  Shares the vmapped/mesh-sharded replication driver — only the
     resampler differs.
     """
     with on_backend(backend):
-        yw = _prepare_window(y, initperiod, lastperiod)
-        Te = yw.shape[0] - nlag
-        if not 1 <= block <= Te:
-            raise ValueError(f"block={block} must be in [1, {Te}]")
-        var = estimate_var(yw, nlag, 0, yw.shape[0] - 1, withconst=True)
-        point = impulse_response(var, "all", horizon)
-        if mesh is None and len(jax.devices()) > 1:
-            mesh = make_mesh()
-        draws = _run_core(
-            yw, jax.random.PRNGKey(seed), nlag, horizon, n_reps, mesh,
-            _block_resampler(int(block)),
-        )
-        q = jnp.quantile(draws, jnp.asarray(quantile_levels), axis=0)
-        return BootstrapIRFs(point, draws, q, np.asarray(quantile_levels))
+        Te = _prepare_window(y, initperiod, lastperiod).shape[0] - nlag
+    if not 1 <= block <= Te:
+        raise ValueError(f"block={block} must be in [1, {Te}]")
+    return _bootstrap_driver(
+        y, nlag, initperiod, lastperiod, horizon, n_reps, seed,
+        quantile_levels, mesh, backend, _block_resampler(int(block)),
+    )

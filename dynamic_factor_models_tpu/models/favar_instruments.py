@@ -10,6 +10,9 @@ correlation of the residual blocks.
 
 from __future__ import annotations
 
+from functools import partial
+
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -66,6 +69,30 @@ def favar_instrument_table(data, names, var_names, factor, factor_var: VARResult
     return cca_with_factors(X, factor, var.resid, factor_var.resid)
 
 
+@partial(jax.jit, static_argnames=("nlag",))
+def _stepwise_scores_batch(Xs, fvr_rows, rows_idx, nlag: int):
+    """Score every candidate of one stepwise step in ONE vmapped program
+    (module-level jit: repeat choose_stepwise calls hit the compile cache
+    per set size instead of re-wrapping).
+
+    Xs: (C, Tw, k) dense candidate windows; fvr_rows: (Tm, q) the
+    factor-VAR residuals at the jointly complete rows; rows_idx: (Tm,)
+    indices of those rows WITHIN the candidate-residual support (window
+    rows nlag..).  Returns the min canonical correlation per candidate.
+    """
+    from .favar import _fit_dense_var
+
+    k = Xs.shape[2]
+    q = fvr_rows.shape[1]
+
+    def one(Xw):
+        _, ehat, _ = _fit_dense_var(Xw, nlag)  # (Tw - nlag, k)
+        r = canonical_correlations(ehat[rows_idx], fvr_rows)
+        return r[min(k, q) - 1]
+
+    return jax.vmap(one)(Xs)
+
+
 def choose_stepwise(data, names, factor, factor_var: VARResults, nfac: int,
                     nlag: int, initperiod: int, lastperiod: int) -> list[str]:
     """Greedy CCA-based instrument choice (cell 60, `choose_stepwise`).
@@ -73,7 +100,10 @@ def choose_stepwise(data, names, factor, factor_var: VARResults, nfac: int,
     Candidates are the series fully observed on [initperiod, lastperiod];
     at each step the variable maximizing the smallest canonical correlation
     between the candidate-VAR residuals and the factor-VAR residuals joins
-    the set.
+    the set.  The reference scores candidates serially (O(candidates x
+    nfac) VAR fits); here each step's candidates are ONE vmapped batch of
+    dense VAR fits + CCAs — same shapes within a step, so one compile per
+    set size.
     """
     data = np.asarray(data)
     names = list(names)
@@ -82,23 +112,39 @@ def choose_stepwise(data, names, factor, factor_var: VARResults, nfac: int,
     cand_idx = list(np.flatnonzero(avail))
     fvr = np.asarray(factor_var.resid)
 
+    # candidate residual support: window rows nlag.. (dense candidates);
+    # intersect with the factor-VAR residual rows once — identical for
+    # every candidate and every step
+    support = np.arange(initperiod + nlag, lastperiod + 1)
+    fvr_ok = np.isfinite(fvr[support]).all(axis=1)
+    rows_idx = jnp.asarray(np.flatnonzero(fvr_ok))
+    fvr_rows = jnp.asarray(fvr[support][fvr_ok])
+    if rows_idx.size == 0:
+        raise ValueError(
+            "no overlap between the candidate window and the factor-VAR "
+            "residual rows"
+        )
+
     chosen: list[int] = []
     for _ in range(nfac):
-        best_r, best_j = -np.inf, None
-        for j in cand_idx:
-            X = data[:, chosen + [j]]
-            var = estimate_var(jnp.asarray(X), nlag, initperiod, lastperiod,
-                               withconst=True, compute_matrices=False)
-            r = _residual_cca(var.resid, fvr)
-            r_min = float(r[min(X.shape[1], fvr.shape[1]) - 1])
-            if r_min > best_r:
-                best_r, best_j = r_min, j
-        if best_j is None:
+        if not cand_idx:
+            raise ValueError(
+                f"stepwise selection stalled after {len(chosen)} of {nfac} "
+                "variables: no fully-observed candidates remain"
+            )
+        Xs = jnp.asarray(
+            np.stack([data[window][:, chosen + [j]] for j in cand_idx])
+        )
+        scores = np.asarray(
+            _stepwise_scores_batch(Xs, fvr_rows, rows_idx, nlag)
+        )
+        if not np.isfinite(scores).any():
             raise ValueError(
                 f"stepwise selection stalled after {len(chosen)} of {nfac} "
                 "variables: no fully-observed candidate yields a finite "
                 "canonical correlation"
             )
+        best_j = cand_idx[int(np.nanargmax(scores))]
         chosen.append(best_j)
         cand_idx.remove(best_j)
     return [names[j] for j in chosen]
