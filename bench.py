@@ -5,161 +5,491 @@ Stock-Watson panel (BASELINE.json north star: < 10 s on TPU).
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} where
 vs_baseline = 10s-target / measured wall-clock (>1 is better than target).
 
-Auxiliary fields:
-- em_iters_per_sec            state-space EM throughput on the real panel
-- pallas_gram_speedup_large_panel   fused Pallas masked-Gram kernel vs the
-  XLA einsum pair at 2048 x 4096 (compiled on the real chip — any kernel
-  failure is fatal, not swallowed)
-- parity_*                    CPU vs TPU max-abs-diff of the same program
-  (north star: <= 1e-5 in f64; both backends run f32 here — TPU has no f64
-  — so the enforced thresholds below are the documented f32 equivalents).
-  Exits nonzero if any parity threshold is exceeded.
+Process layout (the round-2 lesson: one 240 s probe at process start is a
+single coin flip against a tunnel that wedges and recovers on hour scales):
 
-If the TPU tunnel is unreachable (liveness probe times out), the bench
-falls back to the CPU platform and still reports the bootstrap/EM numbers
-with "tpu_unreachable": true; the Pallas and parity sections (TPU-only)
-report null.  DFM_BENCH_FORCE_CPU=1 forces this path for testing.
+  bench.py                 orchestrator — never touches jax devices itself.
+                           Probes the tunnel in killable subprocesses,
+                           RETRIES across the run (first probe, then again
+                           after the CPU fallback sections complete, then on
+                           a backoff loop up to DFM_BENCH_PROBE_BUDGET_S),
+                           and launches the measuring children below.
+  bench.py --run-main      the measured sections in one process (TPU when
+                           reachable; --force-cpu pins the CPU platform
+                           config-level before any device touch).
+  bench.py --run-parity-programs --out F.npz [--factor-in G.npz]
+                           the three parity programs (ALS factor, Kalman
+                           smoother, bootstrap IRF) on the CPU platform at
+                           the ambient precision; run twice (f64 via
+                           JAX_ENABLE_X64=1, then f32) to decompose parity
+                           into precision-effect vs device-effect.
+  bench.py --crossover     manual: Pallas-vs-XLA masked-Gram crossover table
+                           on the live chip (documents _PALLAS_MIN_CELLS).
+
+JSON fields beyond the headline:
+- em_iters_per_sec[_host_sync|_assoc]   state-space EM throughput on the
+  real 222x139 panel: on-device lax.while_loop, host-synced driver, and the
+  associative (parallel-in-time) E-step.
+- em_iters_per_sec_mf_monthly           mixed-frequency EM on the real
+  672x207 monthly panel (io.readin_data_monthly).
+- als_large_* / em_large_*              synthetic large-panel section
+  (T=2048, N=4096, r=8 — the regime ops/pallas_gram.py targets): iters/sec,
+  a documented FLOPs-model throughput, and the MFU estimate against the
+  v5e bf16 peak; *_cpu_ratio = TPU time advantage over the same program on
+  the host CPU (null when the whole bench runs on CPU).
+- pallas_gram_*                         fused kernel vs XLA einsum at the
+  flagship size (TPU only; kernel failure is fatal, not swallowed).
+- parity_factor/smoother/irf            CPU-f32 vs TPU-f32 max-abs-diff
+  (device effect); parity_precision_*   CPU-f64 vs CPU-f32 of the same
+  programs (precision effect) — together they decompose the documented
+  f32 thresholds (docs/PARITY.md).  Exits nonzero on parity failure.
+
+If the TPU tunnel never answers within the probe budget, the bench reports
+CPU numbers with "tpu_unreachable": true and null TPU-only fields.
+DFM_BENCH_FORCE_CPU=1 forces the fallback path deterministically.
 """
 
+import argparse
 import json
+import os
+import subprocess
 import sys
 import time
 
-sys.path.insert(0, __file__.rsplit("/", 1)[0])
+REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
 
-import jax
-import jax.numpy as jnp
-import numpy as np
-
-# documented f32 parity thresholds (north star is 1e-5 in f64; TPU has no
-# f64, so parity runs f32 on both backends under
-# jax.default_matmul_precision("highest") — measured diffs and rationale
-# are recorded in docs/PARITY.md)
+# documented f32 parity thresholds (north star is 1e-5 in f64; the v5e has
+# no f64, so the device comparison runs f32 on both backends under
+# jax.default_matmul_precision("highest") — measured diffs and rationale are
+# recorded in docs/PARITY.md; the precision-effect fields below show the
+# same programs' f64-vs-f32 gap on one device)
 PARITY_THRESHOLDS = {
     "parity_factor": 1e-3,
     "parity_smoother": 1e-3,
     "parity_irf": 1e-3,
 }
 
+# v5e single-chip peak: 197 TFLOP/s bf16 on the MXU.  The float32 programs
+# below run at a fraction of that peak by construction; MFU against the
+# bf16 ceiling is the honest, hardware-anchored denominator (it cannot
+# flatter the result).
+PEAK_FLOPS_V5E_BF16 = 1.97e14
+
+# large-panel regime (the scale ops/pallas_gram.py's docstring targets,
+# beyond the reference's 224x233 panel)
+LARGE_T, LARGE_N, LARGE_R = 2048, 4096, 8
+
+
+def als_iter_flops(T: int, N: int, r: int) -> float:
+    """FLOPs model of one ALS iteration (models/dfm._als_core).
+
+    Loading step: masked Gram over (T, N) with K=r regressors — 2TNr^2 for
+    the N per-series Gram matrices + 2TNr for the right-hand sides.  F-step:
+    the same contraction with series/time roles swapped.  Residual/SSR pass:
+    2TNr.  Per-series r x r solves are O(N r^3), negligible at N >> r.
+    """
+    return 4.0 * T * N * r * r + 6.0 * T * N * r
+
+
+def em_iter_flops(T: int, N: int, r: int, p: int) -> float:
+    """FLOPs model of one EM iteration (models/ssm.em_step).
+
+    E-step filter per step (information form, ssm.py module docstring):
+    C = Lam' R^-1 Lam masked is 2Nr^2, rhs 2Nr, plus ~10 k^3 for the
+    predict/Cholesky/solve block with k = r*p.  RTS smoother per step ~8k^3.
+    M-step: masked Gram 2TNr^2 + Pf contraction 2TNr^2 + residual terms
+    ~4TNr.  Constants are documented estimates — MFU derived from them is an
+    estimate for trend-tracking, not a hardware counter measurement.
+    """
+    k = r * p
+    per_step = 2.0 * N * r * r + 2.0 * N * r + 18.0 * k**3
+    m_step = 4.0 * T * N * r * r + 4.0 * T * N * r
+    return T * per_step + m_step
+
 
 def _sign_align(a, b):
     """Align column signs of b to a (factors are identified up to sign)."""
+    import numpy as np
+
     s = np.sign(np.nansum(a * b, axis=0))
     s[s == 0] = 1.0
     return b * s
 
 
-def parity_checks(ds):
-    """Run factor ALS, Kalman smoother, and bootstrap point IRFs under
-    backend="cpu" and backend="tpu" in one process; return max-abs-diffs.
+# ---------------------------------------------------------------------------
+# parity programs (shared by the device comparison and the precision pair)
+# ---------------------------------------------------------------------------
 
-    Runs under matmul precision "highest" (true-f32 MXU passes; the default
-    bf16 passes are a throughput choice, not a correctness baseline).  The
-    ALS comparison fixes the iteration count (tol=0, max_iter=60) so both
-    backends execute the same number of iterations — with a convergence
-    tolerance the two backends stop at slightly different points of the
-    same fixed-point approach and the diff measures the tolerance, not the
-    numerics."""
+
+def parity_programs(ds, backend, factor_override=None):
+    """Run the three parity programs on one backend; return arrays.
+
+    The ALS comparison fixes the iteration count (tol=0, max_iter=60) so
+    every run executes the same number of iterations — with a convergence
+    tolerance two backends stop at slightly different points of the same
+    fixed-point approach and the diff measures the tolerance, not the
+    numerics.  `factor_override` feeds a canonical factor into the IRF
+    program so its diff isolates the bootstrap/VAR numerics.
+    """
+    import jax.numpy as jnp
+    import numpy as np
+
     from dynamic_factor_models_tpu.models.dfm import DFMConfig, estimate_factor
     from dynamic_factor_models_tpu.models.favar import wild_bootstrap_irfs
     from dynamic_factor_models_tpu.models.ssm import SSMParams, kalman_smoother
     from dynamic_factor_models_tpu.ops.linalg import standardize_data
-    from dynamic_factor_models_tpu.ops.masking import fillz, mask_of
 
     cfg = DFMConfig(nfac_u=4, tol=0.0, max_iter=60)
-    F = {}
-    for b in ("cpu", "tpu"):
-        f, _ = estimate_factor(ds.bpdata, ds.inclcode, 2, 223, cfg, backend=b)
-        F[b] = np.asarray(f)
-    parity_factor = float(
-        np.nanmax(np.abs(F["cpu"] - _sign_align(F["cpu"], F["tpu"])))
-    )
+    F, _ = estimate_factor(ds.bpdata, ds.inclcode, 2, 223, cfg, backend=backend)
+    F = np.asarray(F)
 
-    # smoother: fixed params, standardized included panel
     est = jnp.asarray(np.asarray(ds.bpdata))[:, np.asarray(ds.inclcode) == 1][2:224]
     xstd, _ = standardize_data(est)
+    dtype = xstd.dtype
     r, p, N = 4, 2, xstd.shape[1]
     rng = np.random.default_rng(0)
     params = SSMParams(
-        lam=jnp.asarray(rng.standard_normal((N, r)) * 0.3, jnp.float32),
-        R=jnp.ones(N, jnp.float32),
+        lam=jnp.asarray(rng.standard_normal((N, r)) * 0.3, dtype),
+        R=jnp.ones(N, dtype),
         A=jnp.concatenate(
-            [0.5 * jnp.eye(r, dtype=jnp.float32)[None], jnp.zeros((p - 1, r, r), jnp.float32)]
+            [0.5 * jnp.eye(r, dtype=dtype)[None], jnp.zeros((p - 1, r, r), dtype)]
         ),
-        Q=jnp.eye(r, dtype=jnp.float32),
+        Q=jnp.eye(r, dtype=dtype),
     )
-    sm = {}
-    for b in ("cpu", "tpu"):
-        means, _, ll = kalman_smoother(params, xstd, backend=b)
-        sm[b] = (np.asarray(means), float(ll))
-    parity_smoother = float(np.abs(sm["cpu"][0] - sm["tpu"][0]).max())
+    sm_means, _, _ = kalman_smoother(params, xstd, backend=backend)
 
-    # IRFs: identical factor input (CPU's) on both backends; the bootstrap
-    # PRNG (threefry) is bit-identical across backends, so draws compare too
-    irf = {}
-    for b in ("cpu", "tpu"):
-        bs = wild_bootstrap_irfs(
-            jnp.asarray(F["cpu"]), 4, 2, 223, horizon=24, n_reps=64, seed=0, backend=b
-        )
-        irf[b] = (np.asarray(bs.point), np.asarray(bs.quantiles))
-    parity_irf = float(
-        max(
-            np.abs(irf["cpu"][0] - irf["tpu"][0]).max(),
-            np.abs(irf["cpu"][1] - irf["tpu"][1]).max(),
-        )
+    F_irf = F if factor_override is None else factor_override.astype(F.dtype)
+    bs = wild_bootstrap_irfs(
+        jnp.asarray(F_irf), 4, 2, 223, horizon=24, n_reps=64, seed=0,
+        backend=backend,
     )
     return {
-        "parity_factor": parity_factor,
-        "parity_smoother": parity_smoother,
-        "parity_irf": parity_irf,
+        "factor": F,
+        "smoother": np.asarray(sm_means),
+        "irf_point": np.asarray(bs.point),
+        "irf_quantiles": np.asarray(bs.quantiles),
     }
 
 
-def _guarded_device(timeout_s: int = 240):
-    """First device touch behind the shared subprocess liveness probe
-    (utils.backend.probe_default_device).  When the tunnel is wedged
-    (round-2 observation: the axon terminal can hang for hours), fall back
-    to the CPU platform and produce real — clearly labeled — numbers
-    instead of none: the TPU-only sections (Pallas kernel, CPU<->TPU
-    parity) are skipped and the JSON carries "tpu_unreachable": true.
+def device_parity_checks(ds):
+    """CPU vs TPU max-abs-diff of the parity programs in one process."""
+    import numpy as np
 
-    Returns (device, tpu_ok).  DFM_BENCH_FORCE_CPU=1 exercises the
-    fallback deterministically (tests/test_replication_utils.py covers the
-    branch; the full fallback run is driven manually)."""
-    import os
+    out = {}
+    cpu = parity_programs(ds, "cpu")
+    # one TPU pass: its own factor comes out regardless of the override, and
+    # the override feeds the canonical (CPU) factor into its IRF program —
+    # matching the precision pair's --factor-in protocol
+    tpu = parity_programs(ds, "tpu", factor_override=cpu["factor"])
+    out["parity_factor"] = float(
+        np.nanmax(
+            np.abs(cpu["factor"] - _sign_align(cpu["factor"], tpu["factor"]))
+        )
+    )
+    out["parity_smoother"] = float(np.abs(cpu["smoother"] - tpu["smoother"]).max())
+    out["parity_irf"] = float(
+        max(
+            np.abs(cpu["irf_point"] - tpu["irf_point"]).max(),
+            np.abs(cpu["irf_quantiles"] - tpu["irf_quantiles"]).max(),
+        )
+    )
+    return out
 
-    from dynamic_factor_models_tpu.utils.backend import (
-        fall_back_to_cpu,
-        probe_default_device,
+
+def run_parity_programs(out_path, factor_in):
+    """Child mode: CPU-platform parity programs at the ambient precision."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from dynamic_factor_models_tpu.io.cache import cached_dataset
+
+    ds = cached_dataset("Real")
+    fo = np.load(factor_in)["factor"] if factor_in else None
+    with jax.default_matmul_precision("highest"):
+        res = parity_programs(ds, "cpu", factor_override=fo)
+    np.savez(out_path, **res)
+    print(f"parity programs saved: {out_path}", file=sys.stderr)
+
+
+# ---------------------------------------------------------------------------
+# measured sections (child: --run-main)
+# ---------------------------------------------------------------------------
+
+
+def _time_fixed_iters(fn, n_timing_runs=3):
+    """Best wall-clock of `fn()` (blocking) over n runs; fn pre-compiled."""
+    best = float("inf")
+    for _ in range(n_timing_runs):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def _synthetic_large_panel(T, N, r, dtype):
+    """Factor DGP with 20% missingness at the large-panel benchmark size."""
+    import numpy as np
+
+    rng = np.random.default_rng(7)
+    f = np.zeros((T, r), np.float64)
+    e = rng.standard_normal((T, r))
+    for t in range(1, T):
+        f[t] = 0.7 * f[t - 1] + e[t]
+    lam = rng.standard_normal((N, r)) * 0.5
+    x = f @ lam.T + rng.standard_normal((T, N))
+    x[rng.random((T, N)) < 0.2] = np.nan
+    return x.astype(dtype)
+
+
+def large_panel_section(tpu_ok):
+    """ALS + EM at (T, N, r) = (2048, 4096, 8): seconds per iteration, the
+    FLOPs-model throughput, MFU vs the v5e bf16 peak, and (on TPU) the
+    CPU-host comparison ratio for the same compiled program."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dynamic_factor_models_tpu.models.dfm import _als_core
+    from dynamic_factor_models_tpu.models.ssm import SSMParams, em_step
+    from dynamic_factor_models_tpu.ops.linalg import pca_score, standardize_data
+    from dynamic_factor_models_tpu.ops.masking import fillz, mask_of
+    from dynamic_factor_models_tpu.utils.backend import on_backend
+
+    T, N, r = LARGE_T, LARGE_N, LARGE_R
+    x = _synthetic_large_panel(T, N, r, np.float32)
+
+    n_als, n_em = 8, 4
+
+    def run_als(backend):
+        with on_backend(backend):
+            xj = jnp.asarray(x)
+            xstd, _ = standardize_data(xj)
+            xz, m = fillz(xstd), mask_of(xstd).astype(xstd.dtype)
+            f0 = pca_score(jnp.where(jnp.isnan(xstd), 0.0, xstd), r)
+            lam_ok = jnp.ones(N, bool)
+            args = (xz, m, lam_ok, f0, jnp.float32(0.0), r, n_als)
+            _als_core(*args)[0].block_until_ready()  # compile
+            return _time_fixed_iters(
+                lambda: _als_core(*args)[0].block_until_ready()
+            )
+
+    def run_em(backend):
+        with on_backend(backend):
+            xj = jnp.asarray(x)
+            xstd, _ = standardize_data(xj)
+            xz, m = fillz(xstd), mask_of(xstd).astype(xstd.dtype)
+            params = SSMParams(
+                lam=jnp.zeros((N, r), xz.dtype).at[:, 0].set(1.0),
+                R=jnp.ones(N, xz.dtype),
+                A=0.5 * jnp.eye(r, dtype=xz.dtype)[None],
+                Q=jnp.eye(r, dtype=xz.dtype),
+            )
+
+            def iters():
+                p = params
+                for _ in range(n_em):
+                    p, _ = em_step(p, xz, m)
+                return p
+
+            iters().lam.block_until_ready()  # compile
+            return _time_fixed_iters(lambda: iters().lam.block_until_ready())
+
+    als_t = run_als(None) / n_als
+    em_t = run_em(None) / n_em
+    als_flops = als_iter_flops(T, N, r) / als_t
+    em_flops = em_iter_flops(T, N, r, 1) / em_t
+    out = {
+        "als_large_iters_per_sec": round(1.0 / als_t, 2),
+        "als_large_flops_per_sec": round(als_flops, 0),
+        "em_large_iters_per_sec": round(1.0 / em_t, 2),
+        "em_large_flops_per_sec": round(em_flops, 0),
+    }
+    if tpu_ok:
+        out["als_large_mfu_bf16_peak_pct"] = round(
+            100.0 * als_flops / PEAK_FLOPS_V5E_BF16, 2
+        )
+        out["em_large_mfu_bf16_peak_pct"] = round(
+            100.0 * em_flops / PEAK_FLOPS_V5E_BF16, 2
+        )
+        # same programs pinned to the host CPU: the attribution ratio
+        als_cpu_t = run_als("cpu") / n_als
+        em_cpu_t = run_em("cpu") / n_em
+        out["als_large_tpu_over_cpu"] = round(als_cpu_t / als_t, 1)
+        out["em_large_tpu_over_cpu"] = round(em_cpu_t / em_t, 1)
+    else:
+        out["als_large_mfu_bf16_peak_pct"] = None
+        out["em_large_mfu_bf16_peak_pct"] = None
+        out["als_large_tpu_over_cpu"] = None
+        out["em_large_tpu_over_cpu"] = None
+    return out
+
+
+def mixed_freq_section():
+    """EM iters/sec on the real 672x207 monthly mixed-frequency panel."""
+    import numpy as np
+
+    from dynamic_factor_models_tpu.io.cache import cached_monthly_dataset
+    from dynamic_factor_models_tpu.models.mixed_freq import estimate_mixed_freq_dfm
+
+    ds = cached_monthly_dataset("All")
+    keep = np.asarray(ds.inclcode) == 1
+    x = ds.data[:, keep]
+    is_q = ds.is_quarterly[keep]
+    import jax
+
+    n_iter = 10
+    # block on x_hat: the post-EM filter/RTS/x_hat work is dispatched
+    # asynchronously, and an un-awaited tail would bleed into the next
+    # timing run, deflating the reported iters/sec
+    run = lambda: jax.block_until_ready(
+        estimate_mixed_freq_dfm(x, is_q, r=4, p=5, max_em_iter=n_iter, tol=0.0).x_hat
+    )
+    run()  # compile
+    dt = _time_fixed_iters(run, n_timing_runs=2)
+    return {
+        "em_iters_per_sec_mf_monthly": round(n_iter / dt, 2),
+        "mf_monthly_panel": list(x.shape),
+    }
+
+
+def pallas_section():
+    """Fused Pallas masked-Gram vs XLA einsum at the flagship size (TPU).
+    No exception guard: if the compiled kernel cannot run on this chip the
+    bench must fail visibly (round-1 lesson), not report null."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+
+    from dynamic_factor_models_tpu.ops.pallas_gram import (
+        masked_gram_pallas,
+        masked_gram_xla,
     )
 
-    forced = os.environ.get("DFM_BENCH_FORCE_CPU") == "1"
-    ok, detail = (False, "forced CPU fallback") if forced else (
-        probe_default_device(timeout_s)
+    rng = np.random.default_rng(0)
+    Tbig, Nbig, K = LARGE_T, LARGE_N, LARGE_R
+    Xb = jnp.asarray(rng.standard_normal((Tbig, K)), jnp.float32)
+    Yb = jnp.asarray(rng.standard_normal((Tbig, Nbig)), jnp.float32)
+    Wb = jnp.asarray((rng.random((Tbig, Nbig)) > 0.2), jnp.float32)
+
+    def _loop_time(body, n):
+        """Total wall time of an on-device fori_loop (best of 5)."""
+
+        @jax.jit
+        def loop():
+            return lax.fori_loop(0, n, body, jnp.float32(0.0))
+
+        loop().block_until_ready()  # compile
+        best = float("inf")
+        for _ in range(5):
+            t = time.perf_counter()
+            loop().block_until_ready()
+            best = min(best, time.perf_counter() - t)
+        return best
+
+    def _gram_body(fn):
+        # the carry must feed an input EVERY output depends on (W feeds
+        # both the A and rhs contractions): perturbing only Y lets XLA
+        # hoist the Y-independent A-einsum out of the loop (LICM), and
+        # anything less than full output dependence lets it dead-code-
+        # eliminate the op — either way the XLA side would be under-timed
+        # vs the opaque kernel
+        def body(i, carry):
+            A, b = fn(Xb, Yb, Wb + carry * 1e-30)
+            return A.sum() * 1e-30 + b.sum() * 1e-30
+
+        return body
+
+    # n large enough that kernel time (~250us/call) swamps the ~30ms fixed
+    # dispatch cost of one remote loop launch
+    n_gram = 1000
+    t_pallas = _loop_time(_gram_body(masked_gram_pallas), n_gram) / n_gram
+    t_xla = _loop_time(_gram_body(masked_gram_xla), n_gram) / n_gram
+    return {
+        "pallas_gram_speedup_large_panel": round(t_xla / t_pallas, 2),
+        "pallas_gram_us_per_call": round(t_pallas * 1e6, 1),
+    }
+
+
+def crossover_table():
+    """Manual mode: Pallas-vs-XLA crossover sweep on the live chip; prints a
+    markdown table for ops/pallas_gram.py and docs/PARITY.md."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dynamic_factor_models_tpu.ops.pallas_gram import (
+        masked_gram_pallas,
+        masked_gram_xla,
     )
-    if not ok:
-        # shared guard: raises instead of pinning when a backend is already
-        # initialized (the pin would silently not take effect and the next
-        # array touch would hang on the wedged device)
-        fall_back_to_cpu(detail, caller="bench")
-        return jax.devices()[0], False
-    return jax.devices()[0], True
+    import jax
+    from jax import lax
+
+    sizes = [
+        (224, 256), (512, 512), (1024, 1024), (1024, 2048),
+        (2048, 2048), (2048, 4096), (4096, 4096), (4096, 8192),
+    ]
+    K = LARGE_R
+    print("| T x N | cells | XLA us | Pallas us | speedup |")
+    print("|---|---|---|---|---|")
+    for T, N in sizes:
+        rng = np.random.default_rng(0)
+        X = jnp.asarray(rng.standard_normal((T, K)), jnp.float32)
+        Y = jnp.asarray(rng.standard_normal((T, N)), jnp.float32)
+        W = jnp.asarray((rng.random((T, N)) > 0.2), jnp.float32)
+
+        def loop_time(fn, n=300):
+            def body(i, carry):
+                A, b = fn(X, Y, W + carry * 1e-30)
+                return A.sum() * 1e-30 + b.sum() * 1e-30
+
+            @jax.jit
+            def loop():
+                return lax.fori_loop(0, n, body, jnp.float32(0.0))
+
+            loop().block_until_ready()
+            best = float("inf")
+            for _ in range(3):
+                t = time.perf_counter()
+                loop().block_until_ready()
+                best = min(best, time.perf_counter() - t)
+            return best / n
+
+        tx = loop_time(masked_gram_xla)
+        tp = loop_time(masked_gram_pallas)
+        print(
+            f"| {T} x {N} | 2^{int(np.log2(T*N))} | {tx*1e6:.1f} "
+            f"| {tp*1e6:.1f} | {tx/tp:.2f}x |"
+        )
 
 
-def main():
+def bench_main(force_cpu: bool):
+    import jax
+
+    if force_cpu:
+        from dynamic_factor_models_tpu.utils.backend import fall_back_to_cpu
+
+        fall_back_to_cpu("orchestrator probe exhausted", caller="bench")
+    import jax.numpy as jnp
+    import numpy as np
+
     from dynamic_factor_models_tpu.io.cache import cached_dataset
     from dynamic_factor_models_tpu.models.dfm import DFMConfig, estimate_factor
+    from dynamic_factor_models_tpu.models.emloop import run_em_loop
     from dynamic_factor_models_tpu.models.favar import wild_bootstrap_irfs
-    from dynamic_factor_models_tpu.models.ssm import em_step, SSMParams
+    from dynamic_factor_models_tpu.models.ssm import SSMParams, em_step, em_step_assoc
+    from dynamic_factor_models_tpu.ops.linalg import standardize_data
     from dynamic_factor_models_tpu.ops.masking import fillz, mask_of
 
-    dev, tpu_ok = _guarded_device()
+    dev = jax.devices()[0]
+    tpu_ok = dev.platform in ("tpu", "axon")
     ds = cached_dataset("Real")
 
-    # factors via ALS (f32-safe tolerance; parity is covered below)
+    # headline: 1000-rep wild bootstrap (factors via f32-safe ALS)
     cfg = DFMConfig(nfac_u=4, tol=1e-6, max_iter=2000)
     F, _ = estimate_factor(ds.bpdata, ds.inclcode, 2, 223, cfg)
-
     n_reps, horizon = 1000, 24
     run = lambda seed: wild_bootstrap_irfs(
         F, 4, 2, 223, horizon=horizon, n_reps=n_reps, seed=seed
@@ -170,16 +500,11 @@ def main():
     bs.draws.block_until_ready()
     dt = time.perf_counter() - t0
 
-    # auxiliary: EM iterations/sec on the included panel, measured through
-    # the library's own convergence driver (models/emloop.run_em_loop): the
-    # host-synced path reports iters/sec from its ConvergenceTrace result
-    # object; the on-device lax.while_loop path is timed over a full run
+    # EM on the real included panel: host-synced driver, on-device
+    # while_loop, and the associative (parallel-in-time) E-step
     est = jnp.asarray(np.asarray(ds.bpdata))[:, np.asarray(ds.inclcode) == 1][2:224]
-    from dynamic_factor_models_tpu.models.emloop import run_em_loop
-    from dynamic_factor_models_tpu.ops.linalg import standardize_data
-
     xstd, _ = standardize_data(est)
-    xz, m = fillz(xstd), mask_of(xstd)
+    xz, m = fillz(xstd), mask_of(xstd).astype(xstd.dtype)
     r, p, N = 4, 4, xz.shape[1]
     params = SSMParams(
         lam=jnp.zeros((N, r)).at[:, 0].set(1.0),
@@ -188,108 +513,260 @@ def main():
         Q=jnp.eye(r),
     )
     _, _, _, trace = run_em_loop(
-        em_step, params, (xz, m.astype(xz.dtype)), 0.0, 30, collect_path=True
+        em_step, params, (xz, m), 0.0, 30, collect_path=True
     )
     em_ips_host = trace.iters_per_sec
     n_dev_iter = 100
-    run_em_loop(em_step, params, (xz, m.astype(xz.dtype)), 0.0, n_dev_iter)  # compile
-    t1 = time.perf_counter()
-    _, _, n_ran, _ = run_em_loop(
-        em_step, params, (xz, m.astype(xz.dtype)), 0.0, n_dev_iter
-    )
-    em_ips = n_ran / (time.perf_counter() - t1)
+    em_ips = {}
+    for name, step in (("seq", em_step), ("assoc", em_step_assoc)):
+        run_em_loop(step, params, (xz, m), 0.0, n_dev_iter)  # compile
+        t1 = time.perf_counter()
+        _, _, n_ran, _ = run_em_loop(step, params, (xz, m), 0.0, n_dev_iter)
+        em_ips[name] = n_ran / (time.perf_counter() - t1)
 
-    # auxiliary: fused Pallas masked-Gram vs XLA einsum at large-panel scale
-    # (the regime beyond the 224 x 233 reference panel the kernel targets).
-    # No exception guard: if the compiled kernel cannot run on this chip the
-    # bench must fail visibly (round-1 lesson), not report null.  Skipped
-    # entirely in the CPU fallback (the kernel is a TPU Mosaic program).
+    large = large_panel_section(tpu_ok)
+    mf = mixed_freq_section()
+
     if tpu_ok:
-        from dynamic_factor_models_tpu.ops.pallas_gram import (
-            masked_gram_pallas,
-            masked_gram_xla,
-        )
-        from jax import lax
-
-        rng = np.random.default_rng(0)
-        Tbig, Nbig, K = 2048, 4096, 8
-        Xb = jnp.asarray(rng.standard_normal((Tbig, K)), jnp.float32)
-        Yb = jnp.asarray(rng.standard_normal((Tbig, Nbig)), jnp.float32)
-        Wb = jnp.asarray((rng.random((Tbig, Nbig)) > 0.2), jnp.float32)
-
-        def _loop_time(body, n):
-            """Total wall time of an on-device fori_loop (best of 5)."""
-
-            @jax.jit
-            def loop():
-                return lax.fori_loop(0, n, body, jnp.float32(0.0))
-
-            loop().block_until_ready()  # compile
-            best = float("inf")
-            for _ in range(5):
-                t = time.perf_counter()
-                loop().block_until_ready()
-                best = min(best, time.perf_counter() - t)
-            return best
-
-        def _gram_body(fn):
-            # the carry must feed an input EVERY output depends on (W feeds
-            # both the A and rhs contractions): perturbing only Y lets XLA
-            # hoist the Y-independent A-einsum out of the loop (LICM), and
-            # anything less than full output dependence lets it dead-code-
-            # eliminate the op — either way the XLA side would be
-            # under-timed vs the opaque kernel
-            def body(i, carry):
-                A, b = fn(Xb, Yb, Wb + carry * 1e-30)
-                return A.sum() * 1e-30 + b.sum() * 1e-30
-
-            return body
-
-        # n large enough that kernel time (~250us/call) swamps the ~30ms
-        # fixed dispatch cost of one remote loop launch
-        n_gram = 1000
-        t_pallas = _loop_time(_gram_body(masked_gram_pallas), n_gram) / n_gram
-        t_xla = _loop_time(_gram_body(masked_gram_xla), n_gram) / n_gram
-        gram_speedup = round(t_xla / t_pallas, 2)
-        pallas_us = round(t_pallas * 1e6, 1)
-
+        pallas = pallas_section()
         with jax.default_matmul_precision("highest"):
-            parity = parity_checks(ds)
+            parity = device_parity_checks(ds)
         parity_ok = all(
             parity[k] <= thresh for k, thresh in PARITY_THRESHOLDS.items()
         )
     else:
-        gram_speedup = pallas_us = None
+        pallas = {
+            "pallas_gram_speedup_large_panel": None,
+            "pallas_gram_us_per_call": None,
+        }
         parity = {k: None for k in PARITY_THRESHOLDS}
         parity_ok = None  # not checked — requires both backends
 
-    print(
-        json.dumps(
-            {
-                "metric": "favar_irf_wild_bootstrap_1000rep_wallclock",
-                "value": round(dt, 4),
-                "unit": "s",
-                "vs_baseline": round(10.0 / dt, 2),
-                "device": str(dev),
-                "tpu_unreachable": not tpu_ok,
-                "em_iters_per_sec": round(em_ips, 2),
-                "em_iters_per_sec_host_sync": round(em_ips_host, 2),
-                "pallas_gram_speedup_large_panel": gram_speedup,
-                "pallas_gram_us_per_call": pallas_us,
-                **{
-                    k: (round(v, 8) if v is not None else None)
-                    for k, v in parity.items()
-                },
-                "parity_ok": parity_ok,
-            }
-        )
-    )
+    fragment = {
+        "metric": "favar_irf_wild_bootstrap_1000rep_wallclock",
+        "value": round(dt, 4),
+        "unit": "s",
+        "vs_baseline": round(10.0 / dt, 2),
+        "device": str(dev),
+        "tpu_unreachable": not tpu_ok,
+        "em_iters_per_sec": round(em_ips["seq"], 2),
+        "em_iters_per_sec_host_sync": round(em_ips_host, 2),
+        "em_iters_per_sec_assoc": round(em_ips["assoc"], 2),
+        **mf,
+        **large,
+        **pallas,
+        **{
+            k: (round(v, 8) if v is not None else None)
+            for k, v in parity.items()
+        },
+        "parity_ok": parity_ok,
+    }
+    print(json.dumps(fragment))
     if parity_ok is False:
         print(
             f"PARITY FAILURE: {parity} exceeds {PARITY_THRESHOLDS}",
             file=sys.stderr,
         )
         sys.exit(1)
+
+
+# ---------------------------------------------------------------------------
+# orchestrator
+# ---------------------------------------------------------------------------
+
+
+def _probe_tunnel(timeout_s: int):
+    """Killable-subprocess device probe; returns (tpu_ok, detail).
+
+    The child inherits the ambient platform config (the axon sitecustomize
+    pins jax_platforms at import); a wedged tunnel hangs the child inside
+    native code, which the timeout kills — the orchestrator never touches
+    jax devices itself.
+    """
+    probe = (
+        "import jax, jax.numpy as jnp\n"
+        "jax.block_until_ready(jnp.ones(8).sum())\n"
+        "print('DEVICE_PLATFORM', jax.devices()[0].platform)\n"
+    )
+    try:
+        pr = subprocess.run(
+            [sys.executable, "-c", probe],
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        return False, f"device probe exceeded {timeout_s}s (tunnel wedged?)"
+    if pr.returncode != 0:
+        return False, f"rc={pr.returncode}, stderr={pr.stderr[-300:]!r}"
+    for line in pr.stdout.splitlines():
+        if line.startswith("DEVICE_PLATFORM"):
+            platform = line.split()[-1]
+            return platform in ("tpu", "axon"), f"platform={platform}"
+    return False, f"no DEVICE_PLATFORM line in {pr.stdout[-200:]!r}"
+
+
+class _FailedChild:
+    """Stand-in result for a child that timed out (e.g. the tunnel wedged
+    mid-run, after a successful probe): a failed proc, not an exception, so
+    the orchestrator keeps any already-computed fallback fragment."""
+
+    returncode = -1
+    stdout = ""
+
+
+def _run_child(args, env_extra=None, timeout_s=3600):
+    env = dict(os.environ)
+    env.update(env_extra or {})
+    try:
+        pr = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py"), *args],
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+            env=env,
+        )
+    except subprocess.TimeoutExpired as exc:
+        print(f"bench: child {args[0]} timed out after {exc.timeout}s", file=sys.stderr)
+        return _FailedChild()
+    sys.stderr.write(pr.stderr)
+    return pr
+
+
+def _precision_parity(workdir):
+    """CPU f64-vs-f32 of the parity programs (two children; the f32 leg
+    reuses the f64 leg's factor for its IRF program, mirroring the device
+    comparison's canonical-factor protocol)."""
+    import numpy as np
+
+    f64_path = os.path.join(workdir, "parity_f64.npz")
+    f32_path = os.path.join(workdir, "parity_f32.npz")
+    pr = _run_child(
+        ["--run-parity-programs", "--out", f64_path],
+        env_extra={"JAX_ENABLE_X64": "1"},
+    )
+    if pr.returncode != 0:
+        return {f"parity_precision_{k}": None for k in ("factor", "smoother", "irf")}
+    pr = _run_child(
+        ["--run-parity-programs", "--out", f32_path, "--factor-in", f64_path],
+        env_extra={"JAX_ENABLE_X64": "0"},
+    )
+    if pr.returncode != 0:
+        return {f"parity_precision_{k}": None for k in ("factor", "smoother", "irf")}
+    a = np.load(f64_path)
+    b = np.load(f32_path)
+    return {
+        "parity_precision_factor": round(
+            float(
+                np.nanmax(
+                    np.abs(a["factor"] - _sign_align(a["factor"], b["factor"]))
+                )
+            ),
+            8,
+        ),
+        "parity_precision_smoother": round(
+            float(np.abs(a["smoother"] - b["smoother"]).max()), 8
+        ),
+        # point IRF only: the PRNG consumes its bit-stream differently with
+        # x64 on/off, so the two legs' bootstrap draws are different samples
+        # and the quantile diff would measure Monte-Carlo noise, not
+        # precision (the device comparison runs one precision on both
+        # backends, where draws ARE bit-identical, so it compares quantiles)
+        "parity_precision_irf": round(
+            float(np.abs(a["irf_point"] - b["irf_point"]).max()), 8
+        ),
+    }
+
+
+def orchestrate():
+    import tempfile
+
+    t_start = time.monotonic()
+    budget = float(os.environ.get("DFM_BENCH_PROBE_BUDGET_S", "900"))
+    probe_timeout = int(os.environ.get("DFM_BENCH_PROBE_TIMEOUT_S", "120"))
+    forced_cpu = os.environ.get("DFM_BENCH_FORCE_CPU") == "1"
+
+    attempts = 0
+    tpu_ok = False
+    if not forced_cpu:
+        attempts += 1
+        tpu_ok, detail = _probe_tunnel(probe_timeout)
+        if not tpu_ok:
+            print(f"bench: probe {attempts} failed ({detail})", file=sys.stderr)
+
+    fragment = None
+    with tempfile.TemporaryDirectory() as workdir:
+        if tpu_ok:
+            pr = _run_child(["--run-main"])
+            fragment = _parse_fragment(pr)
+            main_rc = pr.returncode
+        else:
+            # CPU fallback numbers first — then keep re-probing: the tunnel
+            # wedges and recovers on hour scales, so a late success upgrades
+            # the whole report to TPU evidence
+            pr = _run_child(["--run-main", "--force-cpu"])
+            fragment = _parse_fragment(pr)
+            main_rc = pr.returncode
+            while not forced_cpu and time.monotonic() - t_start < budget:
+                attempts += 1
+                tpu_ok, detail = _probe_tunnel(probe_timeout)
+                if tpu_ok:
+                    print(
+                        f"bench: probe {attempts} succeeded — re-running the "
+                        "measured sections on TPU",
+                        file=sys.stderr,
+                    )
+                    pr = _run_child(["--run-main"])
+                    tpu_fragment = _parse_fragment(pr)
+                    if tpu_fragment is not None:
+                        fragment = tpu_fragment
+                        main_rc = pr.returncode
+                    break
+                print(
+                    f"bench: probe {attempts} failed ({detail})", file=sys.stderr
+                )
+                time.sleep(min(60, max(0, budget - (time.monotonic() - t_start))))
+
+        precision = _precision_parity(workdir)
+
+    if fragment is None:
+        print("bench: measured child produced no JSON", file=sys.stderr)
+        sys.exit(2)
+    fragment.update(precision)
+    fragment["probe_attempts"] = attempts
+    fragment["probe_elapsed_s"] = round(time.monotonic() - t_start, 1)
+    print(json.dumps(fragment))
+    sys.exit(main_rc)
+
+
+def _parse_fragment(pr):
+    for line in reversed(pr.stdout.strip().splitlines() or []):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    return None
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--run-main", action="store_true")
+    ap.add_argument("--force-cpu", action="store_true")
+    ap.add_argument("--run-parity-programs", action="store_true")
+    ap.add_argument("--out")
+    ap.add_argument("--factor-in")
+    ap.add_argument("--crossover", action="store_true")
+    args = ap.parse_args()
+    if args.run_parity_programs:
+        run_parity_programs(args.out, args.factor_in)
+    elif args.run_main:
+        bench_main(force_cpu=args.force_cpu)
+    elif args.crossover:
+        crossover_table()
+    else:
+        orchestrate()
 
 
 if __name__ == "__main__":

@@ -296,10 +296,16 @@ def _em_m_step(params: SSMParams, x, m, s_sm, P_sm, lag1):
     Pf = P_sm[:, :r, :r]  # Var(f_t | T)
 
     # --- loadings + R (masked, batched over series) ---
-    # Sxf_i = sum_t m_it x_it E[f_t]';  Sff_i = sum_t m_it (E f E f' + Pf)
-    Eff = jnp.einsum("tr,ts->trs", f, f) + Pf  # (T, r, r)
-    Sff = jnp.einsum("ti,trs->irs", m, Eff)
-    Sxf = jnp.einsum("ti,tr->ir", m * x, f)
+    # Sxf_i = sum_t m_it x_it E[f_t]';  Sff_i = sum_t m_it (E f E f' + Pf).
+    # The E[f]E[f]' part and Sxf are exactly the batched masked-Gram shape
+    # (X = f shared regressors, Y = x targets, W = m), so they route through
+    # the fused Pallas kernel at scale; only the Pf correction needs the
+    # extra (N, T) @ (T, r^2) contraction.
+    from ..ops.pallas_gram import masked_gram
+
+    Tn = x.shape[0]
+    Sff_ff, Sxf = masked_gram(f, x, m)  # (N, r, r), (N, r)
+    Sff = Sff_ff + (m.T @ Pf.reshape(Tn, r * r)).reshape(-1, r, r)
     lam = jax.vmap(solve_normal)(Sff, Sxf)  # (N, r)
     resid = x - f @ lam.T
     extra = jnp.einsum("ir,trs,is->ti", lam, Pf, lam)  # lam' Pf lam
@@ -314,7 +320,6 @@ def _em_m_step(params: SSMParams, x, m, s_sm, P_sm, lag1):
     S10 = (jnp.einsum("tr,tk->rk", s_sm[1:, :r], s_sm[:-1])
            + lag1[:, :r, :].sum(axis=0))
     Ak = S10 @ jnp.linalg.pinv(S00, hermitian=True)  # (r, k)
-    Tn = x.shape[0]
     Q = _psd_floor((S11 - Ak @ S10.T) / (Tn - 1))
     A = jnp.stack([Ak[:, i * r : (i + 1) * r] for i in range(p)])
     return SSMParams(lam, R, A, Q)

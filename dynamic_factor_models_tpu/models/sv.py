@@ -191,13 +191,24 @@ def _draw_h_block(key, u, h_prev, mu, phi, sig, priors: tuple):
 
     def one_ar(h_j, sig_j, kc, ks_):
         y = h_j[1:]
+        kc1, kc2 = jax.random.split(kc)
         Zr = jnp.stack([jnp.ones(Tu - 1, dtype), h_j[:-1]], axis=1)
         prec = Zr.T @ Zr / sig_j**2 + jnp.eye(2, dtype=dtype) / h_coef_scale**2
         pinv = jnp.linalg.pinv(0.5 * (prec + prec.T), hermitian=True)
-        beta = _draw_mvn(kc, pinv @ (Zr.T @ y) / sig_j**2, pinv)
+        beta = _draw_mvn(kc1, pinv @ (Zr.T @ y) / sig_j**2, pinv)
         phi_n = jnp.clip(beta[1], -phi_max, phi_max)
-        mu_n = beta[0] / (1.0 - phi_n)
-        e = y - beta[0] - phi_n * h_j[:-1]
+        # if the slope was clipped, the jointly-drawn intercept no longer
+        # matches it (mu = c/(1-phi) blows up near the boundary); redraw the
+        # intercept from its conditional posterior given the clipped slope,
+        # and use the same (c, phi) pair for both mu and the sigma residuals
+        resid_y = y - phi_n * h_j[:-1]
+        prec_c = (Tu - 1) / sig_j**2 + 1.0 / h_coef_scale**2
+        c_cond = resid_y.sum() / sig_j**2 / prec_c + jax.random.normal(
+            kc2, dtype=dtype
+        ) / jnp.sqrt(prec_c)
+        c_n = jnp.where(phi_n == beta[1], beta[0], c_cond)
+        mu_n = c_n / (1.0 - phi_n)
+        e = resid_y - c_n
         g = jax.random.gamma(ks_, h_sig_shape + 0.5 * (Tu - 1), dtype=dtype)
         sig2_n = (h_sig_rate + 0.5 * (e**2).sum()) / g
         return mu_n, phi_n, jnp.sqrt(sig2_n)

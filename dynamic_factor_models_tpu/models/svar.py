@@ -31,7 +31,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..ops.hac import hac
+from ..ops.hac import hac, hac_weighted
 from ..ops.lags import lagmat
 from ..ops.linalg import ols_batched_series, solve_normal
 from ..ops.masking import fillz, mask_of
@@ -352,7 +352,7 @@ class LocalProjection(NamedTuple):
 
 
 @partial(jax.jit, static_argnames=("max_horizon", "q"))
-def _local_projection_core(y, shock, controls, max_horizon: int, q: int):
+def _local_projection_core(y, shock, controls, max_horizon: int, q: int | None):
     T = y.shape[0]
     H = max_horizon
     X = jnp.hstack([jnp.ones((T, 1), y.dtype), shock[:, None], controls])
@@ -373,14 +373,21 @@ def _local_projection_core(y, shock, controls, max_horizon: int, q: int):
     # regressions share the regressor block, exactly the ops/linalg shape)
     betas, resid = ols_batched_series(jnp.where(valid, Y, jnp.nan), X, W)
 
-    # per-horizon HAC(q) of the shock coefficient: masking rows out of both
+    # per-horizon HAC of the shock coefficient: masking rows out of both
     # X and u (0/1 weights) drops end-of-sample leads from the moments and
-    # the bread, so the shared sandwich applies unchanged
-    def hac_one(u_h, w_h):
-        _, se_h = hac(fillz(u_h), X * w_h[:, None], q)
+    # the bread, so the shared sandwich applies unchanged.  The truncation
+    # is per-horizon (q_h = h, the MA(h) order of the direct-projection
+    # error) via traced Bartlett weights at a shared static q_max; an
+    # explicit q applies one shared truncation to every horizon.
+    q_max = H if q is None else q
+    qs = jnp.arange(H + 1) if q is None else jnp.full(H + 1, q)
+
+    def hac_one(u_h, w_h, q_h):
+        kern = jnp.maximum(0.0, 1.0 - jnp.arange(q_max + 1) / (q_h + 1.0))
+        _, se_h = hac_weighted(fillz(u_h), X * w_h[:, None], kern)
         return se_h[1]
 
-    se = jax.vmap(hac_one, in_axes=(1, 1))(resid, W)
+    se = jax.vmap(hac_one, in_axes=(1, 1, 0))(resid, W, qs)
     return betas, se, W.sum(axis=0)
 
 
@@ -397,8 +404,10 @@ def local_projection(
 
     For each horizon h = 0..max_horizon regresses ``y_{t+h}`` on
     ``[1, shock_t, controls_t]`` and reports the shock coefficient with a
-    HAC(q) band (q defaults to h-aware ``max_horizon``, the usual rule for
-    the MA(h) error a direct projection induces).  `controls` defaults to
+    HAC band.  The default truncation is h-aware: horizon h uses q_h = h,
+    the MA(h) order of the error a direct projection induces, so short
+    horizons are not over-truncated.  Passing an explicit ``q`` applies
+    that one shared truncation to every horizon.  `controls` defaults to
     ``n_lags`` lags of y and of the shock.  All horizons are solved in one
     batched masked regression; HAC runs ``vmap``-ed over horizons.
     """
@@ -410,10 +419,8 @@ def local_projection(
         )
     else:
         controls = jnp.atleast_2d(jnp.asarray(controls).T).T
-    if q is None:
-        q = int(max_horizon)
     with on_backend(backend):
         betas, se, nobs = _local_projection_core(
-            y, shock, controls, int(max_horizon), int(q)
+            y, shock, controls, int(max_horizon), None if q is None else int(q)
         )
         return LocalProjection(betas[1], se, betas, nobs)

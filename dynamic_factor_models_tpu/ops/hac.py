@@ -18,7 +18,14 @@ import jax.numpy as jnp
 
 from .linalg import ols, solve_normal
 
-__all__ = ["form_kernel", "hac", "regress_hac", "compute_chow", "compute_qlr"]
+__all__ = [
+    "form_kernel",
+    "hac",
+    "hac_weighted",
+    "regress_hac",
+    "compute_chow",
+    "compute_qlr",
+]
 
 
 def form_kernel(q: int) -> jnp.ndarray:
@@ -26,24 +33,27 @@ def form_kernel(q: int) -> jnp.ndarray:
     return 1.0 - jnp.arange(q + 1) / (q + 1)
 
 
-def _form_hscrc(z: jnp.ndarray, X: jnp.ndarray, q: int) -> jnp.ndarray:
-    """HAC sandwich: sum of +/-q kernel-weighted autocovariances of z = X.*u,
-    pre/post-multiplied by (X'X)^-1 (cell 55)."""
-    kernel = form_kernel(q)
+def hac(u: jnp.ndarray, X: jnp.ndarray, q: int):
+    """HAC covariance of OLS coefficients and its standard errors (cell 53)."""
+    return hac_weighted(u, X, form_kernel(q))
+
+
+def hac_weighted(u: jnp.ndarray, X: jnp.ndarray, kernel: jnp.ndarray):
+    """HAC covariance with an explicit lag-weight vector of length q_max+1.
+
+    The truncation may be traced: pass Bartlett weights
+    ``max(0, 1 - i/(q+1))`` with a traced q and zeros beyond it, so callers
+    can ``vmap`` over different truncation lags at a shared static q_max.
+    """
+    z = X * u[:, None]
     T = z.shape[0]
     v = kernel[0] * z.T @ z
-    for i in range(1, q + 1):
+    for i in range(1, kernel.shape[0]):
         gamma = z[i:].T @ z[: T - i]
         v = v + kernel[i] * (gamma + gamma.T)
     XX = X.T @ X
     XXinv = jnp.linalg.pinv(XX, hermitian=True)
-    return XXinv @ v @ XXinv
-
-
-def hac(u: jnp.ndarray, X: jnp.ndarray, q: int):
-    """HAC covariance of OLS coefficients and its standard errors (cell 53)."""
-    z = X * u[:, None]
-    vbeta = _form_hscrc(z, X, q)
+    vbeta = XXinv @ v @ XXinv
     return vbeta, jnp.sqrt(jnp.diag(vbeta))
 
 

@@ -95,30 +95,26 @@ def fall_back_to_cpu(detail: str, caller: str = "caller") -> None:
     The config-level platform pin only takes effect while no jax backend is
     initialized; if one already is, the pin would be a silent no-op and the
     next array creation would hang inside native code on the wedged device
-    — so that case raises instead.  Fails CLOSED: if jax's private
-    initialized-backend registry cannot be found (internals moved in an
-    upgrade), raise rather than risk the unbounded hang.
+    — so that case raises instead.  Detection is a post-condition check on
+    public API only: after pinning, ``jax.default_backend()`` must report
+    "cpu".  If a non-CPU backend was already live, that call just reads the
+    existing registry (no new init, so no hang — the hang risk is only in
+    *initializing* a wedged plugin) and reports the live platform, which
+    turns the would-be silent no-op into a loud error; if nothing was
+    initialized, it initializes the CPU platform under the fresh pin.  No
+    private jax internals are consulted, so the guard survives upgrades.
     """
     import sys
 
-    try:
-        from jax._src import xla_bridge
-
-        backends = getattr(xla_bridge, "_backends")
-    except (ImportError, AttributeError) as exc:
+    prev = jax.config.jax_platforms
+    jax.config.update("jax_platforms", "cpu")
+    got = jax.default_backend()
+    if got != "cpu":
+        jax.config.update("jax_platforms", prev)  # undo the ineffective pin
         raise RuntimeError(
-            f"{caller}: default device unusable — {detail} — and jax's "
-            "backend registry could not be inspected to prove a CPU "
-            f"fallback is safe ({exc!r}); failing fast instead of risking "
-            "a hang on the wedged device"
-        )
-    if backends:
-        if jax.default_backend() == "cpu":
-            return  # already CPU-only (e.g. test conftest): nothing to pin
-        raise RuntimeError(
-            f"{caller}: default device unusable — {detail} — and a jax "
-            "backend is already initialized, so a CPU fallback cannot "
-            "take effect in this process"
+            f"{caller}: default device unusable — {detail} — and a "
+            f"{got!r} jax backend is already initialized, so a CPU "
+            "fallback cannot take effect in this process"
         )
     print(
         f"{caller}: TPU unreachable ({detail}); falling back to the CPU "
@@ -126,4 +122,3 @@ def fall_back_to_cpu(detail: str, caller: str = "caller") -> None:
         file=sys.stderr,
         flush=True,
     )
-    jax.config.update("jax_platforms", "cpu")
