@@ -39,7 +39,9 @@ from .ssm import (
 )
 from .favar import (
     BootstrapIRFs,
+    SeriesIRFs,
     block_bootstrap_irfs,
+    series_irfs,
     wild_bootstrap_irfs,
     wild_bootstrap_irfs_resumable,
 )
@@ -59,11 +61,13 @@ from .bayes import (
     BayesPriors,
     BayesResults,
     PosteriorForecast,
+    PosteriorSeriesIRFs,
     dic,
     select_nfac_bayes,
     estimate_dfm_bayes,
     posterior_forecast,
     posterior_irfs,
+    posterior_series_irfs,
     rhat,
     simulation_smoother,
 )

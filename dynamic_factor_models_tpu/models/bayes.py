@@ -51,6 +51,7 @@ __all__ = [
     "BayesPriors",
     "BayesResults",
     "PosteriorForecast",
+    "PosteriorSeriesIRFs",
     "BayesModelComparison",
     "dic",
     "select_nfac_bayes",
@@ -58,6 +59,7 @@ __all__ = [
     "simulation_smoother",
     "posterior_forecast",
     "posterior_irfs",
+    "posterior_series_irfs",
     "rhat",
 ]
 
@@ -440,6 +442,27 @@ def estimate_dfm_bayes(
         )
 
 
+def _irf_one_draw(a_i, q_i, horizon: int):
+    """Cholesky-identified factor IRFs (r, horizon, r) of one (A, Q) draw."""
+    from .var import companion_matrices
+
+    p, r = a_i.shape[0], a_i.shape[1]
+    beta = jnp.concatenate(
+        [jnp.zeros((1, r), a_i.dtype)] + [a_i[j].T for j in range(p)],
+        axis=0,
+    )
+    M, Qs, G = companion_matrices(beta, _psd_floor(q_i), p)
+
+    def step(x, _):
+        return M @ x, Qs @ x
+
+    def one_shock(g):
+        _, out = jax.lax.scan(step, g, None, length=horizon)
+        return out.T
+
+    return jax.vmap(one_shock, in_axes=1, out_axes=2)(G)
+
+
 def posterior_irfs(
     results: BayesResults,
     horizon: int = 24,
@@ -450,32 +473,57 @@ def posterior_irfs(
     companion machinery), vmapped over the flattened chain x draw axis.
 
     Returns (quantiles (nq, r, horizon, r), draws (n, r, horizon, r))."""
-    from .var import companion_matrices
-
     a = results.a_draws.reshape((-1,) + results.a_draws.shape[2:])
     q = results.q_draws.reshape((-1,) + results.q_draws.shape[2:])
-    p, r = a.shape[1], a.shape[2]
 
-    def one(a_i, q_i):
-        beta = jnp.concatenate(
-            [jnp.zeros((1, r), a_i.dtype)]
-            + [a_i[j].T for j in range(p)],
-            axis=0,
-        )
-        M, Qs, G = companion_matrices(beta, _psd_floor(q_i), p)
-
-        def step(x, _):
-            return M @ x, Qs @ x
-
-        def one_shock(g):
-            _, out = jax.lax.scan(step, g, None, length=horizon)
-            return out.T
-
-        return jax.vmap(one_shock, in_axes=1, out_axes=2)(G)
-
-    draws = jax.jit(jax.vmap(one))(a, q)
+    draws = jax.jit(jax.vmap(partial(_irf_one_draw, horizon=horizon)))(a, q)
     qs = jnp.quantile(draws, jnp.asarray(quantile_levels), axis=0)
     return qs, draws
+
+
+class PosteriorSeriesIRFs(NamedTuple):
+    mean: jnp.ndarray  # (nsel, horizon, r) posterior-mean series IRFs
+    quantiles: jnp.ndarray  # (nq, nsel, horizon, r)
+    quantile_levels: np.ndarray
+    draws: jnp.ndarray  # (n_draws, nsel, horizon, r)
+
+
+def posterior_series_irfs(
+    results: BayesResults,
+    horizon: int = 24,
+    series_idx=None,
+    quantile_levels=(0.05, 0.16, 0.5, 0.84, 0.95),
+) -> PosteriorSeriesIRFs:
+    """Posterior IRF bands in OBSERVED-SERIES space, original data units.
+
+    Full posterior propagation: draw d's factor IRFs (from its own A_d, Q_d)
+    are contracted with the SAME draw's loadings Lam_d — so the bands carry
+    both VAR-parameter and loading uncertainty, unlike the FAVAR bootstrap
+    (models/favar.py `series_irfs`) which holds loadings at the point
+    estimate.  The standardized-panel loadings are rescaled by the stored
+    per-series stds, putting the response in the units of the raw series
+    ("response of GDPC96 to shock 1, 5-95% credible band").
+
+    series_idx: optional indices into the INCLUDED-series axis (the order of
+    `results.lam_draws`); default all.
+    """
+    a = results.a_draws.reshape((-1,) + results.a_draws.shape[2:])
+    q = results.q_draws.reshape((-1,) + results.q_draws.shape[2:])
+    lam = results.lam_draws.reshape((-1,) + results.lam_draws.shape[2:])
+    scale = results.stds
+    if series_idx is not None:
+        idx = jnp.asarray(series_idx)
+        lam, scale = lam[:, idx], scale[idx]
+
+    def one(a_i, q_i, lam_i):
+        irf = _irf_one_draw(a_i, q_i, horizon)  # (r, H, r)
+        return jnp.einsum("nk,khj->nhj", lam_i * scale[:, None], irf)
+
+    draws = jax.jit(jax.vmap(one))(a, q, lam)
+    qs = jnp.quantile(draws, jnp.asarray(quantile_levels), axis=0)
+    return PosteriorSeriesIRFs(
+        draws.mean(axis=0), qs, np.asarray(quantile_levels), draws
+    )
 
 
 def _standardized_window(results: BayesResults, data, inclcode,

@@ -238,8 +238,10 @@ def render_extras(
                "factor-1 IRF to own shock (posterior bands)")
     save(fig, "extra_posterior_irf.png")
 
-    # TVP loading drift: the most unstable series' loading path on factor 1
+    # point DFM fit, shared by the TVP and series-IRF panels below
     res = estimate_dfm(ds_real.bpdata, ds_real.inclcode, i0, i1, cfg)
+
+    # TVP loading drift: the most unstable series' loading path on factor 1
     data = np.asarray(ds_real.bpdata)[i0 : i1 + 1][:, incl]
     xz, _ = standardize_data(jnp.asarray(data))
     F = jnp.asarray(np.asarray(res.factor)[i0 : i1 + 1])
@@ -251,6 +253,22 @@ def render_extras(
                {names[i]: np.asarray(tvp.lam_path)[:, i, 0] for i in top},
                "factor-1 loadings of the most unstable series (TVP paths)")
     save(fig, "extra_tvp_loadings.png")
+
+    # series-space FAVAR bands: bootstrap draws of the factor IRFs pushed
+    # through the loadings — response of GDP to the first recursive shock
+    from ..models import series_irfs, wild_bootstrap_irfs
+
+    boot = wild_bootstrap_irfs(res.factor, cfg.n_factorlag, i0, i1,
+                               horizon=16, n_reps=400, seed=0)
+    j_gdp = list(ds_real.bpnamevec).index("GDPC96")
+    s = series_irfs(boot, res.lam, series_idx=[j_gdp])
+    sq = np.asarray(s.quantiles)[:, 0, :, 0]  # (nq, H), shock 1
+    fig, ax = plt.subplots(figsize=(8, 4))
+    line_panel(ax, np.arange(sq.shape[1]), {
+        "point": np.asarray(s.point)[0, :, 0],
+        "5%": sq[0], "median": sq[2], "95%": sq[-1],
+    }, "GDPC96 response to shock 1 (wild-bootstrap 5-95% band)")
+    save(fig, "extra_series_irf_band.png")
 
     # coherence with the first included series across frequencies
     freqs, coh2, _ = coherence(ds_real.bpdata, M=24)

@@ -78,6 +78,36 @@ class TestGibbs:
         # monotone quantiles
         assert (np.diff(np.asarray(qs), axis=0) >= -1e-12).all()
 
+    def test_posterior_series_irfs(self, posterior):
+        from dynamic_factor_models_tpu.models.bayes import posterior_series_irfs
+
+        x, f, lam, res = posterior
+        N = x.shape[1]
+        out = posterior_series_irfs(res, horizon=8)
+        mean, qs = out.mean, out.quantiles
+        assert mean.shape == (N, 8, 1)
+        assert qs.shape == (5, N, 8, 1)
+        assert out.draws.shape == (200, N, 8, 1)
+        assert np.isfinite(np.asarray(qs)).all()
+        assert (np.diff(np.asarray(qs), axis=0) >= -1e-12).all()
+        # the posterior mean sits inside its own 5-95% band
+        inside = (np.asarray(mean) >= np.asarray(qs[0])) & (
+            np.asarray(mean) <= np.asarray(qs[-1])
+        )
+        assert inside.mean() > 0.9
+        # original units: the impact responses are proportional to the true
+        # loadings across series (factor scale is a common constant)
+        impact = np.asarray(mean)[:, 0, 0]
+        assert abs(np.corrcoef(impact, lam[:, 0])[0, 1]) > 0.9
+        # subset selection slices the full result
+        sub = posterior_series_irfs(res, horizon=8, series_idx=[2, 5])
+        np.testing.assert_allclose(
+            np.asarray(sub.mean), np.asarray(mean)[[2, 5]], rtol=1e-10
+        )
+        np.testing.assert_allclose(
+            np.asarray(sub.quantiles), np.asarray(qs)[:, [2, 5]], rtol=1e-10
+        )
+
 
 class TestSimulationSmoother:
     def test_draws_center_on_smoother_mean(self):

@@ -126,6 +126,66 @@ def test_probe_default_device_cpu_short_circuit():
     assert time.perf_counter() - t0 < 1.0
 
 
+class TestSeriesIRFs:
+    """Bootstrap bands pushed through the loadings to series space."""
+
+    @pytest.fixture(scope="class")
+    def boot(self):
+        rng = np.random.default_rng(2)
+        y = np.zeros((250, 3))
+        A1 = np.array([[0.5, 0.1, 0.0], [0.0, 0.4, 0.1], [0.1, 0.0, 0.3]])
+        for t in range(1, 250):
+            y[t] = A1 @ y[t - 1] + rng.standard_normal(3)
+        return wild_bootstrap_irfs(
+            jnp.asarray(y), 1, 0, 249, horizon=8, n_reps=64, seed=0
+        )
+
+    def test_contraction_matches_point(self, boot):
+        from dynamic_factor_models_tpu.models.favar import series_irfs
+
+        lam = np.random.default_rng(3).standard_normal((10, 3))
+        s = series_irfs(boot, lam)
+        assert s.point.shape == (10, 8, 3)
+        assert s.quantiles.shape == (5, 10, 8, 3)
+        np.testing.assert_allclose(
+            np.asarray(s.point),
+            np.einsum("nk,khj->nhj", lam, np.asarray(boot.point)),
+            rtol=1e-12,
+        )
+        # series-space bands bracket the series-space point estimate
+        lo, hi = np.asarray(s.quantiles[0]), np.asarray(s.quantiles[-1])
+        inside = (np.asarray(s.point) >= lo) & (np.asarray(s.point) <= hi)
+        assert inside.mean() > 0.9
+        assert (np.diff(np.asarray(s.quantiles), axis=0) >= -1e-12).all()
+
+    def test_subset_and_scale(self, boot):
+        from dynamic_factor_models_tpu.models.favar import series_irfs
+
+        lam = np.random.default_rng(4).standard_normal((6, 3))
+        full = series_irfs(boot, lam)
+        sub = series_irfs(boot, lam, series_idx=[1, 4])
+        np.testing.assert_allclose(
+            np.asarray(sub.point), np.asarray(full.point)[[1, 4]], rtol=1e-12
+        )
+        np.testing.assert_allclose(
+            np.asarray(sub.quantiles),
+            np.asarray(full.quantiles)[:, [1, 4]],
+            rtol=1e-12,
+        )
+        scale = np.array([2.0] * 6)
+        scaled = series_irfs(boot, lam, scale=scale)
+        np.testing.assert_allclose(
+            np.asarray(scaled.quantiles), 2.0 * np.asarray(full.quantiles),
+            rtol=1e-12,
+        )
+
+    def test_dimension_mismatch_raises(self, boot):
+        from dynamic_factor_models_tpu.models.favar import series_irfs
+
+        with pytest.raises(ValueError, match="factor columns"):
+            series_irfs(boot, np.zeros((5, 4)))
+
+
 class TestBlockBootstrap:
     def test_block_bootstrap_brackets_point(self):
         from dynamic_factor_models_tpu.models.favar import block_bootstrap_irfs
