@@ -512,7 +512,16 @@ def posterior_series_irfs(
     lam = results.lam_draws.reshape((-1,) + results.lam_draws.shape[2:])
     scale = results.stds
     if series_idx is not None:
-        idx = jnp.asarray(series_idx)
+        # bounds-check host-side: jnp gather clamps out-of-range indices
+        # silently — the exact hazard of passing a full-panel index where
+        # an included-series index is expected
+        idx = np.asarray(series_idx)
+        n_incl = lam.shape[1]
+        if idx.size and (idx.min() < -n_incl or idx.max() >= n_incl):
+            raise IndexError(
+                f"series_idx out of range for {n_incl} included series: "
+                f"[{idx.min()}, {idx.max()}]"
+            )
         lam, scale = lam[:, idx], scale[idx]
 
     def one(a_i, q_i, lam_i):

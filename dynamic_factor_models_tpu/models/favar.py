@@ -83,9 +83,17 @@ def series_irfs(
     """
     lam = jnp.asarray(lam)
     if series_idx is not None:
-        lam = lam[jnp.asarray(series_idx)]
+        # bounds-check host-side: jnp gather clamps out-of-range indices
+        # silently, which would return the wrong series' band
+        idx = np.asarray(series_idx)
+        if idx.size and (idx.min() < -lam.shape[0] or idx.max() >= lam.shape[0]):
+            raise IndexError(
+                f"series_idx out of range for {lam.shape[0]} series: "
+                f"[{idx.min()}, {idx.max()}]"
+            )
+        lam = lam[idx]
         if scale is not None:
-            scale = jnp.asarray(scale)[jnp.asarray(series_idx)]
+            scale = jnp.asarray(scale)[idx]
     if lam.shape[-1] != boot.point.shape[0]:
         raise ValueError(
             f"loadings have {lam.shape[-1]} factor columns; the bootstrap "

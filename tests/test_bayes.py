@@ -99,6 +99,9 @@ class TestGibbs:
         # loadings across series (factor scale is a common constant)
         impact = np.asarray(mean)[:, 0, 0]
         assert abs(np.corrcoef(impact, lam[:, 0])[0, 1]) > 0.9
+        # out-of-range indices raise instead of silently clamping
+        with pytest.raises(IndexError, match="out of range"):
+            posterior_series_irfs(res, horizon=8, series_idx=[N])
         # subset selection slices the full result
         sub = posterior_series_irfs(res, horizon=8, series_idx=[2, 5])
         np.testing.assert_allclose(

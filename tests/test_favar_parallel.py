@@ -185,6 +185,13 @@ class TestSeriesIRFs:
         with pytest.raises(ValueError, match="factor columns"):
             series_irfs(boot, np.zeros((5, 4)))
 
+    def test_out_of_range_series_idx_raises(self, boot):
+        from dynamic_factor_models_tpu.models.favar import series_irfs
+
+        lam = np.zeros((5, 3))
+        with pytest.raises(IndexError, match="out of range"):
+            series_irfs(boot, lam, series_idx=[999])
+
 
 class TestBlockBootstrap:
     def test_block_bootstrap_brackets_point(self):
