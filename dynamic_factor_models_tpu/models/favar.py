@@ -82,6 +82,12 @@ def series_irfs(
     band coverage is exact in series space.
     """
     lam = jnp.asarray(lam)
+    if scale is not None:
+        scale = jnp.asarray(scale)
+        if scale.shape[0] != lam.shape[0]:
+            raise ValueError(
+                f"scale has {scale.shape[0]} entries for {lam.shape[0]} series"
+            )
     if series_idx is not None:
         # bounds-check host-side: jnp gather clamps out-of-range indices
         # silently, which would return the wrong series' band
@@ -93,7 +99,7 @@ def series_irfs(
             )
         lam = lam[idx]
         if scale is not None:
-            scale = jnp.asarray(scale)[idx]
+            scale = scale[idx]
     if lam.shape[-1] != boot.point.shape[0]:
         raise ValueError(
             f"loadings have {lam.shape[-1]} factor columns; the bootstrap "

@@ -11,6 +11,11 @@ Layout guidance (the scaling-book recipe): put the embarrassing axis
 (bootstrap replications, panels) on the outer/DCN axis — its only
 collective is the final quantile/moment aggregation — and keep
 series/tensor sharding (`sp`, psum-heavy) on inner/ICI axes.
+
+The multi-process branch is exercised for real by
+tests/test_distributed_multiprocess.py: two OS processes x 4 virtual CPU
+devices joined through the coordination service, cross-process psum over
+Gloo, and the replication-sharded bootstrap on the resulting global mesh.
 """
 
 from __future__ import annotations

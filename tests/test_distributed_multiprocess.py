@@ -1,0 +1,86 @@
+"""Exercise the multi-host path of parallel/distributed.py for real: two OS
+processes (4 virtual CPU devices each) joined by `jax.distributed.initialize`
+into one 8-device runtime, with cross-process collectives over Gloo — the
+CPU-world analogue of two TPU hosts over DCN (SURVEY.md section 5.8).
+
+The single-process no-op branch is covered in test_favar_parallel; this file
+covers the branch that previously existed only on trust: coordination-service
+startup, the process-major outer mesh axis, cross-process psum, and the
+replication-sharded bootstrap on a global mesh with identical SPMD results on
+every process.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+_WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)), "_dist_worker.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.slow
+def test_two_process_runtime_agrees(tmp_path):
+    port, nproc = _free_port(), 2
+    env = {
+        k: v
+        for k, v in os.environ.items()
+        # each worker configures its own platform/device-count flags
+        if k not in ("XLA_FLAGS", "JAX_PLATFORMS")
+    }
+    # stdout/stderr go to files, not pipes: if one worker dies at the
+    # distributed barrier the other blocks, and we still want the dead
+    # worker's traceback (pipes also deadlock when one fills while the
+    # other is being waited on)
+    logs = [(tmp_path / f"w{i}.out", tmp_path / f"w{i}.err") for i in range(nproc)]
+    procs = []
+    try:
+        for i in range(nproc):
+            with open(logs[i][0], "w") as out, open(logs[i][1], "w") as err:
+                procs.append(
+                    subprocess.Popen(
+                        [sys.executable, _WORKER, str(i), str(nproc), str(port)],
+                        stdout=out,
+                        stderr=err,
+                        env=env,
+                    )
+                )
+        deadline = time.monotonic() + 600
+        while any(p.poll() is None for p in procs):
+            # a dead worker strands the others at the barrier: kill the
+            # rest immediately instead of burning the whole deadline
+            if any(p.poll() not in (None, 0) for p in procs):
+                break
+            if time.monotonic() > deadline:
+                break
+            time.sleep(0.5)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+
+    dumps = [
+        f"worker {i}: rc={p.returncode}\n{logs[i][0].read_text()}"
+        f"\n{logs[i][1].read_text()[-2000:]}"
+        for i, p in enumerate(procs)
+    ]
+    assert all(p.returncode == 0 for p in procs), "\n\n".join(dumps)
+    results = sorted(
+        line
+        for o, _ in logs
+        for line in o.read_text().splitlines()
+        if line.startswith("RESULT")
+    )
+    assert len(results) == nproc, "\n\n".join(dumps)
+    # SPMD: every process must report identical psum and bootstrap quantiles
+    payloads = {r.split("pid=")[1].split(" ", 1)[1] for r in results}
+    assert len(payloads) == 1, f"processes disagree: {results}"

@@ -202,7 +202,8 @@ def test_bench_run_child_timeout_returns_failure(monkeypatch):
 @pytest.mark.slow
 def test_render_extras_writes_capability_panels(tmp_path):
     """The beyond-reference panels (SV volatility, posterior IRF fan, TVP
-    loadings, coherence) render to non-trivial PNGs with tiny chains."""
+    loadings, series-space IRF band, coherence) render to non-trivial PNGs
+    with tiny chains."""
     from dynamic_factor_models_tpu.replication.plotting import render_extras
 
     written = render_extras(str(tmp_path), n_keep=8, n_burn=8, n_chains=2)
@@ -210,6 +211,7 @@ def test_render_extras_writes_capability_panels(tmp_path):
     assert names == [
         "extra_coherence.png",
         "extra_posterior_irf.png",
+        "extra_series_irf_band.png",
         "extra_sv_volatility.png",
         "extra_tvp_loadings.png",
     ]
