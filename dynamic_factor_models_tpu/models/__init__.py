@@ -46,7 +46,12 @@ from .favar import (
     wild_bootstrap_irfs_resumable,
 )
 from .dynpca import DynamicPCAResults, coherence, dynamic_pca, spectral_density
-from .multilevel import MultilevelResults, estimate_multilevel_dfm
+from .multilevel import (
+    MultilevelIRFs,
+    MultilevelResults,
+    estimate_multilevel_dfm,
+    multilevel_series_irfs,
+)
 from .ssm_ar import (
     EMARResults,
     SSMARParams,

@@ -32,7 +32,12 @@ from ..ops.masking import fillz, mask_of
 from ..utils.backend import on_backend
 from .dfm import _als_core
 
-__all__ = ["MultilevelResults", "estimate_multilevel_dfm"]
+__all__ = [
+    "MultilevelIRFs",
+    "MultilevelResults",
+    "estimate_multilevel_dfm",
+    "multilevel_series_irfs",
+]
 
 
 class MultilevelResults(NamedTuple):
@@ -45,6 +50,7 @@ class MultilevelResults(NamedTuple):
     tss: float
     n_iter: int
     variance_decomposition: dict  # {"global", "block", "idiosyncratic"}
+    stds: jnp.ndarray  # (N,) standardization scale (original-unit bands)
 
 
 def _als_level(xz, m, f0, nfac, tol_scaled, max_iter):
@@ -78,7 +84,7 @@ def estimate_multilevel_dfm(
         if lastperiod is None:
             lastperiod = data.shape[0] - 1
         xw = data[initperiod : lastperiod + 1]
-        xstd, _ = standardize_data(xw)
+        xstd, stds = standardize_data(xw)
         mask = mask_of(xstd)
         m = mask.astype(xstd.dtype)
         xz = fillz(xstd)
@@ -157,4 +163,91 @@ def estimate_multilevel_dfm(
                 "block": bvar / tss,
                 "idiosyncratic": ssr / tss,
             },
+            stds=jnp.asarray(stds).reshape(-1),
         )
+
+
+class MultilevelIRFs(NamedTuple):
+    """Per-block series-space IRFs to shocks of the joint [F, G_b] system."""
+
+    series: list  # per block: favar.SeriesIRFs (original data units)
+    factor_boots: list  # per block: favar.BootstrapIRFs of the joint system
+    r_global: int  # shocks [0, r_global) are global-factor innovations
+
+
+def multilevel_series_irfs(
+    results: MultilevelResults,
+    horizon: int = 24,
+    nlag: int = 2,
+    n_reps: int = 500,
+    seed: int = 0,
+    quantile_levels=(0.05, 0.16, 0.5, 0.84, 0.95),
+    normalize_global: bool = True,
+    mesh=None,
+    backend: str | None = None,
+) -> MultilevelIRFs:
+    """Responses of every series to a common (global-factor) shock, per
+    block, with wild-bootstrap bands — the Barigozzi-Conti-Luciani (2014,
+    OBES 76(5)) headline exercise: "do euro-area countries respond
+    asymmetrically to the common monetary policy?", answered by comparing
+    block-level IRF bands to one global shock.
+
+    Per block b: a VAR(nlag) on the joint system y_b = [F, G^b] (global
+    factors ordered first, so Cholesky shocks 0..r_global-1 are the common
+    shocks and the block shocks are orthogonalized against them), wild-
+    bootstrap replications sharded over the mesh (models/favar.py), and
+    every draw pushed through the block's loadings [Lam_g | Lam_b] and the
+    stored standardization scale — series-space bands in original units.
+
+    Each block fits its own joint VAR, so a one-sd Cholesky innovation to
+    F_j is NOT the same size across blocks (F's residual variance differs by
+    system).  With ``normalize_global=True`` (default) every draw's IRFs to
+    global shock j are rescaled to a UNIT IMPACT on F_j in that draw's
+    system — the unit-effect normalization of the structural-VAR literature
+    — which removes the shock-size difference and makes cross-block bands
+    comparable.  Residual caveat for reading asymmetry off the bands: the
+    per-block parameter draws are still independent estimations (a shared
+    seed reuses the Rademacher signs only), so treat band overlap as a
+    diagnostic, not a formal test of equal responses.
+    """
+    from .favar import BootstrapIRFs, series_irfs, wild_bootstrap_irfs
+
+    r_g = results.global_factors.shape[1]
+
+    def _unit_impact(arr):
+        # arr (..., ns_sys, H, K): rescale global-shock columns j < r_g so
+        # the impact response of F_j to shock j is exactly 1 per draw
+        cols = []
+        for j in range(arr.shape[-1]):
+            col = arr[..., :, :, j]
+            if j < r_g:
+                col = col / arr[..., j, 0, j][..., None, None]
+            cols.append(col)
+        return jnp.stack(cols, axis=-1)
+
+    series_out, boots = [], []
+    for idx, Gb, Lb in zip(
+        results.blocks, results.block_factors, results.block_loadings
+    ):
+        y = jnp.concatenate([results.global_factors, Gb], axis=1)
+        bs = wild_bootstrap_irfs(
+            y,
+            nlag,
+            0,
+            y.shape[0] - 1,
+            horizon=horizon,
+            n_reps=n_reps,
+            seed=seed,
+            quantile_levels=quantile_levels,
+            mesh=mesh,
+            backend=backend,
+        )
+        if normalize_global:
+            point = _unit_impact(bs.point)
+            draws = _unit_impact(bs.draws)
+            q = jnp.quantile(draws, jnp.asarray(quantile_levels), axis=0)
+            bs = BootstrapIRFs(point, draws, q, np.asarray(quantile_levels))
+        lam = jnp.concatenate([results.global_loadings[idx], Lb], axis=1)
+        series_out.append(series_irfs(bs, lam, scale=results.stds[idx]))
+        boots.append(bs)
+    return MultilevelIRFs(series_out, boots, r_g)

@@ -200,3 +200,71 @@ class TestCoherence:
         assert 1.0 < slope < 3.0
         # diagonal coherence is exactly 1
         assert np.allclose(coh2[:, 0, 0], 1.0, atol=1e-8)
+
+
+class TestMultilevelSeriesIRFs:
+    """The Barigozzi-Conti-Luciani asymmetry exercise: per-block series
+    bands to one common shock (models/multilevel.multilevel_series_irfs)."""
+
+    @staticmethod
+    def _panel(g_scale_a, g_scale_b, seed=0, T=300, nb=20):
+        rng = np.random.default_rng(seed)
+
+        def ar1():
+            u = rng.standard_normal(T) * np.sqrt(1 - 0.7**2)
+            f = np.zeros(T)
+            for t in range(1, T):
+                f[t] = 0.7 * f[t - 1] + u[t]
+            return f
+
+        F, Ga, Gb = ar1(), ar1(), ar1()
+        La = g_scale_a * (0.5 + np.abs(rng.standard_normal(nb)))
+        Lb_ = g_scale_b * (0.5 + np.abs(rng.standard_normal(nb)))
+        x = np.zeros((T, 2 * nb))
+        x[:, :nb] = np.outer(F, La) + np.outer(Ga, rng.standard_normal(nb))
+        x[:, nb:] = np.outer(F, Lb_) + np.outer(Gb, rng.standard_normal(nb))
+        x += 0.5 * rng.standard_normal((T, 2 * nb))
+        return x, [np.arange(nb), np.arange(nb, 2 * nb)]
+
+    def test_bands_and_asymmetry_ordering(self):
+        from dynamic_factor_models_tpu.models.multilevel import (
+            multilevel_series_irfs,
+        )
+
+        x, blocks = self._panel(g_scale_a=1.5, g_scale_b=0.3)
+        res = estimate_multilevel_dfm(x, blocks, 1, 1)
+        out = multilevel_series_irfs(res, horizon=8, nlag=2, n_reps=100)
+        assert out.r_global == 1 and len(out.series) == 2
+        nb = len(blocks[0])
+        for s, bs in zip(out.series, out.factor_boots):
+            assert s.point.shape == (nb, 8, 2)  # joint [F, G_b] system
+            assert s.quantiles.shape == (5, nb, 8, 2)
+            assert np.isfinite(np.asarray(s.quantiles)).all()
+            assert bs.point.shape == (2, 8, 2)
+            # unit-effect normalization: every draw's impact of F on the
+            # global shock is exactly 1, so blocks are comparable
+            np.testing.assert_allclose(np.asarray(bs.point)[0, 0, 0], 1.0)
+            np.testing.assert_allclose(
+                np.asarray(bs.draws)[:, 0, 0, 0], 1.0, atol=1e-12
+            )
+        # block A loads ~5x harder on the global factor: its cumulative
+        # absolute response to the common shock (shock 0) must dominate
+        resp = [
+            np.abs(np.asarray(s.point)[:, :, 0]).sum(axis=1).mean()
+            for s in out.series
+        ]
+        assert resp[0] > 2.0 * resp[1], f"asymmetry not detected: {resp}"
+
+    def test_symmetric_blocks_respond_alike(self):
+        from dynamic_factor_models_tpu.models.multilevel import (
+            multilevel_series_irfs,
+        )
+
+        x, blocks = self._panel(g_scale_a=1.0, g_scale_b=1.0, seed=1)
+        res = estimate_multilevel_dfm(x, blocks, 1, 1)
+        out = multilevel_series_irfs(res, horizon=8, nlag=2, n_reps=100)
+        resp = [
+            np.abs(np.asarray(s.point)[:, :, 0]).sum(axis=1).mean()
+            for s in out.series
+        ]
+        assert 0.6 < resp[0] / resp[1] < 1.6, f"spurious asymmetry: {resp}"
