@@ -33,6 +33,8 @@ from .ssm import (
     EMResults,
     SSMParams,
     em_step,
+    em_step_assoc,
+    em_step_sqrt,
     estimate_dfm_em,
     kalman_filter,
     kalman_smoother,

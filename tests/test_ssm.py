@@ -199,6 +199,74 @@ def test_kalman_f32_f64_parity():
     assert drift < 1e-5, f"f32 smoother drift {drift} exceeds parity bound"
 
 
+class TestSqrtFilter:
+    """Square-root array filter (method='sqrt'): exact f64 agreement with
+    the information filter, and the f32 precision win it exists for."""
+
+    def test_f64_equivalence_with_missing(self, rng):
+        x, f, params = _simulate(rng, missing=0.12)
+        fi = kalman_filter(params, jnp.asarray(x))
+        fs = kalman_filter(params, jnp.asarray(x), method="sqrt")
+        assert abs(float(fi.loglik - fs.loglik)) < 1e-8
+        np.testing.assert_allclose(
+            np.asarray(fi.means), np.asarray(fs.means), atol=1e-10
+        )
+        np.testing.assert_allclose(
+            np.asarray(fi.covs), np.asarray(fs.covs), atol=1e-10
+        )
+        mi, ci, lli = kalman_smoother(params, jnp.asarray(x))
+        ms, cs, lls = kalman_smoother(params, jnp.asarray(x), method="sqrt")
+        np.testing.assert_allclose(np.asarray(mi), np.asarray(ms), atol=1e-10)
+        np.testing.assert_allclose(np.asarray(ci), np.asarray(cs), atol=1e-10)
+
+    def test_f32_loglik_precision_win(self):
+        """Ill-conditioned DGP (tiny R, near-unit-root factor): the f32
+        sqrt filter's log-likelihood error vs the f64 truth is several
+        times smaller than the information filter's (measured ~8-16x)."""
+        rng2 = np.random.default_rng(1)
+        T, N, r, R_scale, rho = 200, 30, 2, 1e-3, 0.99
+        f = np.zeros((T, r))
+        for t in range(1, T):
+            f[t] = rho * f[t - 1] + rng2.standard_normal(r) * np.sqrt(1 - rho**2)
+        lam = rng2.standard_normal((N, r))
+        x = f @ lam.T + np.sqrt(R_scale) * rng2.standard_normal((T, N))
+        x[rng2.random((T, N)) < 0.08] = np.nan
+
+        def run(dtype, method):
+            pr = SSMParams(
+                jnp.asarray(lam, dtype),
+                R_scale * jnp.ones(N, dtype),
+                jnp.asarray(rho * np.eye(r)[None], dtype),
+                jnp.asarray((1 - rho**2) * np.eye(r), dtype),
+            )
+            return float(
+                kalman_filter(pr, jnp.asarray(x, dtype), method=method).loglik
+            )
+
+        ll_true = run(jnp.float64, "sequential")
+        err_info = abs(run(jnp.float32, "sequential") - ll_true)
+        err_sqrt = abs(run(jnp.float32, "sqrt") - ll_true)
+        assert err_sqrt < 0.5 * err_info, (
+            f"sqrt filter did not improve f32 loglik: {err_sqrt} vs {err_info}"
+        )
+
+    def test_method_validation(self, rng):
+        x, _, params = _simulate(rng)
+        with pytest.raises(ValueError, match="method"):
+            kalman_filter(params, jnp.asarray(x), method="nope")
+
+    def test_em_step_sqrt_matches_sequential(self, rng):
+        from dynamic_factor_models_tpu.models.ssm import em_step, em_step_sqrt
+
+        x, _, params = _simulate(rng, missing=0.1)
+        xz, m = fillz(jnp.asarray(x)), mask_of(jnp.asarray(x))
+        p1, ll1 = em_step(params, xz, m)
+        p2, ll2 = em_step_sqrt(params, xz, m)
+        assert abs(float(ll1 - ll2)) < 1e-8
+        for a, b in zip(p1, p2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-8)
+
+
 def test_em_step_assoc_matches_sequential(rng):
     """em_step_assoc (parallel-in-time E-step) == em_step to numerical
     precision: shared M-step, E-steps already pinned at 1e-10 parity."""
