@@ -112,6 +112,7 @@ class TestGibbs:
         )
 
 
+@pytest.mark.slow
 class TestSimulationSmoother:
     def test_draws_center_on_smoother_mean(self):
         """Average of many posterior factor draws ~= RTS smoothed mean."""
@@ -174,6 +175,7 @@ class TestSimulationSmoother:
         assert rhat(shifted) > 2.0
 
 
+@pytest.mark.slow
 class TestPosteriorForecast:
     def test_predictive_bands_cover_future(self):
         """Fit on the first part of a synthetic sample, forecast the rest:
@@ -228,6 +230,7 @@ class TestPosteriorForecast:
             )
 
 
+@pytest.mark.slow
 class TestModelComparison:
     def test_dic_selects_true_factor_count(self):
         """True r=2 panel: DIC should prefer r=2 over r=1 (underfit) and
@@ -256,6 +259,7 @@ class TestModelComparison:
         assert comp.p_d[2] > comp.p_d[0]
 
 
+@pytest.mark.slow
 def test_chain_mesh_sharding():
     """Chains shard over a 1-axis mesh (any axis name) and match shapes."""
     from jax.sharding import Mesh

@@ -43,6 +43,7 @@ def test_table2a_ahn_horenstein(fnes_real):
     np.testing.assert_allclose(er[:4], [3.739, 2.340, 1.384, 1.059], atol=1e-3)
 
 
+@pytest.mark.slow
 def test_table2b_and_2c_all_panel(dataset_all):
     fnes = estimate_factor_numbers(
         dataset_all.bpdata, dataset_all.inclcode, *WINDOW, DFMConfig(), 4,

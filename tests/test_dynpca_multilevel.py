@@ -174,6 +174,7 @@ def test_multilevel_real_panel_category_blocks(dataset_all):
     assert abs(vd["global"] + vd["block"] + vd["idiosyncratic"] - 1.0) < 0.15
 
 
+@pytest.mark.slow
 class TestCoherence:
     def test_coherent_and_independent_pairs(self):
         from dynamic_factor_models_tpu.models.dynpca import coherence

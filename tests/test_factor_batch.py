@@ -9,6 +9,7 @@ sequential fit.
 import dataclasses
 
 import numpy as np
+import pytest
 
 from dynamic_factor_models_tpu.models.dfm import (
     DFMConfig,
@@ -49,6 +50,7 @@ def test_batch_matches_serial_over_r(dataset_real):
         )
 
 
+@pytest.mark.slow
 def test_batch_matches_serial_over_windows(dataset_real):
     ds = dataset_real
     cfg = DFMConfig(tol=1e-8)

@@ -54,6 +54,7 @@ def test_mesh_helpers():
     np.testing.assert_allclose(np.asarray(xs), np.asarray(x))
 
 
+@pytest.mark.slow
 def test_graft_entry_and_dryrun():
     import __graft_entry__ as g
 

@@ -54,6 +54,7 @@ def test_forecast_series_shapes_and_consistency():
     assert np.abs(np.asarray(fc.series)).max() < 10 * np.abs(x).max()
 
 
+@pytest.mark.slow
 def test_nowcast_fills_ragged_edge():
     x, f, lam, rho = _ar1_factor_panel(T=200, N=20, seed=2)
     cfg = DFMConfig(nfac_u=1, n_factorlag=1, n_uarlag=1)
@@ -130,6 +131,7 @@ def test_nowcast_em_original_units():
     assert abs(np.mean(pred) - np.mean(truth)) < 5.0  # right scale, not z-units
 
 
+@pytest.mark.slow
 def test_forecast_ragged_edge_discounts_release_gap():
     # a series with a 3-period release delay: the AR(1) idio forecast must be
     # the conditional expectation coef^(d+1) * e_last — the last observed

@@ -14,6 +14,10 @@ Sources (committed notebook outputs, /root/reference/Stock_Watson.ipynb):
 import numpy as np
 import pytest
 
+# full-scale goldens are the slow lane: minutes each on one core (the fast
+# lane keeps the same tables at reduced width in test_dfm_golden.py)
+pytestmark = pytest.mark.slow
+
 from dynamic_factor_models_tpu.models.dfm import DFMConfig, estimate_dfm, estimate_factor
 from dynamic_factor_models_tpu.models.favar_instruments import (
     choose_stepwise,

@@ -15,6 +15,7 @@ from dynamic_factor_models_tpu.models.favar_instruments import favar_instrument_
 from dynamic_factor_models_tpu.models.instability import instability_scan
 
 
+@pytest.mark.slow
 def test_table4_r4(dataset_all):
     ds = dataset_all
     cfg = DFMConfig(nfac_u=4)

@@ -70,6 +70,7 @@ print("COMPILED_OK", err_pallas, err_xla)
 """
 
 
+@pytest.mark.slow
 def test_pallas_compiled_on_tpu():
     """Compiled (non-interpret) kernel correctness on real TPU hardware.
 

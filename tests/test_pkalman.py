@@ -46,6 +46,7 @@ def problem():
     return _synthetic()
 
 
+@pytest.mark.slow
 def test_filter_parity(problem):
     params, x = problem
     xz, m = fillz(x), mask_of(x)
@@ -91,6 +92,7 @@ def test_no_missing_and_heavy_missing():
         np.testing.assert_allclose(par.loglik, seq.loglik, rtol=1e-9)
 
 
+@pytest.mark.slow
 def test_sharded_scan_matches_associative(problem):
     params, x = problem
     xz, m = fillz(x), mask_of(x)
@@ -105,6 +107,7 @@ def test_sharded_scan_matches_associative(problem):
     np.testing.assert_allclose(np.asarray(shd.C), np.asarray(ref.C), atol=1e-10)
 
 
+@pytest.mark.slow
 def test_sequence_parallel_smoother_on_mesh(problem):
     """Full smoother with time-block sharding across 8 devices — the
     sequence-parallel path end to end."""

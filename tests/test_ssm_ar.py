@@ -2,6 +2,7 @@
 
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from dynamic_factor_models_tpu.models.dfm import DFMConfig
 from dynamic_factor_models_tpu.models.ssm_ar import (
@@ -24,6 +25,7 @@ def _dgp(T=220, N=12, phi=0.7, seed=0):
     return x, f, lam, e
 
 
+@pytest.mark.slow
 def test_em_ar_loglik_monotone_and_phi_recovered():
     x, f, lam, e = _dgp()
     res = estimate_dfm_em_ar(
@@ -45,6 +47,7 @@ def test_em_ar_loglik_monotone_and_phi_recovered():
     assert ce > 0.8, ce
 
 
+@pytest.mark.slow
 def test_em_ar_ragged_edge_idio_persistence():
     # the whole point of AR(1) idio states: a persistent idiosyncratic
     # deviation carries into an unreleased period.  An iid-noise model's

@@ -39,6 +39,7 @@ def sv_posterior():
     return x, f, h, res
 
 
+@pytest.mark.slow
 class TestSVDFM:
     def test_recovers_factor(self, sv_posterior):
         x, f, h, res = sv_posterior
