@@ -175,8 +175,8 @@ class TestSimulationSmoother:
         assert rhat(shifted) > 2.0
 
 
-@pytest.mark.slow
 class TestPosteriorForecast:
+    @pytest.mark.slow
     def test_predictive_bands_cover_future(self):
         """Fit on the first part of a synthetic sample, forecast the rest:
         the 5-95% predictive band should cover ~90% of realized values."""

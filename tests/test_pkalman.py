@@ -46,7 +46,6 @@ def problem():
     return _synthetic()
 
 
-@pytest.mark.slow
 def test_filter_parity(problem):
     params, x = problem
     xz, m = fillz(x), mask_of(x)

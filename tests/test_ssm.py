@@ -267,7 +267,6 @@ class TestSqrtFilter:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-8)
 
 
-@pytest.mark.slow
 def test_em_step_assoc_matches_sequential(rng):
     """em_step_assoc (parallel-in-time E-step) == em_step to numerical
     precision: shared M-step, E-steps already pinned at 1e-10 parity."""
