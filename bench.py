@@ -27,9 +27,10 @@ single coin flip against a tunnel that wedges and recovers on hour scales):
                            on the live chip (documents _PALLAS_MIN_CELLS).
 
 JSON fields beyond the headline:
-- em_iters_per_sec[_host_sync|_assoc]   state-space EM throughput on the
-  real 222x139 panel: on-device lax.while_loop, host-synced driver, and the
-  associative (parallel-in-time) E-step.
+- em_iters_per_sec[_host_sync|_assoc|_sqrt]  state-space EM throughput on
+  the real 222x139 panel: on-device lax.while_loop, host-synced driver, the
+  associative (parallel-in-time) E-step, and the square-root (QR array)
+  E-step — the f32-precision option's speed cost made visible.
 - em_iters_per_sec_mf_monthly           mixed-frequency EM on the real
   672x207 monthly panel (io.readin_data_monthly).
 - als_large_* / em_large_*              synthetic large-panel section
@@ -479,7 +480,12 @@ def bench_main(force_cpu: bool):
     from dynamic_factor_models_tpu.models.dfm import DFMConfig, estimate_factor
     from dynamic_factor_models_tpu.models.emloop import run_em_loop
     from dynamic_factor_models_tpu.models.favar import wild_bootstrap_irfs
-    from dynamic_factor_models_tpu.models.ssm import SSMParams, em_step, em_step_assoc
+    from dynamic_factor_models_tpu.models.ssm import (
+        SSMParams,
+        em_step,
+        em_step_assoc,
+        em_step_sqrt,
+    )
     from dynamic_factor_models_tpu.ops.linalg import standardize_data
     from dynamic_factor_models_tpu.ops.masking import fillz, mask_of
 
@@ -518,7 +524,11 @@ def bench_main(force_cpu: bool):
     em_ips_host = trace.iters_per_sec
     n_dev_iter = 100
     em_ips = {}
-    for name, step in (("seq", em_step), ("assoc", em_step_assoc)):
+    for name, step in (
+        ("seq", em_step),
+        ("assoc", em_step_assoc),
+        ("sqrt", em_step_sqrt),
+    ):
         run_em_loop(step, params, (xz, m), 0.0, n_dev_iter)  # compile
         t1 = time.perf_counter()
         _, _, n_ran, _ = run_em_loop(step, params, (xz, m), 0.0, n_dev_iter)
@@ -552,6 +562,7 @@ def bench_main(force_cpu: bool):
         "em_iters_per_sec": round(em_ips["seq"], 2),
         "em_iters_per_sec_host_sync": round(em_ips_host, 2),
         "em_iters_per_sec_assoc": round(em_ips["assoc"], 2),
+        "em_iters_per_sec_sqrt": round(em_ips["sqrt"], 2),
         **mf,
         **large,
         **pallas,
