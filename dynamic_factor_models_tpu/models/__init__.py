@@ -12,11 +12,16 @@ from .dfm import (
     rolling_factor_estimates,
 )
 from .var import (
+    GrangerCausality,
     HistoricalDecomposition,
+    VARLagSelection,
     VARResults,
     estimate_var,
+    generalized_irf,
+    granger_causality,
     historical_decomposition,
     impulse_response,
+    select_var_lag,
 )
 from .selection import (
     FactorNumberEstimateStats,

@@ -30,6 +30,11 @@ __all__ = [
     "fevd",
     "HistoricalDecomposition",
     "historical_decomposition",
+    "VARLagSelection",
+    "select_var_lag",
+    "generalized_irf",
+    "GrangerCausality",
+    "granger_causality",
 ]
 
 
@@ -64,11 +69,18 @@ def companion_matrices(betahat: jnp.ndarray, seps: jnp.ndarray, nlag: int):
 
 
 @partial(jax.jit, static_argnames=("nlag", "withconst", "compute_matrices"))
-def _estimate_var_window(yw, nlag: int, withconst: bool, compute_matrices: bool):
+def _estimate_var_window(
+    yw, nlag: int, withconst: bool, compute_matrices: bool, row_mask=None
+):
+    """Masked balanced VAR OLS on one window.  `row_mask` (Tw,) optionally
+    restricts the sample further (lag-selection fits every candidate order
+    on one common sample this way).  Also returns X'X for Wald tests."""
     Tw, ns = yw.shape
     xlag = lagmat(yw, range(1, nlag + 1))
     x = jnp.hstack([jnp.ones((Tw, 1), dtype=yw.dtype), fillz(xlag)]) if withconst else fillz(xlag)
     w = mask_of(yw).all(axis=1) & mask_of(xlag).all(axis=1)
+    if row_mask is not None:
+        w = w & row_mask
     wf = w.astype(yw.dtype)
     Xw = x * wf[:, None]
     A = Xw.T @ x
@@ -86,7 +98,7 @@ def _estimate_var_window(yw, nlag: int, withconst: bool, compute_matrices: bool)
         )
     else:
         M = Q = G = jnp.zeros((0, 0), dtype=yw.dtype)
-    return betahat, ehat, seps, M, Q, G, T_used
+    return betahat, ehat, seps, M, Q, G, T_used, A
 
 
 def estimate_var(
@@ -107,7 +119,7 @@ def estimate_var(
     if lastperiod is None:
         lastperiod = y.shape[0] - 1
     yw = y[initperiod : lastperiod + 1]
-    betahat, ehat, seps, M, Q, G, T_used = _estimate_var_window(
+    betahat, ehat, seps, M, Q, G, T_used, _ = _estimate_var_window(
         yw, nlag, withconst, compute_matrices
     )
     resid = jnp.full_like(y, jnp.nan).at[initperiod : lastperiod + 1].set(ehat)
@@ -265,3 +277,148 @@ def historical_decomposition(var: VARResults, y) -> "HistoricalDecomposition":
 
     contribs = jax.vmap(one_shock, in_axes=(1, 1), out_axes=2)(var.G, eps)
     return HistoricalDecomposition(contribs, baseline, eps, rows)
+
+
+# ---------------------------------------------------------------------------
+# lag-order selection, generalized IRFs, Granger causality (beyond reference)
+# ---------------------------------------------------------------------------
+
+
+class VARLagSelection(NamedTuple):
+    aic: np.ndarray  # (max_lag,) criterion values for p = 1..max_lag
+    bic: np.ndarray
+    hq: np.ndarray
+    best: dict  # {"aic": p, "bic": p, "hq": p}
+
+
+def select_var_lag(
+    y,
+    max_lag: int,
+    initperiod: int = 0,
+    lastperiod: int | None = None,
+    withconst: bool = True,
+) -> VARLagSelection:
+    """VAR lag-order selection by AIC / BIC (Schwarz) / Hannan-Quinn.
+
+    All candidate orders are fit on the SAME effective sample — the rows a
+    VAR(max_lag) can use, intersected across orders, so the criteria stay
+    comparable even when missing values knock out different rows per order.
+    Criteria use the ML innovation covariance (no dof correction):
+
+        IC(p) = log|Sigma_p| + penalty(T) * k_p / T,   k_p = ns(ns p + const)
+
+    with penalty 2 (AIC), log T (BIC), 2 log log T (HQ).
+    """
+    y = jnp.asarray(y)
+    if lastperiod is None:
+        lastperiod = y.shape[0] - 1
+    if max_lag < 1:
+        raise ValueError(f"max_lag must be >= 1, got {max_lag}")
+    yw = y[initperiod : lastperiod + 1]
+    ns = yw.shape[1]
+    # common sample: rows whose max_lag-deep lag window is fully observed
+    xlag_max = lagmat(yw, range(1, max_lag + 1))
+    w_common = mask_of(yw).all(axis=1) & mask_of(xlag_max).all(axis=1)
+    T_eff = float(w_common.sum())
+    vals = {"aic": [], "bic": [], "hq": []}
+    for p in range(1, max_lag + 1):
+        _, ehat, _, _, _, _, T_used, _ = _estimate_var_window(
+            yw, p, withconst, False, row_mask=w_common
+        )
+        assert float(T_used) == T_eff  # the common-sample guarantee
+        e0 = jnp.where(w_common[:, None], fillz(ehat), 0.0)
+        sigma_ml = np.asarray(e0.T @ e0) / T_eff
+        logdet = float(np.linalg.slogdet(sigma_ml)[1])
+        k = ns * (ns * p + int(withconst))
+        vals["aic"].append(logdet + 2.0 * k / T_eff)
+        vals["bic"].append(logdet + np.log(T_eff) * k / T_eff)
+        vals["hq"].append(logdet + 2.0 * np.log(np.log(T_eff)) * k / T_eff)
+    arrs = {c: np.asarray(v) for c, v in vals.items()}
+    best = {c: int(np.argmin(a)) + 1 for c, a in arrs.items()}
+    return VARLagSelection(arrs["aic"], arrs["bic"], arrs["hq"], best)
+
+
+def generalized_irf(var: VARResults, T: int) -> jnp.ndarray:
+    """Generalized IRFs (Koop-Pesaran-Potter 1996 / Pesaran-Shin 1998):
+    order-invariant responses to a one-standard-deviation shock in each
+    variable,
+
+        GIRF_j(h) = Phi_h Sigma e_j / sqrt(sigma_jj),
+
+    i.e. the impact column is the j-th column of Sigma scaled by its own
+    standard deviation (conditional-expectation shock under joint
+    normality), instead of the Cholesky column.  For the FIRST variable the
+    GIRF equals the recursive IRF with that variable ordered first; for
+    diagonal Sigma every GIRF equals the corresponding Cholesky IRF.
+
+    Returns (ns, T, nshock) like `impulse_response(var, "all", T)`.
+    """
+    ns = var.seps.shape[0]
+    k = var.M.shape[0]
+    sd = jnp.sqrt(jnp.diagonal(var.seps))
+    impact = var.seps / sd[None, :]  # column j = Sigma e_j / sqrt(sigma_jj)
+    G_gen = jnp.zeros((k, ns), dtype=impact.dtype).at[:ns, :].set(impact)
+    return _irf_all(var.M, var.Q, G_gen, T)
+
+
+class GrangerCausality(NamedTuple):
+    wald: float  # Wald statistic
+    df: int
+    pvalue: float
+    caused: tuple
+    causing: tuple
+
+
+def granger_causality(
+    y,
+    caused,
+    causing,
+    nlag: int,
+    initperiod: int = 0,
+    lastperiod: int | None = None,
+) -> GrangerCausality:
+    """Block Granger-causality Wald test: H0 = all lag coefficients of the
+    `causing` variables are zero in the `caused` equations.
+
+    Classical (homoskedastic) covariance Var(vec B) = Sigma x (X'X)^{-1},
+    chi-square reference with df = nlag * |causing| * |caused| (the
+    standard textbook VAR test, e.g. Luetkepohl 2005 sec. 3.6; a
+    HAC-robust single-equation variant is `ops.hac.regress_hac`).
+    """
+    from jax.scipy.special import gammaincc
+
+    y = jnp.asarray(y)
+    caused = tuple(np.atleast_1d(caused).tolist())
+    causing = tuple(np.atleast_1d(causing).tolist())
+    ns = y.shape[1]
+    for j in caused + causing:
+        if not 0 <= j < ns:
+            raise ValueError(f"variable index {j} out of range for ns={ns}")
+    if set(caused) & set(causing):
+        raise ValueError("caused and causing must be disjoint")
+    if lastperiod is None:
+        lastperiod = y.shape[0] - 1
+
+    yw = y[initperiod : lastperiod + 1]
+    betahat, _, sigma_j, _, _, _, _, XtX = _estimate_var_window(
+        yw, nlag, True, False
+    )
+    sigma = np.asarray(sigma_j)
+
+    # restriction rows: coefficient (1 + lag*ns + causing_var) in each
+    # caused equation
+    rows = np.asarray(
+        [1 + lag * ns + j for lag in range(nlag) for j in causing]
+    )
+    XtX_inv = np.linalg.inv(np.asarray(XtX))
+    b_r = np.asarray(betahat)[np.ix_(rows, list(caused))]  # (nr, nc)
+    # Var(vec of the restricted block) = Sigma[caused,caused] x XtX_inv[rows,rows]
+    V = np.kron(
+        sigma[np.ix_(list(caused), list(caused))], XtX_inv[np.ix_(rows, rows)]
+    )
+    theta = b_r.T.reshape(-1)  # vec by equation (matches the kron order)
+    wald = float(theta @ np.linalg.solve(V, theta))
+    df = len(rows) * len(caused)
+    # survival function directly (1 - gammainc cancels to 0.0 for large Wald)
+    pvalue = float(gammaincc(df / 2.0, wald / 2.0))
+    return GrangerCausality(wald, df, pvalue, caused, causing)
