@@ -46,8 +46,12 @@ from .ssm import (
 )
 from .favar import (
     BootstrapIRFs,
+    ForecastFan,
+    SeriesFan,
     SeriesIRFs,
     block_bootstrap_irfs,
+    bootstrap_forecast_fan,
+    series_forecast_fan,
     series_irfs,
     wild_bootstrap_irfs,
     wild_bootstrap_irfs_resumable,

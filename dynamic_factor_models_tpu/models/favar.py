@@ -32,8 +32,12 @@ from .var import VARResults, companion_matrices, estimate_var, impulse_response
 
 __all__ = [
     "BootstrapIRFs",
+    "ForecastFan",
+    "SeriesFan",
     "SeriesIRFs",
     "block_bootstrap_irfs",
+    "bootstrap_forecast_fan",
+    "series_forecast_fan",
     "series_irfs",
     "wild_bootstrap_irfs",
     "wild_bootstrap_irfs_resumable",
@@ -54,6 +58,18 @@ class SeriesIRFs(NamedTuple):
     point: jnp.ndarray  # (nsel, H, nshock) loadings @ point IRFs
     quantiles: jnp.ndarray  # (nq, nsel, H, nshock)
     quantile_levels: np.ndarray
+
+
+def _validate_series_idx(n_series: int, series_idx) -> np.ndarray:
+    """Host-side bounds check: jnp gather clamps out-of-range indices
+    silently, which would return the wrong series' band."""
+    idx = np.asarray(series_idx)
+    if idx.size and (idx.min() < -n_series or idx.max() >= n_series):
+        raise IndexError(
+            f"series_idx out of range for {n_series} series: "
+            f"[{idx.min()}, {idx.max()}]"
+        )
+    return idx
 
 
 def series_irfs(
@@ -89,14 +105,7 @@ def series_irfs(
                 f"scale has {scale.shape[0]} entries for {lam.shape[0]} series"
             )
     if series_idx is not None:
-        # bounds-check host-side: jnp gather clamps out-of-range indices
-        # silently, which would return the wrong series' band
-        idx = np.asarray(series_idx)
-        if idx.size and (idx.min() < -lam.shape[0] or idx.max() >= lam.shape[0]):
-            raise IndexError(
-                f"series_idx out of range for {lam.shape[0]} series: "
-                f"[{idx.min()}, {idx.max()}]"
-            )
+        idx = _validate_series_idx(lam.shape[0], series_idx)
         lam = lam[idx]
         if scale is not None:
             scale = scale[idx]
@@ -233,14 +242,24 @@ def _prepare_window(y, initperiod: int, lastperiod: int) -> jnp.ndarray:
     return yw[first:]
 
 
-def _run_core(yw, key, nlag, horizon, n_reps, mesh, resample=_resample_wild):
-    """Dispatch one batch of replications, mesh-sharded when a mesh is given."""
+def _dispatch_reps(core_fn, sharded_factory, mesh, n_reps, args_before, args_after=()):
+    """Shared mesh pad-and-slice dispatch for every rep-vmapped core: round
+    n_reps up to a device multiple, jit with a "rep" out-sharding, slice
+    back.  `core_fn(*args_before, n_reps, *args_after)`."""
     if mesh is not None:
         n_dev = mesh.devices.size
         n_padded = ((n_reps + n_dev - 1) // n_dev) * n_dev
-        core = _sharded_core(NamedSharding(mesh, P("rep")))
-        return core(yw, key, nlag, horizon, n_padded, resample)[:n_reps]
-    return _bootstrap_core(yw, key, nlag, horizon, n_reps, resample)
+        core = sharded_factory(NamedSharding(mesh, P("rep")))
+        return core(*args_before, n_padded, *args_after)[:n_reps]
+    return core_fn(*args_before, n_reps, *args_after)
+
+
+def _run_core(yw, key, nlag, horizon, n_reps, mesh, resample=_resample_wild):
+    """Dispatch one batch of replications, mesh-sharded when a mesh is given."""
+    return _dispatch_reps(
+        _bootstrap_core, _sharded_core, mesh, n_reps,
+        (yw, key, nlag, horizon), (resample,),
+    )
 
 
 def _bootstrap_driver(
@@ -399,3 +418,138 @@ def block_bootstrap_irfs(
         y, nlag, initperiod, lastperiod, horizon, n_reps, seed,
         quantile_levels, mesh, backend, _block_resampler(int(block)),
     )
+
+
+# ---------------------------------------------------------------------------
+# bootstrap forecast fans (frequentist counterpart of bayes.posterior_forecast)
+# ---------------------------------------------------------------------------
+
+
+class ForecastFan(NamedTuple):
+    point: jnp.ndarray  # (horizon, ns) deterministic iterated forecast
+    draws: jnp.ndarray  # (n_reps, horizon, ns) parameter + shock draws
+    quantiles: jnp.ndarray  # (nq, horizon, ns)
+    quantile_levels: np.ndarray
+
+
+@partial(jax.jit, static_argnames=("nlag", "horizon", "n_reps"))
+def _fan_core(yw, key, nlag: int, horizon: int, n_reps: int):
+    """One fan draw = refit on a wild-resampled panel (parameter
+    uncertainty) + a forward simulation with wild-resampled future shocks
+    from the refit residuals (shock uncertainty), seeded from the ACTUAL
+    last nlag observations."""
+    betahat, ehat, _ = _fit_dense_var(yw, nlag)
+    y_init = yw[:nlag]
+    y_last = yw[-nlag:]
+    Te = ehat.shape[0]
+
+    def one_rep(k):
+        k1, k2, k3 = jax.random.split(k, 3)
+        ystar = _wild_recursion(y_init, betahat, _resample_wild(k1, ehat), nlag)
+        b_star, e_star, _ = _fit_dense_var(ystar, nlag)
+        idx = jax.random.randint(k2, (horizon,), 0, Te)
+        signs = jax.random.rademacher(k3, (horizon,), dtype=yw.dtype)
+        e_fut = e_star[idx] * signs[:, None]
+        return _wild_recursion(y_last, b_star, e_fut, nlag)[nlag:]
+
+    keys = jax.random.split(key, n_reps)
+    return jax.vmap(one_rep)(keys)
+
+
+@lru_cache(maxsize=8)
+def _sharded_fan_core(out_sharding):
+    return jax.jit(
+        _fan_core,
+        static_argnames=("nlag", "horizon", "n_reps"),
+        out_shardings=out_sharding,
+    )
+
+
+def bootstrap_forecast_fan(
+    y,
+    nlag: int,
+    initperiod: int,
+    lastperiod: int,
+    horizon: int = 8,
+    n_reps: int = 1000,
+    seed: int = 0,
+    quantile_levels=(0.05, 0.16, 0.5, 0.84, 0.95),
+    mesh=None,
+    backend: str | None = None,
+) -> ForecastFan:
+    """Bootstrap forecast fan ("fan chart") for a VAR system — e.g. the
+    estimated factors: predictive bands carrying BOTH parameter uncertainty
+    (each draw refits the VAR on a wild-resampled panel, exactly the
+    `wild_bootstrap_irfs` scheme) and future-shock uncertainty (forward
+    simulation with wild-resampled residuals).  The frequentist counterpart
+    of `bayes.posterior_forecast`; replications shard over the mesh's
+    "rep" axis like every other bootstrap here.
+
+    The point path is the deterministic iterated forecast from the actual
+    last `nlag` rows (identical to `forecast.forecast_factors` on the same
+    VAR); the fan's median tracks it.
+    """
+    with on_backend(backend):
+        yw = _prepare_window(y, initperiod, lastperiod)
+        betahat, _, _ = _fit_dense_var(yw, nlag)
+        point = _wild_recursion(
+            yw[-nlag:], betahat,
+            jnp.zeros((horizon, yw.shape[1]), yw.dtype), nlag,
+        )[nlag:]
+
+        key = jax.random.PRNGKey(seed)
+        if mesh is None and len(jax.devices()) > 1:
+            mesh = make_mesh()
+        draws = _dispatch_reps(
+            _fan_core, _sharded_fan_core, mesh, n_reps, (yw, key, nlag, horizon)
+        )
+        q = jnp.quantile(draws, jnp.asarray(quantile_levels), axis=0)
+        return ForecastFan(point, draws, q, np.asarray(quantile_levels))
+
+
+class SeriesFan(NamedTuple):
+    """Per-series predictive fan (no shock axis, unlike SeriesIRFs)."""
+
+    point: jnp.ndarray  # (nsel, horizon)
+    quantiles: jnp.ndarray  # (nq, nsel, horizon)
+    quantile_levels: np.ndarray
+
+
+def series_forecast_fan(
+    fan: ForecastFan,
+    lam,
+    const=None,
+    series_idx=None,
+    quantile_levels=None,
+) -> SeriesFan:
+    """Push a factor forecast fan through the loadings to per-series
+    predictive bands: draws (d, h, r) @ lam' (+ const) -> (d, h, nsel),
+    quantiles recomputed in series space.  `lam`/`const` in original data
+    units (`DFMResults.lam`/`lam_const`) give original-unit fan charts.
+    """
+    lam = jnp.asarray(lam)
+    if lam.shape[-1] != fan.point.shape[1]:
+        raise ValueError(
+            f"loadings have {lam.shape[-1]} factor columns; the fan system "
+            f"has {fan.point.shape[1]} variables"
+        )
+    if const is None:
+        c = jnp.zeros(lam.shape[0], lam.dtype)
+    else:
+        c = jnp.atleast_1d(jnp.asarray(const))
+        if c.shape[0] == 1:
+            c = jnp.broadcast_to(c, (lam.shape[0],))
+        elif c.shape[0] != lam.shape[0]:
+            raise ValueError(
+                f"const has {c.shape[0]} entries for {lam.shape[0]} series"
+            )
+    if series_idx is not None:
+        idx = _validate_series_idx(lam.shape[0], series_idx)
+        lam, c = lam[idx], c[idx]
+    if quantile_levels is None:
+        quantile_levels = fan.quantile_levels
+
+    point = fan.point @ lam.T + c[None, :]  # (h, nsel)
+    draws = jnp.einsum("dhk,nk->dhn", fan.draws, lam) + c[None, None, :]
+    q = jnp.quantile(draws, jnp.asarray(quantile_levels), axis=0)
+    return SeriesFan(point.T, jnp.moveaxis(q, 2, 1), np.asarray(quantile_levels))

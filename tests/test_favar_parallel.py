@@ -218,3 +218,94 @@ class TestBlockBootstrap:
         y = np.random.default_rng(1).standard_normal((50, 2))
         with pytest.raises(ValueError, match="block"):
             block_bootstrap_irfs(jnp.asarray(y), 1, 0, 49, n_reps=4, block=0)
+
+
+class TestForecastFan:
+    """Bootstrap forecast fans (parameter + shock uncertainty)."""
+
+    @staticmethod
+    def _ar_panel(T=360, seed=5):
+        rng = np.random.default_rng(seed)
+        A1 = np.array([[0.6, 0.1], [0.0, 0.5]])
+        y = np.zeros((T, 2))
+        for t in range(1, T):
+            y[t] = A1 @ y[t - 1] + rng.standard_normal(2)
+        return y
+
+    def test_point_matches_forecast_factors_and_median_tracks(self):
+        from dynamic_factor_models_tpu.models.favar import bootstrap_forecast_fan
+        from dynamic_factor_models_tpu.models.forecast import forecast_factors
+        from dynamic_factor_models_tpu.models.var import estimate_var
+
+        y = self._ar_panel()
+        fan = bootstrap_forecast_fan(jnp.asarray(y), 1, 0, 299, horizon=8,
+                                     n_reps=200, seed=0)
+        var = estimate_var(jnp.asarray(y[:300]), 1)
+        path = forecast_factors(var, jnp.asarray(y[:300]), 8)
+        np.testing.assert_allclose(np.asarray(fan.point), np.asarray(path),
+                                   atol=1e-8)
+        med = np.asarray(fan.quantiles[2])
+        assert np.abs(med - np.asarray(fan.point)).max() < 0.5
+        assert (np.diff(np.asarray(fan.quantiles), axis=0) >= -1e-12).all()
+
+    def test_band_covers_realized_future(self):
+        from dynamic_factor_models_tpu.models.favar import bootstrap_forecast_fan
+
+        hits, total = 0, 0
+        for seed in range(4):
+            y = self._ar_panel(seed=seed)
+            fan = bootstrap_forecast_fan(jnp.asarray(y), 1, 0, 299, horizon=8,
+                                         n_reps=300, seed=seed)
+            lo, hi = np.asarray(fan.quantiles[0]), np.asarray(fan.quantiles[-1])
+            realized = y[300:308]
+            hits += ((realized >= lo) & (realized <= hi)).sum()
+            total += realized.size
+        cover = hits / total
+        assert 0.75 < cover <= 1.0, f"5-95% fan coverage {cover}"
+
+    def test_series_fan_contraction(self):
+        from dynamic_factor_models_tpu.models.favar import (
+            bootstrap_forecast_fan,
+            series_forecast_fan,
+        )
+
+        y = self._ar_panel()
+        fan = bootstrap_forecast_fan(jnp.asarray(y), 1, 0, 299, horizon=6,
+                                     n_reps=100, seed=1)
+        lam = np.random.default_rng(0).standard_normal((7, 2))
+        const = np.arange(7.0)
+        s = series_forecast_fan(fan, lam, const=const)
+        assert s.point.shape == (7, 6)
+        assert s.quantiles.shape == (5, 7, 6)
+        np.testing.assert_allclose(
+            np.asarray(s.point),
+            (np.asarray(fan.point) @ lam.T + const[None, :]).T,
+            atol=1e-10,
+        )
+        sub = series_forecast_fan(fan, lam, const=const, series_idx=[2, 4])
+        np.testing.assert_allclose(np.asarray(sub.point),
+                                   np.asarray(s.point)[[2, 4]], atol=1e-12)
+        with pytest.raises(ValueError, match="factor columns"):
+            series_forecast_fan(fan, np.zeros((3, 5)))
+        with pytest.raises(ValueError, match="const"):
+            series_forecast_fan(fan, lam, const=np.zeros(3))
+        # scalar const broadcasts instead of crashing
+        sc = series_forecast_fan(fan, lam, const=2.0)
+        np.testing.assert_allclose(
+            np.asarray(sc.point),
+            (np.asarray(fan.point) @ lam.T + 2.0).T,
+            atol=1e-10,
+        )
+
+    def test_fan_sharded_equals_unsharded(self):
+        from dynamic_factor_models_tpu.models.favar import bootstrap_forecast_fan
+
+        y = self._ar_panel()
+        mesh = make_mesh(8, ("rep",))
+        f_sh = bootstrap_forecast_fan(jnp.asarray(y), 1, 0, 299, horizon=4,
+                                      n_reps=64, seed=2, mesh=mesh)
+        f_1 = bootstrap_forecast_fan(jnp.asarray(y), 1, 0, 299, horizon=4,
+                                     n_reps=64, seed=2, mesh=None)
+        np.testing.assert_allclose(np.asarray(f_sh.draws), np.asarray(f_1.draws),
+                                   atol=1e-10)
+        assert "rep" in str(f_sh.draws.sharding)
