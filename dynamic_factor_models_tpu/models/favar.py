@@ -242,6 +242,13 @@ def _prepare_window(y, initperiod: int, lastperiod: int) -> jnp.ndarray:
     return yw[first:]
 
 
+def _default_mesh(mesh):
+    """All local devices on a 1-D "rep" mesh unless the caller chose one."""
+    if mesh is None and len(jax.devices()) > 1:
+        return make_mesh()
+    return mesh
+
+
 def _dispatch_reps(core_fn, sharded_factory, mesh, n_reps, args_before, args_after=()):
     """Shared mesh pad-and-slice dispatch for every rep-vmapped core: round
     n_reps up to a device multiple, jit with a "rep" out-sharding, slice
@@ -276,8 +283,7 @@ def _bootstrap_driver(
         point = impulse_response(var, "all", horizon)
 
         key = jax.random.PRNGKey(seed)
-        if mesh is None and len(jax.devices()) > 1:
-            mesh = make_mesh()
+        mesh = _default_mesh(mesh)
         # the replication program is embarrassingly parallel: GSPMD shards the
         # vmapped body over the mesh's "rep" axis
         draws = _run_core(yw, key, nlag, horizon, n_reps, mesh, resample)
@@ -347,8 +353,7 @@ def wild_bootstrap_irfs_resumable(
         yw = _prepare_window(y, initperiod, lastperiod)
         var = estimate_var(yw, nlag, 0, yw.shape[0] - 1, withconst=True)
         point = impulse_response(var, "all", horizon)
-        if mesh is None and len(jax.devices()) > 1:
-            mesh = make_mesh()
+        mesh = _default_mesh(mesh)
 
         spec = np.asarray([seed, chunk_reps, nlag, initperiod, lastperiod, horizon])
         fingerprint = hashlib.sha1(
@@ -498,8 +503,7 @@ def bootstrap_forecast_fan(
         )[nlag:]
 
         key = jax.random.PRNGKey(seed)
-        if mesh is None and len(jax.devices()) > 1:
-            mesh = make_mesh()
+        mesh = _default_mesh(mesh)
         draws = _dispatch_reps(
             _fan_core, _sharded_fan_core, mesh, n_reps, (yw, key, nlag, horizon)
         )

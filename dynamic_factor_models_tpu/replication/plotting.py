@@ -256,7 +256,12 @@ def render_extras(
 
     # series-space FAVAR bands: bootstrap draws of the factor IRFs pushed
     # through the loadings — response of GDP to the first recursive shock
-    from ..models import series_irfs, wild_bootstrap_irfs
+    from ..models import (
+        bootstrap_forecast_fan,
+        series_forecast_fan,
+        series_irfs,
+        wild_bootstrap_irfs,
+    )
 
     boot = wild_bootstrap_irfs(res.factor, cfg.n_factorlag, i0, i1,
                                horizon=16, n_reps=400, seed=0)
@@ -269,6 +274,22 @@ def render_extras(
         "5%": sq[0], "median": sq[2], "95%": sq[-1],
     }, "GDPC96 response to shock 1 (wild-bootstrap 5-95% band)")
     save(fig, "extra_series_irf_band.png")
+
+    # forecast fan chart: factor fan (parameter + shock uncertainty)
+    # pushed through the loadings to GDP, original units
+    fan = bootstrap_forecast_fan(res.factor, cfg.n_factorlag, i0, i1,
+                                 horizon=12, n_reps=400, seed=0)
+    sf = series_forecast_fan(
+        fan, jnp.nan_to_num(res.lam), const=jnp.nan_to_num(res.lam_const),
+        series_idx=[j_gdp],
+    )
+    fq = np.asarray(sf.quantiles)[:, 0, :]
+    fig, ax = plt.subplots(figsize=(8, 4))
+    line_panel(ax, np.arange(1, fq.shape[1] + 1), {
+        "point": np.asarray(sf.point)[0],
+        "5%": fq[0], "median": fq[2], "95%": fq[-1],
+    }, "GDPC96 common-component fan chart (bootstrap 5-95%)")
+    save(fig, "extra_forecast_fan.png")
 
     # coherence with the first included series across frequencies
     freqs, coh2, _ = coherence(ds_real.bpdata, M=24)

@@ -210,6 +210,7 @@ def test_render_extras_writes_capability_panels(tmp_path):
     names = sorted(os.path.basename(p) for p in written)
     assert names == [
         "extra_coherence.png",
+        "extra_forecast_fan.png",
         "extra_posterior_irf.png",
         "extra_series_irf_band.png",
         "extra_sv_volatility.png",
