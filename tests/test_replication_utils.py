@@ -217,3 +217,38 @@ def test_render_extras_writes_capability_panels(tmp_path):
         "extra_tvp_loadings.png",
     ]
     assert all(os.path.getsize(p) > 10_000 for p in written)
+
+
+def test_checkpoint_roundtrip_new_result_types(tmp_path):
+    """Every major round-3 result type survives the pytree npz round-trip
+    (fitted-model persistence, SURVEY.md section 5.4)."""
+    import jax.numpy as jnp
+
+    from dynamic_factor_models_tpu.models.favar import (
+        ForecastFan,
+        bootstrap_forecast_fan,
+    )
+    from dynamic_factor_models_tpu.models.multilevel import estimate_multilevel_dfm
+
+    rng = np.random.default_rng(0)
+    y = np.zeros((120, 2))
+    for t in range(1, 120):
+        y[t] = 0.5 * y[t - 1] + rng.standard_normal(2)
+    fan = bootstrap_forecast_fan(jnp.asarray(y), 1, 0, 119, horizon=4, n_reps=16)
+    p1 = str(tmp_path / "fan.npz")
+    save_pytree(p1, fan)
+    fan2 = load_pytree(p1, fan)
+    np.testing.assert_array_equal(np.asarray(fan.quantiles), np.asarray(fan2.quantiles))
+
+    x = np.hstack([y + rng.standard_normal((120, 2)), y @ rng.standard_normal((2, 2))])
+    ml = estimate_multilevel_dfm(x, [np.arange(2), np.arange(2, 4)], 1, 1)
+    p2 = str(tmp_path / "ml.npz")
+    save_pytree(p2, ml)
+    ml2 = load_pytree(p2, ml)
+    np.testing.assert_array_equal(
+        np.asarray(ml.global_factors), np.asarray(ml2.global_factors)
+    )
+    np.testing.assert_array_equal(np.asarray(ml.stds), np.asarray(ml2.stds))
+    for a, b in zip(ml.block_factors, ml2.block_factors):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert ml2.variance_decomposition.keys() == ml.variance_decomposition.keys()
