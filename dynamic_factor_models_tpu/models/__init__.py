@@ -26,8 +26,10 @@ from .var import (
 from .selection import (
     FactorNumberEstimateStats,
     ahn_horenstein_er,
+    ahn_horenstein_gr,
     amengual_watson_test,
     bai_ng_criterion,
+    bai_ng_criterion_variant,
     estimate_factor_numbers,
     onatski_ed,
 )

@@ -26,9 +26,11 @@ from .dfm import DFMConfig, FactorEstimateStats, estimate_factor_batch
 
 __all__ = [
     "bai_ng_criterion",
+    "bai_ng_criterion_variant",
     "amengual_watson_test",
     "estimate_factor_numbers",
     "ahn_horenstein_er",
+    "ahn_horenstein_gr",
     "onatski_ed",
     "FactorNumberEstimateStats",
 ]
@@ -36,8 +38,32 @@ __all__ = [
 
 def bai_ng_criterion(fes: FactorEstimateStats, nfac_t: int) -> jnp.ndarray:
     """Bai-Ng ICp2 with unbalanced-panel-adjusted counts (reference cell 35)."""
+    return bai_ng_criterion_variant(fes, nfac_t, "icp2")
+
+
+def bai_ng_criterion_variant(
+    fes: FactorEstimateStats, nfac_t: int, variant: str = "icp2"
+) -> jnp.ndarray:
+    """All three Bai-Ng (2002, Econometrica 70(1) eq. 9) ICp penalties with
+    the same unbalanced-count convention as `bai_ng_criterion`:
+
+        icp1: g = log(nobs/(nbar+T)) * (nbar+T)/nobs
+        icp2: g = log(min(nbar, T)) * (nbar+T)/nobs     (the reference's)
+        icp3: g = log(min(nbar, T)) / min(nbar, T)
+
+    ICp2 penalizes hardest in typical macro panels; ICp3 is the most
+    liberal.  All three are consistent under the paper's assumptions.
+    """
     nbar = fes.nobs / fes.T
-    g = jnp.log(jnp.minimum(nbar, fes.T)) * (nbar + fes.T) / fes.nobs
+    c2 = jnp.minimum(nbar, fes.T)
+    if variant == "icp1":
+        g = jnp.log(fes.nobs / (nbar + fes.T)) * (nbar + fes.T) / fes.nobs
+    elif variant == "icp2":
+        g = jnp.log(c2) * (nbar + fes.T) / fes.nobs
+    elif variant == "icp3":
+        g = jnp.log(c2) / c2
+    else:
+        raise ValueError(f"variant must be icp1/icp2/icp3, got {variant!r}")
     return jnp.log(fes.ssr / fes.nobs) + nfac_t * g
 
 
@@ -68,6 +94,32 @@ def ahn_horenstein_er(marginal_r2: np.ndarray) -> np.ndarray:
     """Ahn-Horenstein eigenvalue-ratio criterion from marginal trace R^2
     (driver cell 31/35 convention: ER_r = margR2_r / margR2_{r+1})."""
     return marginal_r2[:-1] / marginal_r2[1:]
+
+
+def ahn_horenstein_gr(marginal_r2: np.ndarray) -> np.ndarray:
+    """Ahn-Horenstein (2013, Econometrica 81(3)) GROWTH-ratio criterion,
+    the companion to ER on the same marginal shares:
+
+        GR_r = log(V_{r-1}/V_r) / log(V_r/V_{r+1}),
+        V_r  = 1 - sum_{j<=r} share_j  (variance left after r factors).
+
+    `marginal_r2` entries must be FRACTIONS OF TOTAL panel variance
+    (`FactorNumberEstimateStats.marginal_r2` or eigenvalue shares) so V_r
+    keeps the idiosyncratic remainder — a truncated max_nfac sweep then
+    yields finite values at every r, unlike a total-of-the-passed-shares
+    normalization whose V_R collapses to 0.  Entries where V hits zero
+    (e.g. the last step of an exhaustive full-spectrum decomposition) are
+    returned as NaN, never inf — nanargmax-safe.  Like ER, pick the r that
+    maximizes GR; more robust than ER when the eigenvalue tail decays
+    slowly (their Monte Carlos).
+    """
+    m = np.asarray(marginal_r2, dtype=float)
+    V = 1.0 - np.concatenate([[0.0], np.cumsum(m)])  # V_0..V_R
+    with np.errstate(divide="ignore", invalid="ignore"):
+        num = np.log(V[:-2] / V[1:-1])
+        den = np.log(V[1:-1] / V[2:])
+        gr = np.where((V[1:-1] > 0) & (V[2:] > 0), num / den, np.nan)
+    return gr
 
 
 def amengual_watson_test(

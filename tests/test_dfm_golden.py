@@ -135,3 +135,67 @@ class TestOnatskiED:
 
         with pytest.raises(ValueError, match="rmax"):
             onatski_ed(np.random.default_rng(0).standard_normal((50, 10)), rmax=10)
+
+
+class TestSelectionVariants:
+    """Bai-Ng ICp1/ICp3 and Ahn-Horenstein GR alongside the reference's
+    ICp2/ER, on a synthetic panel with a known factor count."""
+
+    @staticmethod
+    def _panel(r_true=3, T=250, N=60, seed=0):
+        rng = np.random.default_rng(seed)
+        f = rng.standard_normal((T, r_true))
+        lam = 1.5 * rng.standard_normal((N, r_true))
+        return f @ lam.T + rng.standard_normal((T, N))
+
+    def test_all_icp_variants_recover_true_r(self):
+        import jax.numpy as jnp
+
+        from dynamic_factor_models_tpu.models import (
+            DFMConfig,
+            bai_ng_criterion,
+            bai_ng_criterion_variant,
+            estimate_factor,
+        )
+
+        x = self._panel()
+        ones = np.ones(x.shape[1], np.int64)
+        vals = {v: [] for v in ("icp1", "icp2", "icp3")}
+        for r in range(1, 7):
+            _, fes = estimate_factor(
+                jnp.asarray(x), ones, 0, x.shape[0] - 1,
+                DFMConfig(nfac_u=r, tol=1e-8, max_iter=2000),
+            )
+            for v in vals:
+                vals[v].append(float(bai_ng_criterion_variant(fes, r, v)))
+            # the icp2 variant IS the reference criterion
+            np.testing.assert_allclose(
+                vals["icp2"][-1], float(bai_ng_criterion(fes, r)), rtol=1e-12
+            )
+        for v, seq in vals.items():
+            assert int(np.argmin(seq)) + 1 == 3, f"{v} picked {np.argmin(seq)+1}"
+        with pytest.raises(ValueError, match="variant"):
+            bai_ng_criterion_variant(fes, 1, "icp9")
+
+    def test_growth_ratio_agrees_with_er_on_sharp_structure(self):
+        from dynamic_factor_models_tpu.models import (
+            ahn_horenstein_er,
+            ahn_horenstein_gr,
+        )
+
+        x = self._panel()
+        xz = (x - x.mean(0)) / x.std(0)
+        ev = np.linalg.eigvalsh(xz.T @ xz / x.shape[0])[::-1]
+        shares = ev / ev.sum()
+        er = ahn_horenstein_er(shares)
+        gr = ahn_horenstein_gr(shares)
+        assert gr.shape == (shares.size - 1,)  # GR_1..GR_{R-1}
+        assert int(np.argmax(er[:8])) + 1 == 3
+        assert int(np.nanargmax(gr)) + 1 == 3
+        # full-spectrum input: only the terminal V=0 step may be NaN
+        assert np.isfinite(gr[:-1]).all()
+        # truncated marginal shares (the estimate_factor_numbers shape)
+        # stay finite everywhere: V keeps the idiosyncratic remainder
+        gr_trunc = ahn_horenstein_gr(shares[:10])
+        assert np.isfinite(gr_trunc).all()
+        assert int(np.nanargmax(gr_trunc)) + 1 == 3
