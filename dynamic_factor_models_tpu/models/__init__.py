@@ -58,7 +58,13 @@ from .favar import (
     wild_bootstrap_irfs,
     wild_bootstrap_irfs_resumable,
 )
-from .dynpca import DynamicPCAResults, coherence, dynamic_pca, spectral_density
+from .dynpca import (
+    DynamicPCAResults,
+    coherence,
+    dynamic_pca,
+    forecast_common_component,
+    spectral_density,
+)
 from .multilevel import (
     MultilevelIRFs,
     MultilevelResults,

@@ -38,6 +38,7 @@ __all__ = [
     "spectral_density",
     "dynamic_pca",
     "dynamic_eigenvalue_shares",
+    "forecast_common_component",
     "one_sided_common_component",
     "coherence",
 ]
@@ -207,46 +208,89 @@ def one_sided_common_component(
     DynamicPCAResults.
     """
     with on_backend(backend):
-        x = jnp.asarray(x)
-        if M >= x.shape[0]:
-            raise ValueError(
-                f"lag-window half-width M={M} must be smaller than T={x.shape[0]}"
-            )
-        if not 1 <= q <= x.shape[1]:
-            raise ValueError(f"q={q} out of range for an N={x.shape[1]} panel")
-        if not 1 <= r <= x.shape[1]:
-            raise ValueError(f"r={r} static factors out of range for N={x.shape[1]}")
-        # one standardization + one spectral pass, shared with the two-sided
-        # results we also return (only the cheap lag-0 moment is recomputed)
-        xstd, _ = standardize_data(x)
-        m = mask_of(xstd).astype(xstd.dtype)
-        xz = fillz(xstd)
-        freqs, evals, cspec, cacov, chi2s, share = _dynpca_core(xz, m, M, q)
-        res = DynamicPCAResults(freqs, evals, cspec, cacov, chi2s, share, q, M)
-
-        gamma_x0 = _masked_autocovariances(xz, m, 0)[0]
-        gamma_x0 = 0.5 * (gamma_x0 + gamma_x0.T)
+        xz, gamma_x0, W, res = _one_sided_pieces(x, q, r, M)
         gamma_chi0 = res.common_autocov[0]
         gamma_chi0 = 0.5 * (gamma_chi0 + gamma_chi0.T)
-        gamma_xi0 = gamma_x0 - gamma_chi0
-
-        # generalized symmetric eigenproblem via the idio Cholesky transform;
-        # floor Gamma_xi to keep it PD (it is an estimate, PSD up to error)
-        e, v = jnp.linalg.eigh(gamma_xi0)
-        eps = jnp.asarray(jnp.finfo(e.dtype).eps, e.dtype)
-        e = jnp.maximum(e, jnp.maximum(e[-1] * 16.0 * eps, eps))
-        gamma_xi0 = (v * e) @ v.T
-        L = jnp.linalg.cholesky(gamma_xi0)
-        # A = L^{-1} Gamma_chi L^{-T} via two triangular solves
-        A = jsl.solve_triangular(L, gamma_chi0, lower=True)
-        A = jsl.solve_triangular(L, A.T, lower=True).T
-        ew, U = jnp.linalg.eigh(0.5 * (A + A.T))
-        W = jsl.solve_triangular(L, U[:, ::-1][:, :r], lower=True, trans=1)  # L^{-T} U
-
         Z = xz @ W  # (T, r) static factors, current observations only
         proj = gamma_chi0 @ W @ jnp.linalg.pinv(W.T @ gamma_x0 @ W)
         chi = Z @ proj.T  # (T, N)
         return chi, W, proj, res
+
+
+def _one_sided_pieces(x, q: int, r: int, M: int):
+    """Shared frame of the FHLR one-sided estimator/forecaster: standardized
+    panel, Gamma_x(0), the generalized eigenvectors W of
+    (Gamma_chi(0), Gamma_xi(0)), and the two-sided spectral results."""
+    x = jnp.asarray(x)
+    if M >= x.shape[0]:
+        raise ValueError(
+            f"lag-window half-width M={M} must be smaller than T={x.shape[0]}"
+        )
+    if not 1 <= q <= x.shape[1]:
+        raise ValueError(f"q={q} out of range for an N={x.shape[1]} panel")
+    if not 1 <= r <= x.shape[1]:
+        raise ValueError(f"r={r} static factors out of range for N={x.shape[1]}")
+    # one standardization + one spectral pass, shared with the two-sided
+    # results we also return (only the cheap lag-0 moment is recomputed)
+    xstd, _ = standardize_data(x)
+    m = mask_of(xstd).astype(xstd.dtype)
+    xz = fillz(xstd)
+    freqs, evals, cspec, cacov, chi2s, share = _dynpca_core(xz, m, M, q)
+    res = DynamicPCAResults(freqs, evals, cspec, cacov, chi2s, share, q, M)
+
+    gamma_x0 = _masked_autocovariances(xz, m, 0)[0]
+    gamma_x0 = 0.5 * (gamma_x0 + gamma_x0.T)
+    gamma_chi0 = res.common_autocov[0]
+    gamma_chi0 = 0.5 * (gamma_chi0 + gamma_chi0.T)
+    gamma_xi0 = gamma_x0 - gamma_chi0
+
+    # generalized symmetric eigenproblem via the idio Cholesky transform;
+    # floor Gamma_xi to keep it PD (it is an estimate, PSD up to error)
+    e, v = jnp.linalg.eigh(gamma_xi0)
+    eps = jnp.asarray(jnp.finfo(e.dtype).eps, e.dtype)
+    e = jnp.maximum(e, jnp.maximum(e[-1] * 16.0 * eps, eps))
+    gamma_xi0 = (v * e) @ v.T
+    L = jnp.linalg.cholesky(gamma_xi0)
+    # A = L^{-1} Gamma_chi L^{-T} via two triangular solves
+    A = jsl.solve_triangular(L, gamma_chi0, lower=True)
+    A = jsl.solve_triangular(L, A.T, lower=True).T
+    ew, U = jnp.linalg.eigh(0.5 * (A + A.T))
+    W = jsl.solve_triangular(L, U[:, ::-1][:, :r], lower=True, trans=1)  # L^{-T} U
+    return xz, gamma_x0, W, res
+
+
+def forecast_common_component(
+    x,
+    q: int,
+    r: int,
+    h: int,
+    M: int = 20,
+    backend: str | None = None,
+):
+    """FHLR (2005, JASA 100(471)) h-step forecast of the common component:
+    the one-sided projection with the lag-h common autocovariance,
+
+        chi_{t+h|t} = Gamma_chi(h) W (W' Gamma_x(0) W)^{-1} W' x_t,
+
+    valid because the idiosyncratic component is orthogonal to chi at all
+    leads/lags, so Cov(chi_{t+h}, W'x_t) = Gamma_chi(h) W.  h=0 reduces to
+    `one_sided_common_component` (pinned by tests).  h must lie in [0, M]
+    (the lag window bounds the estimated autocovariances).
+
+    Returns (chi_forecast (T, N) with row t = forecast of chi_{t+h} made at
+    t, proj_h (N, r), results): standardized units, causal row-by-row like
+    the one-sided estimator.
+    """
+    if not 0 <= h <= M:
+        raise ValueError(f"h={h} must lie in [0, M={M}]")
+    with on_backend(backend):
+        xz, gamma_x0, W, res = _one_sided_pieces(x, q, r, M)
+        gamma_chi_h = res.common_autocov[h]  # E[chi_t chi_{t-h}']
+        if h == 0:
+            gamma_chi_h = 0.5 * (gamma_chi_h + gamma_chi_h.T)  # exact h=0 match
+        proj_h = gamma_chi_h @ W @ jnp.linalg.pinv(W.T @ gamma_x0 @ W)
+        chi_f = (xz @ W) @ proj_h.T
+        return chi_f, proj_h, res
 
 
 def coherence(x, M: int = 20, backend: str | None = None):

@@ -8,6 +8,8 @@ import pytest
 from dynamic_factor_models_tpu.models.dynpca import (
     dynamic_eigenvalue_shares,
     dynamic_pca,
+    forecast_common_component,
+    one_sided_common_component,
     spectral_density,
 )
 from dynamic_factor_models_tpu.models.multilevel import estimate_multilevel_dfm
@@ -107,8 +109,6 @@ def test_multilevel_rejects_overlapping_blocks(two_level_panel):
 
 
 def test_one_sided_common_component_recovers_dgp(rng):
-    from dynamic_factor_models_tpu.models.dynpca import one_sided_common_component
-
     # dynamic one-factor DGP: x_it = a_i f_t + b_i f_{t-1} + xi_it
     T, N = 400, 40
     f = np.zeros(T)
@@ -269,3 +269,33 @@ class TestMultilevelSeriesIRFs:
             for s in out.series
         ]
         assert 0.6 < resp[0] / resp[1] < 1.6, f"spurious asymmetry: {resp}"
+
+
+def test_forecast_common_component_fhlr(rng):
+    """FHLR (2005) h-step common-component forecast: h=0 reduces exactly to
+    the one-sided estimator; at h=1,2 a persistent factor stays predictable
+    and the forecast beats the unconditional zero forecast in MSE."""
+    T, N = 500, 30
+    f = np.zeros(T)
+    for t in range(1, T):
+        f[t] = 0.8 * f[t - 1] + rng.standard_normal() * 0.6
+    b = rng.standard_normal(N)
+    chi_true = np.outer(f, b)
+    x = chi_true + 0.6 * rng.standard_normal((T, N))
+
+    chi0, W, proj0, _ = one_sided_common_component(x, q=1, r=1, M=24)
+    chi_h0, proj_h0, _ = forecast_common_component(x, q=1, r=1, h=0, M=24)
+    np.testing.assert_allclose(np.asarray(chi_h0), np.asarray(chi0), atol=1e-8)
+
+    std = x.std(0, ddof=1) * np.sqrt((T - 1) / T)
+    chi_std = (chi_true - chi_true.mean(0)) / std
+    for h in (1, 2):
+        chi_f = np.asarray(forecast_common_component(x, q=1, r=1, h=h, M=24)[0])
+        pred, real = chi_f[24:-h], chi_std[24 + h :]
+        corr = np.corrcoef(pred.ravel(), real.ravel())[0, 1]
+        assert corr > 0.55, f"h={h}: corr {corr:.3f}"
+        mse = ((pred - real) ** 2).mean()
+        assert mse < (real**2).mean(), f"h={h}: no gain over zero forecast"
+
+    with pytest.raises(ValueError, match="h="):
+        forecast_common_component(x, q=1, r=1, h=99, M=24)
