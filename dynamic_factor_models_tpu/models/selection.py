@@ -89,6 +89,28 @@ class FactorNumberEstimateStats(NamedTuple):
         tr = self.trace_r2
         return np.concatenate([tr[:1], np.diff(tr)])
 
+    def icp(self, variant: str = "icp2") -> np.ndarray:
+        """Bai-Ng criterion values over the sweep for any ICp variant
+        (same penalties as `bai_ng_criterion_variant`, computed in f64
+        numpy like `bn_icp`, so icp("icp2") == bn_icp unconditionally)."""
+        nbar = self.nobs / self.T
+        c2 = min(nbar, self.T)
+        if variant == "icp1":
+            g = np.log(self.nobs / (nbar + self.T)) * (nbar + self.T) / self.nobs
+        elif variant == "icp2":
+            g = np.log(c2) * (nbar + self.T) / self.nobs
+        elif variant == "icp3":
+            g = np.log(c2) / c2
+        else:
+            raise ValueError(f"variant must be icp1/icp2/icp3, got {variant!r}")
+        nfacs = np.arange(1, len(self.ssr_static) + 1)
+        return np.log(np.asarray(self.ssr_static) / self.nobs) + nfacs * g
+
+    @property
+    def growth_ratio(self) -> np.ndarray:
+        """Ahn-Horenstein GR over the sweep's marginal trace-R^2 shares."""
+        return ahn_horenstein_gr(self.marginal_r2)
+
 
 def ahn_horenstein_er(marginal_r2: np.ndarray) -> np.ndarray:
     """Ahn-Horenstein eigenvalue-ratio criterion from marginal trace R^2

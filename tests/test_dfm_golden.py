@@ -199,3 +199,22 @@ class TestSelectionVariants:
         gr_trunc = ahn_horenstein_gr(shares[:10])
         assert np.isfinite(gr_trunc).all()
         assert int(np.nanargmax(gr_trunc)) + 1 == 3
+
+    def test_sweep_bundle_exposes_variants(self):
+        import jax.numpy as jnp
+
+        from dynamic_factor_models_tpu.models import (
+            DFMConfig,
+            estimate_factor_numbers,
+        )
+
+        x = self._panel()
+        stats = estimate_factor_numbers(
+            jnp.asarray(x), np.ones(x.shape[1], np.int64), 0, x.shape[0] - 1,
+            DFMConfig(tol=1e-8, max_iter=2000), max_nfac=6, dynamic=False,
+        )
+        np.testing.assert_allclose(stats.icp("icp2"), stats.bn_icp, rtol=1e-10)
+        assert int(np.argmin(stats.icp("icp1"))) + 1 == 3
+        gr = stats.growth_ratio
+        assert np.isfinite(gr).all()  # truncated sweep: V keeps the idio tail
+        assert int(np.nanargmax(gr)) + 1 == 3
