@@ -94,19 +94,19 @@ def als_iter_flops(T: int, N: int, r: int) -> float:
 
 
 def em_iter_flops(T: int, N: int, r: int, p: int) -> float:
-    """FLOPs model of one EM iteration (models/ssm.em_step).
+    """FLOPs model of one EM iteration (models/ssm.em_step_stats, the
+    collapsed production path).
 
-    E-step filter per step (information form, ssm.py module docstring):
-    C = Lam' R^-1 Lam masked is 2Nr^2, rhs 2Nr, plus ~10 k^3 for the
-    predict/Cholesky/solve block with k = r*p.  RTS smoother per step ~8k^3.
-    M-step: masked Gram 2TNr^2 + Pf contraction 2TNr^2 + residual terms
-    ~4TNr.  Constants are documented estimates — MFU derived from them is an
+    Jungbacker-Koopman collapse (ssm._collapse_obs_stats): C_t precompute
+    is one (T, N) @ (N, r(r+1)/2) GEMM ~ TNr^2, b_t one (T, N) @ (N, r)
+    GEMM ~ 2TNr; the scan body is N-free, ~10 k^3 per step for the
+    predict/Cholesky/solve block with k = r*p, RTS smoother ~8 k^3.
+    M-step (suff-stat form): packed Sff GEMM ~ TNr^2 + Sxf 2TNr.
+    Constants are documented estimates — MFU derived from them is an
     estimate for trend-tracking, not a hardware counter measurement.
     """
     k = r * p
-    per_step = 2.0 * N * r * r + 2.0 * N * r + 18.0 * k**3
-    m_step = 4.0 * T * N * r * r + 4.0 * T * N * r
-    return T * per_step + m_step
+    return 2.0 * T * N * r * r + 4.0 * T * N * r + 18.0 * T * k**3
 
 
 def _sign_align(a, b):
@@ -254,7 +254,11 @@ def large_panel_section(tpu_ok):
     import numpy as np
 
     from dynamic_factor_models_tpu.models.dfm import _als_core
-    from dynamic_factor_models_tpu.models.ssm import SSMParams, em_step
+    from dynamic_factor_models_tpu.models.ssm import (
+        SSMParams,
+        compute_panel_stats,
+        em_step_stats,
+    )
     from dynamic_factor_models_tpu.ops.linalg import pca_score, standardize_data
     from dynamic_factor_models_tpu.ops.masking import fillz, mask_of
     from dynamic_factor_models_tpu.utils.backend import on_backend
@@ -289,10 +293,15 @@ def large_panel_section(tpu_ok):
                 Q=jnp.eye(r, dtype=xz.dtype),
             )
 
+            # the production estimate_dfm_em loop threads loop-invariant
+            # PanelStats through every iteration; the bench measures the
+            # same per-iteration program
+            stats = compute_panel_stats(xz, m)
+
             def iters():
                 p = params
                 for _ in range(n_em):
-                    p, _ = em_step(p, xz, m)
+                    p, _ = em_step_stats(p, xz, m, stats)
                 return p
 
             iters().lam.block_until_ready()  # compile

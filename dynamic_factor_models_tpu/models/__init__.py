@@ -60,9 +60,11 @@ from .favar import (
 )
 from .dynpca import (
     DynamicPCAResults,
+    HallinLiskaResults,
     coherence,
     dynamic_pca,
     forecast_common_component,
+    hallin_liska_q,
     spectral_density,
 )
 from .multilevel import (

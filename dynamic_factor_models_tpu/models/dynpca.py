@@ -35,9 +35,11 @@ from ..utils.backend import on_backend
 
 __all__ = [
     "DynamicPCAResults",
+    "HallinLiskaResults",
     "spectral_density",
     "dynamic_pca",
     "dynamic_eigenvalue_shares",
+    "hallin_liska_q",
     "forecast_common_component",
     "one_sided_common_component",
     "coherence",
@@ -180,6 +182,152 @@ def dynamic_eigenvalue_shares(results: DynamicPCAResults) -> np.ndarray:
     tot = ev.sum(axis=1, keepdims=True)
     cum = np.cumsum(ev, axis=1) / tot
     return cum.mean(axis=0)
+
+
+class HallinLiskaResults(NamedTuple):
+    """Output of `hallin_liska_q`.
+
+    q: the selected number of dynamic factors (second stability interval);
+    c_grid: the penalty-constant grid; q_by_c: (n_c,) full-sample q-hat at
+    each c; stability: (n_c,) empirical variance of q-hat across the nested
+    subsamples at each c (S_c in the paper — 0 marks a stability interval);
+    q_subsamples: (J, n_c) the per-subsample selections; sub_sizes: the
+    (n_j, T_j) ladder actually used."""
+
+    q: int
+    c_grid: np.ndarray
+    q_by_c: np.ndarray
+    stability: np.ndarray
+    q_subsamples: np.ndarray
+    sub_sizes: list
+
+
+def _hl_eig_means(x: np.ndarray, M: int) -> np.ndarray:
+    """Frequency-averaged dynamic eigenvalues of a (T, N) panel: (N,),
+    descending — the ingredients of the Hallin-Liska criterion."""
+    xj = jnp.asarray(x)
+    xstd, _ = standardize_data(xj)
+    m = mask_of(xstd).astype(xstd.dtype)
+    spec, _ = _spectrum(fillz(xstd), m, M)
+    evals = jnp.linalg.eigvalsh(spec)[:, ::-1].real  # (H, N) descending
+    return np.asarray(evals.mean(axis=0))
+
+
+def hallin_liska_q(
+    x,
+    q_max: int = 10,
+    M: int | None = None,
+    n_subsamples: int = 4,
+    c_grid=None,
+    criterion: str = "log",
+    backend: str | None = None,
+) -> HallinLiskaResults:
+    """Hallin-Liska (2007, JASA 102(478)) information criterion for the
+    number q of DYNAMIC factors in a generalized dynamic factor model.
+
+    The criterion penalizes the tail of the frequency-averaged dynamic
+    eigenvalues of the lag-window spectral estimate,
+
+        IC_c(k) = crit(k) + k c p(n, T),
+        crit(k) = (1/n) sum_{j>k} mean_h lambda_j(theta_h)   ("avg")
+                  or log of that sum                          ("log"),
+        p(n, T) = (M^-2 + sqrt(M/T) + 1/n) log(min(n, M^2, sqrt(T/M))),
+
+    but its finite-sample bite depends on the undetermined constant c, so
+    HL's estimator is SELF-CALIBRATING: q-hat_j(c) is computed over a grid
+    of c on J nested subsamples (n_j, T_j), and the chosen q is the common
+    value on the SECOND stability interval of c — the first interval (c
+    near 0) trivially selects q_max everywhere, and stability means the
+    selection has zero variance across subsamples (S_c = 0) while being
+    constant in c.  This implements the full procedure, not the
+    variance-share shortcut (`dynamic_eigenvalue_shares` remains as the
+    quick diagnostic); validated on the FHLR analytic q=1 design and a
+    q=2 GDFM in tests/test_config45_validation.py.
+
+    M defaults to floor(0.75 sqrt(T_j)) per subsample (the paper's rate
+    M_T ~ T^1/2).  x: (T, N) panel, NaN missing allowed (masked moments).
+    """
+    if criterion not in ("log", "avg"):
+        raise ValueError(f"criterion must be 'log' or 'avg', got {criterion!r}")
+    if n_subsamples < 2:
+        raise ValueError("need at least 2 nested subsamples for stability")
+    with on_backend(backend):
+        x = np.asarray(x, float)
+        T, N = x.shape
+        if not 1 <= q_max < N:
+            raise ValueError(f"q_max={q_max} out of range for N={N}")
+        if c_grid is None:
+            c_grid = np.linspace(0.01, 3.0, 120)
+        c_grid = np.asarray(c_grid, float)
+        J = n_subsamples
+
+        # nested (n_j, T_j) ladder ending at the full panel (HL sec. 4)
+        sub_sizes = []
+        for j in range(1, J + 1):
+            frac = 0.7 + 0.3 * j / J
+            sub_sizes.append((int(round(N * frac)), int(round(T * frac))))
+        sub_sizes[-1] = (N, T)
+        n_min = min(n for n, _ in sub_sizes)
+        if q_max >= n_min:
+            raise ValueError(
+                f"q_max={q_max} must be smaller than the smallest nested "
+                f"subsample's series count ({n_min}); lower q_max or "
+                "n_subsamples"
+            )
+
+        q_sub = np.zeros((J, c_grid.size), np.int64)
+        for j, (n_j, T_j) in enumerate(sub_sizes):
+            Mj = M if M is not None else max(3, int(0.75 * np.sqrt(T_j)))
+            mu = _hl_eig_means(x[:T_j, :n_j], Mj)  # (n_j,) descending
+            tail = np.concatenate(
+                [np.cumsum(mu[::-1])[::-1], [0.0]]
+            )  # tail[k] = sum_{j>=k} mu_j  (0-indexed)
+            ks = np.arange(q_max + 1)
+            crit = tail[ks] / n_j
+            if criterion == "log":
+                crit = np.log(np.maximum(crit, 1e-300))
+            pen = (
+                Mj ** -2.0 + np.sqrt(Mj / T_j) + 1.0 / n_j
+            ) * np.log(min(n_j, Mj**2, np.sqrt(T_j / Mj)))
+            # IC_c(k) for every c at once: (n_c, q_max+1)
+            ic = crit[None, :] + ks[None, :] * (c_grid[:, None] * pen)
+            q_sub[j] = np.argmin(ic, axis=1)
+
+        stability = q_sub.var(axis=0)
+        q_by_c = q_sub[-1]  # full panel
+
+        # walk c upward: stability intervals are maximal runs with S_c = 0
+        # and constant q-hat; the first is the q_max run at tiny c, the
+        # second is the selection.  Degenerate cases (no second interval)
+        # fall back to the last stable value.
+        q_hat = int(q_by_c[-1])
+        intervals = []
+        i = 0
+        while i < c_grid.size:
+            if stability[i] == 0:
+                k = i
+                while (
+                    k + 1 < c_grid.size
+                    and stability[k + 1] == 0
+                    and q_by_c[k + 1] == q_by_c[i]
+                ):
+                    k += 1
+                intervals.append((i, k, int(q_by_c[i])))
+                i = k + 1
+            else:
+                i += 1
+        if intervals:
+            # drop the leading trivial q_max interval if present
+            cand = [iv for iv in intervals if iv[2] != q_max] or intervals
+            q_hat = cand[0][2]
+        return HallinLiskaResults(
+            q=q_hat,
+            c_grid=c_grid,
+            q_by_c=q_by_c,
+            stability=stability,
+            q_subsamples=q_sub,
+            sub_sizes=sub_sizes,
+        )
 
 
 def one_sided_common_component(

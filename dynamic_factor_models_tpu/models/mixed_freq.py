@@ -31,9 +31,26 @@ import numpy as np
 from ..ops.linalg import solve_normal, standardize_data
 from ..ops.masking import fillz, mask_of
 from ..utils.backend import on_backend
-from .ssm import _companion, _info_filter_scan, _psd_floor, _rts_scan, SSMParams
+from .ssm import (
+    SSMParams,
+    _collapse_obs,
+    _collapse_obs_stats,
+    _companion,
+    _info_filter_scan,
+    _psd_floor,
+    _rts_scan,
+    _solve_loadings_and_R,
+    _sym_pack_idx,
+    compute_panel_stats,
+)
 
-__all__ = ["MixedFreqParams", "em_step_mf", "estimate_mixed_freq_dfm", "MFResults"]
+__all__ = [
+    "MixedFreqParams",
+    "em_step_mf",
+    "em_step_mf_stats",
+    "estimate_mixed_freq_dfm",
+    "MFResults",
+]
 
 _MM_WEIGHTS = np.array([1.0, 2.0, 3.0, 2.0, 1.0]) / 3.0  # Mariano-Murasawa
 _N_AGG = 5
@@ -76,64 +93,86 @@ def _obs_matrix(params: MixedFreqParams) -> jnp.ndarray:
 
 
 @jax.jit
-def _filter_mf(params: MixedFreqParams, x, mask):
+def _filter_mf(params: MixedFreqParams, x, mask, stats=None):
+    """Collapsed masked filter: observations load only on the first
+    q5 = 5r state dims through H[:, :q5], so the Jungbacker-Koopman
+    precompute (ssm._collapse_obs with Hq = H[:, :q5]) moves the
+    O(N (5r)^2) per-step work out of the scan as batched matmuls, exactly
+    as in ssm._filter_scan (exactness pinned in tests/test_collapsed.py).
+    `stats` (ssm.PanelStats) switches to the two-GEMM loop formulation and
+    returns the x'R^-1x quadratic as a total-log-likelihood correction."""
     Tm, Qs = _companion(_as_ssm(params))
-    H = _obs_matrix(params)
+    q5 = _N_AGG * params.r
+    H5 = _obs_matrix(params)[:, :q5]
     dtype = x.dtype
     k = Tm.shape[0]
     s0 = jnp.zeros(k, dtype)
     P0 = 1e2 * jnp.eye(k, dtype=dtype)
+    if stats is None:
+        C, b, ld_R, xRx, n_obs = _collapse_obs(
+            H5, params.R, x, mask.astype(dtype)
+        )
+        ll_corr = jnp.asarray(0.0, dtype)
+    else:
+        C, b, ld_R, xRx, n_obs, ll_corr = _collapse_obs_stats(
+            H5, params.R, x, stats
+        )
 
-    def obs_step(xt, mt, sp):
-        rinv = mt / params.R
-        Hr = H * rinv[:, None]
-        C = H.T @ Hr
-        v = xt - H @ sp
-        rhs = Hr.T @ v
-        return C, rhs, (mt * jnp.log(params.R)).sum(), (rinv * v * v).sum(), mt.sum()
+    def obs_step(inp, sp):
+        Ct, bt, ld, xr, no = inp
+        g = sp[:q5]
+        Cf = jnp.zeros((k, k), dtype).at[:q5, :q5].set(Ct)
+        rhs = jnp.zeros(k, dtype).at[:q5].set(bt - Ct @ g)
+        quad0 = xr - 2.0 * (g @ bt) + g @ Ct @ g
+        return Cf, rhs, ld, quad0, no
 
-    return _info_filter_scan(Tm, Qs, x, mask, obs_step, s0, P0)
+    means, covs, pmeans, pcovs, ll = _info_filter_scan(
+        Tm, Qs, (C, b, ld_R, xRx, n_obs), obs_step, s0, P0
+    )
+    return means, covs, pmeans, pcovs, ll + ll_corr
 
 
-@jax.jit
-def em_step_mf(params: MixedFreqParams, x, mask):
-    """One EM iteration; returns (new_params, loglik of current params).
+def _em_mf_impl(params: MixedFreqParams, x, mask, stats):
+    """Shared EM iteration body; `stats` is an ssm.PanelStats or None.
 
     The aggregated regressor of series i is g_it = sum_j agg_ij f_{t-j};
-    its second moments come from the first 5r x 5r block of E[s s' | T].
+    every panel-sized moment reduces to two contractions of the panel with
+    the PACKED 5r-block state second moments,
+
+        Z_i   = sum_t m_it E[s5 s5' | T]      via  (N, T) @ (T, q5(q5+1)/2)
+        Sxg5_i = sum_t x_it E[s5_t | T]'      via  (N, T) @ (T, 5r)
+
+    after which Sgg_i = (agg_i ⊗ I) Z_i (agg_i ⊗ I)' and
+    Sxg_i = (agg_i ⊗ I) Sxg5_i are tiny per-series einsums, and the
+    loading/R updates are the shared `ssm._solve_loadings_and_R` —
+    no (T, N, r, r) intermediates, no residual panel.
     """
     r, p = params.r, params.p
-    rp = r * p
     q5 = _N_AGG * r
-    m = mask.astype(x.dtype)
     Tn = x.shape[0]
 
     params = params._replace(Q=_psd_floor(params.Q), R=jnp.maximum(params.R, 1e-8))
-    means, covs, pmeans, pcovs, ll = _filter_mf(params, x, mask)
+    means, covs, pmeans, pcovs, ll = _filter_mf(params, x, mask, stats=stats)
     Tm, _ = _companion(_as_ssm(params))
     s_sm, P_sm, lag1 = _rts_scan(Tm, means, covs, pmeans, pcovs)
 
-    # E[s s'] over the 5-lag factor block, reshaped to (T, 5, r, 5, r)
+    if stats is None:
+        m = mask.astype(x.dtype)
+        mT, xT = m.T, x.T
+        Sxx = (x * x).sum(axis=0)
+        n_i = m.sum(axis=0)
+    else:
+        mT, xT, Sxx, n_i = stats.mT, stats.xT, stats.Sxx, stats.n_i
+
     s5 = s_sm[:, :q5]
-    Ess = (
-        jnp.einsum("tk,tl->tkl", s5, s5) + P_sm[:, :q5, :q5]
-    ).reshape(Tn, _N_AGG, r, _N_AGG, r)
-    # per-series aggregated-regressor moments via the weight profile
-    # Egg_i (r, r) = sum_jl agg_ij agg_il E[f_{t-j} f_{t-l}']
-    Egg = jnp.einsum("ij,tjrls,il->tirs", params.agg, Ess, params.agg)
-    g = jnp.einsum("ij,tjr->tir", params.agg, s5.reshape(Tn, _N_AGG, r))  # E[g]
-
-    Sgg = jnp.einsum("ti,tirs->irs", m, Egg)
-    Sxg = jnp.einsum("ti,tir->ir", m * x, g)
-    lam = jax.vmap(solve_normal)(Sgg, Sxg)
-
-    resid = x - jnp.einsum("ir,tir->ti", lam, g)
-    extra = jnp.einsum("ir,tirs,is->ti", lam, Egg, lam) - jnp.einsum(
-        "ir,tir->ti", lam, g
-    ) ** 2
-    n_i = m.sum(axis=0)
-    R = ((m * (resid**2 + extra)).sum(axis=0)) / n_i
-    R = jnp.maximum(R, 1e-8)
+    iu, iv, unpack = _sym_pack_idx(q5)
+    Ess_u = s5[:, iu] * s5[:, iv] + P_sm[:, iu, iv]  # packed E[s5 s5' | T]
+    Z = (mT @ Ess_u)[:, unpack].reshape(-1, _N_AGG, r, _N_AGG, r)
+    Sgg = jnp.einsum("ij,ijrls,il->irs", params.agg, Z, params.agg)
+    Sxg = jnp.einsum(
+        "ij,ijr->ir", params.agg, (xT @ s5).reshape(-1, _N_AGG, r)
+    )
+    lam, R = _solve_loadings_and_R(Sgg, Sxg, Sxx, n_i)
 
     # factor VAR + Q from the full state moments (as in ssm.em_step)
     S11 = jnp.einsum("tr,ts->rs", s_sm[1:, :r], s_sm[1:, :r]) + P_sm[1:, :r, :r].sum(0)
@@ -143,6 +182,20 @@ def em_step_mf(params: MixedFreqParams, x, mask):
     Q = _psd_floor((S11 - Ak @ S10.T) / (Tn - 1))
     A = jnp.stack([Ak[:, i * r : (i + 1) * r] for i in range(p)])
     return MixedFreqParams(lam, R, A, Q, params.agg), ll
+
+
+@jax.jit
+def em_step_mf(params: MixedFreqParams, x, mask):
+    """One EM iteration; returns (new_params, loglik of current params)."""
+    return _em_mf_impl(params, x, mask, None)
+
+
+@jax.jit
+def em_step_mf_stats(params: MixedFreqParams, x, mask, stats):
+    """`em_step_mf` with loop-invariant ssm.PanelStats supplied — the
+    production path of `estimate_mixed_freq_dfm` (same update, the
+    per-iteration cost excludes transposes and data sums)."""
+    return _em_mf_impl(params, x, mask, stats)
 
 
 class MFResults(NamedTuple):
@@ -220,8 +273,9 @@ def estimate_mixed_freq_dfm(
 
         from .emloop import run_em_loop
 
+        stats = compute_panel_stats(xz, m_arr)
         params, llpath, it, trace = run_em_loop(
-            em_step_mf, params, (xz, m_arr), tol, max_em_iter,
+            em_step_mf_stats, params, (xz, m_arr, stats), tol, max_em_iter,
             collect_path=collect_path, trace_name="em_mixed_freq",
             checkpoint_path=checkpoint_path, checkpoint_every=checkpoint_every,
         )

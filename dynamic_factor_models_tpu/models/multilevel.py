@@ -216,12 +216,24 @@ def multilevel_series_irfs(
 
     def _unit_impact(arr):
         # arr (..., ns_sys, H, K): rescale global-shock columns j < r_g so
-        # the impact response of F_j to shock j is exactly 1 per draw
+        # the impact response of F_j to shock j is exactly 1 per draw.
+        # Cholesky impacts are positive in exact arithmetic, but a
+        # degenerate bootstrap draw (near-zero F_j residual variance) can
+        # produce a ~0 impact; guard the divisor so such draws yield large
+        # finite responses instead of inf/NaN bands that poison the
+        # quantile step.
+        eps = jnp.asarray(jnp.finfo(arr.dtype).eps, arr.dtype)
         cols = []
         for j in range(arr.shape[-1]):
             col = arr[..., :, :, j]
             if j < r_g:
-                col = col / arr[..., j, 0, j][..., None, None]
+                impact = arr[..., j, 0, j][..., None, None]
+                safe = jnp.where(
+                    jnp.abs(impact) > eps,
+                    impact,
+                    jnp.where(impact < 0, -eps, eps),
+                )
+                col = col / safe
             cols.append(col)
         return jnp.stack(cols, axis=-1)
 

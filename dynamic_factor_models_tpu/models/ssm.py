@@ -12,8 +12,12 @@ TPU-first design choices:
   * the filter/smoother are ``lax.scan`` over time with static shapes;
   * missing observations are handled by masking rows of Lam (never by
     changing shapes), so one compiled program serves every missing pattern;
-  * the measurement update uses the information (Woodbury) form — per-step
-    cost O(N r^2 + k^3) with k = r*p the state dim, never O(N^3);
+  * the measurement update is OBSERVATION-COLLAPSED (Jungbacker-Koopman
+    2008): the panel enters only through per-step statistics
+    C_t = Lam' R_t^-1 Lam and b_t = Lam' R_t^-1 x_t, precomputed for all t
+    as two MXU-shaped matmuls before the scan — the scan body is O(k^3)
+    with k = r*p the state dim, with NO N-dependence (previously
+    O(N r^2 + k^3) per sequential step) and never O(N^3);
   * one EM iteration (E-step scans + closed-form M-step) is a single jitted
     function; `em iters/sec` is the tracked benchmark metric (BASELINE.json).
 """
@@ -36,11 +40,15 @@ from .dfm import DFMConfig, estimate_dfm
 __all__ = [
     "SSMParams",
     "KalmanResult",
+    "PanelStats",
+    "compute_panel_stats",
     "kalman_filter",
     "kalman_smoother",
     "em_step",
+    "em_step_stats",
     "em_step_assoc",
     "em_step_sqrt",
+    "em_step_sqrt_collapsed",
     "estimate_dfm_em",
     "EMResults",
 ]
@@ -73,6 +81,13 @@ class KalmanResult(NamedTuple):
     covs: jnp.ndarray  # (T, k, k)
     pred_means: jnp.ndarray  # (T, k) one-step-ahead means (filter only)
     pred_covs: jnp.ndarray  # (T, k, k)
+
+
+# Unroll factor for the time scans: small per-step bodies (k x k Cholesky
+# algebra) leave XLA's per-iteration dispatch visible at T in the thousands;
+# unrolling amortizes it on CPU and gives the TPU scheduler a longer basic
+# block, at negligible compile-time cost for the shapes used here.
+_SCAN_UNROLL = 8
 
 
 def _psd_floor(Q: jnp.ndarray) -> jnp.ndarray:
@@ -108,16 +123,19 @@ def _init_state(params: SSMParams):
     return jnp.zeros(k, params.lam.dtype), 1e2 * jnp.eye(k, dtype=params.lam.dtype)
 
 
-def _info_filter_scan(Tm, Qs, x, mask, obs_step, s0, P0, qdiag=None):
+def _info_filter_scan(Tm, Qs, obs_inputs, obs_step, s0, P0, qdiag=None):
     """Generic masked information-form Kalman filter (shared scan body).
 
-    `obs_step(xt, mt, sp) -> (C, rhs, ld_R, quad0, n_obs)` supplies the
-    model-specific measurement update: information matrix C = H'R⁻¹H, gain
-    right-hand side rhs = H'R⁻¹(x - H sp), the observed-rows log|R|, the
-    observation quadratic Σ (x - H sp)'R⁻¹(x - H sp), and the count.  The
-    prediction, Cholesky updates, and determinant-lemma log-likelihood are
-    identical across models (ssm.py restricted-loading form; ssm_ar.py dense
-    observation map) and live only here.
+    `obs_inputs` is a tuple of (T, ...) arrays scanned over;
+    `obs_step(inp, sp) -> (C, rhs, ld_R, quad0, n_obs)` supplies the
+    model-specific measurement update from the per-step slice `inp`:
+    information matrix C = H'R⁻¹H, gain right-hand side
+    rhs = H'R⁻¹(x - H sp), the observed-rows log|R|, the observation
+    quadratic Σ (x - H sp)'R⁻¹(x - H sp), and the count.  The prediction,
+    Cholesky updates, and determinant-lemma log-likelihood are identical
+    across models (ssm.py collapsed form; ssm_ar.py structured dense
+    observation map; mixed_freq.py lag-aggregated collapsed form) and live
+    only here.
 
     `qdiag` (T, r) optionally supplies time-varying transition-noise
     variances for the leading r state dims (stochastic-volatility models);
@@ -125,23 +143,22 @@ def _info_filter_scan(Tm, Qs, x, mask, obs_step, s0, P0, qdiag=None):
     when the variances are fully time-varying.
     """
     k = Tm.shape[0]
-    dtype = x.dtype
+    dtype = s0.dtype
     log2pi = jnp.asarray(np.log(2.0 * np.pi), dtype)
     eye_k = jnp.eye(k, dtype=dtype)
     r_tv = 0 if qdiag is None else qdiag.shape[1]
 
     def step(carry, inp):
         s, P = carry
-        if qdiag is None:
-            xt, mt = inp
-        else:
-            xt, mt, qt = inp
+        if qdiag is not None:
+            qt = inp[-1]
+            inp = inp[:-1]
         sp = Tm @ s
         Pp = Tm @ P @ Tm.T + Qs
         Pp = 0.5 * (Pp + Pp.T)
         if qdiag is not None:
             Pp = Pp.at[jnp.arange(r_tv), jnp.arange(r_tv)].add(qt)
-        C, rhs, ld_R, quad0, n_obs = obs_step(xt, mt, sp)
+        C, rhs, ld_R, quad0, n_obs = obs_step(inp, sp)
         # Pp is PD (Q PD ⇒ the prediction keeps full rank), so Cholesky
         # replaces the eigh-based pinv and yields log-dets for free
         Lp = jnp.linalg.cholesky(Pp)
@@ -159,38 +176,254 @@ def _info_filter_scan(Tm, Qs, x, mask, obs_step, s0, P0, qdiag=None):
         ll = -0.5 * (n_obs * log2pi + ld_R + ld_pp - ld_pu + quad)
         return (su, Pu), (su, Pu, sp, Pp, ll)
 
-    inputs = (
-        (x, mask.astype(dtype))
-        if qdiag is None
-        else (x, mask.astype(dtype), qdiag)
-    )
+    inputs = obs_inputs if qdiag is None else (*obs_inputs, qdiag)
     (_, _), (means, covs, pmeans, pcovs, lls) = jax.lax.scan(
-        step, (s0, P0), inputs
+        step, (s0, P0), inputs, unroll=_SCAN_UNROLL
     )
     return means, covs, pmeans, pcovs, lls.sum()
 
 
+class PanelStats(NamedTuple):
+    """Loop-invariant data statistics, computed once per panel and threaded
+    through the EM loop (run_em_loop args) so no per-iteration work is spent
+    on them.  The transposed copies matter most: XLA does not hoist a
+    transpose of a loop constant out of ``lax.while_loop``, and the M-step's
+    series-side Gram contractions run ~5x faster (measured, CPU) in the
+    contiguous-reduction orientation (N, T) @ (T, cols) than as
+    (T, N).T @ (T, cols) strided reads.  Sxx / n_i / n_obs are pure data
+    sums (x zero-filled at missing, so m*x == x)."""
+
+    m: jnp.ndarray  # (T, N) float mask (dtype of x, ready for GEMM)
+    xT: jnp.ndarray  # (N, T) transposed zero-filled panel
+    mT: jnp.ndarray  # (N, T) transposed float mask
+    Sxx: jnp.ndarray  # (N,) sum_t x_it^2
+    n_i: jnp.ndarray  # (N,) per-series observation counts
+    n_obs: jnp.ndarray  # (T,) per-period observation counts
+
+
+def compute_panel_stats(x, mask) -> PanelStats:
+    """Materialize the loop-invariant statistics for (x zero-filled, mask)."""
+    m = mask.astype(x.dtype)
+    xT = jnp.asarray(x.T)
+    mT = jnp.asarray(m.T)
+    return PanelStats(
+        m=m,
+        xT=xT,
+        mT=mT,
+        Sxx=(xT * xT).sum(axis=1),
+        n_i=mT.sum(axis=1),
+        n_obs=m.sum(axis=1),
+    )
+
+
+def _sym_pack_idx(q: int):
+    """Packed-symmetric index maps: (iu, iv) the upper-triangle coordinate
+    lists (q(q+1)/2 entries) and `unpack` (q*q,) mapping each full (a, b)
+    cell to its packed column — symmetric matmuls then carry only the
+    unique columns (45% fewer FLOPs at q=8) and rebuild by one gather."""
+    a, b_ = np.triu_indices(q)
+    full = np.zeros((q, q), np.int32)
+    full[a, b_] = np.arange(a.size, dtype=np.int32)
+    full = np.maximum(full, full.T)
+    return jnp.asarray(a), jnp.asarray(b_), jnp.asarray(full.reshape(-1))
+
+
+def _collapse_obs(Hq, R, x, m, n_obs=None):
+    """Per-step collapsed observation statistics (Jungbacker-Koopman 2008,
+    "Likelihood-based analysis for dynamic factor models"; exact — see
+    `_filter_scan`).
+
+    Hq: (N, q) the observation-loaded columns of an obs map H = [Hq, 0];
+    R: (N,) diagonal noise variances; x: (T, N) zero-filled panel;
+    m: (T, N) float mask.  Returns per-step arrays
+
+        C[t]     = Hq' diag(m_t / R) Hq          (q, q)
+        b[t]     = Hq' (m_t / R * x_t)           (q,)
+        ld_R[t]  = sum over observed of log R_i
+        xRx[t]   = x_t' R_t^-1 x_t
+        n_obs[t] = observed count
+
+    — everything a measurement update needs, computed as two
+    (T, N) @ (N, *) matmuls (MXU-shaped, one HBM pass) instead of T
+    sequential O(N q^2) products inside the filter scan.  C is symmetric,
+    so its matmul carries only the q(q+1)/2 unique loading-pair columns
+    (plus one fused column for ld_R: m = rinv * R makes m @ log R an
+    rinv @ (R log R) product) and rebuilds the full matrix by one gather.
+    """
+    N, q = Hq.shape
+    iu, iv, unpack = _sym_pack_idx(q)
+    rinv = m / R
+    pair_u = jnp.concatenate(
+        [Hq[:, iu] * Hq[:, iv], (R * jnp.log(R))[:, None]], axis=1
+    )
+    Cu = rinv @ pair_u  # (T, q(q+1)/2 + 1)
+    C = Cu[:, unpack].reshape(-1, q, q)
+    ld_R = Cu[:, -1]
+    w2 = rinv * x
+    b = w2 @ Hq
+    xRx = (w2 * x).sum(axis=1)
+    if n_obs is None:
+        n_obs = m.sum(axis=1)
+    return C, b, ld_R, xRx, n_obs
+
+
+def _collapse_obs_stats(Hq, R, x, stats: PanelStats):
+    """`_collapse_obs` for looped callers holding PanelStats: the 1/R
+    weighting rides the GEMMs' N-indexed right operands (C = m @ (pair/R),
+    b = x @ (Hq/R); m*x == x), and the state-independent quadratic
+    sum_t x'R^-1x_t leaves the per-step stream entirely — returned instead
+    as the scalar log-likelihood correction -1/2 sum_i Sxx_i/R_i (exact:
+    it never touches the state update).  Two panel GEMMs per call, zero
+    (T, N) temporaries."""
+    q = Hq.shape[1]
+    iu, iv, unpack = _sym_pack_idx(q)
+    pair_R = jnp.concatenate(
+        [(Hq[:, iu] * Hq[:, iv]) / R[:, None], jnp.log(R)[:, None]], axis=1
+    )
+    Cu = stats.m @ pair_R
+    C = Cu[:, unpack].reshape(-1, q, q)
+    ld_R = Cu[:, -1]
+    b = x @ (Hq / R[:, None])
+    xRx = jnp.zeros(x.shape[0], x.dtype)
+    ll_corr = -0.5 * (stats.Sxx / R).sum()
+    return C, b, ld_R, xRx, stats.n_obs, ll_corr
+
+
+def _pos_diag(Rf):
+    # QR sign convention: flip rows so the triangular factor has a
+    # positive diagonal (keeps log-det real and factors comparable)
+    sgn = jnp.sign(jnp.diagonal(Rf))
+    sgn = jnp.where(sgn == 0, 1.0, sgn)
+    return sgn[:, None] * Rf
+
+
+@jax.jit
+def _sqrt_filter_scan_collapsed(params: SSMParams, x, mask):
+    """Collapsed square-root (array-form) masked Kalman filter: the
+    SCALABLE sqrt variant (method="sqrt_collapsed").
+
+    Covariances propagate as Cholesky factors through one QR per step
+    (Kailath-Sayed array algorithm): updated covariances are S S' —
+    symmetric PSD by construction, no drift to fix up — and the state
+    recursion is array-form stable.  Know the trade-off, though: forming
+    C_t = Lam'R_t^-1 Lam squares the observation-side conditioning exactly
+    the way normal equations do, so the FULL sqrt filter's f32
+    log-likelihood advantage does NOT survive the collapse (measured on
+    the ill-conditioned DGP family of tests/test_ssm.py: f32 loglik error
+    0.3-0.6 here vs 0.0003-0.0006 full-sqrt vs 0.27 information filter).
+    Use method="sqrt" when f32 likelihood precision is the point and N is
+    moderate; use this when the panel is wide and the O((N+k)^3) full
+    pre-array is unaffordable but an array-form state recursion is still
+    wanted.  Posteriors and log-likelihood remain EXACT in exact
+    arithmetic (f64 agreement with the full filter pinned at 1e-10 in
+    tests/test_collapsed.py).
+
+    This version carries the Jungbacker-Koopman collapse (`_collapse_obs`)
+    into the array algorithm: the N observed series at time t enter only
+    through C_t = Lam' R_t^-1 Lam = V_t D_t V_t' and b_t = Lam' R_t^-1 x_t,
+    and the equivalent r-dim pseudo-observation
+
+        z_t = L_t' f_t + w_t,  w_t ~ N(0, I_r),  L_t = V_t D_t^{1/2},
+        z_t = D_t^{-1/2} V_t' b_t
+
+    has the identical state posterior; the exact full-panel log-likelihood
+    is recovered from the collapsed one by the per-step constant
+
+        c_t = -1/2 [(n_t - rho_t) log 2pi + ld_R_t + x'R^-1x_t - z_t'z_t]
+
+    (rho_t = rank C_t; exactness holds because b_t ∈ range(C_t), so the
+    discarded (N - rho_t)-dim residual component is free of f_t).
+    Rank-deficient steps — n_t < r, collinear observed loadings, or fully
+    missing rows — get dummy pseudo-rows (zero H-row, unit noise, z = 0)
+    that contribute nothing to the update, determinant, or quadratic, so
+    one compiled program serves every missing pattern.  The QR pre-array is
+    (r+k)-square instead of (N+k)-square: the sqrt method stops costing
+    O((N+k)^3) per step and stays viable at full panel width.
+
+        prediction:   qr([S_u' Tm' ; chol(Q_s)'])            -> S_p'
+        measurement:  qr([I_r  0 ; S_p' L_t  S_p']) = [S_e'  K' ; 0  S_u']
+        update:       s_u = s_p + K solve(S_e, z - L'f_p)
+        loglik:       c_t - 1/2 [2 sum log diag S_e + e'e]
+    """
+    Tm, _ = _companion(params)
+    k = Tm.shape[0]
+    r = params.r
+    dtype = x.dtype
+    log2pi = jnp.asarray(np.log(2.0 * np.pi), dtype)
+    # Q is pre-floored by every caller (the _filter_scan contract), so the
+    # Cholesky here is safe without a second eps-floor
+    sqrtQ = jnp.linalg.cholesky(params.Q)  # (r, r)
+    s0, P0 = _init_state(params)
+    S0 = jnp.linalg.cholesky(P0)
+
+    m = mask.astype(dtype)
+    C, b, ld_R, xRx, n_obs = _collapse_obs(params.lam, params.R, x, m)
+    d, V = jnp.linalg.eigh(C)  # batched over T; C = V diag(d) V'
+    eps = jnp.asarray(jnp.finfo(dtype).eps, dtype)
+    rank_tol = (r * eps) * jnp.maximum(d[:, -1:], 1.0)
+    use = d > rank_tol  # (T, r) pseudo-rows carrying information
+    dsafe = jnp.where(use, d, 1.0)
+    # H_t = L_t' with L_t = V_t D_t^{1/2} (dummy rows zeroed)
+    Ht = (V * jnp.where(use, jnp.sqrt(dsafe), 0.0)[:, None, :]).swapaxes(-1, -2)
+    z = jnp.where(use, jnp.einsum("tij,ti->tj", V, b) / jnp.sqrt(dsafe), 0.0)
+    # c_t combined with the collapsed model's rho_t log 2pi term: the
+    # (n - rho) and rho pieces recombine into one n_t log 2pi
+    base = -0.5 * (n_obs * log2pi + ld_R + xRx - (z * z).sum(axis=1))
+
+    def step(carry, inp):
+        s, S = carry  # S lower: P = S S'
+        Ht_t, zt, base_t = inp
+        # --- prediction (array form) ---
+        sp = Tm @ s
+        pre_p = jnp.concatenate(
+            [S.T @ Tm.T, jnp.zeros((r, k), dtype).at[:, :r].set(sqrtQ.T)]
+        )
+        Sp = _pos_diag(jnp.linalg.qr(pre_p, mode="r")).T  # (k, k) lower
+
+        # --- collapsed measurement update (array form) ---
+        HS = Ht_t @ Sp[:r, :]  # (r, k)
+        pre = jnp.zeros((r + k, r + k), dtype)
+        pre = pre.at[:r, :r].set(jnp.eye(r, dtype=dtype))  # unit pseudo-noise
+        pre = pre.at[r:, :r].set(HS.T)
+        pre = pre.at[r:, r:].set(Sp.T)
+        post = _pos_diag(jnp.linalg.qr(pre, mode="r")).T  # lower
+        Se = post[:r, :r]  # (r, r) lower sqrt pseudo-innovation cov
+        Kbar = post[r:, :r]  # (k, r)
+        Su = post[r:, r:]  # (k, k) lower sqrt updated cov
+
+        v = zt - Ht_t @ sp[:r]  # dummy rows: exactly zero
+        e = jsl.solve_triangular(Se, v, lower=True)
+        su = sp + Kbar @ e
+        # dummy rows: diag(Se) = 1 there, e = 0 there — both sums exact
+        ll = base_t - 0.5 * (
+            2.0 * jnp.log(jnp.diagonal(Se)).sum() + (e * e).sum()
+        )
+        return (su, Su), (su, Su @ Su.T, sp, Sp @ Sp.T, ll)
+
+    (_, _), (means, covs, pmeans, pcovs, lls) = jax.lax.scan(
+        step, (s0, S0), (Ht, z, base), unroll=_SCAN_UNROLL
+    )
+    return KalmanResult(lls.sum(), means, covs, pmeans, pcovs)
+
+
 @jax.jit
 def _sqrt_filter_scan(params: SSMParams, x, mask):
-    """Square-root (array-form) masked Kalman filter: propagates Cholesky
-    factors of the covariances through one QR per step instead of the
-    covariances themselves (Kailath-Sayed array algorithm).
+    """Square-root filter, full (N+k)-square pre-array form — the
+    ACCURACY-FIRST path behind method="sqrt".
 
-    The precision option for f32 TPU runs (SURVEY.md section 7.3): the
-    effective condition number seen by the recursion is sqrt of the
-    covariance filter's, and updated covariances are S S' — symmetric PSD
-    by construction, no drift to fix up.  Measured on ill-conditioned DGPs
-    (R 1e-4..1e-1, rho up to 0.999, f32 vs f64 truth): the log-likelihood
-    error drops ~8-16x vs the information filter (whose Cholesky solves
-    already keep the state estimates comparable) — the quantity EM
-    convergence tests and model comparison actually consume.  Costs one
-    (N+k)-square QR per step (vs the information form's O(N r^2 + k^3)),
-    so it is the accuracy-critical path, not the throughput default.
-
+    It keeps the measured f32 log-likelihood win (~8-16x tighter than the
+    information filter on ill-conditioned DGPs; tests/test_ssm.py
+    `test_f32_loglik_precision_win`, docs/PARITY.md) precisely because the
+    panel is never compressed: the observation block enters the QR as
+    [R^1/2; S_p'H'] without ever forming the squared normal matrix
+    Lam'R^-1 Lam.  The Jungbacker-Koopman collapse cannot preserve this
+    (`_sqrt_filter_scan_collapsed` measures f32 errors at info-filter
+    level), so the scalable collapsed variant is a separate method and
+    this one stays O((N+k)^3) per step by design.
     Missing data: masked rows get a zero observation row and unit dummy
     variance — the innovation is exactly zero and the dummy rows are
     uncoupled, so they contribute nothing to the update, the determinant,
-    or the quadratic (no shape change, one compiled program per pattern).
+    or the quadratic.
 
         prediction:   qr([S_u' Tm' ; chol(Q_s)'])          -> S_p'
         measurement:  qr([R^1/2  0 ; S_p' H'  S_p']) = [S_e'  K' ; 0  S_u']
@@ -203,18 +436,9 @@ def _sqrt_filter_scan(params: SSMParams, x, mask):
     N = params.lam.shape[0]
     dtype = x.dtype
     log2pi = jnp.asarray(np.log(2.0 * np.pi), dtype)
-    # Q is pre-floored by every caller (the _filter_scan contract), so the
-    # Cholesky here is safe without a second eps-floor
     sqrtQ = jnp.linalg.cholesky(params.Q)  # (r, r)
     s0, P0 = _init_state(params)
-    S0 = jnp.sqrt(P0[0, 0]) * jnp.eye(k, dtype=dtype)  # P0 isotropic
-
-    def _pos_diag(Rf):
-        # QR sign convention: flip rows so the triangular factor has a
-        # positive diagonal (keeps log-det real and factors comparable)
-        sgn = jnp.sign(jnp.diagonal(Rf))
-        sgn = jnp.where(sgn == 0, 1.0, sgn)
-        return sgn[:, None] * Rf
+    S0 = jnp.linalg.cholesky(P0)
 
     def step(carry, inp):
         s, S = carry  # S lower: P = S S'
@@ -255,24 +479,79 @@ def _sqrt_filter_scan(params: SSMParams, x, mask):
 
 
 @jax.jit
-def _filter_scan(params: SSMParams, x, mask, qdiag=None):
-    """Masked Kalman filter; x (T, N) NaN-free (pre-filled), mask (T, N).
+def _filter_scan(params: SSMParams, x, mask, qdiag=None, stats=None):
+    """Collapsed masked Kalman filter; x (T, N) NaN-free, mask (T, N).
 
     Only the first r state dims load on observations, so the measurement
-    update is the Woodbury-restricted obs_step below.  `qdiag` (T, r)
-    replaces params.Q with time-varying diagonal factor-innovation
-    variances (stochastic-volatility models).
+    update depends on the panel only through the per-step statistics of
+    `_collapse_obs` (Jungbacker-Koopman 2008) — precomputed for all t as
+    batched MXU matmuls, leaving the scan body O(k^3) with no N-dependence.
+    Algebraically identical to `_filter_scan_full` (exactness pinned in
+    tests/test_collapsed.py): the information matrix, gain right-hand side
+    and quadratic reconstruct exactly as
+
+        rhs_t   = b_t - C_t f_p,
+        quad0_t = x'R^-1x_t - 2 f_p'b_t + f_p'C_t f_p,   f_p = sp[:r].
+
+    `qdiag` (T, r) replaces params.Q with time-varying diagonal
+    factor-innovation variances (stochastic-volatility models).
+
+    `stats` (PanelStats) switches to the bandwidth-minimal formulation for
+    looped callers: the per-series 1/R weighting rides the GEMMs'
+    N-indexed right operands (C = m @ (pair/R), b = x @ (Lam/R); m*x == x),
+    and the state-independent quadratic sum_t x'R^-1x_t leaves the scan
+    entirely as the scalar correction sum_i Sxx_i/R_i on the total
+    log-likelihood — two panel GEMMs per iteration, zero (T, N)
+    temporaries.
     """
     Tm, Qs = _companion(params)
     if qdiag is not None:
         Qs = jnp.zeros_like(Qs)  # fully time-varying top block
     k = Tm.shape[0]
     r = params.r
+    s0, P0 = _init_state(params)
+    dtype = x.dtype
+    if stats is None:
+        C, b, ld_R, xRx, n_obs = _collapse_obs(
+            params.lam, params.R, x, mask.astype(dtype)
+        )
+        ll_corr = jnp.asarray(0.0, dtype)
+    else:
+        C, b, ld_R, xRx, n_obs, ll_corr = _collapse_obs_stats(
+            params.lam, params.R, x, stats
+        )
+
+    def obs_step(inp, sp):
+        Ct, bt, ld, xr, no = inp
+        f = sp[:r]
+        Cf = jnp.zeros((k, k), dtype).at[:r, :r].set(Ct)
+        rhs = jnp.zeros(k, dtype).at[:r].set(bt - Ct @ f)
+        quad0 = xr - 2.0 * (f @ bt) + f @ Ct @ f
+        return Cf, rhs, ld, quad0, no
+
+    means, covs, pmeans, pcovs, ll = _info_filter_scan(
+        Tm, Qs, (C, b, ld_R, xRx, n_obs), obs_step, s0, P0, qdiag=qdiag
+    )
+    return KalmanResult(ll + ll_corr, means, covs, pmeans, pcovs)
+
+
+@jax.jit
+def _filter_scan_full(params: SSMParams, x, mask, qdiag=None):
+    """Uncollapsed masked information filter: the O(N r^2)-per-step
+    Woodbury-restricted obs_step applied inside the scan.  Reference
+    implementation for the collapse exactness tests
+    (tests/test_collapsed.py); `_filter_scan` is the production path."""
+    Tm, Qs = _companion(params)
+    if qdiag is not None:
+        Qs = jnp.zeros_like(Qs)
+    k = Tm.shape[0]
+    r = params.r
     lam = params.lam  # (N, r) — state loadings are [lam, 0, ..., 0]
     s0, P0 = _init_state(params)
     dtype = x.dtype
 
-    def obs_step(xt, mt, sp):
+    def obs_step(inp, sp):
+        xt, mt = inp
         rinv = mt / params.R  # (N,), 0 at missing
         lam_r = lam * rinv[:, None]  # (N, r)
         C = jnp.zeros((k, k), dtype).at[:r, :r].set(lam.T @ lam_r)
@@ -282,12 +561,12 @@ def _filter_scan(params: SSMParams, x, mask, qdiag=None):
         return C, rhs, ld_R, (rinv * v * v).sum(), mt.sum()
 
     means, covs, pmeans, pcovs, ll = _info_filter_scan(
-        Tm, Qs, x, mask, obs_step, s0, P0, qdiag=qdiag
+        Tm, Qs, (x, mask.astype(dtype)), obs_step, s0, P0, qdiag=qdiag
     )
     return KalmanResult(ll, means, covs, pmeans, pcovs)
 
 
-_FILTER_METHODS = ("sequential", "associative", "sqrt")
+_FILTER_METHODS = ("sequential", "associative", "sqrt", "sqrt_collapsed")
 
 
 def kalman_filter(
@@ -295,12 +574,16 @@ def kalman_filter(
 ) -> KalmanResult:
     """Masked Kalman filter over a (T, N) panel with NaN missing values.
 
-    method="sequential" is the O(T) ``lax.scan``; "associative" is the
+    method="sequential" is the O(T) ``lax.scan`` with the collapsed
+    (Jungbacker-Koopman) measurement update; "associative" is the
     O(log T)-depth parallel-in-time formulation (models/pkalman.py) —
     identical results to float tolerance, preferable for long samples;
-    "sqrt" is the square-root array filter (`_sqrt_filter_scan`) — same
-    results in f64, an order of magnitude tighter log-likelihood in f32
-    (the TPU precision option).
+    "sqrt" is the full square-root array filter (`_sqrt_filter_scan`) —
+    same results in f64, an order of magnitude tighter log-likelihood in
+    f32 (the accuracy option; O((N+k)^3) per step); "sqrt_collapsed" is
+    the collapsed square-root form (`_sqrt_filter_scan_collapsed`) —
+    exact posteriors at O((r+k)^3) per step, but f32 accuracy at
+    information-filter level (the compression squares the conditioning).
     """
     if method not in _FILTER_METHODS:
         raise ValueError(f"method must be one of {_FILTER_METHODS}, got {method!r}")
@@ -316,6 +599,8 @@ def kalman_filter(
             return kalman_filter_associative(params, fillz(x), mask)
         if method == "sqrt":
             return _sqrt_filter_scan(params, fillz(x), mask)
+        if method == "sqrt_collapsed":
+            return _sqrt_filter_scan_collapsed(params, fillz(x), mask)
         return _filter_scan(params, fillz(x), mask)
 
 
@@ -337,7 +622,9 @@ def _rts_scan(Tm, means, covs, pmeans, pcovs):
     # iterate t = T-2 .. 0 pairing (filtered_t, predicted_{t+1}, smoothed_{t+1})
     last = (means[-1], covs[-1])
     inputs = (means[:-1], covs[:-1], pmeans[1:], pcovs[1:])
-    (_, _), (s_sm, P_sm, lag1) = jax.lax.scan(step, last, inputs, reverse=True)
+    (_, _), (s_sm, P_sm, lag1) = jax.lax.scan(
+        step, last, inputs, reverse=True, unroll=_SCAN_UNROLL
+    )
     s_all = jnp.concatenate([s_sm, means[-1:]], axis=0)
     P_all = jnp.concatenate([P_sm, covs[-1:]], axis=0)
     return s_all, P_all, lag1
@@ -373,7 +660,11 @@ def kalman_smoother(
                 params, fillz(x), mask_of(x)
             )
             return means, covs, ll
-        filt_fn = _sqrt_filter_scan if method == "sqrt" else _filter_scan
+        filt_fn = {
+            "sqrt": _sqrt_filter_scan,
+            "sqrt_collapsed": _sqrt_filter_scan_collapsed,
+            "sequential": _filter_scan,
+        }[method]
         filt = filt_fn(params, fillz(x), mask_of(x))
         means, covs, _ = _smoother_scan(params, filt)
         return means, covs, filt.loglik
@@ -384,30 +675,73 @@ def kalman_smoother(
 # ---------------------------------------------------------------------------
 
 
-def _em_m_step(params: SSMParams, x, m, s_sm, P_sm, lag1):
+def _solve_loadings_and_R(S, Sx, Sxx, n_i):
+    """Batched loading solve + idiosyncratic-variance update from per-series
+    sufficient statistics (shared by the ssm and mixed-frequency M-steps):
+
+        lam_i = S_i^-1 Sx_i,
+        R_i   = (Sxx_i - 2 lam_i'Sx_i + lam_i'S_i lam_i) / n_i.
+
+    S_i is PD whenever a series has any observation (it sums PD smoothed
+    second moments), so the solve is Cholesky with an eps-scaled trace
+    jitter; all-missing series (S_i = 0, Sx_i = 0) land on lam_i = 0 and
+    the n_i floor keeps R_i finite (then floored to 1e-8).
+    """
+    dtype = Sx.dtype
+    r = Sx.shape[1]
+    eps = jnp.asarray(jnp.finfo(dtype).eps, dtype)
+    jitter = (
+        eps * jnp.maximum(jnp.trace(S, axis1=1, axis2=2), 1.0)[:, None, None]
+        * jnp.eye(r, dtype=dtype)
+    )
+    L = jnp.linalg.cholesky(S + jitter)
+    lam = jax.vmap(lambda Lc, b: jsl.cho_solve((Lc, True), b))(L, Sx)
+    R = (
+        Sxx - 2.0 * (lam * Sx).sum(1)
+        + jnp.einsum("ir,irs,is->i", lam, S, lam)
+    ) / jnp.maximum(n_i, 1.0)
+    return lam, jnp.maximum(R, 1e-8)
+
+
+def _em_m_step(params: SSMParams, x, m, s_sm, P_sm, lag1, stats=None):
     """Closed-form M-step from smoothed first/second moments (shared by the
-    sequential-scan and associative E-steps)."""
+    sequential-scan and associative E-steps).
+
+    Bandwidth-lean formulation: the panel enters through exactly three
+    contractions — Sff_i = sum_t m_it E[f f'] (one (N, T) @ (T, r^2)
+    matmul: E[f f'] = E f E f' + Pf folds the covariance correction into
+    the same product), Sxf_i = sum_t x_it E[f_t]' and Sxx_i = sum_t x_it^2
+    (x is zero-filled at missing, so the mask weighting is already baked
+    in).  R then follows from the same statistics,
+
+        R_i = (Sxx_i - 2 lam_i'Sxf_i + lam_i'Sff_i lam_i) / n_i,
+
+    with no residual-panel materialization.  Sff is PD whenever a series
+    has any observation (Pf is PD), so the batched solve is Cholesky, not
+    the eigh pseudo-inverse; all-missing series get an eps-jitter solve
+    that lands on lam_i = 0 (b_i = 0).
+
+    `stats` (PanelStats) supplies the loop-invariant pieces — transposed
+    copies for the fast GEMM orientation plus Sxx / n_i — when the caller
+    runs many iterations on one panel (estimate_dfm_em does); without it
+    the same quantities are formed in place.
+    """
     r, p = params.r, params.p
     f = s_sm[:, :r]  # E[f_t | T]
     Pf = P_sm[:, :r, :r]  # Var(f_t | T)
 
-    # --- loadings + R (masked, batched over series) ---
-    # Sxf_i = sum_t m_it x_it E[f_t]';  Sff_i = sum_t m_it (E f E f' + Pf).
-    # The E[f]E[f]' part and Sxf are exactly the batched masked-Gram shape
-    # (X = f shared regressors, Y = x targets, W = m), so they route through
-    # the fused Pallas kernel at scale; only the Pf correction needs the
-    # extra (N, T) @ (T, r^2) contraction.
-    from ..ops.pallas_gram import masked_gram
-
     Tn = x.shape[0]
-    Sff_ff, Sxf = masked_gram(f, x, m)  # (N, r, r), (N, r)
-    Sff = Sff_ff + (m.T @ Pf.reshape(Tn, r * r)).reshape(-1, r, r)
-    lam = jax.vmap(solve_normal)(Sff, Sxf)  # (N, r)
-    resid = x - f @ lam.T
-    extra = jnp.einsum("ir,trs,is->ti", lam, Pf, lam)  # lam' Pf lam
-    n_i = m.sum(axis=0)
-    R = ((m * (resid**2 + extra)).sum(axis=0)) / n_i
-    R = jnp.maximum(R, 1e-8)
+    iu, iv, unpack = _sym_pack_idx(r)
+    Eff_u = f[:, iu] * f[:, iv] + Pf[:, iu, iv]  # packed E[f f' | T]
+    if stats is None:
+        mT, xT = m.T, x.T
+        Sxx = (x * x).sum(axis=0)  # (N,)
+        n_i = m.sum(axis=0)
+    else:
+        mT, xT, Sxx, n_i = stats.mT, stats.xT, stats.Sxx, stats.n_i
+    Sff = (mT @ Eff_u)[:, unpack].reshape(-1, r, r)  # (N, r, r)
+    Sxf = xT @ f  # (N, r); m*x == x (zero-filled)
+    lam, R = _solve_loadings_and_R(Sff, Sxf, Sxx, n_i)
 
     # --- factor VAR blocks + Q from smoothed second moments ---
     S11 = (jnp.einsum("tr,ts->rs", s_sm[1:, :r], s_sm[1:, :r])
@@ -436,6 +770,22 @@ def em_step(params: SSMParams, x, mask):
 
 
 @jax.jit
+def em_step_stats(params: SSMParams, x, mask, stats: PanelStats):
+    """`em_step` with the loop-invariant PanelStats supplied by the caller:
+    identical update, but the per-iteration cost excludes the transposed
+    panel copies and data sums — the production path of
+    `estimate_dfm_em(method="sequential")` and the large-panel benchmark.
+    """
+    params = params._replace(Q=_psd_floor(params.Q))
+    filt = _filter_scan(params, x, mask, stats=stats)
+    s_sm, P_sm, lag1 = _smoother_scan(params, filt)
+    return (
+        _em_m_step(params, x, stats.m, s_sm, P_sm, lag1, stats=stats),
+        filt.loglik,
+    )
+
+
+@jax.jit
 def em_step_sqrt(params: SSMParams, x, mask):
     """`em_step` with the square-root array E-step: in f32 the convergence
     test consumes a log-likelihood an order of magnitude more accurate
@@ -444,6 +794,19 @@ def em_step_sqrt(params: SSMParams, x, mask):
     m = mask.astype(x.dtype)
     params = params._replace(Q=_psd_floor(params.Q))
     filt = _sqrt_filter_scan(params, x, mask)
+    s_sm, P_sm, lag1 = _smoother_scan(params, filt)
+    return _em_m_step(params, x, m, s_sm, P_sm, lag1), filt.loglik
+
+
+@jax.jit
+def em_step_sqrt_collapsed(params: SSMParams, x, mask):
+    """`em_step` with the collapsed square-root E-step
+    (`_sqrt_filter_scan_collapsed`): array-form state recursion at
+    O((r+k)^3) per step — the sqrt option that stays affordable on wide
+    panels, at information-filter-level f32 likelihood accuracy."""
+    m = mask.astype(x.dtype)
+    params = params._replace(Q=_psd_floor(params.Q))
+    filt = _sqrt_filter_scan_collapsed(params, x, mask)
     s_sm, P_sm, lag1 = _smoother_scan(params, filt)
     return _em_m_step(params, x, m, s_sm, P_sm, lag1), filt.loglik
 
@@ -540,13 +903,18 @@ def estimate_dfm_em(
 
         from .emloop import run_em_loop
 
-        step = {
-            "sequential": em_step,
-            "associative": em_step_assoc,
-            "sqrt": em_step_sqrt,
-        }[method]
+        if method == "sequential":
+            step = em_step_stats
+            args = (xz, m_arr, compute_panel_stats(xz, m_arr))
+        else:
+            step = {
+                "associative": em_step_assoc,
+                "sqrt": em_step_sqrt,
+                "sqrt_collapsed": em_step_sqrt_collapsed,
+            }[method]
+            args = (xz, m_arr)
         params, llpath, n_iter, trace = run_em_loop(
-            step, params, (xz, m_arr), tol, max_em_iter,
+            step, params, args, tol, max_em_iter,
             collect_path=collect_path, trace_name=f"em_dfm_{method}",
             checkpoint_path=checkpoint_path, checkpoint_every=checkpoint_every,
         )

@@ -95,29 +95,45 @@ def _obs_matrix(params: SSMARParams):
 
 @jax.jit
 def _filter_ar(params: SSMARParams, x, mask):
-    """Masked information-form filter with the dense observation map.
+    """Masked information-form filter with the structured observation map.
 
-    Reuses ssm._info_filter_scan — only the obs_step differs: every state
-    dimension of [f-lags, e] can load on observations through H.
+    Reuses ssm._info_filter_scan — only the obs_step differs.  The
+    Jungbacker-Koopman collapse cannot shrink this model's per-step cost
+    the way it does ssm.py's: the N idiosyncratic states live IN the state
+    vector (k = r*p + N), so the O(k^3) information-matrix Cholesky is
+    inherent.  What the H = [Lam, 0, I] block structure does buy is the
+    information matrix and gain assembled in O(N r^2) —
+
+        C = [[Lam'D Lam, 0, Lam'D], [0,0,0], [D Lam, 0, D]],  D = diag(m/kappa)
+
+    — instead of the dense (k,N)@(N,k) product's O(N k^2) ~ O(N^3).
     """
     Tm, Qs = _transition(params)
-    H = _obs_matrix(params)
+    r, p, N = params.r, params.p, params.N
+    rp = r * p
     dtype = x.dtype
     k = Tm.shape[0]
     s0 = jnp.zeros(k, dtype)
     P0 = 1e2 * jnp.eye(k, dtype=dtype)
     log_kappa = jnp.log(jnp.asarray(_KAPPA, dtype))
+    idio = jnp.arange(rp, k)
 
-    def obs_step(xt, mt, sp):
-        rinv = mt / _KAPPA  # (N,), 0 at missing
-        Hr = H * rinv[:, None]  # (N, k)
-        C = H.T @ Hr
-        v = xt - H @ sp
-        rhs = Hr.T @ v
+    def obs_step(inp, sp):
+        xt, mt = inp
+        d = mt / _KAPPA  # (N,), 0 at missing
+        v = xt - params.lam @ sp[:r] - sp[rp:]  # garbage at missing; weight 0
+        dv = d * v
+        dlam = d[:, None] * params.lam  # (N, r)
+        C = jnp.zeros((k, k), dtype)
+        C = C.at[:r, :r].set(params.lam.T @ dlam)
+        C = C.at[:r, rp:].set(dlam.T)
+        C = C.at[rp:, :r].set(dlam)
+        C = C.at[idio, idio].set(d)
+        rhs = jnp.zeros(k, dtype).at[:r].set(params.lam.T @ dv).at[rp:].set(dv)
         n_obs = mt.sum()
-        return C, rhs, n_obs * log_kappa, (rinv * v * v).sum(), n_obs
+        return C, rhs, n_obs * log_kappa, (dv * v).sum(), n_obs
 
-    return _info_filter_scan(Tm, Qs, x, mask, obs_step, s0, P0)
+    return _info_filter_scan(Tm, Qs, (x, mask.astype(dtype)), obs_step, s0, P0)
 
 
 @jax.jit

@@ -325,7 +325,11 @@ def select_var_lag(
         _, ehat, _, _, _, _, T_used, _ = _estimate_var_window(
             yw, p, withconst, False, row_mask=w_common
         )
-        assert float(T_used) == T_eff  # the common-sample guarantee
+        if float(T_used) != T_eff:  # the common-sample guarantee
+            raise RuntimeError(
+                f"lag-selection invariant violated: VAR({p}) used "
+                f"{float(T_used):g} rows, common sample has {T_eff:g}"
+            )
         e0 = jnp.where(w_common[:, None], fillz(ehat), 0.0)
         sigma_ml = np.asarray(e0.T @ e0) / T_eff
         logdet = float(np.linalg.slogdet(sigma_ml)[1])
@@ -384,6 +388,13 @@ def granger_causality(
     chi-square reference with df = nlag * |causing| * |caused| (the
     standard textbook VAR test, e.g. Luetkepohl 2005 sec. 3.6; a
     HAC-robust single-equation variant is `ops.hac.regress_hac`).
+
+    Sigma is the dof-corrected innovation covariance e'e/(T - K) that
+    `estimate_var` reports — a deliberate choice: the statistic is
+    (T - K)/T times the ML-covariance textbook version, i.e. slightly
+    conservative in small samples, and agrees asymptotically.  This keeps
+    one Sigma convention across the VAR layer (reference
+    dfm_functions.ipynb cell 23 uses the same dof correction).
     """
     from jax.scipy.special import gammaincc
 

@@ -1,0 +1,243 @@
+"""Exactness of the Jungbacker-Koopman observation collapse.
+
+The collapsed filters (`ssm._filter_scan`, `ssm._sqrt_filter_scan`,
+`mixed_freq._filter_mf`) must agree with their uncollapsed reference forms
+to float-reorder error in f64: the collapse is an algebraic identity —
+states see the panel only through C_t = H'R_t^-1 H and b_t = H'R_t^-1 x_t,
+and the log-likelihood constant c_t accounts exactly for the discarded
+component — not an approximation (JK 2008, Thm 1).  Tolerance 1e-10 per the
+round-3 verdict's done-criterion.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dynamic_factor_models_tpu.models.mixed_freq import (
+    MixedFreqParams,
+    _filter_mf,
+    _obs_matrix,
+    em_step_mf,
+)
+from dynamic_factor_models_tpu.models.ssm import (
+    SSMParams,
+    _collapse_obs,
+    _companion,
+    _filter_scan,
+    _filter_scan_full,
+    _info_filter_scan,
+    _psd_floor,
+    _sqrt_filter_scan,
+    _sqrt_filter_scan_collapsed,
+    em_step,
+)
+
+TOL = 1e-10
+
+
+def _dgp(rng, T=60, N=25, r=3, p=2, missing=0.3):
+    """Random stable DFM panel with adversarial missing patterns: one fully
+    missing period, one with fewer observed series than factors (rank-
+    deficient C_t), one fully observed."""
+    A1 = 0.5 * np.eye(r) + 0.1 * rng.standard_normal((r, r))
+    A = np.concatenate([A1[None], 0.1 * rng.standard_normal((p - 1, r, r))])
+    lam = rng.standard_normal((N, r))
+    Q = np.eye(r) + 0.3 * np.ones((r, r))
+    R = 0.1 + rng.random(N)
+    f = np.zeros((T, r))
+    e = rng.multivariate_normal(np.zeros(r), Q, size=T)
+    for t in range(p, T):
+        f[t] = sum(A[i] @ f[t - 1 - i] for i in range(p)) + e[t]
+    x = f @ lam.T + np.sqrt(R) * rng.standard_normal((T, N))
+    x[rng.random((T, N)) < missing] = np.nan
+    x[7, :] = np.nan  # fully missing period
+    x[12, :] = np.nan
+    x[12, : r - 1] = 1.0  # n_t < r: C_t rank-deficient
+    x[T - 2, :] = 0.5  # fully observed period
+    params = SSMParams(
+        lam=jnp.asarray(lam),
+        R=jnp.asarray(R),
+        A=jnp.asarray(A),
+        Q=_psd_floor(jnp.asarray(Q)),
+    )
+    m = ~np.isnan(x)
+    return params, jnp.asarray(np.nan_to_num(x)), jnp.asarray(m)
+
+
+def _assert_same(res_a, res_b, tol=TOL):
+    assert np.abs(res_a.loglik - res_b.loglik) <= tol * (
+        1.0 + np.abs(res_b.loglik)
+    )
+    np.testing.assert_allclose(res_a.means, res_b.means, atol=tol)
+    np.testing.assert_allclose(res_a.covs, res_b.covs, atol=tol)
+    np.testing.assert_allclose(res_a.pred_means, res_b.pred_means, atol=tol)
+    np.testing.assert_allclose(res_a.pred_covs, res_b.pred_covs, atol=tol)
+
+
+def test_info_filter_collapse_exact(rng):
+    params, x, m = _dgp(rng)
+    _assert_same(_filter_scan(params, x, m), _filter_scan_full(params, x, m))
+
+
+def test_info_filter_collapse_exact_qdiag(rng):
+    params, x, m = _dgp(rng)
+    qdiag = jnp.asarray(0.5 + rng.random((x.shape[0], params.r)))
+    _assert_same(
+        _filter_scan(params, x, m, qdiag),
+        _filter_scan_full(params, x, m, qdiag),
+    )
+
+
+def test_sqrt_filter_collapse_exact(rng):
+    params, x, m = _dgp(rng)
+    _assert_same(
+        _sqrt_filter_scan_collapsed(params, x, m),
+        _sqrt_filter_scan(params, x, m),
+    )
+
+
+def test_sqrt_collapsed_matches_sequential(rng):
+    """Cross-method: the collapsed sqrt and collapsed information filters
+    are different algorithms for the same model — f64 agreement to 1e-9."""
+    params, x, m = _dgp(rng)
+    _assert_same(
+        _sqrt_filter_scan_collapsed(params, x, m),
+        _filter_scan(params, x, m),
+        1e-9,
+    )
+
+
+def test_em_step_unchanged_by_collapse(rng):
+    """One EM iteration through the collapsed E-step reproduces the
+    uncollapsed iteration's M-step output exactly (same smoothed moments)."""
+    from dynamic_factor_models_tpu.models.ssm import _em_m_step, _smoother_scan
+
+    params, x, m = _dgp(rng)
+    new_c, ll_c = em_step(params, x, m)
+    pf = params._replace(Q=_psd_floor(params.Q))
+    filt = _filter_scan_full(pf, x, m)
+    s_sm, P_sm, lag1 = _smoother_scan(pf, filt)
+    new_f = _em_m_step(pf, x, m.astype(x.dtype), s_sm, P_sm, lag1)
+    assert np.abs(ll_c - filt.loglik) <= TOL * (1.0 + np.abs(filt.loglik))
+    for a, b in zip(new_c, new_f):
+        np.testing.assert_allclose(a, b, atol=1e-9)
+
+
+def test_em_step_stats_exact(rng):
+    """The PanelStats-threaded iteration (production estimate_dfm_em path)
+    reproduces em_step exactly: same params, same log-likelihood — the
+    GEMM-orientation changes and the separated x'R^-1x quadratic are pure
+    reassociations."""
+    from dynamic_factor_models_tpu.models.ssm import (
+        compute_panel_stats,
+        em_step_stats,
+    )
+
+    params, x, m = _dgp(rng)
+    stats = compute_panel_stats(x, m)
+    new_a, ll_a = em_step(params, x, m)
+    new_b, ll_b = em_step_stats(params, x, m, stats)
+    assert np.abs(ll_a - ll_b) <= TOL * (1.0 + np.abs(ll_a))
+    for a, b in zip(new_a, new_b):
+        np.testing.assert_allclose(a, b, atol=1e-9)
+
+
+def _mf_dgp(rng, T=72, N=14, r=2, p=5):
+    n_q = 4
+    is_q = np.zeros(N, bool)
+    is_q[-n_q:] = True
+    lam = rng.standard_normal((N, r))
+    R = 0.2 + rng.random(N)
+    A = np.concatenate(
+        [(0.6 * np.eye(r))[None], 0.05 * rng.standard_normal((p - 1, r, r))]
+    )
+    agg = np.zeros((N, 5))
+    agg[~is_q, 0] = 1.0
+    agg[is_q] = np.array([1.0, 2.0, 3.0, 2.0, 1.0]) / 3.0
+    x = rng.standard_normal((T, N))
+    x[rng.random((T, N)) < 0.2] = np.nan
+    # quarterly series observed only every third month
+    for j in np.nonzero(is_q)[0]:
+        x[np.arange(T) % 3 != 2, j] = np.nan
+    params = MixedFreqParams(
+        lam=jnp.asarray(lam),
+        R=jnp.asarray(R),
+        A=jnp.asarray(A),
+        Q=_psd_floor(jnp.asarray(np.eye(r))),
+        agg=jnp.asarray(agg),
+    )
+    m = ~np.isnan(x)
+    return params, jnp.asarray(np.nan_to_num(x)), jnp.asarray(m)
+
+
+def test_mixed_freq_filter_collapse_exact(rng):
+    """_filter_mf (collapsed over the 5r lag-aggregated dims) vs an inline
+    uncollapsed dense-H information filter."""
+    params, x, m = _mf_dgp(rng)
+    Tm, Qs = _companion(
+        SSMParams(params.lam, params.R, params.A, params.Q)
+    )
+    H = _obs_matrix(params)
+    k = Tm.shape[0]
+    dtype = x.dtype
+    s0 = jnp.zeros(k, dtype)
+    P0 = 1e2 * jnp.eye(k, dtype=dtype)
+
+    def obs_step(inp, sp):
+        xt, mt = inp
+        rinv = mt / params.R
+        Hr = H * rinv[:, None]
+        C = H.T @ Hr
+        v = xt - H @ sp
+        rhs = Hr.T @ v
+        return (
+            C,
+            rhs,
+            (mt * jnp.log(params.R)).sum(),
+            (rinv * v * v).sum(),
+            mt.sum(),
+        )
+
+    full = _info_filter_scan(
+        Tm, Qs, (x, m.astype(dtype)), obs_step, s0, P0
+    )
+    coll = _filter_mf(params, x, m)
+    for a, b in zip(coll, full):
+        np.testing.assert_allclose(a, b, atol=TOL)
+    # and one EM step runs/produces finite params through the collapsed path
+    new_params, ll = em_step_mf(params, x, m)
+    assert np.isfinite(float(ll))
+    assert all(np.all(np.isfinite(np.asarray(leaf))) for leaf in new_params)
+
+
+def test_mf_em_step_stats_exact(rng):
+    """em_step_mf_stats (production loop path) == em_step_mf."""
+    from dynamic_factor_models_tpu.models.mixed_freq import em_step_mf_stats
+    from dynamic_factor_models_tpu.models.ssm import compute_panel_stats
+
+    params, x, m = _mf_dgp(rng)
+    stats = compute_panel_stats(x, m)
+    new_a, ll_a = em_step_mf(params, x, m)
+    new_b, ll_b = em_step_mf_stats(params, x, m, stats)
+    assert np.abs(ll_a - ll_b) <= TOL * (1.0 + np.abs(ll_a))
+    for a, b in zip(new_a, new_b):
+        np.testing.assert_allclose(a, b, atol=1e-9)
+
+
+def test_collapse_obs_statistics(rng):
+    """_collapse_obs agrees with the naive per-step loops."""
+    params, x, m = _dgp(rng, T=20, N=9, r=2, p=1)
+    mf = m.astype(x.dtype)
+    C, b, ld_R, xRx, n_obs = _collapse_obs(params.lam, params.R, x, mf)
+    for t in range(x.shape[0]):
+        rinv = np.asarray(mf[t] / params.R)
+        lam = np.asarray(params.lam)
+        np.testing.assert_allclose(C[t], lam.T @ (rinv[:, None] * lam), atol=TOL)
+        np.testing.assert_allclose(b[t], lam.T @ (rinv * np.asarray(x[t])), atol=TOL)
+        np.testing.assert_allclose(
+            ld_R[t], (np.asarray(mf[t]) * np.log(np.asarray(params.R))).sum(), atol=TOL
+        )
+        np.testing.assert_allclose(
+            xRx[t], (rinv * np.asarray(x[t]) ** 2).sum(), atol=TOL
+        )
+        assert int(n_obs[t]) == int(np.asarray(mf[t]).sum())

@@ -1,0 +1,85 @@
+"""Wholesale numeric pins for Figures 1/4/5 and Table 3 (round-3 verdict
+Missing #1/#2): committed fixture arrays from a verified run, compared at
+1e-3 like the printed-table goldens — a shape-preserving regression in the
+common-component arithmetic (`figure1`/`compute_series`/`table3`) now fails
+CI instead of passing shape checks.
+
+Fixture: data/golden_figures.npz (generated from the replication layer on
+the cached Stock-Watson panels; reference outputs are the committed cells of
+/root/reference/Stock_Watson.ipynb — Figure 1 cells 13-24, Figure 4 cells
+41-43, Figure 5 cells 45-47, Table 3 cell 55).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow
+
+_FIXTURE = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "data",
+    "golden_figures.npz",
+)
+TOL = 1e-3
+
+
+@pytest.fixture(scope="module")
+def golden():
+    return np.load(_FIXTURE)
+
+
+def _close(a, b, tol=TOL):
+    a, b = np.asarray(a), np.asarray(b)
+    assert a.shape == b.shape
+    m = np.isfinite(b)
+    assert (np.isfinite(a) == m).all(), "NaN pattern changed"
+    np.testing.assert_allclose(a[m], b[m], atol=tol, rtol=tol)
+
+
+def test_figure1_values(dataset_real, golden):
+    from dynamic_factor_models_tpu.replication.stock_watson import figure1
+
+    out = figure1(dataset_real)
+    for name in ("GDPC96", "INDPRO", "PAYEMS", "A0M057"):
+        _close(out["series"][name]["actual"], golden[f"fig1_{name}_actual"])
+        _close(out["series"][name]["common"], golden[f"fig1_{name}_common"])
+
+
+def test_figure4_values(dataset_real, golden):
+    from dynamic_factor_models_tpu.replication.stock_watson import figure4
+
+    out = figure4(dataset_real)
+    for k in ("gdp_growth", "common_r1", "common_r3", "common_r5"):
+        _close(out[k], golden[f"fig4_{k}"])
+
+
+def test_figure5_values(dataset_real, golden):
+    from dynamic_factor_models_tpu.replication.stock_watson import figure5
+
+    out = figure5(dataset_real)
+    for k in ("full", "pre", "post"):
+        # the factor is identified up to sign; align to the fixture before
+        # comparing (the ALS sign convention is deterministic on one
+        # platform, but the golden should not pin a BLAS artifact)
+        a, b = np.asarray(out[k]), np.asarray(golden[f"fig5_{k}"])
+        m = np.isfinite(a) & np.isfinite(b)
+        sign = np.sign(np.dot(a[m], b[m]))
+        _close(sign * a, b)
+
+
+def test_table3_wholesale(dataset_all, golden):
+    from dynamic_factor_models_tpu.replication.stock_watson import table3
+
+    r2 = table3(dataset_all)
+    ref = golden["table3"]
+    assert r2.shape == (207, 10)
+    _close(r2, ref)
+
+
+def test_figure1_catches_arithmetic_regression(golden):
+    """The pin has teeth: a shape-preserving 1% scale error fails."""
+    bad = golden["fig1_GDPC96_common"] * 1.01
+    with pytest.raises(AssertionError):
+        _close(bad, golden["fig1_GDPC96_common"])
