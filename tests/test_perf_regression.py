@@ -1,0 +1,160 @@
+"""Round-over-round CPU perf regression lane (round-4 verdict item 7).
+
+Wall-clocks the hot per-iteration programs — collapsed EM step (large panel
+and reference scale), the ALS core, and the mixed-frequency EM step — against
+budgets ~1.6x the typical quiet in-environment measurement.  That margin
+passes ordinary machine noise (observed quiet spread ~±25%) while a
+deliberate 2x algorithmic slowdown of any step fails the lane; a regression
+therefore surfaces in-round, not only at bench time.
+
+Budgets are in milliseconds of min-of-7 steady-state wall clock, first call
+(compile) excluded, measured IN the test environment (conftest enables x64
+and the 8-virtual-device CPU platform, which splits the XLA threadpool and
+runs these ~3x slower than a plain-platform process — calibrate here, not
+in a standalone script).  If hardware changes materially, recalibrate by
+running this file and setting budget ~1.6x the typical quiet measurement.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow
+
+# round-4 quiet in-environment typical values: 65 / 330 / 20 / 68 ms
+BUDGET_EM_LARGE_MS = 110.0
+BUDGET_ALS_LARGE_MS = 550.0
+BUDGET_EM_REF_MS = 35.0
+BUDGET_EM_MF_MS = 110.0
+
+
+def _min_wall(fn, n=7):
+    out = fn()
+    jax.block_until_ready(out)  # compile outside the clock
+    ts = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        out = fn()
+        jax.block_until_ready(out)
+        ts.append(time.perf_counter() - t0)
+    return min(ts) * 1000.0
+
+
+def _panel(T, N, missing, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((T, N)).astype(np.float32)
+    m = rng.random((T, N)) > missing
+    return jnp.asarray(np.where(m, x, 0.0).astype(np.float32)), jnp.asarray(m)
+
+
+def _ssm_params(N, r, p, dtype=jnp.float32):
+    from dynamic_factor_models_tpu.models.ssm import SSMParams
+
+    A = jnp.concatenate(
+        [0.5 * jnp.eye(r, dtype=dtype)[None], jnp.zeros((p - 1, r, r), dtype)]
+    )
+    return SSMParams(
+        lam=jnp.zeros((N, r), dtype).at[:, 0].set(1.0),
+        R=jnp.ones(N, dtype),
+        A=A,
+        Q=jnp.eye(r, dtype=dtype),
+    )
+
+
+def test_em_step_large_panel_budget():
+    from dynamic_factor_models_tpu.models.ssm import (
+        compute_panel_stats,
+        em_step_stats,
+    )
+
+    xz, m = _panel(1024, 2048, 0.2)
+    params = _ssm_params(2048, 8, 1)
+    stats = compute_panel_stats(xz, m)
+    ms = _min_wall(lambda: em_step_stats(params, xz, m, stats))
+    assert ms < BUDGET_EM_LARGE_MS, (
+        f"collapsed EM step regressed: {ms:.1f} ms > {BUDGET_EM_LARGE_MS} ms "
+        f"budget at (T,N,r)=(1024,2048,8)"
+    )
+
+
+def test_als_core_large_panel_budget():
+    from dynamic_factor_models_tpu.models.dfm import _als_core
+
+    xz, m = _panel(1024, 2048, 0.2)
+    rng = np.random.default_rng(1)
+    f0 = jnp.asarray(rng.standard_normal((1024, 8)).astype(np.float32))
+    lam_ok = jnp.ones(2048, bool)
+    mf = m.astype(xz.dtype)
+    ms = _min_wall(
+        lambda: _als_core(xz, mf, lam_ok, f0, jnp.float32(0.0), 8, 4)[0]
+    )
+    assert ms < BUDGET_ALS_LARGE_MS, (
+        f"ALS core regressed: {ms:.1f} ms > {BUDGET_ALS_LARGE_MS} ms budget "
+        f"for 4 iterations at (T,N,r)=(1024,2048,8)"
+    )
+
+
+def test_em_step_reference_scale_budget():
+    from dynamic_factor_models_tpu.models.ssm import (
+        compute_panel_stats,
+        em_step_stats,
+    )
+
+    xz, m = _panel(224, 139, 0.1)
+    params = _ssm_params(139, 4, 4)
+    stats = compute_panel_stats(xz, m)
+    ms = _min_wall(lambda: em_step_stats(params, xz, m, stats))
+    assert ms < BUDGET_EM_REF_MS, (
+        f"reference-scale EM step regressed: {ms:.1f} ms > "
+        f"{BUDGET_EM_REF_MS} ms budget at (T,N,r,p)=(224,139,4,4)"
+    )
+
+
+def test_em_step_mixed_freq_budget():
+    from dynamic_factor_models_tpu.models.mixed_freq import (
+        MixedFreqParams,
+        em_step_mf_stats,
+    )
+    from dynamic_factor_models_tpu.models.ssm import compute_panel_stats
+
+    T, N, r, p = 672, 139, 4, 5
+    xz, m = _panel(T, N, 0.15, seed=2)
+    # quarterly tail observed at quarter ends only
+    mask = np.array(m)  # writable copy (np.asarray of a jax array is RO)
+    mask[np.arange(T) % 3 != 2, N - 40 :] = False
+    m = jnp.asarray(mask)
+    xz = jnp.where(m, xz, 0.0)
+    agg = np.zeros((N, 5), np.float32)
+    agg[: N - 40, 0] = 1.0
+    agg[N - 40 :] = np.array([1, 2, 3, 2, 1], np.float32) / 3.0
+    params = MixedFreqParams(
+        lam=jnp.ones((N, r), xz.dtype),
+        R=jnp.ones(N, xz.dtype),
+        A=jnp.concatenate(
+            [0.7 * jnp.eye(r, dtype=xz.dtype)[None], jnp.zeros((p - 1, r, r), xz.dtype)]
+        ),
+        Q=jnp.eye(r, dtype=xz.dtype),
+        agg=jnp.asarray(agg),
+    )
+    stats = compute_panel_stats(xz, m)
+    ms = _min_wall(lambda: em_step_mf_stats(params, xz, m, stats))
+    assert ms < BUDGET_EM_MF_MS, (
+        f"mixed-frequency EM step regressed: {ms:.1f} ms > "
+        f"{BUDGET_EM_MF_MS} ms budget at (T,N,r,p)=(672,139,4,5)"
+    )
+
+
+def test_budget_has_teeth():
+    """A deliberate 2x slowdown of the measured quantity fails the lane:
+    the budgets sit at ~1.6x calibration, so doubling any calibrated time
+    exceeds its budget (sanity-check the arithmetic stays that way)."""
+    for budget, calibrated in (
+        (BUDGET_EM_LARGE_MS, 65.0),
+        (BUDGET_ALS_LARGE_MS, 330.0),
+        (BUDGET_EM_REF_MS, 20.0),
+        (BUDGET_EM_MF_MS, 68.0),
+    ):
+        assert 2.0 * calibrated > budget, (budget, calibrated)
