@@ -180,6 +180,30 @@ def parity_programs(ds, backend, factor_override=None):
     }
 
 
+def _parity_code_rev() -> str:
+    """Digest of the sources that define the parity programs' numerics:
+    the staged CPU leg is only valid against the code revision that wrote
+    it (comparing legs from different revisions would measure code drift,
+    not device effect)."""
+    import hashlib
+
+    h = hashlib.sha256()
+    for rel in (
+        "bench.py",
+        "dynamic_factor_models_tpu/models/dfm.py",
+        "dynamic_factor_models_tpu/models/ssm.py",
+        "dynamic_factor_models_tpu/models/favar.py",
+        "dynamic_factor_models_tpu/ops/linalg.py",
+        "dynamic_factor_models_tpu/ops/pallas_gram.py",
+    ):
+        try:
+            with open(os.path.join(REPO, rel), "rb") as fh:
+                h.update(fh.read())
+        except OSError:
+            h.update(b"missing:" + rel.encode())
+    return h.hexdigest()
+
+
 def _parity_diffs(cpu, tpu):
     """Max-abs-diffs between two parity-program result dicts."""
     import numpy as np
@@ -218,7 +242,12 @@ def device_parity_checks(ds):
     if os.path.exists(staged):
         try:
             cpu = dict(np.load(staged))
-            if "smoother_sqrt" not in cpu:  # stale pre-sqrt-leg stage file
+            rev = cpu.pop("code_rev", None)
+            if "smoother_sqrt" not in cpu or (
+                rev is None or str(rev) != _parity_code_rev()
+            ):
+                # stale: pre-sqrt-leg file, or written by a different code
+                # revision — recompute rather than compare across revisions
                 cpu = None
             else:
                 print(
@@ -252,7 +281,7 @@ def stage_parity():
     out = os.path.join(REPO, "build", "parity_staged_cpu.npz")
     with jax.default_matmul_precision("highest"):
         res = parity_programs(ds, "cpu")
-    np.savez(out, **res)
+    np.savez(out, code_rev=_parity_code_rev(), **res)
     print(f"staged CPU parity leg: {out}", file=sys.stderr)
 
 
@@ -832,6 +861,31 @@ def orchestrate():
             except (OSError, ValueError):
                 return None
 
+        def _merge_salvage(fragment):
+            """Merge the dead TPU child's completed sections into the CPU
+            fragment, prefixed tpu_partial_*.  Skipped when the child
+            itself recorded tpu_unreachable (its numbers would be CPU
+            numbers mislabeled as TPU evidence)."""
+            salvage = _load_partial()
+            if fragment is None or not salvage:
+                return
+            if salvage.get("tpu_unreachable"):
+                return
+            tpu_fields = {
+                k: v
+                for k, v in salvage.items()
+                if k not in ("device", "tpu_unreachable")
+            }
+            fragment.update(
+                {f"tpu_partial_{k}": v for k, v in tpu_fields.items()}
+            )
+            fragment["tpu_partial_device"] = salvage.get("device")
+            print(
+                f"bench: salvaged {len(tpu_fields)} TPU fields from the "
+                "dead child's partial file",
+                file=sys.stderr,
+            )
+
         if tpu_ok:
             pr = _run_child(
                 ["--run-main"],
@@ -850,25 +904,10 @@ def orchestrate():
                     "falling back to CPU",
                     file=sys.stderr,
                 )
-                salvage = _load_partial()
                 pr = _run_child(["--run-main", "--force-cpu"])
                 fragment = _parse_fragment(pr)
                 main_rc = pr.returncode
-                if fragment is not None and salvage:
-                    tpu_fields = {
-                        k: v
-                        for k, v in salvage.items()
-                        if k not in ("device", "tpu_unreachable")
-                    }
-                    fragment.update(
-                        {f"tpu_partial_{k}": v for k, v in tpu_fields.items()}
-                    )
-                    fragment["tpu_partial_device"] = salvage.get("device")
-                    print(
-                        f"bench: salvaged {len(tpu_fields)} TPU fields from "
-                        "the dead child's partial file",
-                        file=sys.stderr,
-                    )
+                _merge_salvage(fragment)
         else:
             # CPU fallback numbers first — then keep re-probing: the tunnel
             # wedges and recovers on hour scales, so a late success upgrades
@@ -897,27 +936,7 @@ def orchestrate():
                         fragment = tpu_fragment
                         main_rc = pr.returncode
                     else:
-                        salvage = _load_partial()
-                        if fragment is not None and salvage:
-                            tpu_fields = {
-                                k: v
-                                for k, v in salvage.items()
-                                if k not in ("device", "tpu_unreachable")
-                            }
-                            fragment.update(
-                                {
-                                    f"tpu_partial_{k}": v
-                                    for k, v in tpu_fields.items()
-                                }
-                            )
-                            fragment["tpu_partial_device"] = salvage.get(
-                                "device"
-                            )
-                            print(
-                                f"bench: salvaged {len(tpu_fields)} TPU "
-                                "fields from the dead child's partial file",
-                                file=sys.stderr,
-                            )
+                        _merge_salvage(fragment)
                     break
                 print(
                     f"bench: probe {attempts} failed ({detail})", file=sys.stderr

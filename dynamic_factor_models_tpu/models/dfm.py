@@ -121,17 +121,46 @@ def _als_core(
     nfac_o: int = 0,
     fo=None,  # (Tw, nfac_o) observed factors (NaN-free in the window)
 ):
-    from ..ops.pallas_gram import masked_gram
+    from ..ops.pallas_gram import _TPU_PLATFORMS, _context_platform, masked_gram
 
     W = m * lam_ok[None, :]
     if nfac_o == 0:
         fo = jnp.zeros((xz.shape[0], 0), xz.dtype)
 
+    # CPU fast-orientation path: both Gram contractions run as
+    # contiguous-reduction GEMMs with packed-symmetric columns, with the
+    # loop-invariant transposed copies hoisted out of the while_loop (the
+    # PanelStats lesson from models/ssm.py: the strided orientation measures
+    # ~5x slower on CPU, and XLA does not hoist transposes of loop
+    # constants).  On TPU the natural layout feeds the Pallas kernel /
+    # MXU-tiled einsums, so the generic masked_gram path stays.
+    fast_cpu = _context_platform() not in _TPU_PLATFORMS
+    K = nfac_o + nfac
+    if fast_cpu:
+        from .ssm import _sym_pack_idx
+
+        iuK, ivK, unpackK = _sym_pack_idx(K)
+        iun, ivn, unpackn = _sym_pack_idx(nfac)
+        # loop-invariant copies; mask applied EXPLICITLY (callers like
+        # multilevel._als_level pass residual panels that are nonzero at
+        # masked cells, so zero-filling cannot be assumed here)
+        xzm = m * xz  # (Tw, ns)
+        mT = jnp.asarray(m.T)  # (ns, Tw)
+        xzmT = jnp.asarray(xzm.T)  # (ns, Tw)
+        xzW = xzm * lam_ok[None, :]  # == W * xz
+        lam_okf = lam_ok.astype(xz.dtype)
+        Sxxw0 = (xzW * xz).sum()
+
     def lam_step(fu):
         # per-series masked Gram (K4's Unbalanced loop) — Pallas at scale;
         # loadings are estimated jointly on [observed, unobserved] factors
         f = jnp.concatenate([fo, fu], axis=1)
-        A, rhs = masked_gram(f, xz, m)
+        if fast_cpu:
+            pair = f[:, iuK] * f[:, ivK]  # (Tw, K(K+1)/2)
+            A = (mT @ pair)[:, unpackK].reshape(-1, K, K)
+            rhs = xzmT @ f
+        else:
+            A, rhs = masked_gram(f, xz, m)
         lam = jax.vmap(solve_normal)(A, rhs)
         if n_constr:
             constraint = LambdaConstraint(c_series, c_R, c_r)
@@ -142,10 +171,29 @@ def _als_core(
         # per-period masked Gram over the unobserved block only: the observed
         # factors' contribution is subtracted from the target first
         lam_o, lam_u = lam[:, :nfac_o], lam[:, nfac_o:]
-        xr = xz - fo @ lam_o.T
-        A, rhs = masked_gram(lam_u, xr.T, W.T)
-        fu = jax.vmap(solve_normal)(A, rhs)
-        ssr = (W * (xr - fu @ lam_u.T) ** 2).sum()
+        if fast_cpu:
+            pair_l = (lam_u[:, iun] * lam_u[:, ivn]) * lam_okf[:, None]
+            A = (m @ pair_l)[:, unpackn].reshape(-1, nfac, nfac)
+            if nfac_o:
+                xr = xz - fo @ lam_o.T
+                wxr = W * xr
+                Sxxw = (wxr * xr).sum()
+            else:
+                wxr = xzW
+                Sxxw = Sxxw0
+            rhs = wxr @ lam_u  # (Tw, nfac)
+            fu = jax.vmap(solve_normal)(A, rhs)
+            # SSR from the same sufficient statistics — no residual panel
+            ssr = (
+                Sxxw
+                - 2.0 * (fu * rhs).sum()
+                + jnp.einsum("tk,tkl,tl->", fu, A, fu)
+            )
+        else:
+            xr = xz - fo @ lam_o.T
+            A, rhs = masked_gram(lam_u, xr.T, W.T)
+            fu = jax.vmap(solve_normal)(A, rhs)
+            ssr = (W * (xr - fu @ lam_u.T) ** 2).sum()
         return fu, ssr
 
     def cond(carry):

@@ -87,7 +87,7 @@ class KalmanResult(NamedTuple):
 # algebra) leave XLA's per-iteration dispatch visible at T in the thousands;
 # unrolling amortizes it on CPU and gives the TPU scheduler a longer basic
 # block, at negligible compile-time cost for the shapes used here.
-_SCAN_UNROLL = 8
+_SCAN_UNROLL = 4
 
 
 def _psd_floor(Q: jnp.ndarray) -> jnp.ndarray:
