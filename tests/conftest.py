@@ -26,6 +26,19 @@ import pytest  # noqa: E402
 from dynamic_factor_models_tpu.io.cache import cached_dataset  # noqa: E402
 
 
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_between_modules():
+    """Bound the per-process live-JIT footprint: the full suite compiles
+    hundreds of XLA CPU programs in one process, and past a cumulative
+    volume the LLVM JIT segfaults inside backend_compile_and_load
+    (observed at different, individually-innocent programs — order-
+    dependent, neither suite half reproduces alone).  Dropping the
+    compilation caches at module boundaries keeps the live-code volume
+    bounded at the cost of a few repeated compilations."""
+    yield
+    jax.clear_caches()
+
+
 @pytest.fixture(scope="session")
 def dataset_real():
     return cached_dataset("Real")

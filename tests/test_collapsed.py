@@ -142,6 +142,27 @@ def test_em_step_stats_exact(rng):
         np.testing.assert_allclose(a, b, atol=1e-9)
 
 
+def test_em_step_sqrt_collapsed_exact(rng):
+    """The collapsed-sqrt EM iteration matches the sequential one in f64
+    (same smoothed moments feed the same M-step), and the public
+    kalman_filter routes method="sqrt_collapsed"."""
+    from dynamic_factor_models_tpu.models.ssm import (
+        em_step_sqrt_collapsed,
+        kalman_filter,
+    )
+
+    params, x, m = _dgp(rng)
+    new_a, ll_a = em_step(params, x, m)
+    new_b, ll_b = em_step_sqrt_collapsed(params, x, m)
+    assert np.abs(ll_a - ll_b) <= 1e-9 * (1.0 + np.abs(ll_a))
+    for a, b in zip(new_a, new_b):
+        np.testing.assert_allclose(a, b, atol=1e-8)
+    xn = jnp.where(m, x, jnp.nan)
+    res = kalman_filter(params, xn, method="sqrt_collapsed")
+    ref = kalman_filter(params, xn)
+    assert np.abs(res.loglik - ref.loglik) <= 1e-9 * (1.0 + np.abs(ref.loglik))
+
+
 def _mf_dgp(rng, T=72, N=14, r=2, p=5):
     n_q = 4
     is_q = np.zeros(N, bool)
