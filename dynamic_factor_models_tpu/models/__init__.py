@@ -38,11 +38,16 @@ from .instability import InstabilityResults, instability_scan
 from .favar_instruments import cca_with_factors, choose_stepwise, favar_instrument_table
 from .ssm import (
     EMResults,
+    PanelStats,
     SSMParams,
+    compute_panel_stats,
     em_step,
     em_step_assoc,
     em_step_sqrt,
+    em_step_sqrt_collapsed,
+    em_step_stats,
     estimate_dfm_em,
+    estimate_dfm_twostep,
     kalman_filter,
     kalman_smoother,
 )

@@ -103,6 +103,13 @@ def run_em_loop(
     checked) resumes from the last completed chunk and produces the same
     final state as an uninterrupted run.
     """
+    if max_em_iter < 0:
+        raise ValueError(f"max_em_iter must be >= 0, got {max_em_iter}")
+    if max_em_iter == 0:
+        # zero-iteration contract (the DGR two-step estimator): parameters
+        # pass through untouched — the while body cannot even be traced
+        # against a zero-length loglik path
+        return params, np.empty(0), 0, None
     if checkpoint_path is not None and collect_path:
         raise ValueError(
             "collect_path=True uses a host-synced loop that does not "

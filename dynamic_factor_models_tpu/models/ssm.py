@@ -50,6 +50,7 @@ __all__ = [
     "em_step_sqrt",
     "em_step_sqrt_collapsed",
     "estimate_dfm_em",
+    "estimate_dfm_twostep",
     "EMResults",
 ]
 
@@ -930,3 +931,35 @@ def estimate_dfm_em(
             means=n_mean,
             trace=trace,
         )
+
+
+def estimate_dfm_twostep(
+    data,
+    inclcode,
+    initperiod: int,
+    lastperiod: int,
+    config: DFMConfig = DFMConfig(nfac_u=4),
+    backend: str | None = None,
+    method: str = "sequential",
+) -> EMResults:
+    """Doz-Giannone-Reichlin (2011, J. Econometrics 164(1)) TWO-STEP
+    estimator: principal-component/ALS estimates of (Lam, R, A, Q) in step
+    one, a single Kalman-smoother pass for the factors in step two — the
+    workhorse quick estimator of the nowcasting literature, consistent for
+    large (N, T) without EM iteration.
+
+    Exactly `estimate_dfm_em` with zero EM iterations (same initialization
+    from the non-parametric ALS fit, same smoothing readout, same
+    EMResults), so the two-step and the full QML/EM estimates are directly
+    comparable: `n_iter` is 0 and `loglik_path` empty by construction.
+    """
+    return estimate_dfm_em(
+        data,
+        inclcode,
+        initperiod,
+        lastperiod,
+        config,
+        max_em_iter=0,
+        backend=backend,
+        method=method,
+    )

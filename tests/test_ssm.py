@@ -261,6 +261,33 @@ class TestSqrtFilter:
         with pytest.raises(ValueError, match="method"):
             kalman_filter(params, jnp.asarray(x), method="nope")
 
+    def test_twostep_is_zero_iteration_em(self, rng):
+        """Doz-Giannone-Reichlin two-step == estimate_dfm_em with 0 EM
+        iterations: ALS-initialized params, one smoother pass, n_iter=0."""
+        from dynamic_factor_models_tpu.models.dfm import DFMConfig
+        from dynamic_factor_models_tpu.models.ssm import (
+            estimate_dfm_em,
+            estimate_dfm_twostep,
+        )
+
+        x, F_true, _ = _simulate(rng)
+        # ragged edge on the last columns; keep a balanced block for the
+        # ALS PCA initialization
+        x[rng.random(x.shape) < 0.1 * (np.arange(x.shape[1]) >= 5)] = np.nan
+        incl = np.ones(x.shape[1], np.int64)
+        cfg = DFMConfig(nfac_u=2, n_factorlag=2)
+        ts = estimate_dfm_twostep(x, incl, 0, x.shape[0] - 1, cfg)
+        em0 = estimate_dfm_em(x, incl, 0, x.shape[0] - 1, cfg, max_em_iter=0)
+        assert ts.n_iter == 0 and len(ts.loglik_path) == 0
+        np.testing.assert_allclose(ts.factors, em0.factors, atol=1e-12)
+        for a, b in zip(ts.params, em0.params):
+            np.testing.assert_allclose(a, b, atol=1e-12)
+        # the smoothed two-step factors track the truth (DGR consistency)
+        c = np.corrcoef(
+            np.asarray(ts.factors[:, 0]), np.asarray(F_true[:, 0])
+        )[0, 1]
+        assert abs(c) > 0.8
+
     def test_em_step_sqrt_matches_sequential(self, rng):
         from dynamic_factor_models_tpu.models.ssm import em_step, em_step_sqrt
 
