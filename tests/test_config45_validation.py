@@ -202,6 +202,20 @@ class TestHallinLiska:
         res = hallin_liska_q(x, q_max=6)
         assert res.q == 1
 
+    @pytest.mark.slow
+    @pytest.mark.parametrize("which", ["Real", "All"])
+    def test_real_panel_selects_one_dynamic_factor(self, which, request):
+        """Regression pin on the Stock-Watson panels: HL selects q = 1 on
+        both the :Real and :All included panels — consistent with the
+        chapter's one-dominant-dynamic-factor reading (the Table 2(C)
+        Amengual-Watson ICp minimum sits at small dynamic counts)."""
+        from dynamic_factor_models_tpu.models.dynpca import hallin_liska_q
+
+        ds = request.getfixturevalue(f"dataset_{which.lower()}")
+        x = np.asarray(ds.bpdata)[:, np.asarray(ds.inclcode) == 1][2:224]
+        res = hallin_liska_q(x, q_max=8)
+        assert res.q == 1
+
 
 # ---------------------------------------------------------------------------
 # config 5: Breitung-Eickmeier / Barigozzi two-level DFM
