@@ -261,7 +261,7 @@ class TestSqrtFilter:
         with pytest.raises(ValueError, match="method"):
             kalman_filter(params, jnp.asarray(x), method="nope")
 
-    def test_twostep_is_zero_iteration_em(self, rng):
+    def test_twostep_is_zero_iteration_em(self):
         """Doz-Giannone-Reichlin two-step == estimate_dfm_em with 0 EM
         iterations: ALS-initialized params, one smoother pass, n_iter=0."""
         from dynamic_factor_models_tpu.models.dfm import DFMConfig
@@ -270,6 +270,7 @@ class TestSqrtFilter:
             estimate_dfm_twostep,
         )
 
+        rng = np.random.default_rng(11)  # local: order-independent DGP
         x, F_true, _ = _simulate(rng)
         # ragged edge on the last columns; keep a balanced block for the
         # ALS PCA initialization
@@ -282,11 +283,12 @@ class TestSqrtFilter:
         np.testing.assert_allclose(ts.factors, em0.factors, atol=1e-12)
         for a, b in zip(ts.params, em0.params):
             np.testing.assert_allclose(a, b, atol=1e-12)
-        # the smoothed two-step factors track the truth (DGR consistency)
-        c = np.corrcoef(
-            np.asarray(ts.factors[:, 0]), np.asarray(F_true[:, 0])
-        )[0, 1]
-        assert abs(c) > 0.8
+        # the smoothed two-step factors track the truth (DGR consistency);
+        # canonical correlations are rotation/sign-robust
+        cc = np.asarray(
+            canonical_correlations(ts.factors, jnp.asarray(F_true))
+        )
+        assert cc[0] > 0.9 and cc[1] > 0.8
 
     def test_em_step_sqrt_matches_sequential(self, rng):
         from dynamic_factor_models_tpu.models.ssm import em_step, em_step_sqrt
