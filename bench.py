@@ -25,6 +25,15 @@ single coin flip against a tunnel that wedges and recovers on hour scales):
                            into precision-effect vs device-effect.
   bench.py --crossover     manual: Pallas-vs-XLA masked-Gram crossover table
                            on the live chip (documents _PALLAS_MIN_CELLS).
+  bench.py --run-tpu-remainder
+                           manual/watcher mode for short tunnel windows:
+                           only the TPU sections missing from the salvaged
+                           2026-07-31 live record, cheapest compile first
+                           (pallas -> device parity -> large panel ->
+                           crossover), each folded into the durable
+                           evidence store docs/TPU_EVIDENCE.json, which the
+                           orchestrator merges (tpu_live_* fields) into any
+                           CPU-fallback report.
 
 JSON fields beyond the headline:
 - em_iters_per_sec[_host_sync|_assoc|_sqrt]  state-space EM throughput on
@@ -332,10 +341,15 @@ def _synthetic_large_panel(T, N, r, dtype):
     return x.astype(dtype)
 
 
-def large_panel_section(tpu_ok):
+def large_panel_section(tpu_ok, persist=None):
     """ALS + EM at (T, N, r) = (2048, 4096, 8): seconds per iteration, the
     FLOPs-model throughput, MFU vs the v5e bf16 peak, and (on TPU) the
-    CPU-host comparison ratio for the same compiled program."""
+    CPU-host comparison ratio for the same compiled program.
+
+    `persist`, when given, is called with the accumulated fields after
+    EVERY measured program (TPU ALS, TPU EM, then the CPU legs): this
+    section's remote compiles are where the 2026-07-31 window died, so
+    each live timing must hit disk the moment it exists."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -346,7 +360,11 @@ def large_panel_section(tpu_ok):
         compute_panel_stats,
         em_step_stats,
     )
-    from dynamic_factor_models_tpu.ops.linalg import pca_score, standardize_data
+    from dynamic_factor_models_tpu.ops.linalg import (
+        pca_score_np,
+        standardize_data,
+        standardize_data_np,
+    )
     from dynamic_factor_models_tpu.ops.masking import fillz, mask_of
     from dynamic_factor_models_tpu.utils.backend import on_backend
 
@@ -355,12 +373,19 @@ def large_panel_section(tpu_ok):
 
     n_als, n_em = 8, 4
 
+    # init on the host: f0 quality does not affect the timed
+    # fixed-iteration program, and the (2048, 4096) device SVD is the
+    # single biggest remote-compile surface in the whole bench — it is
+    # where the 2026-07-31 live window died
+    xh, _, _ = standardize_data_np(x)
+    f0_host = pca_score_np(xh, r)
+
     def run_als(backend):
         with on_backend(backend):
             xj = jnp.asarray(x)
             xstd, _ = standardize_data(xj)
             xz, m = fillz(xstd), mask_of(xstd).astype(xstd.dtype)
-            f0 = pca_score(jnp.where(jnp.isnan(xstd), 0.0, xstd), r)
+            f0 = jnp.asarray(f0_host, xstd.dtype)
             lam_ok = jnp.ones(N, bool)
             args = (xz, m, lam_ok, f0, jnp.float32(0.0), r, n_als)
             _als_core(*args)[0].block_until_ready()  # compile
@@ -394,33 +419,50 @@ def large_panel_section(tpu_ok):
             iters().lam.block_until_ready()  # compile
             return _time_fixed_iters(lambda: iters().lam.block_until_ready())
 
+    out = {}
+
+    def _emit(fields):
+        out.update(fields)
+        if persist is not None:
+            persist(dict(out))
+
     als_t = run_als(None) / n_als
-    em_t = run_em(None) / n_em
     als_flops = als_iter_flops(T, N, r) / als_t
-    em_flops = em_iter_flops(T, N, r, 1) / em_t
-    out = {
+    fields = {
         "als_large_iters_per_sec": round(1.0 / als_t, 2),
         "als_large_flops_per_sec": round(als_flops, 0),
+    }
+    if tpu_ok:
+        fields["als_large_mfu_bf16_peak_pct"] = round(
+            100.0 * als_flops / PEAK_FLOPS_V5E_BF16, 2
+        )
+    _emit(fields)
+    em_t = run_em(None) / n_em
+    em_flops = em_iter_flops(T, N, r, 1) / em_t
+    fields = {
         "em_large_iters_per_sec": round(1.0 / em_t, 2),
         "em_large_flops_per_sec": round(em_flops, 0),
     }
     if tpu_ok:
-        out["als_large_mfu_bf16_peak_pct"] = round(
-            100.0 * als_flops / PEAK_FLOPS_V5E_BF16, 2
-        )
-        out["em_large_mfu_bf16_peak_pct"] = round(
+        fields["em_large_mfu_bf16_peak_pct"] = round(
             100.0 * em_flops / PEAK_FLOPS_V5E_BF16, 2
         )
+    _emit(fields)
+    if tpu_ok:
         # same programs pinned to the host CPU: the attribution ratio
         als_cpu_t = run_als("cpu") / n_als
+        _emit({"als_large_tpu_over_cpu": round(als_cpu_t / als_t, 1)})
         em_cpu_t = run_em("cpu") / n_em
-        out["als_large_tpu_over_cpu"] = round(als_cpu_t / als_t, 1)
-        out["em_large_tpu_over_cpu"] = round(em_cpu_t / em_t, 1)
+        _emit({"em_large_tpu_over_cpu": round(em_cpu_t / em_t, 1)})
     else:
-        out["als_large_mfu_bf16_peak_pct"] = None
-        out["em_large_mfu_bf16_peak_pct"] = None
-        out["als_large_tpu_over_cpu"] = None
-        out["em_large_tpu_over_cpu"] = None
+        _emit(
+            {
+                "als_large_mfu_bf16_peak_pct": None,
+                "em_large_mfu_bf16_peak_pct": None,
+                "als_large_tpu_over_cpu": None,
+                "em_large_tpu_over_cpu": None,
+            }
+        )
     return out
 
 
@@ -562,11 +604,62 @@ def crossover_table():
         )
 
 
+EVIDENCE_PATH = os.path.join(REPO, "docs", "TPU_EVIDENCE.json")
+
+
+def _load_evidence():
+    """The durable evidence store's contents, or None."""
+    try:
+        with open(EVIDENCE_PATH) as fh:
+            return json.load(fh)
+    except (OSError, ValueError):
+        return None
+
+
+def _update_live_evidence(fields: dict):
+    """Accumulate live-TPU-measured fields into the durable evidence store
+    (docs/TPU_EVIDENCE.json).  The tunnel opens in short windows hours
+    apart, so every live number is written to disk the moment it exists;
+    the orchestrator merges the store (prefixed tpu_live_*) into any
+    CPU-fallback report so evidence from an earlier window survives a
+    wedged driver-time tunnel."""
+    if fields.get("tpu_unreachable", True):
+        return
+    ev = _load_evidence() or {}
+    new = {
+        k: v
+        for k, v in fields.items()
+        if v is not None
+        and k not in ("remainder", "tpu_unreachable")
+        and ev.get(k) != v
+    }
+    if not new:
+        return
+    ev.update(new)
+    now = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    ev["captured_at_utc"] = now
+    # per-window capture log: each write records WHICH fields it set, so a
+    # field's provenance stays traceable to the window that measured it
+    # even after later windows update other fields
+    ev.setdefault("windows", []).append({"at": now, "fields": sorted(new)})
+    tmp = EVIDENCE_PATH + ".tmp"
+    try:
+        with open(tmp, "w") as fh:
+            json.dump(ev, fh, indent=1, sort_keys=True)
+        os.replace(tmp, EVIDENCE_PATH)
+    except OSError as e:
+        # never kill a measuring child over the store, but a lost write of
+        # scarce live-window evidence must be loud in the child's stderr
+        print(f"bench: EVIDENCE STORE WRITE FAILED: {e}", file=sys.stderr)
+
+
 def _persist_partial(fields: dict):
     """Write the accumulated section results to DFM_BENCH_PARTIAL (atomic
     rename) after every completed section: if the tunnel wedges mid-run and
     this child dies, the orchestrator salvages the TPU sections that DID
-    finish instead of losing the whole run (round-3 verdict item 2)."""
+    finish instead of losing the whole run (round-3 verdict item 2).  Live
+    TPU fields are additionally folded into the durable evidence store."""
+    _update_live_evidence(fields)
     path = os.environ.get("DFM_BENCH_PARTIAL")
     if not path:
         return
@@ -574,6 +667,71 @@ def _persist_partial(fields: dict):
     with open(tmp, "w") as fh:
         json.dump(fields, fh)
     os.replace(tmp, path)
+
+
+def run_tpu_remainder(force_cpu: bool = False):
+    """Child mode for short tunnel windows: ONLY the TPU sections the
+    2026-07-31 salvaged live record is missing, cheapest compile surface
+    first (pallas -> device parity -> large panel -> crossover), persisting
+    to DFM_BENCH_PARTIAL after every section so a mid-run wedge keeps
+    whatever finished.  Prints the accumulated JSON on stdout.
+
+    NOTE: call only after a successful tunnel probe (tools/tpu_watch.sh
+    does) — a direct jax.devices() against a wedged tunnel hangs rather
+    than failing.  --force-cpu pins the CPU platform first, which drives
+    the no-TPU error exit deterministically."""
+    import io as _io
+    from contextlib import redirect_stdout
+
+    import jax
+
+    if force_cpu:
+        jax.config.update("jax_platforms", "cpu")
+    dev = jax.devices()[0]
+    if dev.platform not in ("tpu", "axon"):
+        print(json.dumps({"error": f"no TPU device ({dev.platform})"}), flush=True)
+        sys.exit(2)
+    partial = {"device": str(dev), "tpu_unreachable": False, "remainder": True}
+    _persist_partial(partial)
+
+    partial.update(pallas_section())
+    _persist_partial(partial)
+    print(json.dumps(partial), file=sys.stderr, flush=True)
+
+    from dynamic_factor_models_tpu.io.cache import cached_dataset
+
+    ds = cached_dataset("Real")
+    with jax.default_matmul_precision("highest"):
+        parity = device_parity_checks(ds)
+    partial.update(parity)
+    partial["parity_ok"] = all(
+        parity.get(k) is not None and parity[k] <= thresh
+        for k, thresh in PARITY_THRESHOLDS.items()
+    )
+    _persist_partial(partial)
+    print(json.dumps(partial), file=sys.stderr, flush=True)
+
+    def _persist_large(fields):
+        snap = dict(partial)
+        snap.update(fields)
+        _persist_partial(snap)
+
+    partial.update(large_panel_section(True, persist=_persist_large))
+    _persist_partial(partial)
+    print(json.dumps(partial), file=sys.stderr, flush=True)
+
+    buf = _io.StringIO()
+    with redirect_stdout(buf):
+        crossover_table()
+    partial["crossover_markdown"] = buf.getvalue()
+    _persist_partial(partial)
+    print(json.dumps(partial), flush=True)
+    if not partial["parity_ok"]:
+        # all sections captured, but the device-parity gate failed: exit 1
+        # (distinct from the incomplete-run exit) so the watcher surfaces
+        # the failure instead of declaring the evidence complete
+        print("bench: REMAINDER COMPLETE BUT PARITY FAILED", file=sys.stderr)
+        sys.exit(1)
 
 
 def bench_main(force_cpu: bool):
@@ -666,7 +824,12 @@ def bench_main(force_cpu: bool):
     )
     _persist_partial(partial)
 
-    large = large_panel_section(tpu_ok)
+    def _persist_large(fields):
+        snap = dict(partial)
+        snap.update(fields)
+        _persist_partial(snap)
+
+    large = large_panel_section(tpu_ok, persist=_persist_large)
     partial.update(large)
     _persist_partial(partial)
     mf = mixed_freq_section()
@@ -952,6 +1115,18 @@ def orchestrate():
         print("bench: measured child produced no JSON", file=sys.stderr)
         sys.exit(2)
     fragment.update(precision)
+    if fragment.get("tpu_unreachable"):
+        # fold in live numbers captured in an earlier tunnel window (clearly
+        # labeled with their capture timestamp) so a wedged driver-time
+        # tunnel does not erase evidence that already exists on disk
+        ev = _load_evidence()
+        if ev:
+            fragment.update({f"tpu_live_{k}": v for k, v in ev.items()})
+            print(
+                "bench: merged prior live-window TPU evidence "
+                f"({len(ev)} fields from docs/TPU_EVIDENCE.json)",
+                file=sys.stderr,
+            )
     fragment["probe_attempts"] = attempts
     fragment["probe_elapsed_s"] = round(time.monotonic() - t_start, 1)
     print(json.dumps(fragment))
@@ -978,8 +1153,11 @@ def main():
     ap.add_argument("--factor-in")
     ap.add_argument("--crossover", action="store_true")
     ap.add_argument("--stage-parity", action="store_true")
+    ap.add_argument("--run-tpu-remainder", action="store_true")
     args = ap.parse_args()
-    if args.run_parity_programs:
+    if args.run_tpu_remainder:
+        run_tpu_remainder(force_cpu=args.force_cpu)
+    elif args.run_parity_programs:
         run_parity_programs(args.out, args.factor_in)
     elif args.run_main:
         bench_main(force_cpu=args.force_cpu)

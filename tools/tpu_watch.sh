@@ -1,0 +1,47 @@
+#!/usr/bin/env bash
+# TPU tunnel watcher: probe every PROBE_INTERVAL_S seconds; the moment the
+# tunnel answers, run `bench.py --run-tpu-remainder` (the TPU sections the
+# salvaged 2026-07-31 live record is missing).  Every completed section is
+# folded into docs/TPU_EVIDENCE.json by the bench child itself, so a wedge
+# mid-remainder still keeps whatever finished.  Exit 0: remainder fully
+# complete.  Exit 1: complete but the device-parity gate FAILED (surfaced,
+# not swallowed).  Any other child rc: incomplete window — keep probing.
+set -u
+cd "$(dirname "$0")/.."
+LOG=docs/tpu_probe_r04.log
+INTERVAL="${PROBE_INTERVAL_S:-300}"
+
+# re-stage the CPU parity leg up front (bench.py edits invalidate its code
+# rev) so none of the scarce live window is spent on host-only work
+if python bench.py --stage-parity >> /tmp/tpu_watch_stage.log 2>&1; then
+  echo "$(date -u +%FT%TZ) watcher: parity CPU leg staged" >> "$LOG"
+else
+  echo "$(date -u +%FT%TZ) watcher: STAGE-PARITY FAILED (see /tmp/tpu_watch_stage.log) — live window will recompute the CPU leg" >> "$LOG"
+fi
+
+while true; do
+  # compute probe, not just enumeration: a wedged tunnel can answer
+  # jax.devices() and still hang on the first executable
+  if timeout -k 10 90 python -c "
+import jax, jax.numpy as jnp
+assert jax.devices()[0].platform in ('tpu', 'axon')
+jax.block_until_ready(jnp.ones(8).sum())
+" >/dev/null 2>&1; then
+    echo "$(date -u +%FT%TZ) watcher probe LIVE — running bench.py --run-tpu-remainder" >> "$LOG"
+    DFM_BENCH_PARTIAL=/tmp/tpu_remainder_partial.json \
+      timeout -k 30 5400 python bench.py --run-tpu-remainder \
+      > /tmp/tpu_remainder.out 2> /tmp/tpu_remainder.err
+    rc=$?
+    echo "$(date -u +%FT%TZ) watcher remainder rc=$rc (logs /tmp/tpu_remainder.{out,err})" >> "$LOG"
+    if [ "$rc" -eq 0 ]; then
+      echo "$(date -u +%FT%TZ) watcher remainder COMPLETE — docs/TPU_EVIDENCE.json has every TPU field" >> "$LOG"
+      exit 0
+    elif [ "$rc" -eq 1 ]; then
+      echo "$(date -u +%FT%TZ) watcher remainder COMPLETE BUT DEVICE PARITY FAILED — inspect /tmp/tpu_remainder.out" >> "$LOG"
+      exit 1
+    fi
+  else
+    echo "$(date -u +%FT%TZ) watcher probe WEDGED" >> "$LOG"
+  fi
+  sleep "$INTERVAL"
+done
