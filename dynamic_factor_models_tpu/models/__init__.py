@@ -36,6 +36,7 @@ from .selection import (
 from .constraints import LambdaConstraint, construct_constraint
 from .instability import InstabilityResults, instability_scan
 from .favar_instruments import cca_with_factors, choose_stepwise, favar_instrument_table
+from .emaccel import SquaremState, squarem, squarem_state
 from .ssm import (
     EMResults,
     PanelStats,

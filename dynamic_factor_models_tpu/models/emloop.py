@@ -63,14 +63,18 @@ def _fresh_carry(params, tol, max_em_iter):
     )
 
 
-def _fingerprint(args, tol, max_em_iter: int) -> str:
+def _fingerprint(args, tol, max_em_iter: int, params=None) -> str:
     """Digest tying a checkpoint to its run: data bytes, shapes/dtypes,
-    tolerance, and iteration cap — a resume against different inputs is an
-    error, not a silent override."""
+    tolerance, iteration cap, and the parameter pytree STRUCTURE — a
+    resume against different inputs, or across a step-transformer change
+    (plain vs SQUAREM-augmented state), is a clear fingerprint error, not
+    a confusing structural crash in the pytree loader."""
     import hashlib
 
     h = hashlib.sha256()
     h.update(repr((float(tol), int(max_em_iter))).encode())
+    if params is not None:
+        h.update(repr(jax.tree.structure(params)).encode())
     for leaf in jax.tree.leaves(args):
         a = np.asarray(leaf)
         h.update(repr((a.shape, str(a.dtype))).encode())
@@ -147,7 +151,7 @@ def run_em_loop(
 
         from ..utils.checkpoint import load_pytree, save_pytree
 
-        fp = _fingerprint(args, tol, max_em_iter)
+        fp = _fingerprint(args, tol, max_em_iter, params=params)
         if os.path.exists(checkpoint_path):
             stored = load_pytree(checkpoint_path, {"carry": carry, "fp": ""})
             if str(stored["fp"]) != fp:

@@ -225,6 +225,17 @@ class MFResults(NamedTuple):
     trace: object | None = None  # ConvergenceTrace when collect_path=True
 
 
+def _project_params_mf(params: MixedFreqParams) -> MixedFreqParams:
+    """Feasibility projection after SQUAREM extrapolation: R floored
+    positive, Q symmetrized/eigenvalue-floored.  `agg` is a constant of
+    the model — the EM map never moves it, so its extrapolation increments
+    are identically zero and it passes through untouched."""
+    return params._replace(
+        R=jnp.maximum(params.R, jnp.asarray(1e-8, params.R.dtype)),
+        Q=_psd_floor(params.Q),
+    )
+
+
 def estimate_mixed_freq_dfm(
     x,
     is_quarterly,
@@ -236,6 +247,7 @@ def estimate_mixed_freq_dfm(
     collect_path: bool = False,
     checkpoint_path: str | None = None,
     checkpoint_every: int = 25,
+    accel: str | None = None,
 ) -> MFResults:
     """Fit the mixed-frequency DFM on a MONTHLY-frequency (T, N) panel.
 
@@ -245,9 +257,15 @@ def estimate_mixed_freq_dfm(
 
     `x_hat` gives the model's smoothed value of every cell — including the
     monthly path of each quarterly series (the nowcasting readout).
+
+    accel="squarem" wraps the EM step in one SQUAREM extrapolation cycle
+    per loop iteration (`emaccel.squarem`; n_iter then counts cycles of
+    three EM-map evaluations each).
     """
     if p < _N_AGG:
         raise ValueError(f"p={p} must be >= {_N_AGG} for Mariano-Murasawa lags")
+    if accel not in (None, "squarem"):
+        raise ValueError(f"accel must be None or 'squarem', got {accel!r}")
     with on_backend(backend):
         x = jnp.asarray(x)
         is_q = np.asarray(is_quarterly, bool)
@@ -290,11 +308,19 @@ def estimate_mixed_freq_dfm(
         from .emloop import run_em_loop
 
         stats = compute_panel_stats(xz, m_arr)
+        step = em_step_mf_stats
+        if accel == "squarem":
+            from .emaccel import squarem, squarem_state
+
+            step = squarem(em_step_mf_stats, _project_params_mf)
+            params = squarem_state(params)
         params, llpath, it, trace = run_em_loop(
-            em_step_mf_stats, params, (xz, m_arr, stats), tol, max_em_iter,
+            step, params, (xz, m_arr, stats), tol, max_em_iter,
             collect_path=collect_path, trace_name="em_mixed_freq",
             checkpoint_path=checkpoint_path, checkpoint_every=checkpoint_every,
         )
+        if accel == "squarem":
+            params = params.params  # unwrap SquaremState
 
         s_sm, x_hat = _smooth_xhat_mf(params, xz, m_arr)
         return MFResults(

@@ -209,6 +209,18 @@ class EMARResults(NamedTuple):
     trace: object | None = None  # ConvergenceTrace when collect_path=True
 
 
+def _project_params_ar(params: SSMARParams) -> SSMARParams:
+    """Feasibility projection after SQUAREM extrapolation: idiosyncratic
+    AR roots clipped inside the unit circle, variances floored, Q
+    symmetrized/eigenvalue-floored (em_step_ar re-projects Q/sigv2 at
+    entry; the phi clip is the addition extrapolation makes necessary)."""
+    return params._replace(
+        phi=jnp.clip(params.phi, -0.99, 0.99),
+        sigv2=jnp.maximum(params.sigv2, jnp.asarray(1e-8, params.sigv2.dtype)),
+        Q=_psd_floor(params.Q),
+    )
+
+
 def estimate_dfm_em_ar(
     data,
     inclcode,
@@ -221,12 +233,19 @@ def estimate_dfm_em_ar(
     collect_path: bool = False,
     checkpoint_path: str | None = None,
     checkpoint_every: int = 25,
+    accel: str | None = None,
 ) -> EMARResults:
     """Full Banbura-Modugno EM: factors + AR(1) idiosyncratic states.
 
     Initialized from the iid-noise EM fit (`ssm.estimate_dfm_em`), whose R
     becomes the initial sigv2 with phi = 0.
+
+    accel="squarem" wraps the EM step in one SQUAREM extrapolation cycle
+    per loop iteration (`emaccel.squarem`; n_iter then counts cycles of
+    three EM-map evaluations each).
     """
+    if accel not in (None, "squarem"):
+        raise ValueError(f"accel must be None or 'squarem', got {accel!r}")
     with on_backend(backend):
         data = jnp.asarray(data)
         inclcode = np.asarray(inclcode)
@@ -250,11 +269,19 @@ def estimate_dfm_em_ar(
 
         from .emloop import run_em_loop
 
+        step = em_step_ar
+        if accel == "squarem":
+            from .emaccel import squarem, squarem_state
+
+            step = squarem(em_step_ar, _project_params_ar)
+            params = squarem_state(params)
         params, llpath, it, trace = run_em_loop(
-            em_step_ar, params, (xz, m_arr), tol, max_em_iter,
+            step, params, (xz, m_arr), tol, max_em_iter,
             collect_path=collect_path, trace_name="em_dfm_ar",
             checkpoint_path=checkpoint_path, checkpoint_every=checkpoint_every,
         )
+        if accel == "squarem":
+            params = params.params  # unwrap SquaremState
 
         means, covs, pmeans, pcovs, _ = _filter_ar(params, xz, m_arr)
         s_sm, _, _ = _smoother_ar(params, means, covs, pmeans, pcovs)

@@ -1,0 +1,196 @@
+"""SQUAREM EM acceleration (models/emaccel.py): same fixed point as plain
+EM, loglik-guarded monotonicity, and materially fewer map evaluations on a
+slow-converging (persistent-factor) panel.  The reference has no EM at all
+(its `Parametric()` path is declared but unimplemented, SURVEY.md §2.3), so
+these tests pin framework-side semantics, not reference parity."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dynamic_factor_models_tpu.models.emaccel import squarem, squarem_state
+from dynamic_factor_models_tpu.models.emloop import run_em_loop
+from dynamic_factor_models_tpu.models.ssm import (
+    SSMParams,
+    _project_params,
+    compute_panel_stats,
+    em_step_stats,
+    kalman_filter,
+)
+from dynamic_factor_models_tpu.ops.masking import fillz, mask_of
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(11)
+
+
+def _slow_panel(rng, T=160, N=12, r=2, rho=0.95, missing=0.1):
+    """Persistent factors + noisy series: the slow-EM regime (EM's
+    geometric rate degrades as factor persistence and noise rise)."""
+    f = np.zeros((T, r))
+    for t in range(1, T):
+        f[t] = rho * f[t - 1] + rng.standard_normal(r) * np.sqrt(1 - rho**2)
+    lam = rng.standard_normal((N, r)) * 0.6
+    x = f @ lam.T + 1.5 * rng.standard_normal((T, N))
+    x[rng.random((T, N)) < missing] = np.nan
+    return x
+
+
+def _setup(x, rng, r=2):
+    xj = jnp.asarray(x)
+    m = mask_of(xj).astype(xj.dtype)
+    xz = fillz(xj)
+    N = x.shape[1]
+    # random loading init: an exactly-zero loading column is an EM fixed
+    # point of its own (the unloaded factor's smoothed mean is identically
+    # zero, so its M-step loading stays zero)
+    params = SSMParams(
+        lam=jnp.asarray(0.1 * rng.standard_normal((N, r))),
+        R=jnp.ones(N),
+        A=0.5 * jnp.eye(r)[None],
+        Q=jnp.eye(r),
+    )
+    stats = compute_panel_stats(xz, m)
+    return params, (xz, m, stats)
+
+
+def _loglik(params, x):
+    xn = jnp.where(jnp.isnan(jnp.asarray(x)), jnp.nan, jnp.asarray(x))
+    return float(kalman_filter(params, xn).loglik)
+
+
+def test_squarem_reaches_plain_em_fixed_point(rng):
+    x = _slow_panel(rng)
+    params, args = _setup(x, rng)
+    tol = 1e-7
+    plain, _, n_plain, _ = run_em_loop(em_step_stats, params, args, tol, 4000)
+    assert int(n_plain) < 4000, "plain EM must actually converge for this test"
+    accel_step = squarem(em_step_stats, _project_params)
+    state, _, n_cycles, _ = run_em_loop(
+        accel_step, squarem_state(params), args, tol, 4000
+    )
+    accel = state.params
+    ll_plain = _loglik(plain, x)
+    ll_accel = _loglik(accel, x)
+    # both at the same maximum: accelerated must not be below plain beyond
+    # the convergence tolerance's own slack
+    assert ll_accel >= ll_plain - 1e-3 * (1 + abs(ll_plain))
+    # the DFM is identified only up to an invertible factor transform
+    # (lam -> lam G^-1, Q -> G Q G'), so compare the scale-invariant
+    # common-component covariance lam Q lam' and the idiosyncratic R
+    cc_p = np.asarray(plain.lam @ plain.Q @ plain.lam.T)
+    cc_a = np.asarray(accel.lam @ accel.Q @ accel.lam.T)
+    scale = np.abs(cc_p).max()
+    assert np.allclose(cc_a, cc_p, atol=5e-2 * scale), np.abs(cc_a - cc_p).max()
+    assert np.allclose(
+        np.asarray(accel.R), np.asarray(plain.R), rtol=8e-2, atol=5e-3
+    ), "idiosyncratic variances diverged between plain and accelerated EM"
+
+
+def test_squarem_uses_fewer_map_evaluations(rng):
+    x = _slow_panel(rng)
+    params, args = _setup(x, rng)
+    tol = 1e-7
+    _, _, n_plain, _ = run_em_loop(em_step_stats, params, args, tol, 4000)
+    accel_step = squarem(em_step_stats, _project_params)
+    _, _, n_cycles, _ = run_em_loop(
+        accel_step, squarem_state(params), args, tol, 4000
+    )
+    # one cycle = three EM-map evaluations; require a real win, not parity
+    assert 3 * int(n_cycles) < int(n_plain), (
+        f"SQUAREM used {3 * int(n_cycles)} map evals vs plain {int(n_plain)}"
+    )
+
+
+def test_squarem_loglik_path_monotone(rng):
+    x = _slow_panel(rng)
+    params, args = _setup(x, rng)
+    accel_step = squarem(em_step_stats, _project_params)
+    _, llpath, n_cycles, _ = run_em_loop(
+        accel_step, squarem_state(params), args, 0.0, 25, collect_path=True
+    )
+    ll = np.asarray(llpath)
+    diffs = np.diff(ll)
+    # the guard enforces per-cycle monotonicity up to float slack
+    assert (diffs >= -1e-6 * (1 + np.abs(ll[:-1]))).all(), diffs.min()
+
+
+def test_squarem_cache_returns_same_object():
+    a = squarem(em_step_stats, _project_params)
+    b = squarem(em_step_stats, _project_params)
+    assert a is b, "squarem must cache on (step, project) for jit reuse"
+
+
+def test_estimate_dfm_em_accel_end_to_end(dataset_real):
+    from dynamic_factor_models_tpu.models.ssm import estimate_dfm_em
+
+    plain = estimate_dfm_em(
+        dataset_real.bpdata, dataset_real.inclcode, 2, 223, max_em_iter=40
+    )
+    accel = estimate_dfm_em(
+        dataset_real.bpdata,
+        dataset_real.inclcode,
+        2,
+        223,
+        max_em_iter=40,
+        accel="squarem",
+    )
+    # same data/init: the accelerated run must be at least as advanced
+    ll_p = plain.loglik_path[~np.isnan(plain.loglik_path)]
+    ll_a = accel.loglik_path[~np.isnan(accel.loglik_path)]
+    assert ll_a[-1] >= ll_p[-1] - 1e-3 * (1 + abs(ll_p[-1]))
+    assert accel.factors.shape == plain.factors.shape
+
+    with pytest.raises(ValueError, match="accel"):
+        estimate_dfm_em(
+            dataset_real.bpdata,
+            dataset_real.inclcode,
+            2,
+            223,
+            max_em_iter=2,
+            accel="anderson",
+        )
+
+
+def test_accel_wiring_ssm_ar(rng):
+    """estimate_dfm_em_ar(accel='squarem') reaches at least the plain
+    run's loglik on the same synthetic panel and init."""
+    from test_ssm_ar import _dgp
+
+    from dynamic_factor_models_tpu.models.dfm import DFMConfig
+    from dynamic_factor_models_tpu.models.ssm_ar import estimate_dfm_em_ar
+
+    x, _f, _lam, _e = _dgp()
+    cfg = DFMConfig(nfac_u=1, n_factorlag=1)
+    inclcode = np.ones(x.shape[1])
+    plain = estimate_dfm_em_ar(
+        x, inclcode, 0, x.shape[0] - 1, cfg, max_em_iter=30
+    )
+    accel = estimate_dfm_em_ar(
+        x, inclcode, 0, x.shape[0] - 1, cfg, max_em_iter=30, accel="squarem"
+    )
+    ll_p = plain.loglik_path[~np.isnan(plain.loglik_path)]
+    ll_a = accel.loglik_path[~np.isnan(accel.loglik_path)]
+    assert ll_a[-1] >= ll_p[-1] - 1e-3 * (1 + abs(ll_p[-1]))
+    assert np.abs(np.asarray(accel.params.phi)).max() < 1.0
+
+
+def test_accel_wiring_mixed_freq():
+    """estimate_mixed_freq_dfm(accel='squarem') matches the plain run's
+    progress and keeps the aggregation weights untouched."""
+    from test_mixed_freq import _dgp
+
+    from dynamic_factor_models_tpu.models.mixed_freq import (
+        estimate_mixed_freq_dfm,
+    )
+
+    x, is_q, _f, _fa, _xl = _dgp(T=240, Nm=8, Nq=3, seed=3)
+    plain = estimate_mixed_freq_dfm(x, is_q, r=1, max_em_iter=25)
+    accel = estimate_mixed_freq_dfm(x, is_q, r=1, max_em_iter=25, accel="squarem")
+    ll_p = plain.loglik_path[~np.isnan(plain.loglik_path)]
+    ll_a = accel.loglik_path[~np.isnan(accel.loglik_path)]
+    assert ll_a[-1] >= ll_p[-1] - 1e-3 * (1 + abs(ll_p[-1]))
+    assert np.allclose(
+        np.asarray(accel.params.agg), np.asarray(plain.params.agg)
+    ), "agg is a model constant; extrapolation must not move it"
