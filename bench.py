@@ -248,21 +248,16 @@ def device_parity_checks(ds):
 
     staged = os.path.join(REPO, "build", "parity_staged_cpu.npz")
     cpu = None
-    if os.path.exists(staged):
+    # freshness rule shared with the watcher: code_rev match implies the
+    # file was written by this bench.py, which always includes every leg
+    if parity_staged_fresh():
         try:
             cpu = dict(np.load(staged))
-            rev = cpu.pop("code_rev", None)
-            if "smoother_sqrt" not in cpu or (
-                rev is None or str(rev) != _parity_code_rev()
-            ):
-                # stale: pre-sqrt-leg file, or written by a different code
-                # revision — recompute rather than compare across revisions
-                cpu = None
-            else:
-                print(
-                    f"bench: using pre-staged CPU parity leg {staged}",
-                    file=sys.stderr,
-                )
+            cpu.pop("code_rev", None)
+            print(
+                f"bench: using pre-staged CPU parity leg {staged}",
+                file=sys.stderr,
+            )
         except Exception:
             cpu = None
     if cpu is None:
@@ -292,6 +287,21 @@ def stage_parity():
         res = parity_programs(ds, "cpu")
     np.savez(out, code_rev=_parity_code_rev(), **res)
     print(f"staged CPU parity leg: {out}", file=sys.stderr)
+
+
+def parity_staged_fresh() -> bool:
+    """True when the staged CPU parity leg exists and matches the current
+    code revision — the single copy of the freshness rule, shared by
+    `device_parity_checks` and the watcher (`bench.py --parity-staged-fresh`
+    exits 0/1 on it; reads one npz member lazily, no jax import)."""
+    import numpy as np
+
+    staged = os.path.join(REPO, "build", "parity_staged_cpu.npz")
+    try:
+        with np.load(staged) as z:
+            return str(z["code_rev"]) == _parity_code_rev()
+    except (OSError, KeyError, ValueError):
+        return False
 
 
 def run_parity_programs(out_path, factor_in):
@@ -529,15 +539,17 @@ def pallas_section():
             best = min(best, time.perf_counter() - t)
         return best
 
-    def _gram_body(fn):
+    def _gram_body(fn, X, Y, W):
         # the carry must feed an input EVERY output depends on (W feeds
         # both the A and rhs contractions): perturbing only Y lets XLA
         # hoist the Y-independent A-einsum out of the loop (LICM), and
         # anything less than full output dependence lets it dead-code-
         # eliminate the op — either way the XLA side would be under-timed
-        # vs the opaque kernel
+        # vs the opaque kernel.  The perturbation is cast to W's dtype so a
+        # bf16 W stays bf16 (1e-30 is representable in bf16: same exponent
+        # range as f32).
         def body(i, carry):
-            A, b = fn(Xb, Yb, Wb + carry * 1e-30)
+            A, b = fn(X, Y, W + (carry * 1e-30).astype(W.dtype))
             return A.sum() * 1e-30 + b.sum() * 1e-30
 
         return body
@@ -545,11 +557,20 @@ def pallas_section():
     # n large enough that kernel time (~250us/call) swamps the ~30ms fixed
     # dispatch cost of one remote loop launch
     n_gram = 1000
-    t_pallas = _loop_time(_gram_body(masked_gram_pallas), n_gram) / n_gram
-    t_xla = _loop_time(_gram_body(masked_gram_xla), n_gram) / n_gram
+    t_pallas = _loop_time(_gram_body(masked_gram_pallas, Xb, Yb, Wb), n_gram) / n_gram
+    t_xla = _loop_time(_gram_body(masked_gram_xla, Xb, Yb, Wb), n_gram) / n_gram
+    # bf16 operand legs: the HBM-bandwidth option (panel cast OUTSIDE the
+    # loop, f32 accumulation inside the kernels — ops/pallas_gram.py dtype
+    # contract); the fields quantify the bandwidth claim on real hardware
+    X16, Y16, W16 = (a.astype(jnp.bfloat16) for a in (Xb, Yb, Wb))
+    t_pallas16 = _loop_time(_gram_body(masked_gram_pallas, X16, Y16, W16), n_gram) / n_gram
+    t_xla16 = _loop_time(_gram_body(masked_gram_xla, X16, Y16, W16), n_gram) / n_gram
     return {
         "pallas_gram_speedup_large_panel": round(t_xla / t_pallas, 2),
         "pallas_gram_us_per_call": round(t_pallas * 1e6, 1),
+        "pallas_gram_bf16_speedup_vs_f32": round(t_pallas / t_pallas16, 2),
+        "xla_gram_bf16_speedup_vs_f32": round(t_xla / t_xla16, 2),
+        "pallas_gram_bf16_us_per_call": round(t_pallas16 * 1e6, 1),
     }
 
 
@@ -1154,8 +1175,11 @@ def main():
     ap.add_argument("--crossover", action="store_true")
     ap.add_argument("--stage-parity", action="store_true")
     ap.add_argument("--run-tpu-remainder", action="store_true")
+    ap.add_argument("--parity-staged-fresh", action="store_true")
     args = ap.parse_args()
-    if args.run_tpu_remainder:
+    if args.parity_staged_fresh:
+        sys.exit(0 if parity_staged_fresh() else 1)
+    elif args.run_tpu_remainder:
         run_tpu_remainder(force_cpu=args.force_cpu)
     elif args.run_parity_programs:
         run_parity_programs(args.out, args.factor_in)

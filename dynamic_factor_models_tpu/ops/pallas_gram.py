@@ -78,10 +78,22 @@ def masked_gram_pallas(
     X: (T, K) shared regressors; Y: (T, N) targets (NaN-free — pre-fill
     missing with 0); W: (T, N) 0/1 weights.  Zero-weight padding rows and
     columns contribute nothing, so inputs are zero-padded to tile multiples.
+
+    bfloat16 inputs are the HBM-bandwidth option for the large-panel
+    regime (the kernel is bandwidth-bound: one pass over Y and W dominates
+    its cost, and bf16 halves it).  Accumulation is always at least f32 —
+    the MXU takes bf16 operands with an f32 accumulator natively — and the
+    returned Grams are f32, so the per-series solves downstream are
+    unaffected.  Cast the panel ONCE outside an iteration loop: a cast at
+    every call spends the pass it is meant to save.  The VPU-side
+    regressor products are formed in bf16 too (~3 decimal digits), so this
+    is an opt-in for iterative refinement at scale, not for golden-parity
+    paths.
     """
     T, K = X.shape
     N = Y.shape[1]
     dtype = X.dtype
+    acc_dtype = jnp.promote_types(dtype, jnp.float32)
     Tp = -(-T // tile_t) * tile_t
     Np = -(-N // tile_n) * tile_n
     Xp = jnp.zeros((Tp, K), dtype).at[:T].set(X)
@@ -102,12 +114,13 @@ def masked_gram_pallas(
             pl.BlockSpec((K, tile_n), lambda i, j: (0, i), memory_space=pltpu.VMEM),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((K * K, Np), dtype),
-            jax.ShapeDtypeStruct((K, Np), dtype),
+            jax.ShapeDtypeStruct((K * K, Np), acc_dtype),
+            jax.ShapeDtypeStruct((K, Np), acc_dtype),
         ],
         cost_estimate=pl.CostEstimate(
             flops=2 * Tp * Np * K * (K + 1) + Tp * K * K,
-            bytes_accessed=(Tp * K + 2 * Tp * Np + Np * K * (K + 1)) * dtype.itemsize,
+            bytes_accessed=(Tp * K + 2 * Tp * Np) * dtype.itemsize
+            + Np * K * (K + 1) * jnp.dtype(acc_dtype).itemsize,
             transcendentals=0,
         ),
         interpret=interpret,
@@ -118,10 +131,16 @@ def masked_gram_pallas(
 def masked_gram_xla(
     X: jnp.ndarray, Y: jnp.ndarray, W: jnp.ndarray
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """Reference XLA path: the einsum pair the kernel fuses."""
+    """Reference XLA path: the einsum pair the kernel fuses.
+
+    Same dtype contract as the kernel: bf16 inputs contract with an f32
+    accumulator and return f32 Grams."""
+    acc_dtype = jnp.promote_types(X.dtype, jnp.float32)
     W = W.astype(X.dtype)
-    A = jnp.einsum("tk,tn,tl->nkl", X, W, X)
-    rhs = jnp.einsum("tk,tn->nk", X, W * Y)
+    A = jnp.einsum("tk,tn,tl->nkl", X, W, X, preferred_element_type=acc_dtype)
+    rhs = jnp.einsum(
+        "tk,tn->nk", X, W * Y, preferred_element_type=acc_dtype
+    )
     return A, rhs
 
 

@@ -146,3 +146,43 @@ def test_gram_feeds_batched_ols(rng):
     np.testing.assert_allclose(
         np.nan_to_num(r), np.zeros_like(r), atol=1e-8
     )
+
+
+def test_bf16_inputs_accumulate_f32(rng):
+    """bfloat16 panels are the HBM-bandwidth option: both paths must
+    return f32 Grams (f32 accumulation) whose values track the f64
+    reference at bf16 operand precision."""
+    T, N, K = 192, 96, 6
+    Xd = rng.standard_normal((T, K))
+    Yd = rng.standard_normal((T, N))
+    Wd = (rng.random((T, N)) > 0.2).astype(np.float64)
+    A_ref, b_ref = masked_gram_xla(
+        jnp.asarray(Xd), jnp.asarray(Yd), jnp.asarray(Wd)
+    )
+    X16 = jnp.asarray(Xd, jnp.bfloat16)
+    Y16 = jnp.asarray(Yd, jnp.bfloat16)
+    W16 = jnp.asarray(Wd, jnp.bfloat16)
+    scale_A = float(np.abs(np.asarray(A_ref)).max())
+    scale_b = float(np.abs(np.asarray(b_ref)).max())
+    for A, b in (
+        masked_gram_xla(X16, Y16, W16),
+        masked_gram_pallas(X16, Y16, W16, tile_t=64, tile_n=64, interpret=True),
+    ):
+        assert A.dtype == jnp.float32 and b.dtype == jnp.float32
+        # bf16 operands carry ~2-3 decimal digits; the f32 accumulator must
+        # keep the reduction error at operand level, not grow with T
+        assert float(jnp.abs(A - A_ref.astype(jnp.float32)).max()) < 3e-2 * scale_A
+        assert float(jnp.abs(b - b_ref.astype(jnp.float32)).max()) < 3e-2 * scale_b
+
+
+def test_f32_f64_dtype_contract_unchanged(rng):
+    """The pre-bf16 contract is preserved: f32 in -> f32 out, f64 -> f64."""
+    T, N, K = 64, 32, 3
+    for dt in (jnp.float32, jnp.float64):
+        X = jnp.asarray(rng.standard_normal((T, K)), dt)
+        Y = jnp.asarray(rng.standard_normal((T, N)), dt)
+        W = jnp.asarray((rng.random((T, N)) > 0.2), dt)
+        A0, b0 = masked_gram_xla(X, Y, W)
+        A1, b1 = masked_gram_pallas(X, Y, W, tile_t=64, tile_n=64, interpret=True)
+        assert A0.dtype == dt and A1.dtype == dt
+        assert b0.dtype == dt and b1.dtype == dt

@@ -11,15 +11,37 @@ cd "$(dirname "$0")/.."
 LOG=docs/tpu_probe_r04.log
 INTERVAL="${PROBE_INTERVAL_S:-300}"
 
-# re-stage the CPU parity leg up front (bench.py edits invalidate its code
-# rev) so none of the scarce live window is spent on host-only work
-if python bench.py --stage-parity >> /tmp/tpu_watch_stage.log 2>&1; then
-  echo "$(date -u +%FT%TZ) watcher: parity CPU leg staged" >> "$LOG"
-else
-  echo "$(date -u +%FT%TZ) watcher: STAGE-PARITY FAILED (see /tmp/tpu_watch_stage.log) — live window will recompute the CPU leg" >> "$LOG"
-fi
+# stage the CPU parity leg whenever it is missing or its code rev has
+# drifted (edits to any hashed source invalidate it) so none of the scarce
+# live window is spent on host-only work; freshness is bench.py's own rule
+# (`--parity-staged-fresh`, one lazy npz member read, no jax import).  A
+# rev that failed to stage is remembered and not retried until the
+# sources change — a persistently failing stage must not starve the probe
+# loop this watcher exists for.
+LAST_FAILED_STAGE_REV=""
+stage_if_stale() {
+  if python bench.py --parity-staged-fresh 2>/dev/null; then
+    return 0
+  fi
+  local rev
+  rev=$(python -c "
+import importlib.util
+spec = importlib.util.spec_from_file_location('bench', 'bench.py')
+b = importlib.util.module_from_spec(spec); spec.loader.exec_module(b)
+print(b._parity_code_rev())" 2>/dev/null)
+  if [ -n "$rev" ] && [ "$rev" = "$LAST_FAILED_STAGE_REV" ]; then
+    return 0  # already failed on this exact code rev; don't retry
+  fi
+  if python bench.py --stage-parity >> /tmp/tpu_watch_stage.log 2>&1; then
+    echo "$(date -u +%FT%TZ) watcher: parity CPU leg (re)staged" >> "$LOG"
+  else
+    LAST_FAILED_STAGE_REV="$rev"
+    echo "$(date -u +%FT%TZ) watcher: STAGE-PARITY FAILED (see /tmp/tpu_watch_stage.log) — not retrying until sources change" >> "$LOG"
+  fi
+}
 
 while true; do
+  stage_if_stale
   # compute probe, not just enumeration: a wedged tunnel can answer
   # jax.devices() and still hang on the first executable
   if timeout -k 10 90 python -c "
