@@ -300,7 +300,9 @@ def parity_staged_fresh() -> bool:
     try:
         with np.load(staged) as z:
             return str(z["code_rev"]) == _parity_code_rev()
-    except (OSError, KeyError, ValueError):
+    except Exception:
+        # any unreadable state (missing, truncated zip from a killed
+        # np.savez, wrong schema) means "not fresh" — the caller recomputes
         return False
 
 
@@ -390,7 +392,7 @@ def large_panel_section(tpu_ok, persist=None):
     xh, _, _ = standardize_data_np(x)
     f0_host = pca_score_np(xh, r)
 
-    def run_als(backend):
+    def run_als(backend, gram_dtype=None):
         with on_backend(backend):
             xj = jnp.asarray(x)
             xstd, _ = standardize_data(xj)
@@ -398,10 +400,11 @@ def large_panel_section(tpu_ok, persist=None):
             f0 = jnp.asarray(f0_host, xstd.dtype)
             lam_ok = jnp.ones(N, bool)
             args = (xz, m, lam_ok, f0, jnp.float32(0.0), r, n_als)
-            _als_core(*args)[0].block_until_ready()  # compile
-            return _time_fixed_iters(
-                lambda: _als_core(*args)[0].block_until_ready()
-            )
+            run = lambda: _als_core(*args, gram_dtype=gram_dtype)[
+                0
+            ].block_until_ready()
+            run()  # compile
+            return _time_fixed_iters(run)
 
     def run_em(backend):
         with on_backend(backend):
@@ -459,6 +462,15 @@ def large_panel_section(tpu_ok, persist=None):
         )
     _emit(fields)
     if tpu_ok:
+        # bf16-Gram ALS iteration (mixed-precision bulk phase): quantifies
+        # the HBM-bandwidth option at the flagship size on real hardware
+        als_bf16_t = run_als(None, gram_dtype="bfloat16") / n_als
+        _emit(
+            {
+                "als_large_iters_per_sec_bf16": round(1.0 / als_bf16_t, 2),
+                "als_large_bf16_speedup_vs_f32": round(als_t / als_bf16_t, 2),
+            }
+        )
         # same programs pinned to the host CPU: the attribution ratio
         als_cpu_t = run_als("cpu") / n_als
         _emit({"als_large_tpu_over_cpu": round(als_cpu_t / als_t, 1)})

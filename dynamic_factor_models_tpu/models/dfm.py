@@ -105,7 +105,9 @@ class DFMResults(NamedTuple):
 # ---------------------------------------------------------------------------
 
 
-@partial(jax.jit, static_argnames=("nfac", "nfac_o", "max_iter", "n_constr"))
+@partial(
+    jax.jit, static_argnames=("nfac", "nfac_o", "max_iter", "n_constr", "gram_dtype")
+)
 def _als_core(
     xz,  # (Tw, ns) standardized data, NaN->0
     m,  # (Tw, ns) observation mask (float)
@@ -120,12 +122,24 @@ def _als_core(
     c_r=None,  # (nc, k) standardized restriction values
     nfac_o: int = 0,
     fo=None,  # (Tw, nfac_o) observed factors (NaN-free in the window)
+    gram_dtype: str | None = None,
+    n_iter_cap=None,  # traced iteration cap <= max_iter (shared-budget phases)
 ):
     from ..ops.pallas_gram import _TPU_PLATFORMS, _context_platform, masked_gram
 
     W = m * lam_ok[None, :]
     if nfac_o == 0:
         fo = jnp.zeros((xz.shape[0], 0), xz.dtype)
+
+    # gram_dtype="bfloat16": run both Gram contractions on bf16 operands
+    # (ops/pallas_gram.py dtype contract — f32 accumulation, f32 Grams) —
+    # the HBM-bandwidth option for the large-panel regime.  The panel
+    # copies are cast ONCE here, outside the while_loop; solves, factors,
+    # and the SSR stay f32, so the loop converges to the bf16-Gram map's
+    # fixed point, which estimate_factor's f32 polish phase then refines
+    # to the exact one.  Forces the masked_gram path so the semantics are
+    # identical (and testable) on every platform.
+    gd = None if gram_dtype is None else jnp.dtype(gram_dtype)
 
     # CPU fast-orientation path: both Gram contractions run as
     # contiguous-reduction GEMMs with packed-symmetric columns, with the
@@ -134,8 +148,13 @@ def _als_core(
     # ~5x slower on CPU, and XLA does not hoist transposes of loop
     # constants).  On TPU the natural layout feeds the Pallas kernel /
     # MXU-tiled einsums, so the generic masked_gram path stays.
-    fast_cpu = _context_platform() not in _TPU_PLATFORMS
+    fast_cpu = _context_platform() not in _TPU_PLATFORMS and gd is None
     K = nfac_o + nfac
+    if gd is not None:
+        xz_g = xz.astype(gd)
+        m_g = m.astype(gd)
+        xzT_g = xz_g.T
+        WT_g = W.T.astype(gd)
     if fast_cpu:
         from .ssm import _sym_pack_idx
 
@@ -159,6 +178,12 @@ def _als_core(
             pair = f[:, iuK] * f[:, ivK]  # (Tw, K(K+1)/2)
             A = (mT @ pair)[:, unpackK].reshape(-1, K, K)
             rhs = xzmT @ f
+        elif gd is not None:
+            A, rhs = masked_gram(f.astype(gd), xz_g, m_g)
+            # Grams are tiny (ns, K, K); solves and the loop carry stay in
+            # the panel dtype (f64 under x64 would otherwise clash with
+            # the f32 accumulators)
+            A, rhs = A.astype(xz.dtype), rhs.astype(xz.dtype)
         else:
             A, rhs = masked_gram(f, xz, m)
         lam = jax.vmap(solve_normal)(A, rhs)
@@ -191,14 +216,28 @@ def _als_core(
             )
         else:
             xr = xz - fo @ lam_o.T
-            A, rhs = masked_gram(lam_u, xr.T, W.T)
+            if gd is not None:
+                # nfac_o == 0 keeps the hoisted bf16 panel transpose; an
+                # observed-factor residual changes per iteration and must
+                # be re-cast (the Gram read is still halved)
+                xrT = xzT_g if nfac_o == 0 else xr.T.astype(gd)
+                A, rhs = masked_gram(lam_u.astype(gd), xrT, WT_g)
+                A, rhs = A.astype(xz.dtype), rhs.astype(xz.dtype)
+            else:
+                A, rhs = masked_gram(lam_u, xr.T, W.T)
             fu = jax.vmap(solve_normal)(A, rhs)
             ssr = (W * (xr - fu @ lam_u.T) ** 2).sum()
         return fu, ssr
 
+    cap_eff = (
+        max_iter
+        if n_iter_cap is None
+        else jnp.minimum(jnp.asarray(max_iter, jnp.int32), n_iter_cap)
+    )
+
     def cond(carry):
         _, _, ssr, diff, it = carry
-        return (diff >= tol_scaled) & (it < max_iter)
+        return (diff >= tol_scaled) & (it < cap_eff)
 
     def body(carry):
         fu, _, ssr_old, _, it = carry
@@ -234,11 +273,21 @@ def estimate_factor(
     compute_R2: bool = True,
     observed_factor=None,
     backend: str | None = None,
+    gram_dtype: str | None = None,
 ):
     """Iterated-PCA factor extraction (reference cell 20, `estimate_factor!`).
 
     Window bounds are 0-based inclusive.  Returns (factor, fes) with factor
     full-length, NaN outside the window.
+
+    gram_dtype="bfloat16" runs the ALS Gram contractions on bf16 operands
+    (mixed precision: f32 accumulation and solves — see ops/pallas_gram.py),
+    then polishes with exact-precision iterations from the bf16 fixed
+    point, so the returned factors are the EXACT map's fixed point at
+    roughly half the Gram memory traffic per bulk iteration.  The phases
+    share the max_iter budget (total n_iter <= max_iter, +1 only when the
+    bulk phase exhausts it, since the polish always gets one iteration).
+    Default None is the unchanged exact path.
 
     `observed_factor` (T, nfac_o) supplies the observed factors when
     config.nfac_o > 0 — the FAVAR-style capability the reference declares
@@ -312,18 +361,43 @@ def estimate_factor(
                 c_r=constraint.standardized(stds),
             )
         with annotate("als_core"):
+            tol_scaled = config.tol * Tw * ns
+            cap = max_iter if max_iter is not None else config.max_iter
+            phase2_kwargs = {}
+            if gram_dtype is not None:
+                # phase 1: bulk iterations on bf16 Grams to (near) the
+                # reduced-precision fixed point.  The two phases SHARE the
+                # caller's max_iter budget (n_iter stays a valid
+                # convergence flag); the polish always gets >= 1 iteration
+                # so its outputs are real even when phase 1 exhausts cap
+                f1, _, _, n1 = _als_core(
+                    xz, m, lam_ok, f0, tol_scaled, nfac, cap, n_constr,
+                    **kwargs, **fo_kwargs, gram_dtype=gram_dtype,
+                )
+                f0 = f1[:, config.nfac_o :]
+                n_pre = n1
+                phase2_kwargs = dict(
+                    n_iter_cap=jnp.maximum(
+                        jnp.asarray(cap, jnp.int32) - n1.astype(jnp.int32), 1
+                    )
+                )
+            else:
+                n_pre = 0
+            # phase 2 (or the only phase): exact-precision iterations
             f, lam, ssr, n_iter = _als_core(
                 xz,
                 m,
                 lam_ok,
                 f0,
-                config.tol * Tw * ns,
+                tol_scaled,
                 nfac,
-                max_iter if max_iter is not None else config.max_iter,
+                cap,
                 n_constr,
                 **kwargs,
                 **fo_kwargs,
+                **phase2_kwargs,
             )
+            n_iter = n_iter + n_pre
 
         R2 = _r2_pass(xz, m, f, lam_ok) if compute_R2 else jnp.full(ns, jnp.nan)
         factor = jnp.full((data.shape[0], config.nfac_t), jnp.nan, data.dtype)

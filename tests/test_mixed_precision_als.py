@@ -1,0 +1,75 @@
+"""Mixed-precision (bf16-Gram) ALS: phase-1 bulk + exact polish reaches the
+exact fixed point; bf16-only core lands near it."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dynamic_factor_models_tpu.models.dfm import DFMConfig, estimate_factor
+
+
+@pytest.fixture
+def panel():
+    rng = np.random.default_rng(21)
+    T, N, r = 160, 40, 3
+    f = np.zeros((T, r))
+    for t in range(1, T):
+        f[t] = 0.8 * f[t - 1] + rng.standard_normal(r)
+    lam = rng.standard_normal((N, r))
+    x = f @ lam.T + rng.standard_normal((T, N))
+    # keep the first 10 columns fully observed (PCA init needs a balanced
+    # block), knock 10% of cells out of the rest
+    miss = rng.random((T, N)) < 0.1
+    miss[:, :10] = False
+    x[miss] = np.nan
+    return x
+
+
+def test_mixed_precision_reaches_exact_fixed_point(panel):
+    cfg = DFMConfig(nfac_u=3, nt_min_factor=20)
+    f32, fes32 = estimate_factor(panel, np.ones(panel.shape[1]), 0, panel.shape[0] - 1, cfg)
+    fmix, fesmix = estimate_factor(
+        panel, np.ones(panel.shape[1]), 0, panel.shape[0] - 1, cfg,
+        gram_dtype="bfloat16",
+    )
+    # the polish phase must land on the exact map's fixed point: SSR equal
+    # to the pure-exact run at convergence-tolerance level
+    ssr32, ssrmix = float(fes32.ssr), float(fesmix.ssr)
+    assert abs(ssrmix - ssr32) <= 1e-4 * ssr32, (ssr32, ssrmix)
+    # factors identical up to column sign at tight tolerance
+    a, b = np.nan_to_num(np.asarray(f32)), np.nan_to_num(np.asarray(fmix))
+    s = np.sign((a * b).sum(axis=0)); s[s == 0] = 1.0
+    assert np.abs(a - b * s).max() < 5e-3 * np.abs(a).max()
+    # n_iter counts both phases
+    assert int(fesmix.n_iter) >= int(fes32.n_iter) and int(fesmix.n_iter) > 0
+
+
+def test_bf16_core_runs_and_lands_near(panel):
+    from dynamic_factor_models_tpu.models.dfm import _als_core
+    from dynamic_factor_models_tpu.ops.linalg import pca_score, standardize_data
+    from dynamic_factor_models_tpu.ops.masking import fillz, mask_of
+
+    xj = jnp.asarray(panel)
+    xstd, _ = standardize_data(xj)
+    xz, m = fillz(xstd), mask_of(xstd).astype(xstd.dtype)
+    f0 = pca_score(jnp.where(jnp.isnan(xstd), 0.0, xstd), 3)
+    lam_ok = jnp.ones(panel.shape[1], bool)
+    args = (xz, m, lam_ok, f0, jnp.asarray(0.0, xz.dtype), 3, 50)
+    f_exact, _, ssr_exact, _ = _als_core(*args)
+    f_bf16, _, ssr_bf16, _ = _als_core(*args, gram_dtype="bfloat16")
+    # bf16 Grams perturb the map at operand precision: nearby, not equal
+    rel = abs(float(ssr_bf16) - float(ssr_exact)) / float(ssr_exact)
+    assert rel < 2e-2, rel
+    assert f_bf16.dtype == xz.dtype
+
+
+def test_mixed_precision_shares_iteration_budget(panel):
+    """The two phases share max_iter: n_iter stays a valid budget/
+    convergence flag (+1 only when the bulk phase exhausts the cap)."""
+    cfg = DFMConfig(nfac_u=3, nt_min_factor=20)
+    cap = 6
+    _, fes = estimate_factor(
+        panel, np.ones(panel.shape[1]), 0, panel.shape[0] - 1, cfg,
+        max_iter=cap, gram_dtype="bfloat16",
+    )
+    assert int(fes.n_iter) <= cap + 1, int(fes.n_iter)
