@@ -406,7 +406,7 @@ def large_panel_section(tpu_ok, persist=None):
             run()  # compile
             return _time_fixed_iters(run)
 
-    def run_em(backend):
+    def run_em(backend, bf16=False):
         with on_backend(backend):
             xj = jnp.asarray(x)
             xstd, _ = standardize_data(xj)
@@ -420,8 +420,9 @@ def large_panel_section(tpu_ok, persist=None):
 
             # the production estimate_dfm_em loop threads loop-invariant
             # PanelStats through every iteration; the bench measures the
-            # same per-iteration program
-            stats = compute_panel_stats(xz, m)
+            # same per-iteration program (bf16=True: the mixed-precision
+            # bulk-phase program — panel GEMMs on bf16 twins)
+            stats = compute_panel_stats(xz, m, bf16=bf16)
 
             def iters():
                 p = params
@@ -469,6 +470,13 @@ def large_panel_section(tpu_ok, persist=None):
             {
                 "als_large_iters_per_sec_bf16": round(1.0 / als_bf16_t, 2),
                 "als_large_bf16_speedup_vs_f32": round(als_t / als_bf16_t, 2),
+            }
+        )
+        em_bf16_t = run_em(None, bf16=True) / n_em
+        _emit(
+            {
+                "em_large_iters_per_sec_bf16": round(1.0 / em_bf16_t, 2),
+                "em_large_bf16_speedup_vs_f32": round(em_t / em_bf16_t, 2),
             }
         )
         # same programs pinned to the host CPU: the attribution ratio

@@ -280,7 +280,8 @@ def estimate_factor(
     Window bounds are 0-based inclusive.  Returns (factor, fes) with factor
     full-length, NaN outside the window.
 
-    gram_dtype="bfloat16" runs the ALS Gram contractions on bf16 operands
+    gram_dtype="bfloat16" (the only non-None value accepted) runs the ALS
+    Gram contractions on bf16 operands
     (mixed precision: f32 accumulation and solves — see ops/pallas_gram.py),
     then polishes with exact-precision iterations from the bf16 fixed
     point, so the returned factors are the EXACT map's fixed point at
@@ -296,6 +297,12 @@ def estimate_factor(
     solved for in the F-step.  Output factor columns are ordered
     [observed, unobserved].
     """
+    if gram_dtype not in (None, "bfloat16"):
+        # fp16's 5-bit exponent overflows on ordinary standardized panels;
+        # only bf16 (f32 exponent range) is a safe Gram operand narrowing
+        raise ValueError(
+            f"gram_dtype must be None or 'bfloat16', got {gram_dtype!r}"
+        )
     if config.nfac_o:
         if observed_factor is None:
             raise ValueError("config.nfac_o > 0 requires observed_factor")
@@ -366,12 +373,17 @@ def estimate_factor(
             phase2_kwargs = {}
             if gram_dtype is not None:
                 # phase 1: bulk iterations on bf16 Grams to (near) the
-                # reduced-precision fixed point.  The two phases SHARE the
-                # caller's max_iter budget (n_iter stays a valid
-                # convergence flag); the polish always gets >= 1 iteration
-                # so its outputs are real even when phase 1 exhausts cap
+                # reduced-precision fixed point, under a LOOSENED tolerance
+                # (the bf16 map's SSR fluctuates at operand precision near
+                # its fixed point, so the caller's tight tol would never
+                # trigger and the bulk would burn the whole budget).  The
+                # two phases SHARE the caller's max_iter budget (n_iter
+                # stays a valid convergence flag); the polish always gets
+                # >= 1 iteration so its outputs are real even when phase 1
+                # exhausts cap
+                bulk_tol_scaled = max(config.tol, 1e-4) * Tw * ns
                 f1, _, _, n1 = _als_core(
-                    xz, m, lam_ok, f0, tol_scaled, nfac, cap, n_constr,
+                    xz, m, lam_ok, f0, bulk_tol_scaled, nfac, cap, n_constr,
                     **kwargs, **fo_kwargs, gram_dtype=gram_dtype,
                 )
                 f0 = f1[:, config.nfac_o :]

@@ -92,12 +92,19 @@ def run_em_loop(
     trace_name: str = "em",
     checkpoint_path: str | None = None,
     checkpoint_every: int = 25,
+    stop_at=None,
 ):
     """Run an EM loop to convergence; returns (params, loglik_path, n_iter,
     trace).  `step(params, *args) -> (new_params, loglik-of-current-params)`
     must be a module-level jitted function (it is a static jit argument).
 
     trace is a ConvergenceTrace when collect_path=True, else None.
+
+    `stop_at` (int or traced scalar <= max_em_iter) bounds THIS run's
+    iterations without changing the compiled program (it feeds
+    `_em_while`'s traced bound, the same mechanism checkpoint chunking
+    uses) — phase-structured callers use it to share one max_em_iter
+    budget across phases.  Not combinable with checkpoint_path.
 
     `checkpoint_path` makes a long run preemption-safe: the on-device loop
     executes in chunks of `checkpoint_every` iterations, persisting
@@ -121,13 +128,16 @@ def run_em_loop(
         )
     if checkpoint_path is not None and checkpoint_every < 1:
         raise ValueError(f"checkpoint_every must be >= 1, got {checkpoint_every}")
+    if checkpoint_path is not None and stop_at is not None:
+        raise ValueError("stop_at and checkpoint_path are mutually exclusive")
     if collect_path:
+        host_cap = max_em_iter if stop_at is None else min(max_em_iter, int(stop_at))
         trace = ConvergenceTrace(trace_name)
         llpath = []
         ll_prev = -np.inf
         it = 0
         with annotate(trace_name):
-            for it in range(1, max_em_iter + 1):
+            for it in range(1, host_cap + 1):
                 params, ll = step(params, *args)
                 ll = float(ll)
                 llpath.append(ll)
@@ -141,10 +151,11 @@ def run_em_loop(
     carry = _fresh_carry(params, tol_arr, max_em_iter)
 
     if checkpoint_path is None:
+        bound = max_em_iter if stop_at is None else stop_at
         with annotate(trace_name):
             carry = _em_while(
                 step, carry, args, tol_arr, max_em_iter,
-                jnp.asarray(max_em_iter, jnp.int32),
+                jnp.asarray(bound, jnp.int32),
             )
     else:
         import os

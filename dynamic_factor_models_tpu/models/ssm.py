@@ -200,13 +200,36 @@ class PanelStats(NamedTuple):
     Sxx: jnp.ndarray  # (N,) sum_t x_it^2
     n_i: jnp.ndarray  # (N,) per-series observation counts
     n_obs: jnp.ndarray  # (T,) per-period observation counts
+    # optional bfloat16 twins of the four GEMM-side panel copies (None on
+    # the exact path).  When present, `_collapse_obs_stats` and
+    # `_em_m_step` run their panel contractions on bf16 operands with f32
+    # accumulation (the ops/pallas_gram.py dtype contract) — the panel
+    # enters each EM iteration through exactly four (T, N)-sized GEMMs,
+    # all HBM-bandwidth-bound at scale, and bf16 halves that traffic.
+    m16: jnp.ndarray | None = None  # (T, N)
+    x16: jnp.ndarray | None = None  # (T, N)
+    mT16: jnp.ndarray | None = None  # (N, T)
+    xT16: jnp.ndarray | None = None  # (N, T)
 
 
-def compute_panel_stats(x, mask) -> PanelStats:
-    """Materialize the loop-invariant statistics for (x zero-filled, mask)."""
+def compute_panel_stats(x, mask, bf16: bool = False) -> PanelStats:
+    """Materialize the loop-invariant statistics for (x zero-filled, mask).
+
+    bf16=True additionally stores bfloat16 copies of the panel/mask (and
+    transposes), switching the EM iteration's four panel GEMMs to the
+    mixed-precision path — used by `estimate_dfm_em(gram_dtype=...)`'s
+    bulk phase; the exact statistics (Sxx, counts) stay full-precision."""
     m = mask.astype(x.dtype)
     xT = jnp.asarray(x.T)
     mT = jnp.asarray(m.T)
+    extra = {}
+    if bf16:
+        extra = dict(
+            m16=m.astype(jnp.bfloat16),
+            x16=x.astype(jnp.bfloat16),
+            mT16=mT.astype(jnp.bfloat16),
+            xT16=xT.astype(jnp.bfloat16),
+        )
     return PanelStats(
         m=m,
         xT=xT,
@@ -214,6 +237,7 @@ def compute_panel_stats(x, mask) -> PanelStats:
         Sxx=(xT * xT).sum(axis=1),
         n_i=mT.sum(axis=1),
         n_obs=m.sum(axis=1),
+        **extra,
     )
 
 
@@ -268,6 +292,16 @@ def _collapse_obs(Hq, R, x, m, n_obs=None):
     return C, b, ld_R, xRx, n_obs
 
 
+def _bf16_gemm(subscripts: str, a16, b, out_dtype):
+    """The mixed-precision panel-GEMM contract in one place: bf16 panel
+    operand (pre-cast, held in PanelStats), small operand cast to bf16 per
+    call, accumulation at >= f32, result in the caller's dtype."""
+    acc = jnp.promote_types(out_dtype, jnp.float32)
+    return jnp.einsum(
+        subscripts, a16, b.astype(jnp.bfloat16), preferred_element_type=acc
+    ).astype(out_dtype)
+
+
 def _collapse_obs_stats(Hq, R, x, stats: PanelStats):
     """`_collapse_obs` for looped callers holding PanelStats: the 1/R
     weighting rides the GEMMs' N-indexed right operands (C = m @ (pair/R),
@@ -281,10 +315,14 @@ def _collapse_obs_stats(Hq, R, x, stats: PanelStats):
     pair_R = jnp.concatenate(
         [(Hq[:, iu] * Hq[:, iv]) / R[:, None], jnp.log(R)[:, None]], axis=1
     )
-    Cu = stats.m @ pair_R
+    if stats.m16 is not None:
+        Cu = _bf16_gemm("tn,nc->tc", stats.m16, pair_R, x.dtype)
+        b = _bf16_gemm("tn,nq->tq", stats.x16, Hq / R[:, None], x.dtype)
+    else:
+        Cu = stats.m @ pair_R
+        b = x @ (Hq / R[:, None])
     C = Cu[:, unpack].reshape(-1, q, q)
     ld_R = Cu[:, -1]
-    b = x @ (Hq / R[:, None])
     xRx = jnp.zeros(x.shape[0], x.dtype)
     ll_corr = -0.5 * (stats.Sxx / R).sum()
     return C, b, ld_R, xRx, stats.n_obs, ll_corr
@@ -740,8 +778,14 @@ def _em_m_step(params: SSMParams, x, m, s_sm, P_sm, lag1, stats=None):
         n_i = m.sum(axis=0)
     else:
         mT, xT, Sxx, n_i = stats.mT, stats.xT, stats.Sxx, stats.n_i
-    Sff = (mT @ Eff_u)[:, unpack].reshape(-1, r, r)  # (N, r, r)
-    Sxf = xT @ f  # (N, r); m*x == x (zero-filled)
+    if stats is not None and stats.mT16 is not None:
+        Sff = _bf16_gemm("nt,tc->nc", stats.mT16, Eff_u, x.dtype)[
+            :, unpack
+        ].reshape(-1, r, r)
+        Sxf = _bf16_gemm("nt,tr->nr", stats.xT16, f, x.dtype)
+    else:
+        Sff = (mT @ Eff_u)[:, unpack].reshape(-1, r, r)  # (N, r, r)
+        Sxf = xT @ f  # (N, r); m*x == x (zero-filled)
     lam, R = _solve_loadings_and_R(Sff, Sxf, Sxx, n_i)
 
     # --- factor VAR blocks + Q from smoothed second moments ---
@@ -885,6 +929,7 @@ def estimate_dfm_em(
     checkpoint_path: str | None = None,
     checkpoint_every: int = 25,
     accel: str | None = None,
+    gram_dtype: str | None = None,
 ) -> EMResults:
     """State-space DFM via EM on the standardized included panel
     (BASELINE.json config 2: `State-space DFM via EM + Kalman smoother`).
@@ -896,6 +941,14 @@ def estimate_dfm_em(
     E-step for the parallel-in-time scans (`em_step_assoc`); method="sqrt"
     uses the square-root array E-step (`em_step_sqrt`, f32-accurate).
 
+    gram_dtype="bfloat16" (sequential method only) runs a mixed-precision
+    bulk phase first — the iteration's four panel GEMMs (collapse C/b,
+    M-step Sff/Sxf) on bf16 operands with f32 accumulation, at a loosened
+    tolerance — then finishes with exact iterations under the caller's
+    tol from the bulk fixed point.  The phases share max_em_iter; a
+    non-finite bulk outcome falls back to the exact path from the
+    original init.
+
     accel="squarem" wraps the chosen E/M step in one SQUAREM extrapolation
     cycle per loop iteration (`emaccel.squarem`: three EM-map evaluations,
     loglik-guarded, never worse than two plain EM steps) — n_iter then
@@ -906,6 +959,16 @@ def estimate_dfm_em(
         raise ValueError(f"method must be one of {_FILTER_METHODS}, got {method!r}")
     if accel not in (None, "squarem"):
         raise ValueError(f"accel must be None or 'squarem', got {accel!r}")
+    if gram_dtype not in (None, "bfloat16"):
+        raise ValueError(
+            f"gram_dtype must be None or 'bfloat16', got {gram_dtype!r}"
+        )
+    if gram_dtype is not None and method != "sequential":
+        raise ValueError("gram_dtype requires method='sequential' (the stats path)")
+    if gram_dtype is not None and (checkpoint_path is not None or accel is not None):
+        raise ValueError(
+            "gram_dtype is not combinable with checkpoint_path or accel"
+        )
     with on_backend(backend):
         data = jnp.asarray(data)
         inclcode = np.asarray(inclcode)
@@ -940,11 +1003,53 @@ def estimate_dfm_em(
 
             step = squarem(step, _project_params)
             params = squarem_state(params)
+
+        n_pre = 0
+        llpath_pre = np.empty(0)
+        if gram_dtype is not None:
+            # mixed-precision bulk phase: the four panel GEMMs on bf16
+            # operands (PanelStats twins), at a loosened tolerance — bf16
+            # statistics perturb the loglik at ~operand precision, so a
+            # tighter test would never trigger; the exact phase below
+            # finishes from the bulk fixed point under the caller's tol.
+            # Both phases share max_em_iter (the exact phase always gets
+            # >= 1 iteration).
+            # reuse the exact phase's stats (args[2]) — only the bf16
+            # twins are added, no duplicate f32 panel copies in HBM
+            stats16 = args[2]._replace(
+                m16=args[2].m.astype(jnp.bfloat16),
+                x16=xz.astype(jnp.bfloat16),
+                mT16=args[2].mT.astype(jnp.bfloat16),
+                xT16=args[2].xT.astype(jnp.bfloat16),
+            )
+            bulk_tol = max(tol, 1e-4)
+            params_b, llpath_pre, n_pre, _ = run_em_loop(
+                em_step_stats, params, (xz, m_arr, stats16), bulk_tol,
+                max_em_iter, trace_name=f"em_dfm_{method}_bf16",
+            )
+            # guard on the PARAMS, not the recorded loglik: step() returns
+            # the loglik of its input, so a final bulk step that emits
+            # non-finite params still records a finite path entry
+            params_ok = all(
+                bool(np.isfinite(np.asarray(leaf)).all())
+                for leaf in jax.tree.leaves(params_b)
+            )
+            if n_pre > 0 and params_ok:
+                params = params_b
+            else:
+                # a degenerate bf16 step (e.g. an indefinite rounded C_t)
+                # must not poison the exact phase: restart it from the
+                # original init and give it the full budget
+                n_pre = 0
+                llpath_pre = np.empty(0)
         params, llpath, n_iter, trace = run_em_loop(
             step, params, args, tol, max_em_iter,
             collect_path=collect_path, trace_name=f"em_dfm_{method}",
             checkpoint_path=checkpoint_path, checkpoint_every=checkpoint_every,
+            stop_at=max(max_em_iter - n_pre, 1) if n_pre else None,
         )
+        llpath = np.concatenate([llpath_pre, llpath])
+        n_iter = n_iter + n_pre
 
         if accel == "squarem":
             params = params.params  # unwrap SquaremState

@@ -1,0 +1,106 @@
+"""Mixed-precision EM (bf16 PanelStats twins): the four panel GEMMs on
+bf16 operands, f32 accumulation; bulk + exact polish phases share the
+budget and land on the exact path's likelihood."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dynamic_factor_models_tpu.models.ssm import (
+    SSMParams,
+    _collapse_obs_stats,
+    compute_panel_stats,
+    em_step_stats,
+    estimate_dfm_em,
+)
+from dynamic_factor_models_tpu.ops.masking import fillz, mask_of
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(31)
+
+
+def _panel(rng, T=140, N=24, r=2):
+    f = np.zeros((T, r))
+    for t in range(1, T):
+        f[t] = 0.7 * f[t - 1] + rng.standard_normal(r)
+    x = f @ rng.standard_normal((N, r)).T + rng.standard_normal((T, N))
+    x[rng.random((T, N)) < 0.15] = np.nan
+    return x
+
+
+def _setup(x, rng, r=2):
+    xj = jnp.asarray(x)
+    m = mask_of(xj).astype(xj.dtype)
+    xz = fillz(xj)
+    params = SSMParams(
+        lam=jnp.asarray(0.2 * rng.standard_normal((x.shape[1], r))),
+        R=jnp.ones(x.shape[1]),
+        A=0.5 * jnp.eye(r)[None],
+        Q=jnp.eye(r),
+    )
+    return xz, m, params
+
+
+def test_collapse_bf16_tracks_exact(rng):
+    x = _panel(rng)
+    xz, m, params = _setup(x, rng)
+    exact = compute_panel_stats(xz, m)
+    mixed = compute_panel_stats(xz, m, bf16=True)
+    Ce, be, lde, _, _, lce = _collapse_obs_stats(params.lam, params.R, xz, exact)
+    Cm, bm, ldm, _, _, lcm = _collapse_obs_stats(params.lam, params.R, xz, mixed)
+    assert Cm.dtype == xz.dtype and bm.dtype == xz.dtype
+    sC = float(jnp.abs(Ce).max())
+    sb = float(jnp.abs(be).max())
+    assert float(jnp.abs(Cm - Ce).max()) < 2e-2 * sC
+    assert float(jnp.abs(bm - be).max()) < 2e-2 * sb
+    # the scalar pieces come from exact statistics, not the bf16 twins
+    assert float(jnp.abs(lcm - lce)) == 0.0
+    np.testing.assert_allclose(np.asarray(ldm), np.asarray(lde), atol=2e-2)
+
+
+def test_em_step_bf16_stats_near_exact(rng):
+    x = _panel(rng)
+    xz, m, params = _setup(x, rng)
+    pe, lle = em_step_stats(params, xz, m, compute_panel_stats(xz, m))
+    pm, llm = em_step_stats(params, xz, m, compute_panel_stats(xz, m, bf16=True))
+    assert np.isfinite(float(llm))
+    assert abs(float(llm) - float(lle)) < 1e-2 * (1 + abs(float(lle)))
+    assert float(jnp.abs(pm.lam - pe.lam).max()) < 5e-2 * float(jnp.abs(pe.lam).max())
+
+
+def test_estimate_dfm_em_gram_dtype(dataset_real):
+    plain = estimate_dfm_em(
+        dataset_real.bpdata, dataset_real.inclcode, 2, 223, max_em_iter=60,
+        tol=1e-5,
+    )
+    mixed = estimate_dfm_em(
+        dataset_real.bpdata, dataset_real.inclcode, 2, 223, max_em_iter=60,
+        tol=1e-5, gram_dtype="bfloat16",
+    )
+    ll_p = plain.loglik_path[np.isfinite(plain.loglik_path)][-1]
+    ll_m = mixed.loglik_path[np.isfinite(mixed.loglik_path)][-1]
+    # the exact polish must close the bf16 gap to the exact path's level
+    assert ll_m >= ll_p - 1e-3 * (1 + abs(ll_p)), (ll_m, ll_p)
+    # shared budget: n_iter counts both phases and respects the cap (+1)
+    assert int(mixed.n_iter) <= 61
+    assert mixed.factors.shape == plain.factors.shape
+
+
+def test_gram_dtype_validations(dataset_real):
+    with pytest.raises(ValueError, match="gram_dtype"):
+        estimate_dfm_em(
+            dataset_real.bpdata, dataset_real.inclcode, 2, 223,
+            max_em_iter=2, gram_dtype="float16",
+        )
+    with pytest.raises(ValueError, match="sequential"):
+        estimate_dfm_em(
+            dataset_real.bpdata, dataset_real.inclcode, 2, 223,
+            max_em_iter=2, gram_dtype="bfloat16", method="sqrt",
+        )
+    with pytest.raises(ValueError, match="not combinable"):
+        estimate_dfm_em(
+            dataset_real.bpdata, dataset_real.inclcode, 2, 223,
+            max_em_iter=2, gram_dtype="bfloat16", accel="squarem",
+        )

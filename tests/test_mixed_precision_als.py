@@ -73,3 +73,12 @@ def test_mixed_precision_shares_iteration_budget(panel):
         max_iter=cap, gram_dtype="bfloat16",
     )
     assert int(fes.n_iter) <= cap + 1, int(fes.n_iter)
+
+
+def test_gram_dtype_validation(panel):
+    cfg = DFMConfig(nfac_u=3, nt_min_factor=20)
+    with pytest.raises(ValueError, match="gram_dtype"):
+        estimate_factor(
+            panel, np.ones(panel.shape[1]), 0, panel.shape[0] - 1, cfg,
+            gram_dtype="float16",
+        )
