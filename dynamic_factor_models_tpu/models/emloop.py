@@ -23,7 +23,7 @@ import numpy as np
 
 from ..utils.profiling import ConvergenceTrace, annotate
 
-__all__ = ["run_em_loop"]
+__all__ = ["run_em_loop", "run_bulk_then_exact"]
 
 
 @partial(jax.jit, static_argnames=("step", "max_em_iter"))
@@ -191,3 +191,51 @@ def run_em_loop(
     params, _, _, n_iter, path = carry
     n_iter = int(n_iter)
     return params, np.asarray(path)[:n_iter], n_iter, None
+
+
+def run_bulk_then_exact(
+    bulk_step,
+    exact_step,
+    params,
+    bulk_args: tuple,
+    exact_args: tuple,
+    tol: float,
+    max_em_iter: int,
+    trace_name: str,
+    collect_path: bool = False,
+):
+    """Mixed-precision two-phase EM driver (the single copy of the
+    gram_dtype orchestration shared by `ssm.estimate_dfm_em` and
+    `mixed_freq.estimate_mixed_freq_dfm`).
+
+    Phase 1 runs `bulk_step` on `bulk_args` (the bf16-twin stats) under a
+    loosened tolerance, capped at HALF the budget — the bulk map is only
+    productive in moderate signal-to-noise regimes, so the exact phase
+    must always keep at least half.  A bulk phase ending in non-finite
+    PARAMS (the loglik path records the loglik of each iteration's INPUT,
+    so it cannot certify the final output) falls back to the original
+    init with the full budget.  Phase 2 runs `exact_step` on `exact_args`
+    under the caller's tol for the remaining budget (always >= 1
+    iteration).  Returns (params, concatenated loglik path, total
+    n_iter, trace).
+    """
+    params_b, llpath_pre, n_pre, _ = run_em_loop(
+        bulk_step, params, bulk_args, max(tol, 1e-4), max_em_iter,
+        trace_name=trace_name + "_bf16", stop_at=max(max_em_iter // 2, 1),
+    )
+    params_ok = all(
+        bool(np.isfinite(np.asarray(leaf)).all())
+        for leaf in jax.tree.leaves(params_b)
+    )
+    if n_pre > 0 and params_ok:
+        params = params_b
+    else:
+        n_pre = 0
+        llpath_pre = np.empty(0)
+    del params_b
+    params, llpath, n_iter, trace = run_em_loop(
+        exact_step, params, exact_args, tol, max_em_iter,
+        collect_path=collect_path, trace_name=trace_name,
+        stop_at=max(max_em_iter - n_pre, 1) if n_pre else None,
+    )
+    return params, np.concatenate([llpath_pre, llpath]), n_iter + n_pre, trace

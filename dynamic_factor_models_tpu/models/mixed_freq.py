@@ -33,6 +33,7 @@ from ..ops.masking import fillz, mask_of
 from ..utils.backend import on_backend
 from .ssm import (
     SSMParams,
+    _bf16_gemm,
     _collapse_obs,
     _collapse_obs_stats,
     _companion,
@@ -167,11 +168,17 @@ def _em_mf_impl(params: MixedFreqParams, x, mask, stats):
     s5 = s_sm[:, :q5]
     iu, iv, unpack = _sym_pack_idx(q5)
     Ess_u = s5[:, iu] * s5[:, iv] + P_sm[:, iu, iv]  # packed E[s5 s5' | T]
-    Z = (mT @ Ess_u)[:, unpack].reshape(-1, _N_AGG, r, _N_AGG, r)
+    if stats is not None and stats.mT16 is not None:
+        # mixed-precision twins present: the two panel GEMMs run on bf16
+        # operands (ssm._bf16_gemm contract), everything downstream exact
+        Zu = _bf16_gemm("nt,tc->nc", stats.mT16, Ess_u, x.dtype)
+        Sxg5 = _bf16_gemm("nt,tq->nq", stats.xT16, s5, x.dtype)
+    else:
+        Zu = mT @ Ess_u
+        Sxg5 = xT @ s5
+    Z = Zu[:, unpack].reshape(-1, _N_AGG, r, _N_AGG, r)
     Sgg = jnp.einsum("ij,ijrls,il->irs", params.agg, Z, params.agg)
-    Sxg = jnp.einsum(
-        "ij,ijr->ir", params.agg, (xT @ s5).reshape(-1, _N_AGG, r)
-    )
+    Sxg = jnp.einsum("ij,ijr->ir", params.agg, Sxg5.reshape(-1, _N_AGG, r))
     lam, R = _solve_loadings_and_R(Sgg, Sxg, Sxx, n_i)
 
     # factor VAR + Q from the full state moments (as in ssm.em_step)
@@ -214,6 +221,24 @@ def em_step_mf_stats(params: MixedFreqParams, x, mask, stats):
     return _em_mf_impl(params, x, mask, stats)
 
 
+@jax.jit
+def em_step_mf_stats_bulk(params: MixedFreqParams, x, mask, stats):
+    """`em_step_mf_stats` with idiosyncratic variances floored at 1e-3:
+    the mixed-precision bulk map (see ssm.em_step_stats_bulk — the
+    collapse's 1/R weighting amplifies bf16 operand error by
+    max_i(lam_i^2 / R_i), and quarterly series fit nearly exactly drive
+    R_i small enough to turn rounding into likelihood garbage).  The
+    exact polish phase removes the floor."""
+    return _em_mf_impl(
+        params._replace(
+            R=jnp.maximum(params.R, jnp.asarray(1e-3, params.R.dtype))
+        ),
+        x,
+        mask,
+        stats,
+    )
+
+
 class MFResults(NamedTuple):
     params: MixedFreqParams
     factors: jnp.ndarray  # (T, r) smoothed MONTHLY factors
@@ -248,6 +273,7 @@ def estimate_mixed_freq_dfm(
     checkpoint_path: str | None = None,
     checkpoint_every: int = 25,
     accel: str | None = None,
+    gram_dtype: str | None = None,
 ) -> MFResults:
     """Fit the mixed-frequency DFM on a MONTHLY-frequency (T, N) panel.
 
@@ -266,6 +292,14 @@ def estimate_mixed_freq_dfm(
         raise ValueError(f"p={p} must be >= {_N_AGG} for Mariano-Murasawa lags")
     if accel not in (None, "squarem"):
         raise ValueError(f"accel must be None or 'squarem', got {accel!r}")
+    if gram_dtype not in (None, "bfloat16"):
+        raise ValueError(
+            f"gram_dtype must be None or 'bfloat16', got {gram_dtype!r}"
+        )
+    if gram_dtype is not None and (checkpoint_path is not None or accel is not None):
+        raise ValueError(
+            "gram_dtype is not combinable with checkpoint_path or accel"
+        )
     with on_backend(backend):
         x = jnp.asarray(x)
         is_q = np.asarray(is_quarterly, bool)
@@ -314,13 +348,34 @@ def estimate_mixed_freq_dfm(
 
             step = squarem(em_step_mf_stats, _project_params_mf)
             params = squarem_state(params)
-        params, llpath, it, trace = run_em_loop(
-            step, params, (xz, m_arr, stats), tol, max_em_iter,
-            collect_path=collect_path, trace_name="em_mixed_freq",
-            checkpoint_path=checkpoint_path, checkpoint_every=checkpoint_every,
-        )
-        if accel == "squarem":
-            params = params.params  # unwrap SquaremState
+
+        if gram_dtype is not None:
+            # mixed-precision bulk + exact polish — see
+            # emloop.run_bulk_then_exact (gram_dtype excludes accel, so no
+            # SquaremState unwrap is needed on this branch)
+            from .emloop import run_bulk_then_exact
+
+            stats16 = stats._replace(
+                m16=stats.m.astype(jnp.bfloat16),
+                x16=xz.astype(jnp.bfloat16),
+                mT16=stats.mT.astype(jnp.bfloat16),
+                xT16=stats.xT.astype(jnp.bfloat16),
+            )
+            params, llpath, it, trace = run_bulk_then_exact(
+                em_step_mf_stats_bulk, step, params,
+                (xz, m_arr, stats16), (xz, m_arr, stats), tol, max_em_iter,
+                trace_name="em_mixed_freq", collect_path=collect_path,
+            )
+            del stats16
+        else:
+            params, llpath, it, trace = run_em_loop(
+                step, params, (xz, m_arr, stats), tol, max_em_iter,
+                collect_path=collect_path, trace_name="em_mixed_freq",
+                checkpoint_path=checkpoint_path,
+                checkpoint_every=checkpoint_every,
+            )
+            if accel == "squarem":
+                params = params.params  # unwrap SquaremState
 
         s_sm, x_hat = _smooth_xhat_mf(params, xz, m_arr)
         return MFResults(

@@ -831,6 +831,25 @@ def em_step_stats(params: SSMParams, x, mask, stats: PanelStats):
 
 
 @jax.jit
+def em_step_stats_bulk(params: SSMParams, x, mask, stats: PanelStats):
+    """`em_step_stats` with the idiosyncratic variances floored at 1e-3:
+    the mixed-precision bulk map.  The collapse weights the panel by 1/R,
+    so bf16 operand error is amplified by max_i(lam_i^2 / R_i) — a series
+    fit nearly exactly (R_i -> 0) turns rounding into likelihood garbage.
+    Flooring R bounds the amplification; the bulk phase converges to the
+    floored map's fixed point and the exact polish phase then removes the
+    floor.  Used only by `estimate_dfm_em(gram_dtype=...)`."""
+    return em_step_stats(
+        params._replace(
+            R=jnp.maximum(params.R, jnp.asarray(1e-3, params.R.dtype))
+        ),
+        x,
+        mask,
+        stats,
+    )
+
+
+@jax.jit
 def em_step_sqrt(params: SSMParams, x, mask):
     """`em_step` with the square-root array E-step: in f32 the convergence
     test consumes a log-likelihood an order of magnitude more accurate
@@ -1004,52 +1023,32 @@ def estimate_dfm_em(
             step = squarem(step, _project_params)
             params = squarem_state(params)
 
-        n_pre = 0
-        llpath_pre = np.empty(0)
         if gram_dtype is not None:
-            # mixed-precision bulk phase: the four panel GEMMs on bf16
-            # operands (PanelStats twins), at a loosened tolerance — bf16
-            # statistics perturb the loglik at ~operand precision, so a
-            # tighter test would never trigger; the exact phase below
-            # finishes from the bulk fixed point under the caller's tol.
-            # Both phases share max_em_iter (the exact phase always gets
-            # >= 1 iteration).
-            # reuse the exact phase's stats (args[2]) — only the bf16
-            # twins are added, no duplicate f32 panel copies in HBM
+            # mixed-precision bulk + exact polish (emloop.run_bulk_then_exact
+            # holds the single copy of the orchestration): bf16 twins are
+            # added to the exact phase's stats via _replace — no duplicate
+            # f32 panel copies — and released as soon as the bulk ends
+            from .emloop import run_bulk_then_exact
+
             stats16 = args[2]._replace(
                 m16=args[2].m.astype(jnp.bfloat16),
                 x16=xz.astype(jnp.bfloat16),
                 mT16=args[2].mT.astype(jnp.bfloat16),
                 xT16=args[2].xT.astype(jnp.bfloat16),
             )
-            bulk_tol = max(tol, 1e-4)
-            params_b, llpath_pre, n_pre, _ = run_em_loop(
-                em_step_stats, params, (xz, m_arr, stats16), bulk_tol,
-                max_em_iter, trace_name=f"em_dfm_{method}_bf16",
+            params, llpath, n_iter, trace = run_bulk_then_exact(
+                em_step_stats_bulk, step, params,
+                (xz, m_arr, stats16), args, tol, max_em_iter,
+                trace_name=f"em_dfm_{method}", collect_path=collect_path,
             )
-            # guard on the PARAMS, not the recorded loglik: step() returns
-            # the loglik of its input, so a final bulk step that emits
-            # non-finite params still records a finite path entry
-            params_ok = all(
-                bool(np.isfinite(np.asarray(leaf)).all())
-                for leaf in jax.tree.leaves(params_b)
+            del stats16
+        else:
+            params, llpath, n_iter, trace = run_em_loop(
+                step, params, args, tol, max_em_iter,
+                collect_path=collect_path, trace_name=f"em_dfm_{method}",
+                checkpoint_path=checkpoint_path,
+                checkpoint_every=checkpoint_every,
             )
-            if n_pre > 0 and params_ok:
-                params = params_b
-            else:
-                # a degenerate bf16 step (e.g. an indefinite rounded C_t)
-                # must not poison the exact phase: restart it from the
-                # original init and give it the full budget
-                n_pre = 0
-                llpath_pre = np.empty(0)
-        params, llpath, n_iter, trace = run_em_loop(
-            step, params, args, tol, max_em_iter,
-            collect_path=collect_path, trace_name=f"em_dfm_{method}",
-            checkpoint_path=checkpoint_path, checkpoint_every=checkpoint_every,
-            stop_at=max(max_em_iter - n_pre, 1) if n_pre else None,
-        )
-        llpath = np.concatenate([llpath_pre, llpath])
-        n_iter = n_iter + n_pre
 
         if accel == "squarem":
             params = params.params  # unwrap SquaremState
