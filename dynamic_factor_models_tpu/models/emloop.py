@@ -218,11 +218,24 @@ def run_bulk_then_exact(
     under the caller's tol for the remaining budget (always >= 1
     iteration).  Returns (params, concatenated loglik path, total
     n_iter, trace).
+
+    Build `bulk_args` inline in the call expression (don't bind the bf16
+    twins in the caller): this function drops its reference before phase 2,
+    so the twin arrays are freed for the exact phase's working set.
+
+    A budget of one iteration skips the bulk phase entirely — half of one
+    is zero useful bulk work, and the caller's cap is a hard bound.
     """
+    if max_em_iter < 2:
+        return run_em_loop(
+            exact_step, params, exact_args, tol, max_em_iter,
+            collect_path=collect_path, trace_name=trace_name,
+        )
     params_b, llpath_pre, n_pre, _ = run_em_loop(
         bulk_step, params, bulk_args, max(tol, 1e-4), max_em_iter,
         trace_name=trace_name + "_bf16", stop_at=max(max_em_iter // 2, 1),
     )
+    del bulk_args  # the bf16 twins: freed before the exact phase runs
     params_ok = all(
         bool(np.isfinite(np.asarray(leaf)).all())
         for leaf in jax.tree.leaves(params_b)

@@ -354,19 +354,14 @@ def estimate_mixed_freq_dfm(
             # emloop.run_bulk_then_exact (gram_dtype excludes accel, so no
             # SquaremState unwrap is needed on this branch)
             from .emloop import run_bulk_then_exact
+            from .ssm import _with_bf16_twins
 
-            stats16 = stats._replace(
-                m16=stats.m.astype(jnp.bfloat16),
-                x16=xz.astype(jnp.bfloat16),
-                mT16=stats.mT.astype(jnp.bfloat16),
-                xT16=stats.xT.astype(jnp.bfloat16),
-            )
             params, llpath, it, trace = run_bulk_then_exact(
                 em_step_mf_stats_bulk, step, params,
-                (xz, m_arr, stats16), (xz, m_arr, stats), tol, max_em_iter,
+                (xz, m_arr, _with_bf16_twins(stats, xz)),
+                (xz, m_arr, stats), tol, max_em_iter,
                 trace_name="em_mixed_freq", collect_path=collect_path,
             )
-            del stats16
         else:
             params, llpath, it, trace = run_em_loop(
                 step, params, (xz, m_arr, stats), tol, max_em_iter,

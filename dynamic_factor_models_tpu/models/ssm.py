@@ -212,6 +212,18 @@ class PanelStats(NamedTuple):
     xT16: jnp.ndarray | None = None  # (N, T)
 
 
+def _with_bf16_twins(stats: PanelStats, x) -> PanelStats:
+    """The single copy of the bf16-twin construction: adds bfloat16 casts
+    of the four GEMM-side panel operands to existing exact stats (no
+    duplicate f32 copies — `_replace` shares the f32 fields)."""
+    return stats._replace(
+        m16=stats.m.astype(jnp.bfloat16),
+        x16=x.astype(jnp.bfloat16),
+        mT16=stats.mT.astype(jnp.bfloat16),
+        xT16=stats.xT.astype(jnp.bfloat16),
+    )
+
+
 def compute_panel_stats(x, mask, bf16: bool = False) -> PanelStats:
     """Materialize the loop-invariant statistics for (x zero-filled, mask).
 
@@ -222,23 +234,15 @@ def compute_panel_stats(x, mask, bf16: bool = False) -> PanelStats:
     m = mask.astype(x.dtype)
     xT = jnp.asarray(x.T)
     mT = jnp.asarray(m.T)
-    extra = {}
-    if bf16:
-        extra = dict(
-            m16=m.astype(jnp.bfloat16),
-            x16=x.astype(jnp.bfloat16),
-            mT16=mT.astype(jnp.bfloat16),
-            xT16=xT.astype(jnp.bfloat16),
-        )
-    return PanelStats(
+    stats = PanelStats(
         m=m,
         xT=xT,
         mT=mT,
         Sxx=(xT * xT).sum(axis=1),
         n_i=mT.sum(axis=1),
         n_obs=m.sum(axis=1),
-        **extra,
     )
+    return _with_bf16_twins(stats, x) if bf16 else stats
 
 
 def _sym_pack_idx(q: int):
@@ -1026,22 +1030,16 @@ def estimate_dfm_em(
         if gram_dtype is not None:
             # mixed-precision bulk + exact polish (emloop.run_bulk_then_exact
             # holds the single copy of the orchestration): bf16 twins are
-            # added to the exact phase's stats via _replace — no duplicate
-            # f32 panel copies — and released as soon as the bulk ends
+            # built inline so the driver holds the only reference and can
+            # release them before the exact phase
             from .emloop import run_bulk_then_exact
 
-            stats16 = args[2]._replace(
-                m16=args[2].m.astype(jnp.bfloat16),
-                x16=xz.astype(jnp.bfloat16),
-                mT16=args[2].mT.astype(jnp.bfloat16),
-                xT16=args[2].xT.astype(jnp.bfloat16),
-            )
             params, llpath, n_iter, trace = run_bulk_then_exact(
                 em_step_stats_bulk, step, params,
-                (xz, m_arr, stats16), args, tol, max_em_iter,
+                (xz, m_arr, _with_bf16_twins(args[2], xz)), args,
+                tol, max_em_iter,
                 trace_name=f"em_dfm_{method}", collect_path=collect_path,
             )
-            del stats16
         else:
             params, llpath, n_iter, trace = run_em_loop(
                 step, params, args, tol, max_em_iter,
