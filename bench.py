@@ -710,6 +710,12 @@ def _persist_partial(fields: dict):
     os.replace(tmp, path)
 
 
+def _is_tpu_platform(platform: str) -> bool:
+    """Injectable for the orchestration tests (tests/test_bench_remainder.py
+    stub it to exercise the unattended remainder path on CPU)."""
+    return platform in ("tpu", "axon")
+
+
 def run_tpu_remainder(force_cpu: bool = False):
     """Child mode for short tunnel windows: ONLY the TPU sections the
     2026-07-31 salvaged live record is missing, cheapest compile surface
@@ -729,7 +735,7 @@ def run_tpu_remainder(force_cpu: bool = False):
     if force_cpu:
         jax.config.update("jax_platforms", "cpu")
     dev = jax.devices()[0]
-    if dev.platform not in ("tpu", "axon"):
+    if not _is_tpu_platform(dev.platform):
         print(json.dumps({"error": f"no TPU device ({dev.platform})"}), flush=True)
         sys.exit(2)
     partial = {"device": str(dev), "tpu_unreachable": False, "remainder": True}
@@ -800,7 +806,7 @@ def bench_main(force_cpu: bool):
     from dynamic_factor_models_tpu.ops.masking import fillz, mask_of
 
     dev = jax.devices()[0]
-    tpu_ok = dev.platform in ("tpu", "axon")
+    tpu_ok = _is_tpu_platform(dev.platform)
     ds = cached_dataset("Real")
     partial = {"device": str(dev), "tpu_unreachable": not tpu_ok}
 
@@ -956,7 +962,7 @@ def _probe_tunnel(timeout_s: int):
     for line in pr.stdout.splitlines():
         if line.startswith("DEVICE_PLATFORM"):
             platform = line.split()[-1]
-            return platform in ("tpu", "axon"), f"platform={platform}"
+            return _is_tpu_platform(platform), f"platform={platform}"
     return False, f"no DEVICE_PLATFORM line in {pr.stdout[-200:]!r}"
 
 

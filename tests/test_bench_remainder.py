@@ -1,0 +1,90 @@
+"""Orchestration test for bench.py --run-tpu-remainder: the code path the
+watcher runs UNATTENDED in a scarce tunnel window.  Sections are stubbed;
+what is under test is the plumbing — section order, per-section partial
+persistence, evidence-store accumulation, and the parity-failure exit."""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+
+@pytest.fixture
+def bench(tmp_path, monkeypatch):
+    spec = importlib.util.spec_from_file_location(
+        "bench_under_test",
+        os.path.join(os.path.dirname(__file__), "..", "bench.py"),
+    )
+    b = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(b)
+    monkeypatch.setattr(b, "EVIDENCE_PATH", str(tmp_path / "evidence.json"))
+    monkeypatch.setenv("DFM_BENCH_PARTIAL", str(tmp_path / "partial.json"))
+    monkeypatch.setattr(b, "_is_tpu_platform", lambda p: True)
+
+    calls = []
+    monkeypatch.setattr(
+        b, "pallas_section",
+        lambda: calls.append("pallas") or {"pallas_gram_speedup_large_panel": 1.5},
+    )
+    monkeypatch.setattr(
+        b, "device_parity_checks",
+        lambda ds: calls.append("parity") or {
+            k: 1e-5 for k in b.PARITY_THRESHOLDS
+        },
+    )
+    monkeypatch.setattr(
+        b, "large_panel_section",
+        lambda tpu_ok, persist=None: calls.append("large") or {
+            "em_large_iters_per_sec": 9.9
+        },
+    )
+    monkeypatch.setattr(
+        b, "crossover_table", lambda: calls.append("crossover") or print("| t |")
+    )
+
+    class _FakeDS:
+        pass
+
+    import dynamic_factor_models_tpu.io.cache as cache
+
+    monkeypatch.setattr(cache, "cached_dataset", lambda name: _FakeDS())
+    b._test_calls = calls
+    return b
+
+
+def test_remainder_section_order_and_stores(bench, tmp_path, capsys):
+    bench.run_tpu_remainder()
+    assert bench._test_calls == ["pallas", "parity", "large", "crossover"]
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    final = json.loads(out)
+    assert final["parity_ok"] is True
+    assert final["pallas_gram_speedup_large_panel"] == 1.5
+    assert "crossover_markdown" in final
+    # per-section persistence: the partial file holds the full accumulation
+    partial = json.loads((tmp_path / "partial.json").read_text())
+    assert partial["em_large_iters_per_sec"] == 9.9
+    # the durable evidence store accumulated the live fields with provenance
+    ev = json.loads((tmp_path / "evidence.json").read_text())
+    assert ev["em_large_iters_per_sec"] == 9.9 and ev["parity_ok"] is True
+    assert len(ev["windows"]) >= 1
+
+
+def test_remainder_parity_failure_exits_1(bench, monkeypatch):
+    monkeypatch.setattr(
+        bench, "device_parity_checks",
+        lambda ds: {k: 0.5 for k in bench.PARITY_THRESHOLDS},  # way over 1e-3
+    )
+    with pytest.raises(SystemExit) as ei:
+        bench.run_tpu_remainder()
+    # exit 1 = complete-but-parity-failed (the watcher surfaces it); the
+    # sections after parity still ran so the window was not wasted
+    assert ei.value.code == 1
+    assert bench._test_calls[-1] == "crossover"
+
+
+def test_remainder_no_tpu_exits_2(bench, monkeypatch):
+    monkeypatch.setattr(bench, "_is_tpu_platform", lambda p: False)
+    with pytest.raises(SystemExit) as ei:
+        bench.run_tpu_remainder()
+    assert ei.value.code == 2
