@@ -225,6 +225,12 @@ def run_bulk_then_exact(
 
     A budget of one iteration skips the bulk phase entirely — half of one
     is zero useful bulk work, and the caller's cap is a hard bound.
+
+    Step transformers compose transparently: when BOTH steps are wrapped
+    the same way (e.g. `squarem(bulk)` and `squarem(exact)`), the
+    augmented loop state flows from the bulk phase into the exact phase
+    unchanged — the caller wraps the initial params once and unwraps the
+    result once.
     """
     if max_em_iter < 2:
         return run_em_loop(

@@ -296,10 +296,8 @@ def estimate_mixed_freq_dfm(
         raise ValueError(
             f"gram_dtype must be None or 'bfloat16', got {gram_dtype!r}"
         )
-    if gram_dtype is not None and (checkpoint_path is not None or accel is not None):
-        raise ValueError(
-            "gram_dtype is not combinable with checkpoint_path or accel"
-        )
+    if gram_dtype is not None and checkpoint_path is not None:
+        raise ValueError("gram_dtype is not combinable with checkpoint_path")
     with on_backend(backend):
         x = jnp.asarray(x)
         is_q = np.asarray(is_quarterly, bool)
@@ -351,13 +349,17 @@ def estimate_mixed_freq_dfm(
 
         if gram_dtype is not None:
             # mixed-precision bulk + exact polish — see
-            # emloop.run_bulk_then_exact (gram_dtype excludes accel, so no
-            # SquaremState unwrap is needed on this branch)
+            # emloop.run_bulk_then_exact
             from .emloop import run_bulk_then_exact
             from .ssm import _with_bf16_twins
 
+            bulk_step = em_step_mf_stats_bulk
+            if accel == "squarem":
+                # same wrapper on both phases: the SquaremState flows from
+                # the bulk loop into the exact loop unchanged
+                bulk_step = squarem(em_step_mf_stats_bulk, _project_params_mf)
             params, llpath, it, trace = run_bulk_then_exact(
-                em_step_mf_stats_bulk, step, params,
+                bulk_step, step, params,
                 (xz, m_arr, _with_bf16_twins(stats, xz)),
                 (xz, m_arr, stats), tol, max_em_iter,
                 trace_name="em_mixed_freq", collect_path=collect_path,
@@ -369,8 +371,8 @@ def estimate_mixed_freq_dfm(
                 checkpoint_path=checkpoint_path,
                 checkpoint_every=checkpoint_every,
             )
-            if accel == "squarem":
-                params = params.params  # unwrap SquaremState
+        if accel == "squarem":
+            params = params.params  # unwrap SquaremState
 
         s_sm, x_hat = _smooth_xhat_mf(params, xz, m_arr)
         return MFResults(

@@ -988,10 +988,8 @@ def estimate_dfm_em(
         )
     if gram_dtype is not None and method != "sequential":
         raise ValueError("gram_dtype requires method='sequential' (the stats path)")
-    if gram_dtype is not None and (checkpoint_path is not None or accel is not None):
-        raise ValueError(
-            "gram_dtype is not combinable with checkpoint_path or accel"
-        )
+    if gram_dtype is not None and checkpoint_path is not None:
+        raise ValueError("gram_dtype is not combinable with checkpoint_path")
     with on_backend(backend):
         data = jnp.asarray(data)
         inclcode = np.asarray(inclcode)
@@ -1034,8 +1032,13 @@ def estimate_dfm_em(
             # release them before the exact phase
             from .emloop import run_bulk_then_exact
 
+            bulk_step = em_step_stats_bulk
+            if accel == "squarem":
+                # same wrapper on both phases: the SquaremState flows from
+                # the bulk loop into the exact loop unchanged
+                bulk_step = squarem(em_step_stats_bulk, _project_params)
             params, llpath, n_iter, trace = run_bulk_then_exact(
-                em_step_stats_bulk, step, params,
+                bulk_step, step, params,
                 (xz, m_arr, _with_bf16_twins(args[2], xz)), args,
                 tol, max_em_iter,
                 trace_name=f"em_dfm_{method}", collect_path=collect_path,

@@ -102,8 +102,28 @@ def test_gram_dtype_validations(dataset_real):
     with pytest.raises(ValueError, match="not combinable"):
         estimate_dfm_em(
             dataset_real.bpdata, dataset_real.inclcode, 2, 223,
-            max_em_iter=2, gram_dtype="bfloat16", accel="squarem",
+            max_em_iter=2, gram_dtype="bfloat16",
+            checkpoint_path="/tmp/never.npz",
         )
+
+
+def test_accel_composes_with_gram_dtype(dataset_real):
+    """accel='squarem' + gram_dtype='bfloat16': SQUAREM cycles on the
+    cheap bf16 bulk map, SquaremState flowing through both phases."""
+    both = estimate_dfm_em(
+        dataset_real.bpdata, dataset_real.inclcode, 2, 223,
+        max_em_iter=30, tol=1e-5, accel="squarem", gram_dtype="bfloat16",
+    )
+    plain = estimate_dfm_em(
+        dataset_real.bpdata, dataset_real.inclcode, 2, 223,
+        max_em_iter=30, tol=1e-5,
+    )
+    ll_b = both.loglik_path[np.isfinite(both.loglik_path)][-1]
+    ll_p = plain.loglik_path[np.isfinite(plain.loglik_path)][-1]
+    # 30 composed cycles cover >= 30 plain iterations of progress
+    assert ll_b >= ll_p - 1e-3 * (1 + abs(ll_p)), (ll_b, ll_p)
+    assert int(both.n_iter) <= 31
+    assert np.isfinite(np.asarray(both.params.lam)).all()
 
 
 def test_mixed_freq_gram_dtype():
@@ -169,3 +189,33 @@ def test_mixed_freq_gram_dtype_adverse_regime_stays_sane():
     assert int(mixed.n_iter) <= cap + 1
     with pytest.raises(ValueError, match="gram_dtype"):
         estimate_mixed_freq_dfm(x, is_q, r=1, max_em_iter=2, gram_dtype="f16")
+
+
+def test_mixed_freq_accel_composes_with_gram_dtype():
+    """The composed accel+gram_dtype path on estimate_mixed_freq_dfm:
+    SquaremState must flow through both phases and unwrap before the
+    smoothing readout."""
+    from dynamic_factor_models_tpu.models.mixed_freq import (
+        MixedFreqParams,
+        estimate_mixed_freq_dfm,
+    )
+
+    rng = np.random.default_rng(13)
+    T, Nm, Nq = 180, 6, 2
+    f = np.zeros(T)
+    for t in range(1, T):
+        f[t] = 0.8 * f[t - 1] + rng.standard_normal()
+    x_m = np.outer(f, rng.standard_normal(Nm)) + 1.0 * rng.standard_normal((T, Nm))
+    x_q = np.full((T, Nq), np.nan)
+    qe = np.arange(5, T, 3)
+    x_q[qe] = np.outer(f, np.ones(Nq))[qe] + 1.0 * rng.standard_normal((len(qe), Nq))
+    x = np.hstack([x_m, x_q])
+    is_q = np.array([False] * Nm + [True] * Nq)
+    both = estimate_mixed_freq_dfm(
+        x, is_q, r=1, max_em_iter=20, tol=1e-5,
+        accel="squarem", gram_dtype="bfloat16",
+    )
+    assert isinstance(both.params, MixedFreqParams), type(both.params)
+    ll = both.loglik_path[np.isfinite(both.loglik_path)]
+    assert len(ll) > 0 and np.isfinite(ll[-1])
+    assert int(both.n_iter) <= 21
