@@ -37,6 +37,13 @@ from .constraints import LambdaConstraint, construct_constraint
 from .instability import InstabilityResults, instability_scan
 from .favar_instruments import cca_with_factors, choose_stepwise, favar_instrument_table
 from .emaccel import SquaremState, squarem, squarem_state
+from .msdfm import (
+    MSDFMParams,
+    MSDFMResults,
+    fit_ms_dfm,
+    kim_filter,
+    kim_smoother_probs,
+)
 from .ssm import (
     EMResults,
     PanelStats,

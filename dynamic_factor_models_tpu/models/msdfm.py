@@ -1,0 +1,362 @@
+"""Markov-switching dynamic factor model (Kim-Nelson / Chauvet).
+
+The classic business-cycle-dating DFM (Chauvet 1998; Kim-Nelson 1999 ch.5;
+the model behind Chauvet-Piger recession probabilities) — a single common
+factor whose MEAN switches with a latent Markov regime:
+
+    x_t = lam (mu_{S_t} + z_t) + e_t,   e_t ~ N(0, diag(R))
+    z_t = phi z_{t-1} + u_t,            u_t ~ N(0, 1)   (scale fixed: ident.)
+    S_t in {0..M-1},  P[i, j] = Pr(S_t = j | S_{t-1} = i)
+
+The reference has nothing in this family; the spec is the papers.
+
+TPU-first design:
+  * the observation enters ONLY through the Jungbacker-Koopman collapsed
+    statistics (ssm._collapse_obs with Hq = lam): per-step scalars
+    C_t = lam'R^-1 lam, b_t = lam'R^-1 x_t, x'R^-1x, log|R|_obs — two
+    (T, N) GEMMs precomputed before the scan, so the Kim filter's scan
+    body is O(M^2) scalar algebra with no N-dependence;
+  * the regime-switching mean shifts the observation intercept only, so
+    the regime-pair branches differ in MEANS and (through Kim collapse
+    spread) variances — all (M, M) pairs evaluated by broadcasting inside
+    one ``lax.scan`` step (M = 2 default, any M compiles);
+  * the exact Kim (1994) moment-matching collapse: per-regime posterior
+    means/variances re-mixed each step (variance carries the cross-regime
+    mean spread);
+  * estimation is DIFFERENTIABLE maximum likelihood: the filter loglik is
+    a pure jax function of the parameters, maximized with optax.adam
+    under an unconstrained reparametrization (softplus/tanh/sigmoid) —
+    the JAX-native alternative to Kim-Nelson's approximate EM.
+
+Mask semantics as everywhere in this framework: NaN = missing, collapsed
+statistics weight missing rows to zero exactly.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.masking import fillz, mask_of
+from ..utils.backend import on_backend
+from .ssm import _collapse_obs
+
+__all__ = [
+    "MSDFMParams",
+    "MSDFMResults",
+    "kim_filter",
+    "kim_smoother_probs",
+    "fit_ms_dfm",
+]
+
+_LOG2PI = float(np.log(2.0 * np.pi))
+
+
+class MSDFMParams(NamedTuple):
+    """lam: (N,) loadings; R: (N,) idio variances; mu: (M,) regime means
+    (ascending by convention — regime 0 is the low-mean/recession state);
+    phi: AR(1) coefficient of the demeaned factor; P: (M, M) transition
+    matrix, rows sum to 1."""
+
+    lam: jnp.ndarray
+    R: jnp.ndarray
+    mu: jnp.ndarray
+    phi: jnp.ndarray
+    P: jnp.ndarray
+
+    @property
+    def n_regimes(self) -> int:
+        return self.mu.shape[0]
+
+
+class MSDFMResults(NamedTuple):
+    params: MSDFMParams
+    loglik: float
+    filt_probs: jnp.ndarray  # (T, M) Pr(S_t | x_{1:t})
+    smoothed_probs: jnp.ndarray  # (T, M) Pr(S_t | x_{1:T})
+    factor: jnp.ndarray  # (T,) E[mu_{S_t} + z_t | x_{1:t}] filtered factor
+    loss_path: np.ndarray  # optimizer loss per step
+    stds: jnp.ndarray
+    means: jnp.ndarray
+
+
+@jax.jit
+def kim_filter(params: MSDFMParams, x, mask):
+    """Kim (1994) filter on the collapsed observation statistics.
+
+    Returns (loglik, filt_probs (T, M), pred_probs (T, M), m_filt (T, M),
+    P_filt (T, M)) where m/P are the per-regime posterior mean/variance of
+    the demeaned factor z_t.  Exact Hamilton recursion over regimes; the
+    Gaussian branch collapse is Kim's moment-matching approximation.
+    """
+    M = params.n_regimes
+    dtype = x.dtype
+    lam = params.lam[:, None]  # (N, 1)
+    C, b, ld_R, xRx, n_obs = _collapse_obs(
+        lam, params.R, fillz(x), mask.astype(dtype)
+    )
+    C = C[:, 0, 0]  # (T,) scalar information
+    b = b[:, 0]  # (T,)
+    mu = params.mu  # (M,)
+    phi = params.phi
+    Pm = params.P  # (M, M) rows: from-regime i
+    log_Pm = jnp.log(jnp.clip(Pm, 1e-30, 1.0))
+
+    # stationary init for z; uniform-ish regime prior from P's stationarity
+    # (simple uniform keeps the filter parameter-smooth for the optimizer)
+    m0 = jnp.zeros(M, dtype)
+    P0 = jnp.full(M, 1.0 / jnp.maximum(1.0 - phi**2, 1e-3), dtype)
+    p0 = jnp.full(M, 1.0 / M, dtype)
+
+    def step(carry, inp):
+        m_i, P_i, logp_i = carry  # per-regime (M,), (M,), (M,) log probs
+        Ct, bt, ldt, xRxt, nt = inp
+
+        # per-pair prediction (i -> j): z dynamics are regime-free
+        a = phi * m_i  # (M,) predicted mean, indexed by i
+        Pp = phi**2 * P_i + 1.0  # (M,) predicted var, indexed by i
+
+        # regime-j observation: x_t - lam*mu_j = lam z_t + e
+        b_j = bt - Ct * mu  # (M,) indexed by j
+        xRx_j = xRxt - 2.0 * mu * bt + Ct * mu**2  # (M,)
+
+        # information update per (i, j): precision 1/Pp_i + Ct
+        Pu = 1.0 / (1.0 / Pp[:, None] + Ct)  # (M_i, 1) -> (M_i, M_j)? Ct scalar
+        Pu = jnp.broadcast_to(Pu, (M, M))  # (i, j)
+        rhs = b_j[None, :] - Ct * a[:, None]  # (i, j) innovation information
+        m_u = a[:, None] + Pu * rhs  # (i, j) posterior mean
+        # determinant-lemma loglik of the pair (see ssm._info_filter_scan)
+        ld_pp = jnp.log(Pp)[:, None]
+        ld_pu = jnp.log(Pu)
+        quad0 = xRx_j[None, :] - 2.0 * a[:, None] * b_j[None, :] + Ct * a[:, None] ** 2
+        quad = quad0 - rhs * Pu * rhs
+        ll_ij = -0.5 * (nt * _LOG2PI + ldt + ld_pp - ld_pu + quad)
+
+        # Hamilton step in log space
+        log_joint = logp_i[:, None] + log_Pm + ll_ij  # (i, j)
+        step_ll = jax.scipy.special.logsumexp(log_joint)
+        log_post = log_joint - step_ll  # normalized log w_ij
+        logp_j = jax.scipy.special.logsumexp(log_post, axis=0)  # (j,)
+        w = jnp.exp(log_post - logp_j[None, :])  # (i, j), cols sum to 1
+
+        # Kim collapse: re-mix means, variances carry the mean spread
+        m_j = (w * m_u).sum(axis=0)
+        P_j = (w * (Pu + (m_u - m_j[None, :]) ** 2)).sum(axis=0)
+
+        pred_probs = jnp.exp(
+            jax.scipy.special.logsumexp(logp_i[:, None] + log_Pm, axis=0)
+        )
+        return (m_j, P_j, logp_j), (
+            step_ll,
+            jnp.exp(logp_j),
+            pred_probs,
+            m_j,
+            P_j,
+        )
+
+    (_, _, _), (lls, filt_probs, pred_probs, m_filt, P_filt) = jax.lax.scan(
+        step, (m0, P0, jnp.log(p0)), (C, b, ld_R, xRx, n_obs)
+    )
+    return lls.sum(), filt_probs, pred_probs, m_filt, P_filt
+
+
+@jax.jit
+def kim_smoother_probs(params: MSDFMParams, filt_probs, pred_probs):
+    """Kim (1994) backward smoother for the regime probabilities:
+    Pr(S_t | x_{1:T}) from the stored filtered and one-step-ahead
+    probabilities."""
+    Pm = params.P
+
+    def back(sm_next, inp):
+        filt_t, pred_next = inp
+        # Pr(S_t=i | T) = filt_i * sum_j P_ij * sm_next_j / pred_next_j
+        ratio = sm_next / jnp.maximum(pred_next, 1e-30)
+        sm = filt_t * (Pm @ ratio)
+        sm = sm / jnp.maximum(sm.sum(), 1e-30)
+        return sm, sm
+
+    sm_T = filt_probs[-1]
+    _, sm_rev = jax.lax.scan(
+        back, sm_T, (filt_probs[:-1][::-1], pred_probs[1:][::-1])
+    )
+    return jnp.concatenate([sm_rev[::-1], sm_T[None]], axis=0)
+
+
+def _pack(params: MSDFMParams):
+    """Unconstrained reparametrization for gradient-based MLE."""
+    mu = params.mu
+    dmu = jnp.diff(mu)
+    return {
+        "lam": params.lam,
+        "log_R": jnp.log(params.R),
+        "mu0": mu[0],
+        "log_dmu": jnp.log(jnp.maximum(dmu, 1e-6)),
+        "atanh_phi": jnp.arctanh(jnp.clip(params.phi / 0.98, -0.999, 0.999)),
+        "log_P": jnp.log(jnp.clip(params.P, 1e-8, 1.0)),
+    }
+
+
+def _unpack(theta) -> MSDFMParams:
+    mu = theta["mu0"] + jnp.concatenate(
+        [jnp.zeros(1), jnp.cumsum(jnp.exp(theta["log_dmu"]))]
+    )
+    P_un = jax.nn.softmax(theta["log_P"], axis=1)
+    return MSDFMParams(
+        lam=theta["lam"],
+        R=jnp.exp(jnp.clip(theta["log_R"], -12.0, 12.0)),
+        mu=mu,
+        phi=0.98 * jnp.tanh(theta["atanh_phi"]),
+        P=P_un,
+    )
+
+
+@partial(jax.jit, static_argnames=("n_steps",))
+def _fit_adam(theta0, xz_nan, mask, n_steps: int, lr):
+    import optax
+
+    opt = optax.adam(lr)
+
+    def loss_fn(theta):
+        p = _unpack(theta)
+        ll, *_ = kim_filter(p, xz_nan, mask)
+        return -ll / xz_nan.shape[0]
+
+    def step(carry, _):
+        theta, state = carry
+        loss, g = jax.value_and_grad(loss_fn)(theta)
+        updates, state = opt.update(g, state, theta)
+        theta = optax.apply_updates(theta, updates)
+        return (theta, state), loss
+
+    (theta, _), losses = jax.lax.scan(
+        step, (theta0, opt.init(theta0)), None, length=n_steps
+    )
+    return theta, losses
+
+
+def fit_ms_dfm(
+    x,
+    n_regimes: int = 2,
+    n_steps: int = 600,
+    lr: float = 0.02,
+    backend: str | None = None,
+    seed: int = 0,
+    n_restarts: int = 4,
+) -> MSDFMResults:
+    """Fit the MS-DFM by differentiable MLE on a (T, N) panel (NaN =
+    missing).  The panel is standardized internally; regime 0 is the
+    low-mean regime (recession, for business-cycle panels), so
+    `results.smoothed_probs[:, 0]` is the recession probability path.
+
+    The MS likelihood is multimodal (a weak-regime mode where the AR
+    factor absorbs the switching exists essentially always), so the
+    optimizer runs `n_restarts` perturbed initializations — regime means
+    seeded from lower/upper quantile means of the first PC — as ONE
+    vmapped adam program, and returns the best final likelihood.
+    """
+    with on_backend(backend):
+        from ..ops.linalg import standardize_data
+
+        x = jnp.asarray(x)
+        xstd, stds = standardize_data(x)  # preserves the NaN pattern
+        mask = mask_of(xstd)
+        n_mean = (fillz(x) * mask).sum(axis=0) / jnp.maximum(mask.sum(axis=0), 1)
+        N = x.shape[1]
+
+        # init: loadings from the first PC of the filled panel; regime
+        # means from lower/upper quantile means of that factor (data-driven
+        # separation); persistence from the factor's own autocorrelation
+        from ..ops.linalg import pca_score
+
+        f0 = pca_score(fillz(xstd), 1)[:, 0]
+        f0 = f0 / jnp.maximum(f0.std(), 1e-6)
+        W = mask.astype(xstd.dtype)
+        lam0 = (W * fillz(xstd) * f0[:, None]).sum(0) / jnp.maximum(
+            (W * f0[:, None] ** 2).sum(0), 1e-6
+        )
+        # sign convention: majority-positive loadings so "high mean" = boom
+        sgn = jnp.sign(jnp.sign(lam0).sum())
+        sgn = jnp.where(sgn == 0, 1.0, sgn)
+        lam0, f0 = lam0 * sgn, f0 * sgn
+        qs = jnp.quantile(f0, jnp.linspace(0.0, 1.0, n_regimes + 1))
+
+        def _band_mean(k):
+            band = (f0 >= qs[k]) & (f0 <= qs[k + 1])
+            return jnp.where(band, f0, 0.0).sum() / jnp.maximum(band.sum(), 1)
+
+        mu_grid = jnp.asarray([_band_mean(k) for k in range(n_regimes)])
+        phi0 = jnp.clip(
+            (f0[1:] * f0[:-1]).mean() / jnp.maximum((f0**2).mean(), 1e-6),
+            0.1,
+            0.9,
+        )
+        P0 = jnp.full((n_regimes, n_regimes), 0.1 / max(n_regimes - 1, 1))
+        P0 = P0.at[jnp.arange(n_regimes), jnp.arange(n_regimes)].set(0.9)
+        init = MSDFMParams(
+            lam=lam0,
+            R=jnp.ones(N, xstd.dtype),
+            mu=jnp.sort(mu_grid).astype(xstd.dtype),
+            phi=phi0.astype(xstd.dtype),
+            P=P0.astype(xstd.dtype),
+        )
+
+        # perturbed restarts as one vmapped program: jitter the regime
+        # separation, base mean, and persistence; restart 0 is the base
+        theta0 = _pack(init)
+        keys = jax.random.split(jax.random.PRNGKey(seed), 3)
+        scale = jnp.concatenate(
+            [jnp.zeros(1), 0.6 * jax.random.normal(keys[0], (n_restarts - 1,))]
+        )
+        mu0_jit = jnp.concatenate(
+            [jnp.zeros(1), 0.4 * jax.random.normal(keys[1], (n_restarts - 1,))]
+        )
+        phi_jit = jnp.concatenate(
+            [jnp.zeros(1), 0.5 * jax.random.normal(keys[2], (n_restarts - 1,))]
+        )
+
+        def _restart(s, dm, dp):
+            t = dict(theta0)
+            t["log_dmu"] = theta0["log_dmu"] + s
+            t["mu0"] = theta0["mu0"] + dm
+            t["atanh_phi"] = theta0["atanh_phi"] + dp
+            return t
+
+        thetas = jax.vmap(_restart)(scale, mu0_jit, phi_jit)
+        theta_all, losses_all = jax.vmap(
+            lambda t: _fit_adam(t, xstd, mask, n_steps, lr)
+        )(thetas)
+        final = jnp.where(
+            jnp.isfinite(losses_all[:, -1]), losses_all[:, -1], jnp.inf
+        )
+        # rank restarts by their recorded final loss, but accept a restart
+        # only if the RETURNED theta's own likelihood is finite — losses[i]
+        # is evaluated before update i, so a blowup on the very last adam
+        # step would otherwise slip through the finiteness guard
+        order = np.argsort(np.asarray(final))
+        for best in order:
+            theta = jax.tree.map(lambda a: a[int(best)], theta_all)
+            params = _unpack(theta)
+            ll, filt_probs, pred_probs, m_filt, _ = kim_filter(
+                params, xstd, mask
+            )
+            if bool(jnp.isfinite(ll)):
+                break
+        else:
+            raise RuntimeError("all MS-DFM restarts diverged (non-finite loss)")
+        losses = losses_all[int(best)]
+        smoothed = kim_smoother_probs(params, filt_probs, pred_probs)
+        factor = (filt_probs * (params.mu[None, :] + m_filt)).sum(axis=1)
+        return MSDFMResults(
+            params=params,
+            loglik=float(ll),
+            filt_probs=filt_probs,
+            smoothed_probs=smoothed,
+            factor=factor,
+            loss_path=np.asarray(losses),
+            stds=stds,
+            means=n_mean,
+        )

@@ -1,0 +1,133 @@
+"""Markov-switching DFM (models/msdfm.py): exact single-regime equivalence
+with a dense Kalman filter, synthetic regime recovery via the fitted
+smoothed probabilities, and the real-panel recession readout (slow)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dynamic_factor_models_tpu.models.msdfm import (
+    MSDFMParams,
+    fit_ms_dfm,
+    kim_filter,
+    kim_smoother_probs,
+)
+from dynamic_factor_models_tpu.ops.masking import mask_of
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(17)
+
+
+def _dense_ms_loglik_single_regime(lam, R, c, phi, x):
+    """Reference: scalar-state Kalman with stationary init, observation
+    x_t = lam*(c + z_t) + e — computed densely in NumPy with masking."""
+    T, N = x.shape
+    m, P = 0.0, 1.0 / (1.0 - phi**2)
+    ll = 0.0
+    for t in range(T):
+        obs = ~np.isnan(x[t])
+        a, Pp = phi * m, phi**2 * P + 1.0
+        lo, Ro = lam[obs], R[obs]
+        v = x[t, obs] - lo * c - lo * a
+        S = np.outer(lo, lo) * Pp + np.diag(Ro)
+        Sinv = np.linalg.inv(S)
+        ll += -0.5 * (
+            obs.sum() * np.log(2 * np.pi)
+            + np.linalg.slogdet(S)[1]
+            + v @ Sinv @ v
+        )
+        K = Pp * (Sinv @ lo)
+        m = a + K @ v
+        P = Pp * (1.0 - (lo @ K))
+    return ll
+
+
+def test_single_regime_matches_dense_kalman(rng):
+    T, N = 60, 5
+    lam = rng.standard_normal(N)
+    R = 0.3 + rng.random(N)
+    c, phi = 0.4, 0.8
+    z = np.zeros(T)
+    for t in range(1, T):
+        z[t] = phi * z[t - 1] + rng.standard_normal()
+    x = np.outer(c + z, lam) + np.sqrt(R) * rng.standard_normal((T, N))
+    x[rng.random((T, N)) < 0.1] = np.nan
+
+    params = MSDFMParams(
+        lam=jnp.asarray(lam),
+        R=jnp.asarray(R),
+        mu=jnp.asarray([c]),
+        phi=jnp.asarray(phi),
+        P=jnp.asarray([[1.0]]),
+    )
+    xj = jnp.asarray(x)
+    ll, filt, pred, _, _ = kim_filter(params, xj, mask_of(xj))
+    ll_ref = _dense_ms_loglik_single_regime(lam, R, c, phi, x)
+    # with one regime the Kim collapse is exact: loglik must match the
+    # dense filter to float precision
+    assert abs(float(ll) - ll_ref) < 1e-6 * (1 + abs(ll_ref)), (
+        float(ll),
+        ll_ref,
+    )
+    assert np.allclose(np.asarray(filt), 1.0)
+    sm = kim_smoother_probs(params, filt, pred)
+    assert np.allclose(np.asarray(sm), 1.0)
+
+
+def _two_regime_panel(rng, T=400, N=8):
+    """Identifiable design: the regime separation (2.5) clearly exceeds
+    the stationary sd of the within-regime AR factor (1/sqrt(1-0.3^2)
+    ~ 1.05) — with separation ~ the factor sd, maximum likelihood
+    genuinely prefers a weak-regime configuration (checked: the ML mode
+    beats the true parameters' likelihood on such designs), so a recovery
+    test there would test the DGP, not the estimator."""
+    P = np.array([[0.92, 0.08], [0.04, 0.96]])
+    mu = np.array([-2.0, 0.5])
+    phi = 0.3
+    S = np.zeros(T, int)
+    z = np.zeros(T)
+    for t in range(1, T):
+        S[t] = rng.choice(2, p=P[S[t - 1]])
+        z[t] = phi * z[t - 1] + rng.standard_normal()
+    lam = 0.6 + 0.4 * rng.random(N)
+    f = mu[S] + z
+    x = np.outer(f, lam) + 0.6 * rng.standard_normal((T, N))
+    x[rng.random((T, N)) < 0.05] = np.nan
+    return x, S
+
+
+def test_fit_recovers_regimes(rng):
+    x, S = _two_regime_panel(rng)
+    res = fit_ms_dfm(x, n_steps=400)
+    # loss decreased and stayed finite
+    assert np.isfinite(res.loss_path).all()
+    assert res.loss_path[-1] < res.loss_path[0] - 0.1
+    # regime classification vs truth (regime 0 = low mean by construction)
+    pred0 = np.asarray(res.smoothed_probs[:, 0]) > 0.5
+    acc = max((pred0 == (S == 0)).mean(), (pred0 == (S == 1)).mean())
+    assert acc > 0.85, acc
+    # means ordered and separated
+    mu = np.asarray(res.params.mu)
+    assert mu[0] < mu[1] and (mu[1] - mu[0]) > 0.4, mu
+
+
+@pytest.mark.slow
+def test_real_panel_recession_probabilities(dataset_real):
+    """On the included :Real panel the low-regime smoothed probability
+    must be ELEVATED during the Great Recession (2008Q1-2009Q2) relative
+    to its full-sample mean — the Chauvet-Piger readout."""
+    import numpy as np
+
+    x = np.asarray(dataset_real.bpdata)[:, np.asarray(dataset_real.inclcode) == 1]
+    x = x[2:224]
+    res = fit_ms_dfm(x, n_steps=500)
+    prob = np.asarray(res.smoothed_probs[:, 0])
+    # calvec starts 1959Q1 at row 0 of bpdata; window starts at row 2
+    # (1959Q3).  2008Q1 = (2008-1959)*4 + 0 = 196 -> index 194 in-window;
+    # 2009Q2 inclusive -> 194..199
+    gr = prob[194:200].mean()
+    assert np.isfinite(res.loglik)
+    assert gr > prob.mean() + 0.2, (gr, prob.mean())
+    assert gr > 0.5, gr
