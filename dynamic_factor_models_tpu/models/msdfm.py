@@ -329,25 +329,24 @@ def fit_ms_dfm(
         theta_all, losses_all = jax.vmap(
             lambda t: _fit_adam(t, xstd, mask, n_steps, lr)
         )(thetas)
-        final = jnp.where(
-            jnp.isfinite(losses_all[:, -1]), losses_all[:, -1], jnp.inf
-        )
-        # rank restarts by their recorded final loss, but accept a restart
-        # only if the RETURNED theta's own likelihood is finite — losses[i]
-        # is evaluated before update i, so a blowup on the very last adam
-        # step would otherwise slip through the finiteness guard
-        order = np.argsort(np.asarray(final))
-        for best in order:
-            theta = jax.tree.map(lambda a: a[int(best)], theta_all)
-            params = _unpack(theta)
-            ll, filt_probs, pred_probs, m_filt, _ = kim_filter(
-                params, xstd, mask
-            )
-            if bool(jnp.isfinite(ll)):
-                break
-        else:
+        # select by each restart's RETURNED parameters' own likelihood:
+        # losses[i] is evaluated before adam update i, so the recorded
+        # final loss describes the penultimate theta — ranking by it could
+        # both miss a last-step blowup and pick a worse-likelihood mode
+        candidates = []
+        for k in range(n_restarts):
+            theta_k = jax.tree.map(lambda a: a[k], theta_all)
+            params_k = _unpack(theta_k)
+            out_k = kim_filter(params_k, xstd, mask)
+            ll_k = float(out_k[0])
+            if np.isfinite(ll_k):
+                candidates.append((ll_k, k, theta_k, params_k, out_k))
+        if not candidates:
             raise RuntimeError("all MS-DFM restarts diverged (non-finite loss)")
-        losses = losses_all[int(best)]
+        _, best, theta, params, (ll, filt_probs, pred_probs, m_filt, _) = max(
+            candidates, key=lambda c: c[0]
+        )
+        losses = losses_all[best]
         smoothed = kim_smoother_probs(params, filt_probs, pred_probs)
         factor = (filt_probs * (params.mu[None, :] + m_filt)).sum(axis=1)
         return MSDFMResults(

@@ -131,3 +131,27 @@ def test_real_panel_recession_probabilities(dataset_real):
     assert np.isfinite(res.loglik)
     assert gr > prob.mean() + 0.2, (gr, prob.mean())
     assert gr > 0.5, gr
+
+
+def test_three_regimes_compile_and_run(rng):
+    """n_regimes is a free static: M=3 must compile and produce ordered
+    means and a valid transition matrix."""
+    x, _ = _two_regime_panel(rng, T=250)
+    res = fit_ms_dfm(x, n_regimes=3, n_steps=150, n_restarts=2)
+    mu = np.asarray(res.params.mu)
+    P = np.asarray(res.params.P)
+    assert mu.shape == (3,) and (np.diff(mu) > 0).all()
+    assert np.allclose(P.sum(axis=1), 1.0, atol=1e-6) and (P >= 0).all()
+    assert np.isfinite(res.loglik)
+    assert np.allclose(np.asarray(res.smoothed_probs).sum(axis=1), 1.0, atol=1e-5)
+
+
+def test_heavy_missingness_stays_finite(rng):
+    """40% missing cells incl. fully-missing rows: the collapsed filter
+    weights them out exactly; fit must stay finite."""
+    x, _ = _two_regime_panel(rng, T=200)
+    x[rng.random(x.shape) < 0.4] = np.nan
+    x[50] = np.nan  # a fully-missing period
+    res = fit_ms_dfm(x, n_steps=150, n_restarts=2)
+    assert np.isfinite(res.loglik)
+    assert np.isfinite(np.asarray(res.smoothed_probs)).all()
