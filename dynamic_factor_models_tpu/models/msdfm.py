@@ -335,15 +335,14 @@ def fit_ms_dfm(
         # both miss a last-step blowup and pick a worse-likelihood mode
         candidates = []
         for k in range(n_restarts):
-            theta_k = jax.tree.map(lambda a: a[k], theta_all)
-            params_k = _unpack(theta_k)
+            params_k = _unpack(jax.tree.map(lambda a: a[k], theta_all))
             out_k = kim_filter(params_k, xstd, mask)
             ll_k = float(out_k[0])
             if np.isfinite(ll_k):
-                candidates.append((ll_k, k, theta_k, params_k, out_k))
+                candidates.append((ll_k, k, params_k, out_k))
         if not candidates:
             raise RuntimeError("all MS-DFM restarts diverged (non-finite loss)")
-        _, best, theta, params, (ll, filt_probs, pred_probs, m_filt, _) = max(
+        _, best, params, (ll, filt_probs, pred_probs, m_filt, _) = max(
             candidates, key=lambda c: c[0]
         )
         losses = losses_all[best]

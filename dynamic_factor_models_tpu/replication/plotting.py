@@ -291,6 +291,22 @@ def render_extras(
     }, "GDPC96 common-component fan chart (bootstrap 5-95%)")
     save(fig, "extra_forecast_fan.png")
 
+    # Markov-switching DFM: smoothed recession probability (Chauvet-Piger
+    # readout) over the sample, with the factor path underneath
+    from ..models import fit_ms_dfm
+
+    ms = fit_ms_dfm(data, n_steps=400)
+    prob0 = np.asarray(ms.smoothed_probs[:, 0])
+    fig, (ax1, ax2) = plt.subplots(2, 1, figsize=(8, 5), sharex=True)
+    ax1.fill_between(year, 0.0, prob0, color="0.55", alpha=0.8)
+    ax1.set_ylim(0, 1)
+    ax1.set_title("MS-DFM smoothed recession probability (low-mean regime)")
+    ax2.plot(year, np.asarray(ms.factor), lw=1.0)
+    ax2.axhline(0.0, color="0.8", lw=0.8)
+    ax2.set_title("filtered switching factor")
+    fig.tight_layout()
+    save(fig, "extra_recession_prob.png")
+
     # coherence with the first included series across frequencies
     freqs, coh2, _ = coherence(ds_real.bpdata, M=24)
     freqs, coh2 = np.asarray(freqs), np.asarray(coh2)

@@ -212,6 +212,7 @@ def test_render_extras_writes_capability_panels(tmp_path):
         "extra_coherence.png",
         "extra_forecast_fan.png",
         "extra_posterior_irf.png",
+        "extra_recession_prob.png",
         "extra_series_irf_band.png",
         "extra_sv_volatility.png",
         "extra_tvp_loadings.png",
