@@ -180,6 +180,7 @@ def render_extras(
     n_keep: int = 40,
     n_burn: int = 40,
     n_chains: int = 2,
+    ms_steps: int = 400,
 ) -> list[str]:
     """Render the beyond-reference capability panels to PNG: stochastic-
     volatility path, posterior IRF fan, TVP loading drift, and coherence
@@ -295,7 +296,7 @@ def render_extras(
     # readout) over the sample, with the factor path underneath
     from ..models import fit_ms_dfm
 
-    ms = fit_ms_dfm(data, n_steps=400)
+    ms = fit_ms_dfm(data, n_steps=ms_steps)
     prob0 = np.asarray(ms.smoothed_probs[:, 0])
     fig, (ax1, ax2) = plt.subplots(2, 1, figsize=(8, 5), sharex=True)
     ax1.fill_between(year, 0.0, prob0, color="0.55", alpha=0.8)

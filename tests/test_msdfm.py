@@ -155,3 +155,25 @@ def test_heavy_missingness_stays_finite(rng):
     res = fit_ms_dfm(x, n_steps=150, n_restarts=2)
     assert np.isfinite(res.loglik)
     assert np.isfinite(np.asarray(res.smoothed_probs)).all()
+
+
+@pytest.mark.slow
+def test_monthly_recession_dating():
+    """The actual Chauvet (1998) setting: monthly series only, monthly
+    recession probabilities — elevated through the Great Recession months."""
+    from dynamic_factor_models_tpu.io.cache import cached_monthly_dataset
+
+    ds = cached_monthly_dataset("All")
+    cal = np.asarray(ds.calvec)
+    keep = (np.asarray(ds.inclcode) == 1) & (~ds.is_quarterly)
+    x = np.asarray(ds.data)[:, keep]
+    res = fit_ms_dfm(x, n_steps=500)
+    prob = np.asarray(res.smoothed_probs[:, 0])
+    # monthly dating is sharp: the probability concentrates in the acute
+    # phase (Sep-08..Mar-09) rather than the full NBER span
+    acute = prob[(cal >= 2008.66) & (cal <= 2009.26)].mean()
+    window = prob[(cal >= 2008.0) & (cal <= 2009.5)]
+    assert np.isfinite(res.loglik)
+    assert acute > 0.5, acute
+    assert window.max() > 0.8, window.max()
+    assert window.mean() > prob.mean() + 0.2, (window.mean(), prob.mean())

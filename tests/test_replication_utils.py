@@ -206,7 +206,7 @@ def test_render_extras_writes_capability_panels(tmp_path):
     with tiny chains."""
     from dynamic_factor_models_tpu.replication.plotting import render_extras
 
-    written = render_extras(str(tmp_path), n_keep=8, n_burn=8, n_chains=2)
+    written = render_extras(str(tmp_path), n_keep=8, n_burn=8, n_chains=2, ms_steps=80)
     names = sorted(os.path.basename(p) for p in written)
     assert names == [
         "extra_coherence.png",
@@ -253,3 +253,17 @@ def test_checkpoint_roundtrip_new_result_types(tmp_path):
     for a, b in zip(ml.block_factors, ml2.block_factors):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
     assert ml2.variance_decomposition.keys() == ml.variance_decomposition.keys()
+
+    # round-4 MS-DFM results persist too (recession-dating deliverable)
+    from dynamic_factor_models_tpu.models.msdfm import fit_ms_dfm
+
+    ms = fit_ms_dfm(x, n_steps=40, n_restarts=2)
+    p3 = str(tmp_path / "ms.npz")
+    save_pytree(p3, ms)
+    ms2 = load_pytree(p3, ms)
+    np.testing.assert_array_equal(
+        np.asarray(ms.smoothed_probs), np.asarray(ms2.smoothed_probs)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(ms.params.mu), np.asarray(ms2.params.mu)
+    )
