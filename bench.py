@@ -524,6 +524,37 @@ def mixed_freq_section():
     }
 
 
+def _gram_loop_seconds(fn, X, Y, W, n: int, n_timing: int = 5):
+    """Per-call seconds of `fn(X, Y, W)` measured as one on-device
+    fori_loop of n calls (best of n_timing runs).  The carry perturbs W —
+    the one input EVERY output depends on (A and rhs both contract W):
+    perturbing only Y lets XLA hoist the Y-independent A-einsum out of the
+    loop (LICM), and anything less than full output dependence lets it
+    dead-code-eliminate the op — either way the XLA side would be
+    under-timed vs the opaque kernel.  The perturbation is cast to W's
+    dtype so a bf16 W stays bf16 (1e-30 is representable in bf16: same
+    exponent range as f32)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    def body(i, carry):
+        A, b = fn(X, Y, W + (carry * 1e-30).astype(W.dtype))
+        return A.sum() * 1e-30 + b.sum() * 1e-30
+
+    @jax.jit
+    def loop():
+        return lax.fori_loop(0, n, body, jnp.float32(0.0))
+
+    loop().block_until_ready()  # compile
+    best = float("inf")
+    for _ in range(n_timing):
+        t = time.perf_counter()
+        loop().block_until_ready()
+        best = min(best, time.perf_counter() - t)
+    return best / n
+
+
 def pallas_section():
     """Fused Pallas masked-Gram vs XLA einsum at the flagship size (TPU).
     No exception guard: if the compiled kernel cannot run on this chip the
@@ -544,47 +575,17 @@ def pallas_section():
     Yb = jnp.asarray(rng.standard_normal((Tbig, Nbig)), jnp.float32)
     Wb = jnp.asarray((rng.random((Tbig, Nbig)) > 0.2), jnp.float32)
 
-    def _loop_time(body, n):
-        """Total wall time of an on-device fori_loop (best of 5)."""
-
-        @jax.jit
-        def loop():
-            return lax.fori_loop(0, n, body, jnp.float32(0.0))
-
-        loop().block_until_ready()  # compile
-        best = float("inf")
-        for _ in range(5):
-            t = time.perf_counter()
-            loop().block_until_ready()
-            best = min(best, time.perf_counter() - t)
-        return best
-
-    def _gram_body(fn, X, Y, W):
-        # the carry must feed an input EVERY output depends on (W feeds
-        # both the A and rhs contractions): perturbing only Y lets XLA
-        # hoist the Y-independent A-einsum out of the loop (LICM), and
-        # anything less than full output dependence lets it dead-code-
-        # eliminate the op — either way the XLA side would be under-timed
-        # vs the opaque kernel.  The perturbation is cast to W's dtype so a
-        # bf16 W stays bf16 (1e-30 is representable in bf16: same exponent
-        # range as f32).
-        def body(i, carry):
-            A, b = fn(X, Y, W + (carry * 1e-30).astype(W.dtype))
-            return A.sum() * 1e-30 + b.sum() * 1e-30
-
-        return body
-
     # n large enough that kernel time (~250us/call) swamps the ~30ms fixed
     # dispatch cost of one remote loop launch
     n_gram = 1000
-    t_pallas = _loop_time(_gram_body(masked_gram_pallas, Xb, Yb, Wb), n_gram) / n_gram
-    t_xla = _loop_time(_gram_body(masked_gram_xla, Xb, Yb, Wb), n_gram) / n_gram
+    t_pallas = _gram_loop_seconds(masked_gram_pallas, Xb, Yb, Wb, n_gram)
+    t_xla = _gram_loop_seconds(masked_gram_xla, Xb, Yb, Wb, n_gram)
     # bf16 operand legs: the HBM-bandwidth option (panel cast OUTSIDE the
     # loop, f32 accumulation inside the kernels — ops/pallas_gram.py dtype
     # contract); the fields quantify the bandwidth claim on real hardware
     X16, Y16, W16 = (a.astype(jnp.bfloat16) for a in (Xb, Yb, Wb))
-    t_pallas16 = _loop_time(_gram_body(masked_gram_pallas, X16, Y16, W16), n_gram) / n_gram
-    t_xla16 = _loop_time(_gram_body(masked_gram_xla, X16, Y16, W16), n_gram) / n_gram
+    t_pallas16 = _gram_loop_seconds(masked_gram_pallas, X16, Y16, W16, n_gram)
+    t_xla16 = _gram_loop_seconds(masked_gram_xla, X16, Y16, W16, n_gram)
     return {
         "pallas_gram_speedup_large_panel": round(t_xla / t_pallas, 2),
         "pallas_gram_us_per_call": round(t_pallas * 1e6, 1),
@@ -612,36 +613,26 @@ def crossover_table():
         (2048, 2048), (2048, 4096), (4096, 4096), (4096, 8192),
     ]
     K = LARGE_R
-    print("| T x N | cells | XLA us | Pallas us | speedup |")
-    print("|---|---|---|---|---|")
+    print(
+        "| T x N | cells | XLA us | Pallas us | speedup "
+        "| Pallas bf16 us | bf16 speedup |"
+    )
+    print("|---|---|---|---|---|---|---|")
     for T, N in sizes:
         rng = np.random.default_rng(0)
         X = jnp.asarray(rng.standard_normal((T, K)), jnp.float32)
         Y = jnp.asarray(rng.standard_normal((T, N)), jnp.float32)
         W = jnp.asarray((rng.random((T, N)) > 0.2), jnp.float32)
-
-        def loop_time(fn, n=300):
-            def body(i, carry):
-                A, b = fn(X, Y, W + carry * 1e-30)
-                return A.sum() * 1e-30 + b.sum() * 1e-30
-
-            @jax.jit
-            def loop():
-                return lax.fori_loop(0, n, body, jnp.float32(0.0))
-
-            loop().block_until_ready()
-            best = float("inf")
-            for _ in range(3):
-                t = time.perf_counter()
-                loop().block_until_ready()
-                best = min(best, time.perf_counter() - t)
-            return best / n
-
-        tx = loop_time(masked_gram_xla)
-        tp = loop_time(masked_gram_pallas)
+        X16, Y16, W16 = (a.astype(jnp.bfloat16) for a in (X, Y, W))
+        tx = _gram_loop_seconds(masked_gram_xla, X, Y, W, 300, n_timing=3)
+        tp = _gram_loop_seconds(masked_gram_pallas, X, Y, W, 300, n_timing=3)
+        tp16 = _gram_loop_seconds(
+            masked_gram_pallas, X16, Y16, W16, 300, n_timing=3
+        )
         print(
             f"| {T} x {N} | 2^{int(np.log2(T*N))} | {tx*1e6:.1f} "
-            f"| {tp*1e6:.1f} | {tx/tp:.2f}x |"
+            f"| {tp*1e6:.1f} | {tx/tp:.2f}x "
+            f"| {tp16*1e6:.1f} | {tp/tp16:.2f}x |"
         )
 
 
