@@ -5,8 +5,13 @@ the model behind Chauvet-Piger recession probabilities) — a single common
 factor whose MEAN switches with a latent Markov regime:
 
     x_t = lam (mu_{S_t} + z_t) + e_t,   e_t ~ N(0, diag(R))
-    z_t = phi z_{t-1} + u_t,            u_t ~ N(0, 1)   (scale fixed: ident.)
+    z_t = phi z_{t-1} + u_t,            u_t ~ N(0, sigma2_{S_t})
     S_t in {0..M-1},  P[i, j] = Pr(S_t = j | S_{t-1} = i)
+
+with sigma2_0 = 1 fixed as the scale anchor.  The plain mean-switching
+model is sigma2 = ones (the fit default); `switching_variance=True` frees
+the remaining variances — Kim-Nelson ch.5 switching volatility, the
+innovation variance entering with the ARRIVING regime.
 
 The reference has nothing in this family; the spec is the papers.
 
@@ -60,13 +65,16 @@ class MSDFMParams(NamedTuple):
     """lam: (N,) loadings; R: (N,) idio variances; mu: (M,) regime means
     (ascending by convention — regime 0 is the low-mean/recession state);
     phi: AR(1) coefficient of the demeaned factor; P: (M, M) transition
-    matrix, rows sum to 1."""
+    matrix, rows sum to 1; sigma2: (M,) regime-dependent factor-innovation
+    variances (Kim-Nelson ch.5 switching volatility).  sigma2[0] = 1 is
+    the scale anchor — the plain mean-switching model has sigma2 = ones."""
 
     lam: jnp.ndarray
     R: jnp.ndarray
     mu: jnp.ndarray
     phi: jnp.ndarray
     P: jnp.ndarray
+    sigma2: jnp.ndarray
 
     @property
     def n_regimes(self) -> int:
@@ -106,31 +114,33 @@ def kim_filter(params: MSDFMParams, x, mask):
     Pm = params.P  # (M, M) rows: from-regime i
     log_Pm = jnp.log(jnp.clip(Pm, 1e-30, 1.0))
 
+    sig2 = params.sigma2  # (M,) innovation variance entering WITH regime j
+
     # stationary init for z; uniform-ish regime prior from P's stationarity
     # (simple uniform keeps the filter parameter-smooth for the optimizer)
     m0 = jnp.zeros(M, dtype)
-    P0 = jnp.full(M, 1.0 / jnp.maximum(1.0 - phi**2, 1e-3), dtype)
+    P0 = sig2 / jnp.maximum(1.0 - phi**2, 1e-3)
     p0 = jnp.full(M, 1.0 / M, dtype)
 
     def step(carry, inp):
         m_i, P_i, logp_i = carry  # per-regime (M,), (M,), (M,) log probs
         Ct, bt, ldt, xRxt, nt = inp
 
-        # per-pair prediction (i -> j): z dynamics are regime-free
+        # per-pair prediction (i -> j): the mean recursion is regime-free;
+        # the innovation variance enters with the ARRIVING regime j
         a = phi * m_i  # (M,) predicted mean, indexed by i
-        Pp = phi**2 * P_i + 1.0  # (M,) predicted var, indexed by i
+        Pp = phi**2 * P_i[:, None] + sig2[None, :]  # (i, j) predicted var
 
         # regime-j observation: x_t - lam*mu_j = lam z_t + e
         b_j = bt - Ct * mu  # (M,) indexed by j
         xRx_j = xRxt - 2.0 * mu * bt + Ct * mu**2  # (M,)
 
-        # information update per (i, j): precision 1/Pp_i + Ct
-        Pu = 1.0 / (1.0 / Pp[:, None] + Ct)  # (M_i, 1) -> (M_i, M_j)? Ct scalar
-        Pu = jnp.broadcast_to(Pu, (M, M))  # (i, j)
+        # information update per (i, j): precision 1/Pp_ij + Ct
+        Pu = 1.0 / (1.0 / Pp + Ct)  # (i, j)
         rhs = b_j[None, :] - Ct * a[:, None]  # (i, j) innovation information
         m_u = a[:, None] + Pu * rhs  # (i, j) posterior mean
         # determinant-lemma loglik of the pair (see ssm._info_filter_scan)
-        ld_pp = jnp.log(Pp)[:, None]
+        ld_pp = jnp.log(Pp)
         ld_pu = jnp.log(Pu)
         quad0 = xRx_j[None, :] - 2.0 * a[:, None] * b_j[None, :] + Ct * a[:, None] ** 2
         quad = quad0 - rhs * Pu * rhs
@@ -197,31 +207,43 @@ def _pack(params: MSDFMParams):
         "log_dmu": jnp.log(jnp.maximum(dmu, 1e-6)),
         "atanh_phi": jnp.arctanh(jnp.clip(params.phi / 0.98, -0.999, 0.999)),
         "log_P": jnp.log(jnp.clip(params.P, 1e-8, 1.0)),
+        # regime innovation variances relative to the regime-0 anchor
+        "log_sig": jnp.log(jnp.clip(params.sigma2[1:] / params.sigma2[0], 1e-4, 1e4)),
     }
 
 
-def _unpack(theta) -> MSDFMParams:
+def _unpack(theta, switching_variance: bool) -> MSDFMParams:
     mu = theta["mu0"] + jnp.concatenate(
         [jnp.zeros(1), jnp.cumsum(jnp.exp(theta["log_dmu"]))]
     )
     P_un = jax.nn.softmax(theta["log_P"], axis=1)
+    M = mu.shape[0]
+    if switching_variance:
+        # sigma2[0] = 1 is the scale anchor (the factor's overall scale is
+        # identified by the regime-0 innovation variance)
+        sigma2 = jnp.concatenate(
+            [jnp.ones(1), jnp.exp(jnp.clip(theta["log_sig"], -8.0, 8.0))]
+        )
+    else:
+        sigma2 = jnp.ones(M)
     return MSDFMParams(
         lam=theta["lam"],
         R=jnp.exp(jnp.clip(theta["log_R"], -12.0, 12.0)),
         mu=mu,
         phi=0.98 * jnp.tanh(theta["atanh_phi"]),
         P=P_un,
+        sigma2=sigma2,
     )
 
 
-@partial(jax.jit, static_argnames=("n_steps",))
-def _fit_adam(theta0, xz_nan, mask, n_steps: int, lr):
+@partial(jax.jit, static_argnames=("n_steps", "switching_variance"))
+def _fit_adam(theta0, xz_nan, mask, n_steps: int, lr, switching_variance: bool):
     import optax
 
     opt = optax.adam(lr)
 
     def loss_fn(theta):
-        p = _unpack(theta)
+        p = _unpack(theta, switching_variance)
         ll, *_ = kim_filter(p, xz_nan, mask)
         return -ll / xz_nan.shape[0]
 
@@ -246,6 +268,7 @@ def fit_ms_dfm(
     backend: str | None = None,
     seed: int = 0,
     n_restarts: int = 4,
+    switching_variance: bool = False,
 ) -> MSDFMResults:
     """Fit the MS-DFM by differentiable MLE on a (T, N) panel (NaN =
     missing).  The panel is standardized internally; regime 0 is the
@@ -257,6 +280,10 @@ def fit_ms_dfm(
     optimizer runs `n_restarts` perturbed initializations — regime means
     seeded from lower/upper quantile means of the first PC — as ONE
     vmapped adam program, and returns the best final likelihood.
+
+    switching_variance=True additionally frees the regime innovation
+    variances (Kim-Nelson switching volatility; sigma2[0] = 1 stays the
+    scale anchor, so the RATIOS are what is identified and fitted).
     """
     with on_backend(backend):
         from ..ops.linalg import standardize_data
@@ -302,6 +329,7 @@ def fit_ms_dfm(
             mu=jnp.sort(mu_grid).astype(xstd.dtype),
             phi=phi0.astype(xstd.dtype),
             P=P0.astype(xstd.dtype),
+            sigma2=jnp.ones(n_regimes, xstd.dtype),
         )
 
         # perturbed restarts as one vmapped program: jitter the regime
@@ -327,7 +355,7 @@ def fit_ms_dfm(
 
         thetas = jax.vmap(_restart)(scale, mu0_jit, phi_jit)
         theta_all, losses_all = jax.vmap(
-            lambda t: _fit_adam(t, xstd, mask, n_steps, lr)
+            lambda t: _fit_adam(t, xstd, mask, n_steps, lr, switching_variance)
         )(thetas)
         # select by each restart's RETURNED parameters' own likelihood:
         # losses[i] is evaluated before adam update i, so the recorded
@@ -335,7 +363,9 @@ def fit_ms_dfm(
         # both miss a last-step blowup and pick a worse-likelihood mode
         candidates = []
         for k in range(n_restarts):
-            params_k = _unpack(jax.tree.map(lambda a: a[k], theta_all))
+            params_k = _unpack(
+                jax.tree.map(lambda a: a[k], theta_all), switching_variance
+            )
             out_k = kim_filter(params_k, xstd, mask)
             ll_k = float(out_k[0])
             if np.isfinite(ll_k):
