@@ -40,7 +40,9 @@ from .emaccel import SquaremState, squarem, squarem_state
 from .msdfm import (
     MSDFMParams,
     MSDFMResults,
+    MSForecast,
     fit_ms_dfm,
+    forecast_ms,
     kim_filter,
     kim_smoother_probs,
 )

@@ -53,9 +53,11 @@ from .ssm import _collapse_obs
 __all__ = [
     "MSDFMParams",
     "MSDFMResults",
+    "MSForecast",
     "kim_filter",
     "kim_smoother_probs",
     "fit_ms_dfm",
+    "forecast_ms",
 ]
 
 _LOG2PI = float(np.log(2.0 * np.pi))
@@ -388,3 +390,46 @@ def fit_ms_dfm(
             stds=stds,
             means=n_mean,
         )
+
+
+class MSForecast(NamedTuple):
+    regime_probs: jnp.ndarray  # (h, M) Pr(S_{T+k} | x_{1:T})
+    factor_mean: jnp.ndarray  # (h,) E[f_{T+k} | x_{1:T}]
+    factor_var: jnp.ndarray  # (h,) Var(f_{T+k} | x_{1:T})
+    series_mean: jnp.ndarray  # (h, N) lam * factor_mean (standardized units)
+
+
+@partial(jax.jit, static_argnames=("horizon",))
+def forecast_ms(params: MSDFMParams, filt_probs, m_filt, P_filt, horizon: int):
+    """h-step-ahead forecast distribution from the end-of-sample Kim
+    filter state: regime probabilities propagate through P^k, the demeaned
+    factor through the AR(1) (its variance accumulating the
+    regime-probability-weighted innovation variance), and the factor mean
+    mixes the regime means with the forecast regime probabilities.
+
+    `filt_probs`, `m_filt`, `P_filt` are `kim_filter` outputs; the state
+    used is their LAST row (time T).  Recession-probability forecasts are
+    `regime_probs[:, 0]`.  Exact for the regime chain; the factor moments
+    are the standard Kim-filter mixture approximation (the filtered
+    cross-regime spread enters the h=1 variance).
+    """
+    mu, phi, Pm, sig2 = params.mu, params.phi, params.P, params.sigma2
+    p_T = filt_probs[-1]
+    # collapse the per-regime filtered state to one mixture moment pair
+    m0 = (p_T * m_filt[-1]).sum()
+    V0 = (p_T * (P_filt[-1] + (m_filt[-1] - m0) ** 2)).sum()
+
+    def step(carry, _):
+        p, m, V = carry
+        p_next = p @ Pm
+        m_next = phi * m
+        V_next = phi**2 * V + (p_next * sig2).sum()
+        fmean = (p_next * mu).sum() + m_next
+        fvar = V_next + (p_next * (mu - (p_next * mu).sum()) ** 2).sum()
+        return (p_next, m_next, V_next), (p_next, fmean, fvar)
+
+    _, (probs, fmean, fvar) = jax.lax.scan(
+        step, (p_T, m0, V0), None, length=horizon
+    )
+    series_mean = fmean[:, None] * params.lam[None, :]
+    return MSForecast(probs, fmean, fvar, series_mean)

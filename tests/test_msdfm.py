@@ -297,3 +297,34 @@ def test_two_regime_filter_matches_dense_reference(rng):
             float(ll),
             ll_ref,
         )
+
+
+def test_forecast_ms_properties(rng):
+    """Forecast distribution sanity: h=large regime probs converge to the
+    chain's stationary distribution; factor mean decays toward the
+    stationary regime-mean mixture; variances are positive and growing
+    toward the stationary variance."""
+    from dynamic_factor_models_tpu.models.msdfm import forecast_ms
+
+    x, _ = _two_regime_panel(rng, T=200)
+    res = fit_ms_dfm(x, n_steps=200, n_restarts=2)
+    xj = jnp.asarray(x)
+    ll, filt, pred, m_f, P_f = kim_filter(res.params, xj, mask_of(xj))
+    fc = forecast_ms(res.params, filt, m_f, P_f, horizon=60)
+    probs = np.asarray(fc.regime_probs)
+    assert probs.shape == (60, 2)
+    np.testing.assert_allclose(probs.sum(axis=1), 1.0, atol=1e-5)
+    # stationary distribution of the fitted chain
+    P = np.asarray(res.params.P)
+    evals, evecs = np.linalg.eig(P.T)
+    pi = np.real(evecs[:, np.argmax(np.real(evals))])
+    pi = pi / pi.sum()
+    np.testing.assert_allclose(probs[-1], pi, atol=1e-3)
+    # long-horizon factor mean -> stationary mixture mean
+    mu = np.asarray(res.params.mu)
+    np.testing.assert_allclose(
+        float(fc.factor_mean[-1]), float(pi @ mu), atol=1e-2
+    )
+    var = np.asarray(fc.factor_var)
+    assert (var > 0).all()
+    assert fc.series_mean.shape == (60, x.shape[1])
