@@ -59,6 +59,7 @@ from .ssm import (
     em_step_sqrt_collapsed,
     em_step_stats,
     estimate_dfm_em,
+    estimate_dfm_mle,
     estimate_dfm_twostep,
     kalman_filter,
     kalman_smoother,

@@ -217,8 +217,10 @@ def _pack(params: MSDFMParams):
         "log_R": jnp.log(params.R),
         "mu0": mu[0],
         "log_dmu": jnp.log(jnp.maximum(dmu, 1e-12)),
+        # 1e-6 margin: representable in f32 (1 - 1e-9 rounds to 1.0f and
+        # arctanh(1) = inf); the round-trip error in phi is <= 1e-6
         "atanh_phi": jnp.arctanh(
-            jnp.clip(params.phi / 0.98, -1.0 + 1e-9, 1.0 - 1e-9)
+            jnp.clip(params.phi / 0.98, -1.0 + 1e-6, 1.0 - 1e-6)
         ),
         "log_P": jnp.log(jnp.clip(params.P, 1e-8, 1.0)),
         # regime innovation variances relative to the regime-0 anchor
