@@ -61,6 +61,7 @@ from .ssm import (
     estimate_dfm_em,
     estimate_dfm_mle,
     estimate_dfm_twostep,
+    ssm_standard_errors,
     kalman_filter,
     kalman_smoother,
 )

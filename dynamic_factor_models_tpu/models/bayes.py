@@ -173,18 +173,17 @@ def simulation_smoother(
 
 def _prepare_panel(data, inclcode, initperiod: int, lastperiod: int):
     """Shared sampler data path (same as estimate_dfm_em): standardized
-    included panel over the window, with mask and original-unit moments.
+    included panel over the window, with mask and original-unit moments —
+    delegates to ssm._window_panel, the single copy of the prologue.
 
     Returns (data, inclcode, xz, m_arr, stds, n_mean)."""
+    from .ssm import _window_panel
+
     data = jnp.asarray(data)
     inclcode = np.asarray(inclcode)
-    est = data[:, inclcode == 1]
-    xw = est[initperiod : lastperiod + 1]
-    xstd, stds = standardize_data(xw)
-    m_arr = mask_of(xstd)
-    xz = fillz(xstd)
-    mw = mask_of(xw)
-    n_mean = (fillz(xw) * mw).sum(axis=0) / mw.sum(axis=0)
+    xz, m_arr, stds, n_mean = _window_panel(
+        data, inclcode, initperiod, lastperiod
+    )
     return data, inclcode, xz, m_arr, stds, n_mean
 
 

@@ -127,10 +127,10 @@ def _filter_mf(params: MixedFreqParams, x, mask, stats=None):
         quad0 = xr - 2.0 * (g @ bt) + g @ Ct @ g
         return Cf, rhs, ld, quad0, no
 
-    means, covs, pmeans, pcovs, ll = _info_filter_scan(
+    means, covs, pmeans, pcovs, lls = _info_filter_scan(
         Tm, Qs, (C, b, ld_R, xRx, n_obs), obs_step, s0, P0
     )
-    return means, covs, pmeans, pcovs, ll + ll_corr
+    return means, covs, pmeans, pcovs, lls.sum() + ll_corr
 
 
 def _em_mf_impl(params: MixedFreqParams, x, mask, stats):

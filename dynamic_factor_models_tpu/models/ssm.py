@@ -52,6 +52,8 @@ __all__ = [
     "estimate_dfm_em",
     "estimate_dfm_twostep",
     "estimate_dfm_mle",
+    "ssm_standard_errors",
+    "SSMStandardErrors",
     "EMResults",
 ]
 
@@ -143,6 +145,10 @@ def _info_filter_scan(Tm, Qs, obs_inputs, obs_step, s0, P0, qdiag=None):
     variances for the leading r state dims (stochastic-volatility models);
     it is ADDED to the constant Qs, so pass Qs with a zero top-left block
     when the variances are fully time-varying.
+
+    Returns (means, covs, pred_means, pred_covs, lls) with lls the
+    PER-STEP log-likelihood terms (T,) — callers sum; inference code
+    (OPG scores) differentiates them individually.
     """
     k = Tm.shape[0]
     dtype = s0.dtype
@@ -182,7 +188,7 @@ def _info_filter_scan(Tm, Qs, obs_inputs, obs_step, s0, P0, qdiag=None):
     (_, _), (means, covs, pmeans, pcovs, lls) = jax.lax.scan(
         step, (s0, P0), inputs, unroll=_SCAN_UNROLL
     )
-    return means, covs, pmeans, pcovs, lls.sum()
+    return means, covs, pmeans, pcovs, lls
 
 
 class PanelStats(NamedTuple):
@@ -573,10 +579,10 @@ def _filter_scan(params: SSMParams, x, mask, qdiag=None, stats=None):
         quad0 = xr - 2.0 * (f @ bt) + f @ Ct @ f
         return Cf, rhs, ld, quad0, no
 
-    means, covs, pmeans, pcovs, ll = _info_filter_scan(
+    means, covs, pmeans, pcovs, lls = _info_filter_scan(
         Tm, Qs, (C, b, ld_R, xRx, n_obs), obs_step, s0, P0, qdiag=qdiag
     )
-    return KalmanResult(ll + ll_corr, means, covs, pmeans, pcovs)
+    return KalmanResult(lls.sum() + ll_corr, means, covs, pmeans, pcovs)
 
 
 @jax.jit
@@ -604,10 +610,10 @@ def _filter_scan_full(params: SSMParams, x, mask, qdiag=None):
         ld_R = (mt * jnp.log(params.R)).sum()
         return C, rhs, ld_R, (rinv * v * v).sum(), mt.sum()
 
-    means, covs, pmeans, pcovs, ll = _info_filter_scan(
+    means, covs, pmeans, pcovs, lls = _info_filter_scan(
         Tm, Qs, (x, mask.astype(dtype)), obs_step, s0, P0, qdiag=qdiag
     )
-    return KalmanResult(ll, means, covs, pmeans, pcovs)
+    return KalmanResult(lls.sum(), means, covs, pmeans, pcovs)
 
 
 _FILTER_METHODS = ("sequential", "associative", "sqrt", "sqrt_collapsed")
@@ -1112,8 +1118,16 @@ def _pack_ssm(params: SSMParams):
     """Unconstrained reparametrization for direct gradient MLE: loadings
     and VAR blocks free, R through log, Q through its Cholesky factor
     (log-diagonal) — stationarity of A is NOT enforced (an explosive
-    excursion shows up as a likelihood collapse and adam steps back)."""
-    L = jnp.linalg.cholesky(params.Q)
+    excursion shows up as a likelihood collapse and adam steps back).
+
+    Q is PSD-floored before factoring so caller-supplied indefinite
+    covariances degrade gracefully (as in kalman_filter) instead of
+    silently NaN-ing the Cholesky.  The pack floors and the unpack clips
+    cover the same ranges: every value this function can emit maps back
+    through `_unpack_ssm` unchanged — a mismatch would create zero-
+    gradient dead zones that freeze adam coordinates and zero out OPG
+    scores at legally-fitted parameters."""
+    L = jnp.linalg.cholesky(_psd_floor(params.Q))
     r = params.r
     il = jnp.tril_indices(r, -1)
     return {
@@ -1128,13 +1142,15 @@ def _pack_ssm(params: SSMParams):
 def _unpack_ssm(theta, r: int) -> SSMParams:
     il = jnp.tril_indices(r, -1)
     L = jnp.zeros((r, r), theta["lam"].dtype)
+    # clip bounds strictly contain _pack_ssm's emit ranges (log 1e-8 =
+    # -18.4, log 1e-10 = -23.03): round-trip exact, no dead zones
     L = L.at[jnp.arange(r), jnp.arange(r)].set(
-        jnp.exp(jnp.clip(theta["log_qdiag"], -10.0, 10.0))
+        jnp.exp(jnp.clip(theta["log_qdiag"], -20.0, 20.0))
     )
     L = L.at[il].set(theta["q_lower"])
     return SSMParams(
         lam=theta["lam"],
-        R=jnp.exp(jnp.clip(theta["log_R"], -12.0, 12.0)),
+        R=jnp.exp(jnp.clip(theta["log_R"], -25.0, 25.0)),
         A=theta["A"],
         Q=L @ L.T,
     )
@@ -1228,3 +1244,119 @@ def estimate_dfm_mle(
             means=n_mean,
             trace=None,
         )
+
+
+def _ssm_step_lls(params: SSMParams, x, mask):
+    """Per-step log-likelihood terms (T,) of the collapsed filter — the
+    score source for OPG standard errors.  Uses the stats-free collapse so
+    the x'R^-1 x quadratic stays attributed to its own step (the PanelStats
+    formulation moves it out of the scan as a TOTAL correction, which sums
+    to the same likelihood but has no per-step decomposition)."""
+    Tm, Qs = _companion(params)
+    k = Tm.shape[0]
+    r = params.r
+    s0, P0 = _init_state(params)
+    dtype = x.dtype
+    C, b, ld_R, xRx, n_obs = _collapse_obs(
+        params.lam, params.R, x, mask.astype(dtype)
+    )
+
+    def obs_step(inp, sp):
+        Ct, bt, ld, xr, no = inp
+        f = sp[:r]
+        Cf = jnp.zeros((k, k), dtype).at[:r, :r].set(Ct)
+        rhs = jnp.zeros(k, dtype).at[:r].set(bt - Ct @ f)
+        quad0 = xr - 2.0 * (f @ bt) + f @ Ct @ f
+        return Cf, rhs, ld, quad0, no
+
+    _, _, _, _, lls = _info_filter_scan(
+        Tm, Qs, (C, b, ld_R, xRx, n_obs), obs_step, s0, P0
+    )
+    return lls
+
+
+class SSMStandardErrors(NamedTuple):
+    """Delta-method OPG standard errors for the state-space DFM.  The
+    structural mode covers the dynamics block (A, Q); lam/R fields are
+    NaN unless which="all"."""
+
+    A: jnp.ndarray  # (p, r, r)
+    Q: jnp.ndarray  # (r, r)
+    lam: jnp.ndarray  # (N, r)
+    R: jnp.ndarray  # (N,)
+
+
+def ssm_standard_errors(
+    params: SSMParams, x, mask=None, which: str = "structural"
+) -> SSMStandardErrors:
+    """OPG (BHHH) standard errors for a fitted state-space DFM (the EM,
+    two-step, or direct-MLE estimate): the per-step collapsed-filter
+    log-likelihood terms are differentiable, so the score matrix is one
+    jitted forward-mode jacobian; delta-method through the Cholesky/log
+    reparametrization gives natural-scale SEs.
+
+    which="structural" (default) scores (A, Q) holding (lam, R) fixed —
+    well-posed on wide panels; which="all" scores everything and refuses
+    rank-deficient designs (T <= #params).  `x` is the STANDARDIZED panel
+    (NaN = missing) the model was fitted on.  First-order inference near
+    the optimum; EM stops on a likelihood-change rule, so treat the last
+    digits with the usual caution.
+    """
+    from jax.flatten_util import ravel_pytree
+
+    x = jnp.asarray(x)
+    if mask is None:
+        mask = mask_of(x)
+    xz = jnp.where(mask, x, 0.0)
+    if which not in ("structural", "all"):
+        raise ValueError(f"which must be 'structural' or 'all', got {which!r}")
+    r = params.r
+    theta0 = _pack_ssm(params)
+    struct_keys = ("A", "log_qdiag", "q_lower")
+    if which == "structural":
+        free0 = {k: theta0[k] for k in struct_keys}
+        fixed = {k: v for k, v in theta0.items() if k not in struct_keys}
+    else:
+        free0 = dict(theta0)
+        fixed = {}
+    flat0, unravel = ravel_pytree(free0)
+    d = flat0.shape[0]
+    T = x.shape[0]
+    if T <= d:
+        raise ValueError(
+            f"OPG needs more time steps than free parameters: T={T} vs "
+            f"{d} (which={which!r}); use which='structural' or a longer "
+            "sample"
+        )
+
+    def lls_of(flat):
+        theta = dict(fixed)
+        theta.update(unravel(flat))
+        p = _unpack_ssm(theta, r)
+        return _ssm_step_lls(p, xz, mask)
+
+    scores = jax.jit(jax.jacfwd(lls_of))(flat0)  # (T, d)
+    info = scores.T @ scores
+    cov_theta = jnp.linalg.pinv(info, hermitian=True)
+
+    def natural(flat):
+        theta = dict(fixed)
+        theta.update(unravel(flat))
+        p = _unpack_ssm(theta, r)
+        return jnp.concatenate(
+            [p.A.ravel(), p.Q.ravel(), p.lam.ravel(), p.R]
+        )
+
+    G = jax.jacobian(natural)(flat0)
+    var_nat = jnp.einsum("ij,jk,ik->i", G, cov_theta, G)
+    se = jnp.sqrt(jnp.maximum(var_nat, 0.0))
+    p_, N = params.p, params.lam.shape[0]
+    i = 0
+    se_A = se[i : i + p_ * r * r].reshape(p_, r, r); i += p_ * r * r
+    se_Q = se[i : i + r * r].reshape(r, r); i += r * r
+    se_lam = se[i : i + N * r].reshape(N, r); i += N * r
+    se_R = se[i : i + N]
+    if which == "structural":
+        se_lam = jnp.full((N, r), jnp.nan)
+        se_R = jnp.full(N, jnp.nan)
+    return SSMStandardErrors(A=se_A, Q=se_Q, lam=se_lam, R=se_R)

@@ -133,7 +133,10 @@ def _filter_ar(params: SSMARParams, x, mask):
         n_obs = mt.sum()
         return C, rhs, n_obs * log_kappa, (dv * v).sum(), n_obs
 
-    return _info_filter_scan(Tm, Qs, (x, mask.astype(dtype)), obs_step, s0, P0)
+    means, covs, pmeans, pcovs, lls = _info_filter_scan(
+        Tm, Qs, (x, mask.astype(dtype)), obs_step, s0, P0
+    )
+    return means, covs, pmeans, pcovs, lls.sum()
 
 
 @jax.jit

@@ -219,9 +219,10 @@ def test_mixed_freq_filter_collapse_exact(rng):
             mt.sum(),
         )
 
-    full = _info_filter_scan(
+    *full_moments, full_lls = _info_filter_scan(
         Tm, Qs, (x, m.astype(dtype)), obs_step, s0, P0
     )
+    full = (*full_moments, full_lls.sum())  # scan returns per-step terms
     coll = _filter_mf(params, x, m)
     for a, b in zip(coll, full):
         np.testing.assert_allclose(a, b, atol=TOL)

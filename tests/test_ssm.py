@@ -441,3 +441,37 @@ def test_estimate_dfm_mle_matches_em_neighborhood(dataset_real):
     assert corr > 0.97, corr
     # Q positive definite by the Cholesky parametrization
     assert (np.linalg.eigvalsh(np.asarray(mle.params.Q)) > 0).all()
+
+
+def test_ssm_standard_errors(dataset_real):
+    """OPG SEs for the state-space DFM: per-step collapsed lls sum to the
+    filter loglik exactly; structural SEs finite/positive; whole-vector
+    mode refuses rank-deficient designs."""
+    from dynamic_factor_models_tpu.models.ssm import (
+        _ssm_step_lls,
+        estimate_dfm_em,
+        ssm_standard_errors,
+    )
+    from dynamic_factor_models_tpu.ops.linalg import standardize_data
+
+    em = estimate_dfm_em(
+        dataset_real.bpdata, dataset_real.inclcode, 2, 223, max_em_iter=30
+    )
+    est = np.asarray(dataset_real.bpdata)[
+        :, np.asarray(dataset_real.inclcode) == 1
+    ][2:224]
+    xstd, _ = standardize_data(jnp.asarray(est))
+    m = ~jnp.isnan(xstd)
+    xz = jnp.where(m, xstd, 0.0)
+    # per-step terms sum to the filter likelihood (stats-free path)
+    lls = _ssm_step_lls(em.params, xz, m)
+    filt = kalman_filter(em.params, jnp.where(m, xz, jnp.nan))
+    np.testing.assert_allclose(
+        float(lls.sum()), float(filt.loglik), rtol=1e-10
+    )
+    se = ssm_standard_errors(em.params, xstd)
+    assert np.isfinite(np.asarray(se.A)).all() and (np.asarray(se.A) > 0).all()
+    assert np.isfinite(np.asarray(se.Q)).all()
+    assert np.isnan(np.asarray(se.lam)).all()
+    with pytest.raises(ValueError, match="time steps"):
+        ssm_standard_errors(em.params, xstd[:40], which="all")
