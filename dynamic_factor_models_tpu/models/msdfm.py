@@ -468,16 +468,21 @@ def ms_standard_errors(
     mask=None,
     switching_variance: bool | None = None,
     which: str = "structural",
+    cov: str = "sandwich",
 ) -> MSStandardErrors:
     """OPG (BHHH) standard errors for a fitted MS-DFM.
 
     The per-step log-likelihood contributions are differentiable through
     the whole Kim recursion, so the score matrix is one forward-mode
     jacobian over the unconstrained parameter vector; the information
-    estimate is the outer product of scores (valid at/near the MLE —
-    adam stops near, not at, the optimum, so treat these as first-order
-    inference).  SEs are mapped to the natural scale by the delta method
-    through the same reparametrization the optimizer used.
+    estimate defaults to the SANDWICH H^-1 (S'S) H^-1 — the Kim
+    likelihood is a quasi-likelihood (the collapse is an approximation),
+    so the information equality behind bare OPG fails and cov="opg"
+    understates uncertainty (calibrated against Monte-Carlo spread in the
+    tests; adam stops near, not at, the optimum, so treat these as
+    first-order inference either way).  SEs are mapped to the natural
+    scale by the delta method through the same reparametrization the
+    optimizer used.
 
     which="structural" (default) differentiates only the regime-dynamics
     block (mu, phi, P, sigma2) holding the measurement parameters
@@ -503,6 +508,8 @@ def ms_standard_errors(
         )
     if which not in ("structural", "all"):
         raise ValueError(f"which must be 'structural' or 'all', got {which!r}")
+    if cov not in ("sandwich", "opg"):
+        raise ValueError(f"cov must be 'sandwich' or 'opg', got {cov!r}")
     theta0 = _pack(params)
     struct_keys = ("mu0", "log_dmu", "atanh_phi", "log_P", "log_sig")
     if which == "structural":
@@ -537,8 +544,18 @@ def ms_standard_errors(
     # forward-mode: d is small (structural: M + 1 + M^2 + (M-1)), so d
     # JVP passes through the T-step scan beat T reverse passes
     scores = jax.jit(jax.jacfwd(lls_of))(flat0)  # (T, d)
-    info = scores.T @ scores
-    cov_theta = jnp.linalg.pinv(info, hermitian=True)
+    opg = scores.T @ scores
+    if cov == "opg":
+        cov_theta = jnp.linalg.pinv(opg, hermitian=True)
+    else:
+        # sandwich H^-1 (S'S) H^-1: the Kim likelihood is a QUASI-
+        # likelihood (the Gaussian-mixture collapse is an approximation),
+        # so the information equality behind bare OPG fails and OPG alone
+        # understates uncertainty (verified against Monte-Carlo spread in
+        # tests); H is the Hessian of the total loglik — d is small
+        H = jax.jit(jax.hessian(lambda f: lls_of(f).sum()))(flat0)
+        Hinv = jnp.linalg.pinv(-H, hermitian=True)
+        cov_theta = Hinv @ opg @ Hinv
 
     def natural(flat):
         theta = dict(fixed)

@@ -1287,12 +1287,18 @@ class SSMStandardErrors(NamedTuple):
 
 
 def ssm_standard_errors(
-    params: SSMParams, x, mask=None, which: str = "structural"
+    params: SSMParams,
+    x,
+    mask=None,
+    which: str = "structural",
+    cov: str = "sandwich",
 ) -> SSMStandardErrors:
     """OPG (BHHH) standard errors for a fitted state-space DFM (the EM,
     two-step, or direct-MLE estimate): the per-step collapsed-filter
     log-likelihood terms are differentiable, so the score matrix is one
-    jitted forward-mode jacobian; delta-method through the Cholesky/log
+    jitted forward-mode jacobian; the covariance defaults to the sandwich
+    H^-1 (S'S) H^-1 (robust to quasi-likelihood effects; cov="opg" for
+    the bare outer product); delta-method through the Cholesky/log
     reparametrization gives natural-scale SEs.
 
     which="structural" (default) scores (A, Q) holding (lam, R) fixed —
@@ -1310,6 +1316,8 @@ def ssm_standard_errors(
     xz = jnp.where(mask, x, 0.0)
     if which not in ("structural", "all"):
         raise ValueError(f"which must be 'structural' or 'all', got {which!r}")
+    if cov not in ("sandwich", "opg"):
+        raise ValueError(f"cov must be 'sandwich' or 'opg', got {cov!r}")
     r = params.r
     theta0 = _pack_ssm(params)
     struct_keys = ("A", "log_qdiag", "q_lower")
@@ -1336,8 +1344,16 @@ def ssm_standard_errors(
         return _ssm_step_lls(p, xz, mask)
 
     scores = jax.jit(jax.jacfwd(lls_of))(flat0)  # (T, d)
-    info = scores.T @ scores
-    cov_theta = jnp.linalg.pinv(info, hermitian=True)
+    opg = scores.T @ scores
+    if cov == "opg":
+        cov_theta = jnp.linalg.pinv(opg, hermitian=True)
+    else:
+        # sandwich H^-1 (S'S) H^-1 (default): robust to the quasi-
+        # likelihood character of EM-stopped / model-misspecified fits,
+        # where the information equality behind bare OPG fails
+        H = jax.jit(jax.hessian(lambda f: lls_of(f).sum()))(flat0)
+        Hinv = jnp.linalg.pinv(-H, hermitian=True)
+        cov_theta = Hinv @ opg @ Hinv
 
     def natural(flat):
         theta = dict(fixed)
