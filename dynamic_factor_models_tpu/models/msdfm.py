@@ -470,7 +470,7 @@ def ms_standard_errors(
     which: str = "structural",
     cov: str = "sandwich",
 ) -> MSStandardErrors:
-    """OPG (BHHH) standard errors for a fitted MS-DFM.
+    """Sandwich/OPG standard errors for a fitted MS-DFM.
 
     The per-step log-likelihood contributions are differentiable through
     the whole Kim recursion, so the score matrix is one forward-mode
@@ -529,7 +529,8 @@ def ms_standard_errors(
     n_null = M + (0 if switching_variance else M - 1)
     if T <= d - n_null:
         raise ValueError(
-            f"OPG needs more time steps than free parameters: T={T} vs "
+            f"score-based inference needs more time steps than free "
+            f"parameters: T={T} vs "
             f"{d - n_null} effective parameters (which={which!r}); use "
             "which='structural' or a longer sample"
         )
@@ -543,19 +544,9 @@ def ms_standard_errors(
 
     # forward-mode: d is small (structural: M + 1 + M^2 + (M-1)), so d
     # JVP passes through the T-step scan beat T reverse passes
-    scores = jax.jit(jax.jacfwd(lls_of))(flat0)  # (T, d)
-    opg = scores.T @ scores
-    if cov == "opg":
-        cov_theta = jnp.linalg.pinv(opg, hermitian=True)
-    else:
-        # sandwich H^-1 (S'S) H^-1: the Kim likelihood is a QUASI-
-        # likelihood (the Gaussian-mixture collapse is an approximation),
-        # so the information equality behind bare OPG fails and OPG alone
-        # understates uncertainty (verified against Monte-Carlo spread in
-        # tests); H is the Hessian of the total loglik — d is small
-        H = jax.jit(jax.hessian(lambda f: lls_of(f).sum()))(flat0)
-        Hinv = jnp.linalg.pinv(-H, hermitian=True)
-        cov_theta = Hinv @ opg @ Hinv
+    from .ssm import _score_covariance
+
+    cov_theta = _score_covariance(lls_of, flat0, cov)
 
     def natural(flat):
         theta = dict(fixed)

@@ -1275,6 +1275,34 @@ def _ssm_step_lls(params: SSMParams, x, mask):
     return lls
 
 
+def _score_covariance(lls_of, flat0, cov: str):
+    """Shared covariance engine for the score-based SE functions
+    (ssm_standard_errors / msdfm.ms_standard_errors): forward-mode scores,
+    then OPG or the sandwich H^-1 (S'S) H^-1.  The sandwich guards the
+    Hessian: these estimates are near, not at, the optimum (EM stops on a
+    likelihood-change rule; adam on a step budget), where -H can be
+    indefinite in weakly identified directions and pinv would amplify by
+    1/lambda^2 — on detection it falls back to OPG with a warning."""
+    import warnings
+
+    scores = jax.jit(jax.jacfwd(lls_of))(flat0)  # (T, d)
+    opg = scores.T @ scores
+    if cov == "sandwich":
+        H = jax.jit(jax.hessian(lambda f: lls_of(f).sum()))(flat0)
+        negH = -0.5 * (H + H.T)
+        evals = jnp.linalg.eigvalsh(negH)
+        if bool(evals[0] < -1e-8 * jnp.maximum(jnp.abs(evals[-1]), 1e-30)):
+            warnings.warn(
+                "sandwich covariance: -Hessian is indefinite at these "
+                "parameters (not at a local optimum); falling back to OPG",
+                stacklevel=3,
+            )
+        else:
+            Hinv = jnp.linalg.pinv(negH, hermitian=True)
+            return Hinv @ opg @ Hinv
+    return jnp.linalg.pinv(opg, hermitian=True)
+
+
 class SSMStandardErrors(NamedTuple):
     """Delta-method OPG standard errors for the state-space DFM.  The
     structural mode covers the dynamics block (A, Q); lam/R fields are
@@ -1293,7 +1321,7 @@ def ssm_standard_errors(
     which: str = "structural",
     cov: str = "sandwich",
 ) -> SSMStandardErrors:
-    """OPG (BHHH) standard errors for a fitted state-space DFM (the EM,
+    """Sandwich/OPG standard errors for a fitted state-space DFM (the EM,
     two-step, or direct-MLE estimate): the per-step collapsed-filter
     log-likelihood terms are differentiable, so the score matrix is one
     jitted forward-mode jacobian; the covariance defaults to the sandwich
@@ -1332,9 +1360,9 @@ def ssm_standard_errors(
     T = x.shape[0]
     if T <= d:
         raise ValueError(
-            f"OPG needs more time steps than free parameters: T={T} vs "
-            f"{d} (which={which!r}); use which='structural' or a longer "
-            "sample"
+            f"score-based inference needs more time steps than free "
+            f"parameters: T={T} vs {d} (which={which!r}); use "
+            "which='structural' or a longer sample"
         )
 
     def lls_of(flat):
@@ -1343,17 +1371,7 @@ def ssm_standard_errors(
         p = _unpack_ssm(theta, r)
         return _ssm_step_lls(p, xz, mask)
 
-    scores = jax.jit(jax.jacfwd(lls_of))(flat0)  # (T, d)
-    opg = scores.T @ scores
-    if cov == "opg":
-        cov_theta = jnp.linalg.pinv(opg, hermitian=True)
-    else:
-        # sandwich H^-1 (S'S) H^-1 (default): robust to the quasi-
-        # likelihood character of EM-stopped / model-misspecified fits,
-        # where the information equality behind bare OPG fails
-        H = jax.jit(jax.hessian(lambda f: lls_of(f).sum()))(flat0)
-        Hinv = jnp.linalg.pinv(-H, hermitian=True)
-        cov_theta = Hinv @ opg @ Hinv
+    cov_theta = _score_covariance(lls_of, flat0, cov)
 
     def natural(flat):
         theta = dict(fixed)

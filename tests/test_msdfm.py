@@ -77,7 +77,7 @@ def test_single_regime_matches_dense_kalman(rng):
     assert np.allclose(np.asarray(sm), 1.0)
 
 
-def _two_regime_panel(rng, T=400, N=8):
+def _two_regime_panel(rng, T=400, N=8, S=None, lam=None):
     """Identifiable design: the regime separation (2.5) clearly exceeds
     the stationary sd of the within-regime AR factor (1/sqrt(1-0.3^2)
     ~ 1.05) — with separation ~ the factor sd, maximum likelihood
@@ -87,12 +87,15 @@ def _two_regime_panel(rng, T=400, N=8):
     P = np.array([[0.92, 0.08], [0.04, 0.96]])
     mu = np.array([-2.0, 0.5])
     phi = 0.3
-    S = np.zeros(T, int)
+    if S is None:
+        S = np.zeros(T, int)
+        for t in range(1, T):
+            S[t] = rng.choice(2, p=P[S[t - 1]])
     z = np.zeros(T)
     for t in range(1, T):
-        S[t] = rng.choice(2, p=P[S[t - 1]])
         z[t] = phi * z[t - 1] + rng.standard_normal()
-    lam = 0.6 + 0.4 * rng.random(N)
+    if lam is None:
+        lam = 0.6 + 0.4 * rng.random(N)
     f = mu[S] + z
     x = np.outer(f, lam) + 0.6 * rng.standard_normal((T, N))
     x[rng.random((T, N)) < 0.05] = np.nan
@@ -310,9 +313,9 @@ def test_forecast_ms_properties(rng):
     res = fit_ms_dfm(x, n_steps=200, n_restarts=2)
     xj = jnp.asarray(x)
     ll, filt, pred, m_f, P_f = kim_filter(res.params, xj, mask_of(xj))
-    fc = forecast_ms(res.params, filt, m_f, P_f, horizon=60)
+    fc = forecast_ms(res.params, filt, m_f, P_f, horizon=240)
     probs = np.asarray(fc.regime_probs)
-    assert probs.shape == (60, 2)
+    assert probs.shape == (240, 2)
     np.testing.assert_allclose(probs.sum(axis=1), 1.0, atol=1e-5)
     # stationary distribution of the fitted chain
     P = np.asarray(res.params.P)
@@ -327,7 +330,7 @@ def test_forecast_ms_properties(rng):
     )
     var = np.asarray(fc.factor_var)
     assert (var > 0).all()
-    assert fc.series_mean.shape == (60, x.shape[1])
+    assert fc.series_mean.shape == (240, x.shape[1])
 
 
 def test_opg_standard_errors(rng):
@@ -347,6 +350,11 @@ def test_opg_standard_errors(rng):
     assert np.isnan(np.asarray(se.lam)).all()  # no inference in this mode
     # the sigma2 anchor is structurally fixed: zero standard error
     assert float(se.sigma2[0]) == 0.0
+    # the OPG escape hatch stays alive, and cov values are validated
+    se_opg = ms_standard_errors(res.params, xstd, cov="opg")
+    assert np.isfinite(np.asarray(se_opg.mu)).all()
+    with pytest.raises(ValueError, match="cov"):
+        ms_standard_errors(res.params, xstd, cov="hac")
     # which="all" is well-posed here (T=400 > d~26) and covers lam too
     se_all = ms_standard_errors(res.params, xstd, which="all")
     assert np.isfinite(np.asarray(se_all.lam)).all()
@@ -380,8 +388,6 @@ def test_se_calibration_monte_carlo_fixed_regime_path():
 
     T, N = 400, 8
     P = np.array([[0.92, 0.08], [0.04, 0.96]])
-    mu = np.array([-2.0, 0.5])
-    phi = 0.3
     path_rng = np.random.default_rng(100)
     S = np.zeros(T, int)
     for t in range(1, T):
@@ -391,10 +397,7 @@ def test_se_calibration_monte_carlo_fixed_regime_path():
     mus, ses = [], []
     for rep in range(10):
         rng = np.random.default_rng(500 + rep)
-        z = np.zeros(T)
-        for t in range(1, T):
-            z[t] = phi * z[t - 1] + rng.standard_normal()
-        x = np.outer(mu[S] + z, lam) + 0.6 * rng.standard_normal((T, N))
+        x, _ = _two_regime_panel(rng, T=T, N=N, S=S, lam=lam)
         res = fit_ms_dfm(x, n_steps=300, n_restarts=2)
         xstd = (np.asarray(x) - np.asarray(res.means)) / np.asarray(res.stds)
         se = ms_standard_errors(res.params, xstd)

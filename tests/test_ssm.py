@@ -473,5 +473,9 @@ def test_ssm_standard_errors(dataset_real):
     assert np.isfinite(np.asarray(se.A)).all() and (np.asarray(se.A) > 0).all()
     assert np.isfinite(np.asarray(se.Q)).all()
     assert np.isnan(np.asarray(se.lam)).all()
+    se_opg = ssm_standard_errors(em.params, xstd, cov="opg")
+    assert np.isfinite(np.asarray(se_opg.A)).all()
+    with pytest.raises(ValueError, match="cov"):
+        ssm_standard_errors(em.params, xstd, cov="hac")
     with pytest.raises(ValueError, match="time steps"):
         ssm_standard_errors(em.params, xstd[:40], which="all")
