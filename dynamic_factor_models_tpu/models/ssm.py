@@ -1280,9 +1280,11 @@ def _score_covariance(lls_of, flat0, cov: str):
     (ssm_standard_errors / msdfm.ms_standard_errors): forward-mode scores,
     then OPG or the sandwich H^-1 (S'S) H^-1.  The sandwich guards the
     Hessian: these estimates are near, not at, the optimum (EM stops on a
-    likelihood-change rule; adam on a step budget), where -H can be
-    indefinite in weakly identified directions and pinv would amplify by
-    1/lambda^2 — on detection it falls back to OPG with a warning."""
+    likelihood-change rule; adam on a step budget): near-flat and
+    noise-negative curvature directions are excluded by an eigenvalue
+    floor (they carry no information and would otherwise be amplified by
+    1/lambda^2), and substantially indefinite points fall back to OPG
+    with a warning."""
     import warnings
 
     scores = jax.jit(jax.jacfwd(lls_of))(flat0)  # (T, d)
@@ -1290,15 +1292,26 @@ def _score_covariance(lls_of, flat0, cov: str):
     if cov == "sandwich":
         H = jax.jit(jax.hessian(lambda f: lls_of(f).sum()))(flat0)
         negH = -0.5 * (H + H.T)
-        evals = jnp.linalg.eigvalsh(negH)
-        if bool(evals[0] < -1e-8 * jnp.maximum(jnp.abs(evals[-1]), 1e-30)):
+        evals, evecs = jnp.linalg.eigh(negH)
+        emax = jnp.maximum(evals[-1], 1e-30)
+        if bool(evals[0] < -1e-4 * emax):
+            # substantially negative curvature: these parameters are far
+            # from any local maximum and a sandwich there is meaningless
             warnings.warn(
-                "sandwich covariance: -Hessian is indefinite at these "
-                "parameters (not at a local optimum); falling back to OPG",
+                "sandwich covariance: -Hessian is substantially indefinite "
+                "at these parameters (not near a local optimum); falling "
+                "back to OPG",
                 stacklevel=3,
             )
         else:
-            Hinv = jnp.linalg.pinv(negH, hermitian=True)
+            # eigenvalue-floored inverse: near-flat (and noise-negative)
+            # directions — weakly identified combinations, EM's slow-tail
+            # residual drift — carry no curvature information and are
+            # excluded exactly as pinv excludes rank deficiency, instead
+            # of being amplified by 1/lambda^2
+            keep = evals > 1e-8 * emax
+            inv_e = jnp.where(keep, 1.0 / jnp.where(keep, evals, 1.0), 0.0)
+            Hinv = (evecs * inv_e[None, :]) @ evecs.T
             return Hinv @ opg @ Hinv
     return jnp.linalg.pinv(opg, hermitian=True)
 

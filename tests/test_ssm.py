@@ -469,7 +469,14 @@ def test_ssm_standard_errors(dataset_real):
     np.testing.assert_allclose(
         float(lls.sum()), float(filt.loglik), rtol=1e-10
     )
-    se = ssm_standard_errors(em.params, xstd)
+    # the floored sandwich applies warning-free at an EM stop: tiny
+    # noise-negative curvature directions (EM's slow tail) are excluded
+    # by the eigenvalue floor, not amplified and not fatal
+    import warnings as _warnings
+
+    with _warnings.catch_warnings():
+        _warnings.simplefilter("error", UserWarning)
+        se = ssm_standard_errors(em.params, xstd)
     assert np.isfinite(np.asarray(se.A)).all() and (np.asarray(se.A) > 0).all()
     assert np.isfinite(np.asarray(se.Q)).all()
     assert np.isnan(np.asarray(se.lam)).all()
@@ -477,5 +484,16 @@ def test_ssm_standard_errors(dataset_real):
     assert np.isfinite(np.asarray(se_opg.A)).all()
     with pytest.raises(ValueError, match="cov"):
         ssm_standard_errors(em.params, xstd, cov="hac")
+
+    # a point FAR from any optimum (explosive A): substantially
+    # indefinite -H must fall back to OPG with the warning
+    bad = em.params._replace(A=em.params.A.at[0].set(1.8 * jnp.eye(4)))
+    with pytest.warns(UserWarning, match="indefinite"):
+        se_bad = ssm_standard_errors(bad, xstd)
+    np.testing.assert_allclose(
+        np.asarray(se_bad.A),
+        np.asarray(ssm_standard_errors(bad, xstd, cov="opg").A),
+        rtol=1e-6,
+    )
     with pytest.raises(ValueError, match="time steps"):
         ssm_standard_errors(em.params, xstd[:40], which="all")
