@@ -262,6 +262,167 @@ def _r2_pass(xz, m, f, lam_ok):
     return jnp.where(lam_ok, 1.0 - ssr / tss, jnp.nan)
 
 
+def _polish_fixed_point_f64(
+    xz,
+    m,
+    lam_ok,
+    f,
+    nfac_o: int = 0,
+    fo=None,
+    tol: float = 1e-11,
+    max_iter: int = 4000,
+):
+    """Host float64 polish of the ALS fixed point.
+
+    Iterates the exact ALS map (lambda-step then F-step, identical
+    semantics to `_als_core`: mask-only Grams in the lambda-step,
+    mask*lam_ok weights in the F-step, minimum-norm pinv solves) in NumPy
+    float64 from the jitted loop's terminal iterate until the max-abs
+    factor update falls below `tol`.  Because the map contracts toward its
+    fixed point, the ambient-precision (f32) terminal iterate is already in
+    the basin; the polish removes the accumulated f32 trajectory error so
+    the returned factors sit at the float64 fixed point regardless of the
+    ambient JAX precision or backend — this is what closes the north star's
+    1e-5 factor-parity bar (the f32 60-iteration trajectory alone diverges
+    from f64's by ~8e-5; see docs/PARITY.md).
+
+    Host-side by design: NumPy float64 is available under any JAX x64
+    setting and on any backend, and the panels at reference scale are tiny
+    (the polish is O(T*ns*K^2) per iteration).  Plain fixed-point iteration
+    plus one Aitken/Steffensen extrapolation step every 8 iterations (the
+    scalar-secant estimate of the contraction rate applied per-entry-safe,
+    on the whole factor block) to cover slowly-contracting spectra.
+
+    Returns (f_full, lam, ssr, n_it) in float64.
+    """
+    x = np.asarray(xz, np.float64)
+    m = np.asarray(m, np.float64)
+    ok = np.asarray(lam_ok, np.float64)
+    Tw = x.shape[0]
+    nfac = f.shape[1] - nfac_o
+    fu = np.asarray(f[:, nfac_o:], np.float64)
+    fo = (
+        np.zeros((Tw, 0), np.float64)
+        if nfac_o == 0
+        else np.asarray(fo, np.float64)
+    )
+    K = nfac_o + nfac
+    iuK, ivK = np.triu_indices(K)
+    iun, ivn = np.triu_indices(nfac)
+    W = m * ok[None, :]
+    xm = m * x  # masked panel (zero-filled cells stay zero under the mask)
+
+    def lam_step(fu):
+        ff = np.concatenate([fo, fu], axis=1)
+        pair = ff[:, iuK] * ff[:, ivK]  # (Tw, K(K+1)/2)
+        Ap = m.T @ pair  # (ns, packed)
+        A = np.empty((m.shape[1], K, K))
+        A[:, iuK, ivK] = Ap
+        A[:, ivK, iuK] = Ap
+        rhs = xm.T @ ff  # (ns, K)
+        lam = np.einsum(
+            "ikl,il->ik", np.linalg.pinv(A, hermitian=True), rhs
+        )
+        return lam
+
+    def f_step(lam):
+        lam_o, lam_u = lam[:, :nfac_o], lam[:, nfac_o:]
+        pair_l = (lam_u[:, iun] * lam_u[:, ivn]) * ok[:, None]
+        Ap = m @ pair_l  # (Tw, packed)
+        A = np.empty((Tw, nfac, nfac))
+        A[:, iun, ivn] = Ap
+        A[:, ivn, iun] = Ap
+        xr = xm - (m * (fo @ lam_o.T) if nfac_o else 0.0)
+        rhs = (xr * ok[None, :]) @ lam_u  # (Tw, nfac)
+        fu = np.einsum("tkl,tl->tk", np.linalg.pinv(A, hermitian=True), rhs)
+        return fu
+
+    def als_map(fu):
+        return f_step(lam_step(fu))
+
+    prev_delta = np.inf
+    delta = np.inf
+    f_prev = fu
+    n_it = 0
+    for n_it in range(1, max_iter + 1):
+        fu = als_map(f_prev)
+        delta = np.abs(fu - f_prev).max()
+        if delta < tol:
+            break
+        # Aitken/Steffensen extrapolation: near the fixed point the error
+        # contracts linearly, e_{k+1} ~ rho e_k, so the limit is
+        # f + (f_new - f) / (1 - rho) with rho estimated from successive
+        # update norms.  Applied only when the rate estimate is stable
+        # (0 < rho < 1) and verified by a fresh map application.
+        if n_it % 8 == 0 and np.isfinite(prev_delta) and prev_delta > 0:
+            rho = delta / prev_delta
+            if 1e-3 < rho < 0.999:
+                f_ex = fu + (fu - f_prev) * (rho / (1.0 - rho))
+                f_chk = als_map(f_ex)
+                if np.abs(f_chk - f_ex).max() < delta:
+                    fu, delta = f_chk, np.abs(f_chk - f_ex).max()
+        f_prev, prev_delta = fu, delta
+    if not (delta < tol):
+        # a capped, non-converged iterate is NOT a function of the data
+        # alone (two backends would polish to different points) — the
+        # parity guarantee fails, so say so instead of returning silently
+        import warnings
+
+        warnings.warn(
+            f"float64 ALS polish did not converge in {max_iter} iterations "
+            f"(last update {delta:.3e} >= tol {tol:.1e}); the polished "
+            "factors may still depend on the starting iterate",
+            stacklevel=3,
+        )
+
+    # Canonicalize: the masked ALS map is invariant under any invertible
+    # rotation Q of the unobserved block (fu -> fu Q, lam_u -> lam_u Q^-T
+    # maps fixed points to fixed points — every masked regression
+    # reparametrizes exactly), so fixed points form a GL(nfac) manifold and
+    # the polished iterate inherits its trajectory's arbitrary rotation.
+    # Project to the standard DFM representative — fu'fu/Tw = I, lam_u'lam_u
+    # diagonal descending, column signs fixed by the largest-|loading| entry
+    # — so two polishes from different trajectories (f32 vs f64, CPU vs TPU)
+    # return the SAME array, not merely the same column space.
+    lam_u0 = lam_step(fu)[:, nfac_o:]
+    S = _sym_sqrt(fu.T @ fu)
+    # pinv, not inv: a rank-deficient panel (effective rank < nfac) drives
+    # a factor column to ~0 at the fixed point and S goes singular — the
+    # same minimum-norm convention every ALS solve in this module uses
+    S_inv = np.linalg.pinv(S, hermitian=True)
+    if S[np.diag_indices_from(S)].min() < 1e-10 * max(S.max(), 1.0):
+        import warnings
+
+        warnings.warn(
+            "float64 ALS polish: factor Gram is (near-)rank-deficient — "
+            "the panel supports fewer than nfac factors; null columns are "
+            "canonicalized to zero, not noise",
+            stacklevel=3,
+        )
+    F1 = fu @ S_inv * np.sqrt(Tw)
+    L1 = lam_u0 @ S / np.sqrt(Tw)
+    evals, V = np.linalg.eigh(L1.T @ L1)
+    order = np.argsort(evals)[::-1]
+    V = V[:, order]
+    fu = F1 @ V
+    L = L1 @ V
+    sign = np.sign(L[np.abs(L).argmax(axis=0), np.arange(L.shape[1])])
+    sign[sign == 0] = 1.0
+    fu = fu * sign[None, :]
+
+    lam = lam_step(fu)
+    lam_u = lam[:, nfac_o:]
+    xr_full = x - (fo @ lam[:, :nfac_o].T if nfac_o else 0.0)
+    ssr = (W * (xr_full - fu @ lam_u.T) ** 2).sum()
+    return np.concatenate([fo, fu], axis=1), lam, ssr, n_it
+
+
+def _sym_sqrt(A):
+    """Symmetric PSD square root via eigendecomposition (host, float64)."""
+    w, V = np.linalg.eigh(A)
+    return (V * np.sqrt(np.clip(w, 0.0, None))) @ V.T
+
+
 def estimate_factor(
     data,
     inclcode,
@@ -274,6 +435,7 @@ def estimate_factor(
     observed_factor=None,
     backend: str | None = None,
     gram_dtype: str | None = None,
+    polish: str | None = None,
 ):
     """Iterated-PCA factor extraction (reference cell 20, `estimate_factor!`).
 
@@ -290,6 +452,13 @@ def estimate_factor(
     bulk phase exhausts it, since the polish always gets one iteration).
     Default None is the unchanged exact path.
 
+    polish="float64" appends a host-side NumPy float64 fixed-point polish
+    (`_polish_fixed_point_f64`) after the jitted loop, so the returned
+    factors/SSR sit at the float64 ALS fixed point on ANY backend and
+    ambient precision — the north-star 1e-5 factor-parity path.  Not
+    supported together with `constraint` (the polish iterates the
+    unconstrained map).
+
     `observed_factor` (T, nfac_o) supplies the observed factors when
     config.nfac_o > 0 — the FAVAR-style capability the reference declares
     (`nfac_o`, dfm_functions.ipynb cells 6-7) but never implements: observed
@@ -303,6 +472,12 @@ def estimate_factor(
         raise ValueError(
             f"gram_dtype must be None or 'bfloat16', got {gram_dtype!r}"
         )
+    if polish not in (None, "float64"):
+        raise ValueError(f"polish must be None or 'float64', got {polish!r}")
+    if polish is not None and constraint is not None:
+        # the host polish iterates the unconstrained ALS map; silently
+        # dropping the constraint would return a different fixed point
+        raise ValueError("polish='float64' is not supported with a constraint")
     if config.nfac_o:
         if observed_factor is None:
             raise ValueError("config.nfac_o > 0 requires observed_factor")
@@ -410,6 +585,19 @@ def estimate_factor(
                 **phase2_kwargs,
             )
             n_iter = n_iter + n_pre
+
+        if polish is not None:
+            with annotate("als_polish_f64"):
+                f_np, lam_np, ssr_np, _ = _polish_fixed_point_f64(
+                    np.asarray(xz),
+                    np.asarray(m),
+                    np.asarray(lam_ok),
+                    np.asarray(f),
+                    nfac_o=config.nfac_o,
+                    fo=None if fo is None else np.asarray(fo),
+                )
+                f = jnp.asarray(f_np, xz.dtype)
+                ssr = jnp.asarray(ssr_np, xz.dtype)
 
         R2 = _r2_pass(xz, m, f, lam_ok) if compute_R2 else jnp.full(ns, jnp.nan)
         factor = jnp.full((data.shape[0], config.nfac_t), jnp.nan, data.dtype)
@@ -741,11 +929,15 @@ def estimate_dfm(
     constraint_loading: LambdaConstraint | None = None,
     observed_factor=None,
     backend: str | None = None,
+    polish: str | None = None,
 ) -> DFMResults:
     """Non-parametric DFM: factors -> loadings -> factor VAR (cell 27).
 
     The parametric (state-space EM) path is `models.ssm.estimate_dfm_em` —
     a capability the reference declared but never implemented.
+    polish="float64" passes through to `estimate_factor` (the backend- and
+    precision-independent canonical fixed point; loadings, the factor VAR,
+    and everything downstream then inherit it).
     """
     with on_backend(backend):
         factor, fes = estimate_factor(
@@ -756,6 +948,7 @@ def estimate_dfm(
             config,
             constraint_factor,
             observed_factor=observed_factor,
+            polish=polish,
         )
         lam, r2, uar_coef, uar_ser, lam_const = estimate_factor_loading(
             data, factor, initperiod, lastperiod, config, constraint_loading

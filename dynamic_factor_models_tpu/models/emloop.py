@@ -219,6 +219,13 @@ def run_bulk_then_exact(
     iteration).  Returns (params, concatenated loglik path, total
     n_iter, trace).
 
+    The concatenated loglik path can DROP at the phase boundary (index
+    `n_pre`): the bulk entries are logliks of the bf16-Gram (R-floored)
+    map, the exact entries of the exact map — two different objectives.
+    A one-step decrease at the seam is the precision gap being repaid,
+    not EM divergence; monotonicity diagnostics should treat the two
+    segments separately.
+
     Build `bulk_args` inline in the call expression (don't bind the bf16
     twins in the caller): this function drops its reference before phase 2,
     so the twin arrays are freed for the exact phase's working set.

@@ -214,7 +214,12 @@ def _pack(params: MSDFMParams):
     dmu = jnp.diff(mu)
     return {
         "lam": params.lam,
-        "log_R": jnp.log(params.R),
+        # emit exactly _unpack's clip range [-12, 12]: an R outside
+        # [e^-12, e^12] would otherwise land in a clip dead zone whose zero
+        # gradient silently kills that coordinate's score, while any R that
+        # _unpack itself can emit (including the e^-12 floor a fit can
+        # reach) round-trips exactly
+        "log_R": jnp.clip(jnp.log(params.R), -12.0, 12.0),
         "mu0": mu[0],
         "log_dmu": jnp.log(jnp.maximum(dmu, 1e-12)),
         # 1e-6 margin: representable in f32 (1 - 1e-9 rounds to 1.0f and
@@ -469,6 +474,8 @@ def ms_standard_errors(
     switching_variance: bool | None = None,
     which: str = "structural",
     cov: str = "sandwich",
+    x_raw=None,
+    hac_lags: int | None = None,
 ) -> MSStandardErrors:
     """Sandwich/OPG standard errors for a fitted MS-DFM.
 
@@ -496,6 +503,21 @@ def ms_standard_errors(
     `x` is the STANDARDIZED panel (NaN = missing) the model was fitted
     on — rebuild it as `(x_raw - res.means) / res.stds`.  When
     `switching_variance` is None it is inferred from sigma2 != ones.
+
+    `x_raw` (the UNSTANDARDIZED panel) switches on standardization
+    propagation: the fit conditions on per-series sample means/stds that
+    are themselves estimates, and with a persistent regime chain the
+    realized regime mix moves them enough to dominate the cross-sample
+    spread of mu-hat (measured free-path Monte-Carlo ratios ~0.3-0.5
+    without the correction).  The two stages are treated as one stacked
+    M-estimator: the first-stage moment contributions u_t (mean and
+    population-std estimating equations per series) enter through the
+    adjusted score s_t - C u_t with C = (d2 ll / d theta d gamma)
+    (d u / d gamma)^-1, and the meat uses a Bartlett long-run covariance
+    (`hac_lags`, default floor(1.3 sqrt(T))) because u_t inherits the
+    regime chain's serial correlation.  Newey-McFadden (1994, ch. 36,
+    sec. 6) two-step form, specialized to exactly-identified first-stage
+    moments.
     """
     from jax.flatten_util import ravel_pytree
 
@@ -510,6 +532,16 @@ def ms_standard_errors(
         raise ValueError(f"which must be 'structural' or 'all', got {which!r}")
     if cov not in ("sandwich", "opg"):
         raise ValueError(f"cov must be 'sandwich' or 'opg', got {cov!r}")
+    if not np.isclose(float(params.sigma2[0]), 1.0):
+        # _pack stores regime variances as ratios to sigma2[0] and _unpack
+        # re-anchors sigma2[0] = 1, so hand-built params with a different
+        # anchor would be silently rescaled and the scores evaluated at the
+        # wrong point; fit_ms_dfm output is always anchored
+        raise ValueError(
+            f"params.sigma2[0] must be 1.0 (the scale anchor), got "
+            f"{float(params.sigma2[0])!r}; rescale sigma2 by sigma2[0] "
+            "(and fold the scale into lam/R) before requesting SEs"
+        )
     theta0 = _pack(params)
     struct_keys = ("mu0", "log_dmu", "atanh_phi", "log_P", "log_sig")
     if which == "structural":
@@ -546,7 +578,74 @@ def ms_standard_errors(
     # JVP passes through the T-step scan beat T reverse passes
     from .ssm import _score_covariance
 
-    cov_theta = _score_covariance(lls_of, flat0, cov)
+    adjust, n_hac = None, 0
+    if x_raw is not None:
+        x_raw = jnp.asarray(x_raw)
+        if x_raw.shape != x.shape:
+            raise ValueError(
+                f"x_raw shape {x_raw.shape} must match x {x.shape}"
+            )
+        mr = mask.astype(x_raw.dtype)
+        n_i = mr.sum(axis=0)
+        xf = fillz(x_raw)
+        # fully-missing (n_i = 0) or constant (std = 0) series contribute
+        # NOTHING to the fit, so their standardization moments have zero
+        # influence — the safe divisors make their u columns and C columns
+        # exactly zero instead of NaN-poisoning every adjusted score
+        n_safe = jnp.maximum(n_i, 1.0)
+        mean_i = (mr * xf).sum(axis=0) / n_safe
+        dev = jnp.where(mask, xf - mean_i, 0.0)
+        std_i = jnp.sqrt((dev**2).sum(axis=0) / n_safe)  # population std
+        std_i = jnp.where(std_i > 0, std_i, 1.0)
+        if not bool(
+            jnp.nanmax(jnp.abs(jnp.where(mask, dev / std_i, 0.0) - fillz(x)))
+            < 1e-3
+        ):
+            raise ValueError(
+                "x_raw does not standardize to x under the fit's "
+                "population-std convention; pass the exact raw panel the "
+                "model was fitted on"
+            )
+        Np = x.shape[1]
+        gamma0 = jnp.concatenate([mean_i, std_i])
+
+        def ll_total_g(flat, gamma):
+            theta = dict(fixed)
+            theta.update(unravel(flat))
+            p = _unpack(theta, switching_variance)
+            xs = jnp.where(mask, (xf - gamma[:Np]) / gamma[Np:], 0.0)
+            lls, *_ = _kim_scan(p, xs, mask)
+            return lls.sum()
+
+        # cross-information (d, 2N): how the score moves when the
+        # standardization constants do
+        Jsum = jax.jit(jax.jacfwd(jax.grad(ll_total_g, argnums=0), argnums=1))(
+            flat0, gamma0
+        )
+        # first-stage Jacobian sum_t du_t/dgamma is diagonal by series:
+        # d(mean eq)/dmean = -n_i; d(std eq)/dstd = -2 std_i n_i; the
+        # cross block sum_t -2 m dev = 0 exactly at the fitted moments
+        denom = jnp.concatenate([-n_safe, -2.0 * std_i * n_safe])
+        C = Jsum / denom[None, :]
+        # zero out the columns of excluded (fully-missing) series: their
+        # u columns are already all-zero, so this only protects against a
+        # spurious Jsum entry meeting the placeholder divisor
+        live = jnp.concatenate([n_i > 0, n_i > 0])
+        C = C * live[None, :]
+        u = jnp.concatenate([dev, dev**2 - mr * std_i**2], axis=1)
+
+        def adjust(scores):
+            return scores - u @ C.T
+
+        n_hac = (
+            hac_lags if hac_lags is not None else max(1, int(1.3 * np.sqrt(T)))
+        )
+    elif hac_lags is not None:
+        n_hac = hac_lags
+
+    cov_theta = _score_covariance(
+        lls_of, flat0, cov, adjust_scores=adjust, hac_lags=n_hac
+    )
 
     def natural(flat):
         theta = dict(fixed)

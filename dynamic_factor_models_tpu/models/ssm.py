@@ -91,7 +91,11 @@ class KalmanResult(NamedTuple):
 # algebra) leave XLA's per-iteration dispatch visible at T in the thousands;
 # unrolling amortizes it on CPU and gives the TPU scheduler a longer basic
 # block, at negligible compile-time cost for the shapes used here.
-_SCAN_UNROLL = 4
+# Env-overridable (read once at import) so the bench's reference-scale
+# latency decomposition can sweep it in child processes on the live chip.
+import os as _os
+
+_SCAN_UNROLL = int(_os.environ.get("DFM_SCAN_UNROLL", "4"))
 
 
 def _psd_floor(Q: jnp.ndarray) -> jnp.ndarray:
@@ -1168,16 +1172,26 @@ def _mle_adam(theta0, xz, m, stats, n_steps: int, lr, r: int):
         return -filt.loglik / xz.shape[0]
 
     def step(carry, _):
-        theta, state = carry
+        theta, state, best_theta, best_loss = carry
         loss, g = jax.value_and_grad(loss_fn)(theta)
+        # best-so-far over the path (loss is evaluated BEFORE the update,
+        # so step 0 covers the init itself); a NaN loss never wins
+        better = loss < best_loss
+        best_theta = jax.tree.map(
+            lambda b, t: jnp.where(better, t, b), best_theta, theta
+        )
+        best_loss = jnp.where(better, loss, best_loss)
         updates, state = opt.update(g, state, theta)
         theta = optax.apply_updates(theta, updates)
-        return (theta, state), loss
+        return (theta, state, best_theta, best_loss), loss
 
-    (theta, _), losses = jax.lax.scan(
-        step, (theta0, opt.init(theta0)), None, length=n_steps
+    (theta, _, best_theta, _), losses = jax.lax.scan(
+        step,
+        (theta0, opt.init(theta0), theta0, jnp.asarray(jnp.inf, xz.dtype)),
+        None,
+        length=n_steps,
     )
-    return theta, losses
+    return theta, losses, best_theta
 
 
 def estimate_dfm_mle(
@@ -1217,16 +1231,27 @@ def estimate_dfm_mle(
             data, inclcode, initperiod, lastperiod, config, xz, m_arr
         )
         stats = compute_panel_stats(xz, m_arr)
-        theta, losses = _mle_adam(
+        theta, losses, best_theta = _mle_adam(
             _pack_ssm(params0), xz, m_arr, stats, n_steps, lr, r
         )
         params = _unpack_ssm(theta, r)
         params = params._replace(Q=_psd_floor(params.Q))
         # losses[i] is recorded BEFORE update i: evaluate the RETURNED
-        # parameters' own likelihood, fall back to the ALS init if the
-        # final adam step left the stationary region (A is unconstrained)
+        # parameters' own likelihood; return the best-so-far adam iterate
+        # instead when the final step overshot (a finite-but-worse last
+        # iterate was previously returned as-is), and fall back to the ALS
+        # init if everything is non-finite (A is unconstrained, so an
+        # explosive excursion can collapse the likelihood)
         filt = _filter_scan(params, xz, m_arr, stats=stats)
         ll_final = float(filt.loglik)
+        params_b = _unpack_ssm(best_theta, r)
+        params_b = params_b._replace(Q=_psd_floor(params_b.Q))
+        filt_b = _filter_scan(params_b, xz, m_arr, stats=stats)
+        ll_best = float(filt_b.loglik)
+        if np.isfinite(ll_best) and (
+            not np.isfinite(ll_final) or ll_best > ll_final
+        ):
+            params, filt, ll_final = params_b, filt_b, ll_best
         if not np.isfinite(ll_final):
             params = params0
             filt = _filter_scan(params, xz, m_arr, stats=stats)
@@ -1275,7 +1300,9 @@ def _ssm_step_lls(params: SSMParams, x, mask):
     return lls
 
 
-def _score_covariance(lls_of, flat0, cov: str):
+def _score_covariance(
+    lls_of, flat0, cov: str, adjust_scores=None, hac_lags: int = 0
+):
     """Shared covariance engine for the score-based SE functions
     (ssm_standard_errors / msdfm.ms_standard_errors): forward-mode scores,
     then OPG or the sandwich H^-1 (S'S) H^-1.  The sandwich guards the
@@ -1284,11 +1311,26 @@ def _score_covariance(lls_of, flat0, cov: str):
     noise-negative curvature directions are excluded by an eigenvalue
     floor (they carry no information and would otherwise be amplified by
     1/lambda^2), and substantially indefinite points fall back to OPG
-    with a warning."""
+    with a warning.
+
+    `adjust_scores` maps the raw (T, d) score matrix to an adjusted one —
+    the two-step M-estimation hook (msdfm standardization propagation
+    replaces s_t with s_t - C u_t).  `hac_lags` > 0 replaces the plain
+    S'S with a Bartlett long-run covariance of the (adjusted) scores:
+    adjusted scores inherit the serial correlation of the first-stage
+    moment contributions even when the raw scores are near-m.d.s."""
     import warnings
 
     scores = jax.jit(jax.jacfwd(lls_of))(flat0)  # (T, d)
+    if adjust_scores is not None:
+        scores = adjust_scores(scores)
     opg = scores.T @ scores
+    if hac_lags > 0:
+        Tn = scores.shape[0]
+        for lag in range(1, min(hac_lags, Tn - 1) + 1):
+            w = 1.0 - lag / (hac_lags + 1.0)
+            g = scores[lag:].T @ scores[:-lag]
+            opg = opg + w * (g + g.T)
     if cov == "sandwich":
         H = jax.jit(jax.hessian(lambda f: lls_of(f).sum()))(flat0)
         negH = -0.5 * (H + H.T)

@@ -42,6 +42,11 @@ def bench(tmp_path, monkeypatch):
     monkeypatch.setattr(
         b, "crossover_table", lambda: calls.append("crossover") or print("| t |")
     )
+    b._real_refscale_section = b.refscale_section
+    monkeypatch.setattr(
+        b, "refscale_section",
+        lambda: calls.append("refscale") or {"em_refscale_best_ips": 180.0},
+    )
 
     class _FakeDS:
         pass
@@ -55,7 +60,9 @@ def bench(tmp_path, monkeypatch):
 
 def test_remainder_section_order_and_stores(bench, tmp_path, capsys):
     bench.run_tpu_remainder()
-    assert bench._test_calls == ["pallas", "parity", "large", "crossover"]
+    assert bench._test_calls == [
+        "pallas", "parity", "large", "crossover", "refscale"
+    ]
     out = capsys.readouterr().out.strip().splitlines()[-1]
     final = json.loads(out)
     assert final["parity_ok"] is True
@@ -80,7 +87,7 @@ def test_remainder_parity_failure_exits_1(bench, monkeypatch):
     # exit 1 = complete-but-parity-failed (the watcher surfaces it); the
     # sections after parity still ran so the window was not wasted
     assert ei.value.code == 1
-    assert bench._test_calls[-1] == "crossover"
+    assert bench._test_calls[-1] == "refscale"
 
 
 def test_remainder_no_tpu_exits_2(bench, monkeypatch):
@@ -88,3 +95,89 @@ def test_remainder_no_tpu_exits_2(bench, monkeypatch):
     with pytest.raises(SystemExit) as ei:
         bench.run_tpu_remainder()
     assert ei.value.code == 2
+
+
+def test_refscale_crossover_summary(bench, tmp_path, monkeypatch):
+    """The live leg vs staged-CPU comparison: per-cell ratios and the
+    measured (T, n_reps) crossover points, including the 'never crossed
+    within the grid' encoding (0, not None — the evidence store drops
+    nulls and a negative finding must survive)."""
+    live = {
+        "refscale_platform": "tpu",
+        "em_refscale_best_unroll": 8,
+        "em_refscale_best_ips": 160.0,   # loses at T=222
+        "em_ips_T444": 150.0,            # loses
+        "em_ips_T888": 120.0,            # wins (cpu 100)
+        "em_ips_T1776": 90.0,            # wins (cpu 50)
+        "bootstrap_1000rep_s": 0.30,     # loses (cpu 0.12)
+        "bootstrap_4000rep_s": 0.40,     # wins  (cpu 0.50)
+        "bootstrap_16000rep_s": 0.60,    # wins  (cpu 2.00)
+    }
+    staged = {
+        "code_rev": bench._parity_code_rev(),
+        "em_refscale_best_ips": 180.0,
+        "em_ips_T444": 170.0,
+        "em_ips_T888": 100.0,
+        "em_ips_T1776": 50.0,
+        "bootstrap_1000rep_s": 0.12,
+        "bootstrap_4000rep_s": 0.50,
+        "bootstrap_16000rep_s": 2.00,
+    }
+    monkeypatch.setattr(bench, "REFSCALE_STAGED", str(tmp_path / "rs.json"))
+    (tmp_path / "rs.json").write_text(json.dumps(staged))
+    monkeypatch.setattr(bench, "_refscale_measure", lambda force_cpu: dict(live))
+    out = bench._real_refscale_section()
+    assert out["refscale_cpu_staged"] is True
+    assert out["em_T_crossover"] == 888
+    assert out["bootstrap_reps_crossover"] == 4000
+    assert out["em_ips_T888_tpu_over_cpu"] == 1.2
+    assert out["bootstrap_16000rep_s_tpu_over_cpu"] == pytest.approx(3.333)
+    # a chip that never wins reports 0, not a dropped field
+    live_lose = {k: v for k, v in live.items()}
+    live_lose.update(
+        {"em_ips_T888": 90.0, "em_ips_T1776": 40.0,
+         "bootstrap_4000rep_s": 0.6, "bootstrap_16000rep_s": 2.5}
+    )
+    monkeypatch.setattr(
+        bench, "_refscale_measure", lambda force_cpu: dict(live_lose)
+    )
+    out2 = bench._real_refscale_section()
+    assert out2["em_T_crossover"] == 0
+    assert out2["bootstrap_reps_crossover"] == 0
+
+
+def test_refscale_stale_staging_detected(bench, tmp_path, monkeypatch):
+    monkeypatch.setattr(bench, "REFSCALE_STAGED", str(tmp_path / "rs.json"))
+    (tmp_path / "rs.json").write_text(json.dumps({"code_rev": "stale"}))
+    assert bench.refscale_staged_fresh() is False
+    monkeypatch.setattr(
+        bench, "_refscale_measure", lambda force_cpu: {"refscale_platform": "tpu", "em_refscale_best_ips": 1.0}
+    )
+    out = bench._real_refscale_section()
+    # stale staging: no ratios fabricated, the flag says why
+    assert out["refscale_cpu_staged"] is False
+    assert not any(k.endswith("_tpu_over_cpu") for k in out)
+
+
+def test_refscale_refuses_cpu_live_leg(bench, tmp_path, monkeypatch):
+    """A live leg whose children silently landed on CPU must never be
+    recorded as chip evidence — no ratios, no crossovers."""
+    monkeypatch.setattr(bench, "REFSCALE_STAGED", str(tmp_path / "rs.json"))
+    (tmp_path / "rs.json").write_text(
+        json.dumps({"code_rev": bench._parity_code_rev(),
+                    "em_refscale_best_ips": 100.0})
+    )
+    # undo the fixture's always-TPU stub: this test is about the platform
+    # check itself
+    monkeypatch.setattr(
+        bench, "_is_tpu_platform", lambda p: p in ("tpu", "axon")
+    )
+    monkeypatch.setattr(
+        bench, "_refscale_measure",
+        lambda force_cpu: {"refscale_platform": "cpu",
+                           "em_refscale_best_ips": 99.0},
+    )
+    out = bench._real_refscale_section()
+    assert out["refscale_live_leg_on_tpu"] is False
+    assert "em_T_crossover" not in out
+    assert not any(k.endswith("_tpu_over_cpu") for k in out)

@@ -157,7 +157,9 @@ class TestHallinLiska:
         flag = np.vstack([np.zeros((1, q)), f[:-1]])
         return f @ b0.T + flag @ b1.T + sig * rng.standard_normal((T, N))
 
-    @pytest.mark.parametrize("q_true,T,N", [(1, 400, 30), (2, 400, 40)])
+    @pytest.mark.parametrize(
+        "q_true,T,N", [(1, 400, 30), (2, 400, 40), (3, 350, 45)]
+    )
     def test_recovers_q(self, q_true, T, N):
         from dynamic_factor_models_tpu.models.dynpca import hallin_liska_q
 

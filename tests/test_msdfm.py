@@ -378,12 +378,12 @@ def test_opg_standard_errors(rng):
 def test_se_calibration_monte_carlo_fixed_regime_path():
     """Sandwich-SE calibration against Monte-Carlo spread, holding the
     REGIME PATH fixed across replications and redrawing only the Gaussian
-    innovations: the SEs condition on the standardization, and with a
-    persistent chain the realized regime mix moves each replication's
-    sample means enough to dominate the cross-rep spread of mu-hat (a
-    preprocessing channel, not a defect of the SE formula — measured
-    ratios ~0.3-0.5 with free paths).  With the path fixed, the mean
-    reported SE must sit within a factor ~2 of the Monte-Carlo SD."""
+    innovations: the default SEs condition on the standardization, and
+    with a persistent chain the realized regime mix moves each
+    replication's sample means enough to dominate the cross-rep spread of
+    mu-hat (a preprocessing channel — propagated by `x_raw=`, see the
+    free-path test below).  With the path fixed, the mean reported SE
+    must sit within a factor ~2 of the Monte-Carlo SD."""
     from dynamic_factor_models_tpu.models.msdfm import ms_standard_errors
 
     T, N = 400, 8
@@ -412,3 +412,64 @@ def test_se_calibration_monte_carlo_fixed_regime_path():
         se_mean,
         ratio,
     )
+
+
+@pytest.mark.slow
+def test_se_calibration_monte_carlo_free_regime_path():
+    """Sandwich-SE calibration with the regime path FREE — the production
+    setting (round-4 verdict item 4).  Each replication redraws the chain,
+    so the realized regime mix moves the per-series sample means/stds the
+    panel is standardized with; `x_raw=` propagates that first stage
+    through the sandwich (stacked M-estimator: adjusted scores s_t - C u_t
+    with a Bartlett long-run meat).  Measured on this design: plain
+    conditional ratios [0.95, 0.49] (regime 1 understated 2x), propagated
+    [1.53, 0.74] — the mean propagated SE must sit within a factor ~2 of
+    the Monte-Carlo SD for BOTH regimes, and must not be smaller than the
+    conditional SE (the correction only adds variance)."""
+    from dynamic_factor_models_tpu.models.msdfm import ms_standard_errors
+
+    T, N = 400, 8
+    lam = 0.6 + 0.4 * np.random.default_rng(100).random(N)
+
+    mus, ses_prop, ses_plain = [], [], []
+    for rep in range(10):
+        rng = np.random.default_rng(500 + rep)
+        x, _ = _two_regime_panel(rng, T=T, N=N, lam=lam)  # free path
+        res = fit_ms_dfm(x, n_steps=300, n_restarts=2)
+        xstd = (np.asarray(x) - np.asarray(res.means)) / np.asarray(res.stds)
+        ses_plain.append(np.asarray(ms_standard_errors(res.params, xstd).mu))
+        se = ms_standard_errors(res.params, xstd, x_raw=x)
+        mus.append(np.asarray(res.params.mu))
+        ses_prop.append(np.asarray(se.mu))
+    mus = np.array(mus)
+    sd_mc = mus.std(axis=0, ddof=1)
+    ratio = np.array(ses_prop).mean(axis=0) / np.maximum(sd_mc, 1e-8)
+    assert (ratio > 0.5).all() and (ratio < 2.0).all(), (
+        sd_mc,
+        np.array(ses_prop).mean(axis=0),
+        ratio,
+    )
+    # the propagated variance dominates the conditional one rep-by-rep
+    assert (np.array(ses_prop) >= np.array(ses_plain) * 0.99).all()
+
+
+def test_se_propagation_validation():
+    """x_raw plumbing: shape mismatch and a panel that does not
+    standardize to x are both rejected loudly."""
+    from dynamic_factor_models_tpu.models.msdfm import ms_standard_errors
+
+    rng = np.random.default_rng(3)
+    x, _ = _two_regime_panel(rng, T=200, N=6)
+    res = fit_ms_dfm(x, n_steps=250, n_restarts=2)
+    xstd = (np.asarray(x) - np.asarray(res.means)) / np.asarray(res.stds)
+    with pytest.raises(ValueError, match="shape"):
+        ms_standard_errors(res.params, xstd, x_raw=x[:60])
+    with pytest.raises(ValueError, match="standardize"):
+        # a genuinely different panel (rows reversed) — note a per-series
+        # AFFINE transform would standardize to the same xstd and is
+        # correctly accepted: the propagated SEs are invariant to it
+        ms_standard_errors(res.params, xstd, x_raw=x[::-1])
+    # propagated SEs on the fitted panel: finite and positive
+    se_p = ms_standard_errors(res.params, xstd, x_raw=x)
+    assert np.isfinite(np.asarray(se_p.mu)).all()
+    assert (np.asarray(se_p.mu) > 0).all()

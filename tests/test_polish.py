@@ -1,0 +1,156 @@
+"""Float64 fixed-point polish + canonical rotation (`polish="float64"`).
+
+The north-star parity bar (BASELINE.json: factor parity at 1e-5) cannot be
+met by the raw fixed-iteration ALS trajectory — f32 and f64 trajectories
+diverge by ~8e-5 after 60 iterations, and the ALS fixed points form a
+GL(nfac) manifold (rotational indeterminacy), so even fully-converged runs
+from different trajectories land at different rotations.  The polish
+(`dfm._polish_fixed_point_f64`) iterates the exact masked ALS map in host
+NumPy float64 to convergence and projects onto the canonical representative
+(F'F/T = I, lam'lam diagonal descending, signs fixed), making the output a
+function of the data alone — not of backend, precision, or iteration count.
+"""
+
+import numpy as np
+import pytest
+
+from dynamic_factor_models_tpu.models.dfm import (
+    DFMConfig,
+    _polish_fixed_point_f64,
+    estimate_factor,
+)
+from dynamic_factor_models_tpu.models.constraints import LambdaConstraint
+
+
+def _panel(T=160, N=50, r=3, seed=0, missing=0.1):
+    rng = np.random.default_rng(seed)
+    f = rng.standard_normal((T, r))
+    lam = rng.standard_normal((N, r))
+    x = f @ lam.T + 0.3 * rng.standard_normal((T, N))
+    # ragged missingness, but keep a fully-balanced block for the PCA init
+    miss = rng.random((T, N)) < missing
+    miss[:, : r + 4] = False
+    x[miss] = np.nan
+    return x
+
+
+def _polished(x, max_iter, r=3):
+    cfg = DFMConfig(nfac_u=r, tol=0.0, max_iter=max_iter)
+    F, fes = estimate_factor(
+        x, np.ones(x.shape[1]), 0, x.shape[0] - 1, cfg, polish="float64"
+    )
+    return np.asarray(F), fes
+
+
+def test_polish_is_iteration_count_invariant():
+    # ALS stopped at 25 vs 120 iterations lands at different points of the
+    # fixed-point approach; the polish must erase that difference entirely
+    x = _panel()
+    Fa, fes_a = _polished(x, 25)
+    Fb, fes_b = _polished(x, 120)
+    np.testing.assert_allclose(Fa, Fb, atol=1e-8)
+    assert abs(float(fes_a.ssr) - float(fes_b.ssr)) < 1e-6
+
+
+def test_polish_reaches_fixed_point_and_canonical_form():
+    x = _panel(seed=1)
+    r = 3
+    F, _ = _polished(x, 40)
+    Tw = x.shape[0]
+    # canonical scale: F'F/T = I on the unobserved block
+    G = F.T @ F / Tw
+    np.testing.assert_allclose(G, np.eye(r), atol=1e-8)
+    # fixed point: one more exact float64 map application barely moves it
+    m = (~np.isnan(x)).astype(float)
+    xs = np.where(np.isnan(x), 0.0, x)
+    mu = (m * xs).sum(0) / m.sum(0)
+    xc = np.where(m > 0, xs - mu, 0.0)
+    sd = np.sqrt((m * xc**2).sum(0) / m.sum(0))
+    xz = np.where(m > 0, xc / sd, 0.0)
+    F2, _, _, n_it = _polish_fixed_point_f64(
+        xz, m, np.ones(x.shape[1]), F, tol=1e-13, max_iter=50
+    )
+    np.testing.assert_allclose(F2, F, atol=1e-7)
+    assert n_it < 50  # converged, not capped
+
+
+def test_polish_loading_gram_is_descending_diagonal():
+    x = _panel(seed=2)
+    cfg = DFMConfig(nfac_u=3, tol=0.0, max_iter=60)
+    m = (~np.isnan(x)).astype(float)
+    xs = np.where(np.isnan(x), 0.0, x)
+    mu = (m * xs).sum(0) / m.sum(0)
+    xc = np.where(m > 0, xs - mu, 0.0)
+    sd = np.sqrt((m * xc**2).sum(0) / m.sum(0))
+    xz = np.where(m > 0, xc / sd, 0.0)
+    f0 = xz[:, :3].copy()
+    F, lam, _, _ = _polish_fixed_point_f64(xz, m, np.ones(x.shape[1]), f0)
+    LtL = lam.T @ lam
+    off = LtL - np.diag(np.diag(LtL))
+    assert np.abs(off).max() < 1e-7 * np.abs(np.diag(LtL)).max()
+    d = np.diag(LtL)
+    assert np.all(np.diff(d) <= 1e-9)
+
+
+def test_polish_with_observed_factors():
+    rng = np.random.default_rng(3)
+    T, N = 150, 40
+    fo = rng.standard_normal((T, 1))
+    x = np.asarray(_panel(T, N, r=2, seed=4)) + 0.8 * fo @ rng.standard_normal(
+        (N, 1)
+    ).T
+    cfg = DFMConfig(nfac_o=1, nfac_u=2, tol=0.0)
+    Fa, _ = estimate_factor(
+        x, np.ones(N), 0, T - 1, cfg, observed_factor=fo,
+        max_iter=25, polish="float64",
+    )
+    Fb, _ = estimate_factor(
+        x, np.ones(N), 0, T - 1, cfg, observed_factor=fo,
+        max_iter=120, polish="float64",
+    )
+    np.testing.assert_allclose(np.asarray(Fa), np.asarray(Fb), atol=1e-8)
+    # observed column passes through verbatim
+    np.testing.assert_allclose(np.asarray(Fa)[:, 0], fo[:, 0], atol=1e-12)
+
+
+def test_polish_of_raw_iterate_matches_api_path():
+    """The bench parity program polishes the RAW leg's terminal iterate
+    directly (reconstructing xz/m/lam_ok with the same public helpers)
+    instead of re-running the jitted ALS inside estimate_factor — pinned
+    here: both routes land on the identical canonical fixed point."""
+    from dynamic_factor_models_tpu.ops.linalg import standardize_data
+    from dynamic_factor_models_tpu.ops.masking import fillz, mask_of
+
+    x = _panel(seed=7)
+    cfg = DFMConfig(nfac_u=3, tol=0.0, max_iter=60)
+    init, last = 4, x.shape[0] - 3  # non-trivial window
+    F_api, _ = estimate_factor(
+        x, np.ones(x.shape[1]), init, last, cfg, polish="float64"
+    )
+    F_raw, _ = estimate_factor(x, np.ones(x.shape[1]), init, last, cfg)
+    xw = np.asarray(x)[init : last + 1]
+    xstd, _ = standardize_data(xw)
+    m = np.asarray(mask_of(xstd), float)
+    lam_ok = m.sum(axis=0) >= cfg.nt_min_factor
+    F_pol_w, _, _, _ = _polish_fixed_point_f64(
+        np.asarray(fillz(xstd)), m, lam_ok, np.asarray(F_raw)[init : last + 1]
+    )
+    np.testing.assert_allclose(
+        F_pol_w, np.asarray(F_api)[init : last + 1], atol=1e-8
+    )
+
+
+def test_polish_validation():
+    x = _panel()
+    cfg = DFMConfig(nfac_u=2)
+    with pytest.raises(ValueError, match="polish must be"):
+        estimate_factor(x, np.ones(x.shape[1]), 0, x.shape[0] - 1, cfg,
+                        polish="f64")
+    con = LambdaConstraint(
+        series=np.array([0], dtype=np.int32),
+        R=np.ones((1, 1, 2)),
+        r=np.ones((1, 1)),
+    )
+    with pytest.raises(ValueError, match="not supported with a constraint"):
+        estimate_factor(x, np.ones(x.shape[1]), 0, x.shape[0] - 1, cfg,
+                        constraint=con, polish="float64")

@@ -8,7 +8,7 @@
 # not swallowed).  Any other child rc: incomplete window — keep probing.
 set -u
 cd "$(dirname "$0")/.."
-LOG=docs/tpu_probe_r04.log
+LOG=docs/tpu_probe_r05.log
 INTERVAL="${PROBE_INTERVAL_S:-300}"
 
 # stage the CPU parity leg whenever it is missing or its code rev has
@@ -20,7 +20,8 @@ INTERVAL="${PROBE_INTERVAL_S:-300}"
 # loop this watcher exists for.
 LAST_FAILED_STAGE_REV=""
 stage_if_stale() {
-  if python bench.py --parity-staged-fresh 2>/dev/null; then
+  if python bench.py --parity-staged-fresh 2>/dev/null \
+     && python bench.py --refscale-staged-fresh 2>/dev/null; then
     return 0
   fi
   local rev
@@ -32,11 +33,18 @@ print(b._parity_code_rev())" 2>/dev/null)
   if [ -n "$rev" ] && [ "$rev" = "$LAST_FAILED_STAGE_REV" ]; then
     return 0  # already failed on this exact code rev; don't retry
   fi
-  if python bench.py --stage-parity >> /tmp/tpu_watch_stage.log 2>&1; then
-    echo "$(date -u +%FT%TZ) watcher: parity CPU leg (re)staged" >> "$LOG"
+  local fails=""
+  python bench.py --parity-staged-fresh 2>/dev/null \
+    || python bench.py --stage-parity >> /tmp/tpu_watch_stage.log 2>&1 \
+    || fails="$fails parity"
+  python bench.py --refscale-staged-fresh 2>/dev/null \
+    || python bench.py --stage-refscale >> /tmp/tpu_watch_stage.log 2>&1 \
+    || fails="$fails refscale"
+  if [ -z "$fails" ]; then
+    echo "$(date -u +%FT%TZ) watcher: CPU legs (parity+refscale) (re)staged" >> "$LOG"
   else
     LAST_FAILED_STAGE_REV="$rev"
-    echo "$(date -u +%FT%TZ) watcher: STAGE-PARITY FAILED (see /tmp/tpu_watch_stage.log) — not retrying until sources change" >> "$LOG"
+    echo "$(date -u +%FT%TZ) watcher: STAGING FAILED for:$fails (see /tmp/tpu_watch_stage.log) — not retrying until sources change" >> "$LOG"
   fi
 }
 
