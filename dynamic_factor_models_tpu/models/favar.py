@@ -21,6 +21,7 @@ from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
+import jax.scipy.linalg as jsl
 import numpy as np
 
 from ..ops.lags import lagmat
@@ -122,18 +123,43 @@ def series_irfs(
     if scale is not None:
         s = jnp.asarray(scale)[:, None, None]
         point, draws = point * s, draws * s[None]
-    q = jnp.quantile(draws, jnp.asarray(quantile_levels), axis=0)
+    q = jnp.nanquantile(draws, jnp.asarray(quantile_levels), axis=0)
     return SeriesIRFs(point, q, np.asarray(quantile_levels))
 
 
-def _fit_dense_var(y, nlag: int):
-    """Dense (no-missing) VAR fit: returns betahat, resid, seps."""
+def _fit_dense_var(y, nlag: int, solver: str = "pinv"):
+    """Dense (no-missing) VAR fit: returns betahat, resid, seps.
+
+    solver="pinv" (default) keeps the minimum-norm convention every
+    estimation path uses.  solver="chol" is the bootstrap's per-replication
+    fast path: a Cholesky solve of the ridged Gram — under vmap the pinv's
+    batched eigendecomposition of the (1+ns*nlag)-square Gram is the
+    single most accelerator-hostile op in the replication program (small
+    batched eigh maps terribly onto the MXU), while batched triangular
+    solves are nearly free.  A max-diagonal-relative ridge keeps the
+    factorization clear of the f32 breakdown threshold on degenerate
+    resamples, and the band quantiles are nan-aware so a pathological
+    replication drops out instead of poisoning the band; the OUTER fit
+    (the reported point IRF) always uses pinv, so switching the rep
+    solver moves only Monte-Carlo band noise."""
     Tw = y.shape[0]
     x = jnp.hstack([jnp.ones((Tw, 1), y.dtype), lagmat(y, range(1, nlag + 1))])
     x = x[nlag:]
     yr = y[nlag:]
     A = x.T @ x
-    betahat = solve_normal(A, x.T @ yr)
+    if solver == "chol":
+        k = A.shape[0]
+        # ridge scaled by the LARGEST diagonal entry: f32 Cholesky breaks
+        # down at ~eps_f32 * lambda_max(A), and lambda_max <= k * max(diag)
+        # for PSD A, so 1e-5 * max(diag) clears the breakdown threshold
+        # with margin on any eigenvalue spread (a mean-trace ridge does
+        # not); the perturbation is ~1e-5 relative — invisible against
+        # Monte-Carlo band noise
+        ridge = 1e-5 * jnp.max(jnp.diagonal(A)) + 1e-30
+        c, lo = jsl.cho_factor(A + ridge * jnp.eye(k, dtype=A.dtype))
+        betahat = jsl.cho_solve((c, lo), x.T @ yr)
+    else:
+        betahat = solve_normal(A, x.T @ yr)
     ehat = yr - x @ betahat
     seps = ehat.T @ ehat / (yr.shape[0] - x.shape[1])
     return betahat, ehat, seps
@@ -154,7 +180,9 @@ def _wild_recursion(y_init, betahat, eta, nlag: int) -> jnp.ndarray:
             y_t = y_t + blocks[i] @ lags[i]
         return jnp.concatenate([y_t[None], lags[:-1]], axis=0), y_t
 
-    _, tail = jax.lax.scan(recurse, y_init[::-1], eta)
+    # unroll: the per-step body is a couple of tiny matmuls, so loop
+    # overhead dominates the T-step recursion on accelerators
+    _, tail = jax.lax.scan(recurse, y_init[::-1], eta, unroll=4)
     return jnp.concatenate([y_init, tail], axis=0)
 
 
@@ -201,14 +229,14 @@ def _bootstrap_core(yw, key, nlag: int, horizon: int, n_reps: int,
     def one_rep(k):
         ystar = _wild_recursion(y_init, betahat, resample(k, ehat), nlag)
 
-        b_star, _, seps_star = _fit_dense_var(ystar, nlag)
+        b_star, _, seps_star = _fit_dense_var(ystar, nlag, solver="chol")
         M, Q, G = companion_matrices(b_star, seps_star, nlag)
 
         def step(xv, _):
             return M @ xv, Q @ xv
 
         def one_shock(g):
-            _, out = jax.lax.scan(step, g, None, length=horizon)
+            _, out = jax.lax.scan(step, g, None, length=horizon, unroll=4)
             return out.T
 
         return jax.vmap(one_shock, in_axes=1, out_axes=2)(G)
@@ -288,7 +316,7 @@ def _bootstrap_driver(
         # vmapped body over the mesh's "rep" axis
         draws = _run_core(yw, key, nlag, horizon, n_reps, mesh, resample)
 
-        q = jnp.quantile(draws, jnp.asarray(quantile_levels), axis=0)
+        q = jnp.nanquantile(draws, jnp.asarray(quantile_levels), axis=0)
         return BootstrapIRFs(point, draws, q, np.asarray(quantile_levels))
 
 
@@ -390,7 +418,7 @@ def wild_bootstrap_irfs_resumable(
             os.replace(tmp, checkpoint_path)
 
         draws = jnp.asarray(np.concatenate(done, axis=0)[:n_reps])
-        q = jnp.quantile(draws, jnp.asarray(quantile_levels), axis=0)
+        q = jnp.nanquantile(draws, jnp.asarray(quantile_levels), axis=0)
         return BootstrapIRFs(point, draws, q, np.asarray(quantile_levels))
 
 
@@ -451,7 +479,7 @@ def _fan_core(yw, key, nlag: int, horizon: int, n_reps: int):
     def one_rep(k):
         k1, k2, k3 = jax.random.split(k, 3)
         ystar = _wild_recursion(y_init, betahat, _resample_wild(k1, ehat), nlag)
-        b_star, e_star, _ = _fit_dense_var(ystar, nlag)
+        b_star, e_star, _ = _fit_dense_var(ystar, nlag, solver="chol")
         idx = jax.random.randint(k2, (horizon,), 0, Te)
         signs = jax.random.rademacher(k3, (horizon,), dtype=yw.dtype)
         e_fut = e_star[idx] * signs[:, None]
@@ -507,7 +535,7 @@ def bootstrap_forecast_fan(
         draws = _dispatch_reps(
             _fan_core, _sharded_fan_core, mesh, n_reps, (yw, key, nlag, horizon)
         )
-        q = jnp.quantile(draws, jnp.asarray(quantile_levels), axis=0)
+        q = jnp.nanquantile(draws, jnp.asarray(quantile_levels), axis=0)
         return ForecastFan(point, draws, q, np.asarray(quantile_levels))
 
 
@@ -555,5 +583,5 @@ def series_forecast_fan(
 
     point = fan.point @ lam.T + c[None, :]  # (h, nsel)
     draws = jnp.einsum("dhk,nk->dhn", fan.draws, lam) + c[None, None, :]
-    q = jnp.quantile(draws, jnp.asarray(quantile_levels), axis=0)
+    q = jnp.nanquantile(draws, jnp.asarray(quantile_levels), axis=0)
     return SeriesFan(point.T, jnp.moveaxis(q, 2, 1), np.asarray(quantile_levels))

@@ -175,7 +175,7 @@ def _proxy_bootstrap_core(
         z_star = jnp.concatenate([zw[:nlag], z_tail * signs])
         ystar = _wild_recursion(y_init, betahat, ehat * signs[:, None], nlag)
 
-        b_star, e_star, seps_star = _fit_dense_var(ystar, nlag)
+        b_star, e_star, seps_star = _fit_dense_var(ystar, nlag, solver="chol")
         resid_full = jnp.full((Tw, ns), jnp.nan, yw.dtype).at[nlag:].set(e_star)
         pid = _proxy_impact_core(resid_full, z_star, policy)
 
@@ -225,7 +225,7 @@ def proxy_bootstrap_irfs(
         draws = _proxy_bootstrap_core(
             yw, zw, jax.random.PRNGKey(seed), nlag, policy, horizon, n_reps
         )
-        q = jnp.quantile(draws, jnp.asarray(quantile_levels), axis=0)
+        q = jnp.nanquantile(draws, jnp.asarray(quantile_levels), axis=0)
         return ProxyBootstrapIRFs(point, draws, q, np.asarray(quantile_levels), pid)
 
 
