@@ -91,11 +91,13 @@ class KalmanResult(NamedTuple):
 # algebra) leave XLA's per-iteration dispatch visible at T in the thousands;
 # unrolling amortizes it on CPU and gives the TPU scheduler a longer basic
 # block, at negligible compile-time cost for the shapes used here.
+# Default 8: measured best on the reference-scale EM sweep (quiet CPU,
+# 149/168/139 it/s at 4/8/16 — bench.py --run-em-refscale).
 # Env-overridable (read once at import) so the bench's reference-scale
 # latency decomposition can sweep it in child processes on the live chip.
 import os as _os
 
-_SCAN_UNROLL = int(_os.environ.get("DFM_SCAN_UNROLL", "4"))
+_SCAN_UNROLL = int(_os.environ.get("DFM_SCAN_UNROLL", "8"))
 
 
 def _psd_floor(Q: jnp.ndarray) -> jnp.ndarray:
