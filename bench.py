@@ -30,7 +30,7 @@ single coin flip against a tunnel that wedges and recovers on hour scales):
                            only the TPU sections missing from the salvaged
                            2026-07-31 live record, cheapest compile first
                            (pallas -> device parity -> large panel ->
-                           crossover -> refscale decomposition), each
+                           refscale decomposition -> crossover), each
                            folded into the durable evidence store
                            docs/TPU_EVIDENCE.json, which the orchestrator
                            merges (tpu_live_* fields) into any CPU-fallback
@@ -1009,9 +1009,10 @@ def _is_tpu_platform(platform: str) -> bool:
 def run_tpu_remainder(force_cpu: bool = False):
     """Child mode for short tunnel windows: ONLY the TPU sections the
     2026-07-31 salvaged live record is missing, cheapest compile surface
-    first (pallas -> device parity -> large panel -> crossover), persisting
-    to DFM_BENCH_PARTIAL after every section so a mid-run wedge keeps
-    whatever finished.  Prints the accumulated JSON on stdout.
+    first (pallas -> device parity -> large panel -> refscale
+    decomposition -> crossover), persisting to DFM_BENCH_PARTIAL after
+    every section so a mid-run wedge keeps whatever finished.  Prints the
+    accumulated JSON on stdout.
 
     NOTE: call only after a successful tunnel probe (tools/tpu_watch.sh
     does) — a direct jax.devices() against a wedged tunnel hangs rather
@@ -1057,16 +1058,18 @@ def run_tpu_remainder(force_cpu: bool = False):
     _persist_partial(partial)
     print(json.dumps(partial), file=sys.stderr, flush=True)
 
+    # reference-scale latency decomposition BEFORE the crossover sweep:
+    # the decomposition (win-or-prove-the-floor) is a verdict done-bar,
+    # the markdown sweep is documentation — a short window should capture
+    # the former first
+    partial.update(refscale_section())
+    _persist_partial(partial)
+    print(json.dumps(partial), file=sys.stderr, flush=True)
+
     buf = _io.StringIO()
     with redirect_stdout(buf):
         crossover_table()
     partial["crossover_markdown"] = buf.getvalue()
-    _persist_partial(partial)
-    print(json.dumps(partial), file=sys.stderr, flush=True)
-
-    # reference-scale latency decomposition LAST: the verdict-mandated
-    # remainder fields above must never wait behind it
-    partial.update(refscale_section())
     _persist_partial(partial)
     print(json.dumps(partial), flush=True)
     if not partial["parity_ok"]:

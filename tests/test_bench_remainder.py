@@ -61,7 +61,7 @@ def bench(tmp_path, monkeypatch):
 def test_remainder_section_order_and_stores(bench, tmp_path, capsys):
     bench.run_tpu_remainder()
     assert bench._test_calls == [
-        "pallas", "parity", "large", "crossover", "refscale"
+        "pallas", "parity", "large", "refscale", "crossover"
     ]
     out = capsys.readouterr().out.strip().splitlines()[-1]
     final = json.loads(out)
@@ -87,7 +87,7 @@ def test_remainder_parity_failure_exits_1(bench, monkeypatch):
     # exit 1 = complete-but-parity-failed (the watcher surfaces it); the
     # sections after parity still ran so the window was not wasted
     assert ei.value.code == 1
-    assert bench._test_calls[-1] == "refscale"
+    assert bench._test_calls[-1] == "crossover"
 
 
 def test_remainder_no_tpu_exits_2(bench, monkeypatch):
