@@ -214,32 +214,28 @@ def _draw_lam_r_block(key, f, xz, m, R_prev, lam_scale, a0, b0):
     return lam, R
 
 
-def _gibbs_sweep(carry, xz, m, p: int, priors: tuple):
-    """One full Gibbs sweep: f | params  ->  (lam, R) | f  ->  (A, Q) | f."""
-    key, params = carry
-    lam_scale, a0, b0, q_df_extra, q_scale = priors
-    dtype = xz.dtype
-    T, N = xz.shape
-    r = params.r
+def _draw_var_mniw(key, f, p: int, q_df_extra, q_scale):
+    """Joint (Q, A) | f draw for the factor VAR under a flat prior on A and
+    IW(r+1+q_df_extra, q_scale I) prior on Q, with A integrated out of the
+    Q marginal (a collapsed draw, not a conditional on the previous A).
 
-    key, kf, klamr, kvar = jax.random.split(key, 4)
-
-    # --- factors ---
-    f, ll = _simulation_smoother_core(params, xz, m, kf)
-
-    # --- loadings + idiosyncratic variances (batched over series) ---
-    lam, R = _draw_lam_r_block(klamr, f, xz, m, params.R, lam_scale, a0, b0)
-
-    # --- factor VAR (Matrix-Normal-Inverse-Wishart) ---
+    Marginalizing A under the flat prior contributes |Q|^{rp/2} to the
+    integrand, so the Q marginal is IW(nu0 + (T-p) - rp, S0 + E0'E0) with
+    E0 the OLS residuals — the matrix version of the scalar n - k
+    degrees-of-freedom correction.  (Without the -rp the stationary
+    distribution concentrates Q ~7% tight at reference scale.)  Then
+    vec(A) | Q ~ N(vec(Ahat), Q kron ZZ^{-1})."""
+    dtype = f.dtype
+    T, r = f.shape
     Z = jnp.concatenate([f[p - 1 - i : T - 1 - i] for i in range(p)], axis=1)
     Y = f[p:]
     ZZ = Z.T @ Z + 1e-8 * jnp.eye(r * p, dtype=dtype)
     Ahat = solve_normal(ZZ, Z.T @ Y)  # (r*p, r)
     E0 = Y - Z @ Ahat
     S = q_scale * jnp.eye(r, dtype=dtype) + E0.T @ E0
-    nu = (r + 1.0 + q_df_extra) + (T - p)
+    nu = (r + 1.0 + q_df_extra) + (T - p) - r * p
 
-    kq, ka = jax.random.split(kvar)
+    kq, ka = jax.random.split(key)
     # Q ~ IW(nu, S): Q = inv(W), W ~ Wishart(nu, S^{-1}) by Bartlett
     Ls_inv = jnp.linalg.cholesky(jnp.linalg.pinv(0.5 * (S + S.T), hermitian=True))
     kchi, knorm = jax.random.split(kq)
@@ -256,6 +252,24 @@ def _gibbs_sweep(carry, xz, m, p: int, priors: tuple):
     Eg = jax.random.normal(ka, (r * p, r), dtype=dtype)
     Adraw = Ahat + jsl.solve_triangular(Lzz.T, Eg, lower=False) @ jnp.linalg.cholesky(Q).T
     A = jnp.stack([Adraw[i * r : (i + 1) * r].T for i in range(p)])
+    return A, Q
+
+
+def _gibbs_sweep(carry, xz, m, p: int, priors: tuple):
+    """One full Gibbs sweep: f | params  ->  (lam, R) | f  ->  (A, Q) | f."""
+    key, params = carry
+    lam_scale, a0, b0, q_df_extra, q_scale = priors
+
+    key, kf, klamr, kvar = jax.random.split(key, 4)
+
+    # --- factors ---
+    f, ll = _simulation_smoother_core(params, xz, m, kf)
+
+    # --- loadings + idiosyncratic variances (batched over series) ---
+    lam, R = _draw_lam_r_block(klamr, f, xz, m, params.R, lam_scale, a0, b0)
+
+    # --- factor VAR (Matrix-Normal-Inverse-Wishart, collapsed Q draw) ---
+    A, Q = _draw_var_mniw(kvar, f, p, q_df_extra, q_scale)
 
     new_params = SSMParams(lam=lam, R=R, A=A, Q=Q)
     return (key, new_params), (f, lam, R, A, Q, ll)
@@ -394,6 +408,18 @@ def estimate_dfm_bayes(
             data, inclcode, initperiod, lastperiod, config, xz, m_arr
         )
         p = config.n_factorlag
+        r = config.nfac_u
+        T_w = xz.shape[0]
+        # the collapsed Q draw (IW with nu = r+1+extra + (T-p) - rp) needs
+        # every Bartlett gamma shape positive: nu > r - 1.  Below that,
+        # jax.random.gamma would return silent NaNs and the whole chain
+        # would go NaN — refuse loudly instead
+        if (r + 1.0 + float(priors.q_df_extra)) + (T_w - p) - r * p <= r - 1:
+            raise ValueError(
+                f"sample too short for the factor-VAR posterior: need "
+                f"T - p > r*p - 2 - q_df_extra (T={T_w}, p={p}, r={r}); "
+                "reduce n_factorlag or nfac_u"
+            )
         prior_t = (
             float(priors.lam_scale),
             float(priors.r_shape),
