@@ -154,3 +154,18 @@ def test_polish_validation():
     with pytest.raises(ValueError, match="not supported with a constraint"):
         estimate_factor(x, np.ones(x.shape[1]), 0, x.shape[0] - 1, cfg,
                         constraint=con, polish="float64")
+
+
+def test_polished_path_preserves_table2a_goldens(dataset_real):
+    """The polish is a refinement, not a different estimator: the Table 2(A)
+    trace R-squared goldens must hold on the POLISHED path at the same 1e-3
+    tolerance as the raw path (tests/test_dfm_golden.py)."""
+    golden = [0.385, 0.489, 0.533, 0.564, 0.594]
+    for r, g in zip((1, 2, 3, 4, 5), golden):
+        cfg = DFMConfig(nfac_u=r, tol=1e-8)
+        _, fes = estimate_factor(
+            dataset_real.bpdata, dataset_real.inclcode, 2, 223, cfg,
+            polish="float64",
+        )
+        tr = 1.0 - float(fes.ssr) / float(fes.tss)
+        np.testing.assert_allclose(tr, g, atol=1e-3)
