@@ -87,6 +87,12 @@ class FactorEstimateStats(NamedTuple):
     ssr: jnp.ndarray
     R2: jnp.ndarray  # per included series, NaN where below nt_min
     n_iter: jnp.ndarray
+    # polish="float64" only: whether the host f64 polish converged within
+    # its cap (None when no polish ran).  A capped polish means the
+    # returned factors may still depend on the starting iterate — the
+    # cross-backend parity guarantee is void, so the flag rides along
+    # into bench evidence instead of being discarded.
+    polish_converged: bool | None = None
 
 
 class DFMResults(NamedTuple):
@@ -293,7 +299,9 @@ def _polish_fixed_point_f64(
     scalar-secant estimate of the contraction rate applied per-entry-safe,
     on the whole factor block) to cover slowly-contracting spectra.
 
-    Returns (f_full, lam, ssr, n_it) in float64.
+    Returns (f_full, lam, ssr, n_it, converged) in float64; converged
+    False means the iteration hit max_iter with the last update still at
+    or above tol (also warned).
     """
     x = np.asarray(xz, np.float64)
     m = np.asarray(m, np.float64)
@@ -414,7 +422,7 @@ def _polish_fixed_point_f64(
     lam_u = lam[:, nfac_o:]
     xr_full = x - (fo @ lam[:, :nfac_o].T if nfac_o else 0.0)
     ssr = (W * (xr_full - fu @ lam_u.T) ** 2).sum()
-    return np.concatenate([fo, fu], axis=1), lam, ssr, n_it
+    return np.concatenate([fo, fu], axis=1), lam, ssr, n_it, bool(delta < tol)
 
 
 def _sym_sqrt(A):
@@ -466,6 +474,9 @@ def estimate_factor(
     solved for in the F-step.  Output factor columns are ordered
     [observed, unobserved].
     """
+    from ..utils.compile import configure_compilation_cache
+
+    configure_compilation_cache()
     if gram_dtype not in (None, "bfloat16"):
         # fp16's 5-bit exponent overflows on ordinary standardized panels;
         # only bf16 (f32 exponent range) is a safe Gram operand narrowing
@@ -586,15 +597,18 @@ def estimate_factor(
             )
             n_iter = n_iter + n_pre
 
+        polish_converged = None
         if polish is not None:
             with annotate("als_polish_f64"):
-                f_np, lam_np, ssr_np, _ = _polish_fixed_point_f64(
-                    np.asarray(xz),
-                    np.asarray(m),
-                    np.asarray(lam_ok),
-                    np.asarray(f),
-                    nfac_o=config.nfac_o,
-                    fo=None if fo is None else np.asarray(fo),
+                f_np, lam_np, ssr_np, _, polish_converged = (
+                    _polish_fixed_point_f64(
+                        np.asarray(xz),
+                        np.asarray(m),
+                        np.asarray(lam_ok),
+                        np.asarray(f),
+                        nfac_o=config.nfac_o,
+                        fo=None if fo is None else np.asarray(fo),
+                    )
                 )
                 f = jnp.asarray(f_np, xz.dtype)
                 ssr = jnp.asarray(ssr_np, xz.dtype)
@@ -602,7 +616,9 @@ def estimate_factor(
         R2 = _r2_pass(xz, m, f, lam_ok) if compute_R2 else jnp.full(ns, jnp.nan)
         factor = jnp.full((data.shape[0], config.nfac_t), jnp.nan, data.dtype)
         factor = factor.at[initperiod : lastperiod + 1].set(f)
-        fes = FactorEstimateStats(Tw, ns, nobs, tss, ssr, R2, n_iter)
+        fes = FactorEstimateStats(
+            Tw, ns, nobs, tss, ssr, R2, n_iter, polish_converged
+        )
         return factor, fes
 
 

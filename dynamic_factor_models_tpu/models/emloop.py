@@ -26,8 +26,7 @@ from ..utils.profiling import ConvergenceTrace, annotate
 __all__ = ["run_em_loop", "run_bulk_then_exact"]
 
 
-@partial(jax.jit, static_argnames=("step", "max_em_iter"))
-def _em_while(step, carry, args, tol, max_em_iter: int, stop_at):
+def _em_while_impl(step, carry, args, tol, max_em_iter: int, stop_at):
     """On-device EM loop.  Semantics match the host loop exactly: iterate
     `params, ll = step(params, *args)`; after iteration it >= 2, stop when
     |ll - ll_prev| < tol * (1 + |ll_prev|); always stop at max_em_iter.
@@ -50,6 +49,25 @@ def _em_while(step, carry, args, tol, max_em_iter: int, stop_at):
         return new_params, ll, ll_new.astype(dtype), it + 1, path
 
     return jax.lax.while_loop(cond, body, carry)
+
+
+_em_while_plain = partial(
+    jax.jit, static_argnames=("step", "max_em_iter")
+)(_em_while_impl)
+# donated variant: the carry (params + convergence scalars + the
+# max_em_iter-long loglik path) is input-output aliased, so XLA reuses
+# its buffers instead of copying — chunked checkpoint runs re-donate each
+# chunk's output into the next.  Unsupported on CPU (XLA warns and
+# copies), hence the utils.compile.donation_enabled() gate in callers.
+_em_while_donated = partial(
+    jax.jit, static_argnames=("step", "max_em_iter"), donate_argnums=(1,)
+)(_em_while_impl)
+
+
+def _em_while_jit(donate: bool):
+    """The jitted on-device EM loop; donate=True is the carry-donating
+    variant (callers must not reuse the carry they pass in)."""
+    return _em_while_donated if donate else _em_while_plain
 
 
 def _fresh_carry(params, tol, max_em_iter):
@@ -147,22 +165,43 @@ def run_em_loop(
                 ll_prev = ll
         return params, np.asarray(llpath), it, trace
 
+    from ..utils.compile import aot_call, aot_statics, donation_enabled
+
     tol_arr = jnp.asarray(tol, jnp.result_type(float))
+    donate = donation_enabled()
+    fp_params = params
+    if donate:
+        # the donated program may reuse every carry buffer, including the
+        # caller-visible init params — hand the carry a copy so the
+        # caller's arrays stay valid (run_bulk_then_exact re-reads the
+        # init when the bulk phase goes non-finite)
+        params = jax.tree.map(jnp.copy, params)
     carry = _fresh_carry(params, tol_arr, max_em_iter)
+    del params  # donated with the carry; only the carry's copy is live
+    loop = _em_while_jit(donate)
+    statics = aot_statics(step, max_em_iter, donate)
+
+    def _run(carry, bound):
+        # dispatches to a utils.compile.precompile'd executable when one
+        # matches (kernel "em_loop"); otherwise the live jit, whose
+        # compile hits the persistent cache for a known program
+        return aot_call(
+            "em_loop",
+            lambda c, a, t, s: loop(step, c, a, t, max_em_iter, s),
+            carry, args, tol_arr, jnp.asarray(bound, jnp.int32),
+            statics=statics,
+        )
 
     if checkpoint_path is None:
         bound = max_em_iter if stop_at is None else stop_at
         with annotate(trace_name):
-            carry = _em_while(
-                step, carry, args, tol_arr, max_em_iter,
-                jnp.asarray(bound, jnp.int32),
-            )
+            carry = _run(carry, bound)
     else:
         import os
 
         from ..utils.checkpoint import load_pytree, save_pytree
 
-        fp = _fingerprint(args, tol, max_em_iter, params=params)
+        fp = _fingerprint(args, tol, max_em_iter, params=fp_params)
         if os.path.exists(checkpoint_path):
             stored = load_pytree(checkpoint_path, {"carry": carry, "fp": ""})
             if str(stored["fp"]) != fp:
@@ -177,13 +216,13 @@ def run_em_loop(
                 it = int(carry[3])
                 if it >= max_em_iter:
                     break
-                stop_at = jnp.asarray(
-                    min(it + checkpoint_every, max_em_iter), jnp.int32
-                )
-                new_carry = _em_while(step, carry, args, tol_arr, max_em_iter, stop_at)
-                if int(new_carry[3]) == it:  # converged (cond false on entry)
+                # reassign unconditionally: under donation the input
+                # carry's buffers are dead after the call (the output is
+                # value-identical when cond is false on entry, so keeping
+                # it preserves the old semantics)
+                carry = _run(carry, min(it + checkpoint_every, max_em_iter))
+                if int(carry[3]) == it:  # converged (cond false on entry)
                     break
-                carry = new_carry
                 tmp = checkpoint_path + ".tmp.npz"
                 save_pytree(tmp, {"carry": carry, "fp": fp})
                 os.replace(tmp, checkpoint_path)

@@ -27,8 +27,9 @@ import numpy as np
 from ..ops.lags import lagmat
 from ..ops.linalg import solve_normal
 from ..ops.masking import mask_of
-from ..parallel.mesh import NamedSharding, P, make_mesh
+from ..parallel.mesh import NamedSharding, P, make_mesh, rep_pad
 from ..utils.backend import on_backend
+from ..utils.compile import configure_compilation_cache, donation_enabled
 from .var import VARResults, companion_matrices, estimate_var, impulse_response
 
 __all__ = [
@@ -50,6 +51,12 @@ class BootstrapIRFs(NamedTuple):
     draws: jnp.ndarray  # (n_reps, ns, H, nshock)
     quantiles: jnp.ndarray  # (nq, ns, H, nshock)
     quantile_levels: np.ndarray
+    # finite-replication accounting: nanquantile silently narrows the
+    # effective sample when replications go non-finite (an exploding
+    # resampled VAR), so the count rides along with the bands.  None only
+    # on legacy constructions.
+    n_finite: int | None = None  # replications with fully finite IRFs
+    finite_fraction: float | None = None  # n_finite / n_reps
 
 
 class SeriesIRFs(NamedTuple):
@@ -285,16 +292,36 @@ def _default_mesh(mesh):
     return mesh
 
 
+@partial(jax.jit, donate_argnums=(0,), static_argnames=("n",))
+def _donated_slice(draws, n: int):
+    """Slice the first n replications out of a padded draw batch, donating
+    the padded buffer so XLA can free/reuse it immediately (rep bucketing
+    can pad substantially; without donation both buffers coexist until GC).
+    Only used when donation is supported (utils.compile.donation_enabled)."""
+    return draws[:n]
+
+
+def _slice_reps(draws, n_reps: int):
+    if draws.shape[0] == n_reps:
+        return draws
+    if donation_enabled():
+        return _donated_slice(draws, n_reps)
+    return draws[:n_reps]
+
+
 def _dispatch_reps(core_fn, sharded_factory, mesh, n_reps, args_before, args_after=()):
-    """Shared mesh pad-and-slice dispatch for every rep-vmapped core: round
-    n_reps up to a device multiple, jit with a "rep" out-sharding, slice
-    back.  `core_fn(*args_before, n_reps, *args_after)`."""
+    """Shared pad-and-slice dispatch for every rep-vmapped core: round
+    n_reps up to a device multiple (and a ``DFM_REP_BUCKET`` bucket
+    multiple, so varying rep counts share one compiled executable), jit
+    with a "rep" out-sharding when a mesh is given, slice back.
+    `core_fn(*args_before, n_reps, *args_after)`.  `jax.random.split`
+    prefix stability makes the slice exact."""
     if mesh is not None:
-        n_dev = mesh.devices.size
-        n_padded = ((n_reps + n_dev - 1) // n_dev) * n_dev
+        n_padded = rep_pad(n_reps, mesh.devices.size)
         core = sharded_factory(NamedSharding(mesh, P("rep")))
-        return core(*args_before, n_padded, *args_after)[:n_reps]
-    return core_fn(*args_before, n_reps, *args_after)
+        return _slice_reps(core(*args_before, n_padded, *args_after), n_reps)
+    n_padded = rep_pad(n_reps, 1)
+    return _slice_reps(core_fn(*args_before, n_padded, *args_after), n_reps)
 
 
 def _run_core(yw, key, nlag, horizon, n_reps, mesh, resample=_resample_wild):
@@ -305,12 +332,34 @@ def _run_core(yw, key, nlag, horizon, n_reps, mesh, resample=_resample_wild):
     )
 
 
+def _finite_rep_stats(draws, n_reps: int):
+    """Count replications whose IRF draw is entirely finite; warn when the
+    nanquantile bands rest on < 99% of the requested replications (the
+    bands silently narrow their effective sample otherwise)."""
+    import warnings
+
+    n_finite = int(
+        jnp.isfinite(draws).all(axis=tuple(range(1, draws.ndim))).sum()
+    )
+    frac = n_finite / n_reps if n_reps else 1.0
+    if frac < 0.99:
+        warnings.warn(
+            f"bootstrap: only {n_finite}/{n_reps} replications produced "
+            f"finite IRFs ({frac:.1%}); quantile bands are computed on the "
+            "finite subset — consider more lags, a longer window, or "
+            "checking the input panel for outliers",
+            stacklevel=3,
+        )
+    return n_finite, frac
+
+
 def _bootstrap_driver(
     y, nlag, initperiod, lastperiod, horizon, n_reps, seed,
     quantile_levels, mesh, backend, resample,
 ) -> BootstrapIRFs:
     """Shared bootstrap frame: window prep -> point IRFs -> mesh default ->
     vmapped replications (`resample` picks the scheme) -> quantiles."""
+    configure_compilation_cache()
     with on_backend(backend):
         # drop leading incomplete rows (factor windows start with NaN lags)
         yw = _prepare_window(y, initperiod, lastperiod)
@@ -325,7 +374,10 @@ def _bootstrap_driver(
         draws = _run_core(yw, key, nlag, horizon, n_reps, mesh, resample)
 
         q = jnp.nanquantile(draws, jnp.asarray(quantile_levels), axis=0)
-        return BootstrapIRFs(point, draws, q, np.asarray(quantile_levels))
+        n_finite, frac = _finite_rep_stats(draws, n_reps)
+        return BootstrapIRFs(
+            point, draws, q, np.asarray(quantile_levels), n_finite, frac
+        )
 
 
 def wild_bootstrap_irfs(
@@ -427,7 +479,10 @@ def wild_bootstrap_irfs_resumable(
 
         draws = jnp.asarray(np.concatenate(done, axis=0)[:n_reps])
         q = jnp.nanquantile(draws, jnp.asarray(quantile_levels), axis=0)
-        return BootstrapIRFs(point, draws, q, np.asarray(quantile_levels))
+        n_finite, frac = _finite_rep_stats(draws, n_reps)
+        return BootstrapIRFs(
+            point, draws, q, np.asarray(quantile_levels), n_finite, frac
+        )
 
 
 def block_bootstrap_irfs(

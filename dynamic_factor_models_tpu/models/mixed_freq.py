@@ -42,6 +42,7 @@ from .ssm import (
     _rts_scan,
     _solve_loadings_and_R,
     _sym_pack_idx,
+    _var_moments,
     compute_panel_stats,
 )
 
@@ -181,12 +182,12 @@ def _em_mf_impl(params: MixedFreqParams, x, mask, stats):
     Sxg = jnp.einsum("ij,ijr->ir", params.agg, Sxg5.reshape(-1, _N_AGG, r))
     lam, R = _solve_loadings_and_R(Sgg, Sxg, Sxx, n_i)
 
-    # factor VAR + Q from the full state moments (as in ssm.em_step)
-    S11 = jnp.einsum("tr,ts->rs", s_sm[1:, :r], s_sm[1:, :r]) + P_sm[1:, :r, :r].sum(0)
-    S00 = jnp.einsum("tk,tl->kl", s_sm[:-1], s_sm[:-1]) + P_sm[:-1].sum(0)
-    S10 = jnp.einsum("tr,tk->rk", s_sm[1:, :r], s_sm[:-1]) + lag1[:, :r, :].sum(0)
+    # factor VAR + Q from the full state moments (as in ssm.em_step);
+    # stats.tw keeps shape-bucketed padding periods out of the moments
+    tw = None if stats is None else stats.tw
+    S11, S00, S10, Tn_eff = _var_moments(s_sm, P_sm, lag1, r, Tn, tw)
     Ak = S10 @ jnp.linalg.pinv(S00, hermitian=True)
-    Q = _psd_floor((S11 - Ak @ S10.T) / (Tn - 1))
+    Q = _psd_floor((S11 - Ak @ S10.T) / (Tn_eff - 1))
     A = jnp.stack([Ak[:, i * r : (i + 1) * r] for i in range(p)])
     return MixedFreqParams(lam, R, A, Q, params.agg), ll
 
@@ -274,6 +275,7 @@ def estimate_mixed_freq_dfm(
     checkpoint_every: int = 25,
     accel: str | None = None,
     gram_dtype: str | None = None,
+    bucket=None,
 ) -> MFResults:
     """Fit the mixed-frequency DFM on a MONTHLY-frequency (T, N) panel.
 
@@ -287,7 +289,22 @@ def estimate_mixed_freq_dfm(
     accel="squarem" wraps the EM step in one SQUAREM extrapolation cycle
     per loop iteration (`emaccel.squarem`; n_iter then counts cycles of
     three EM-map evaluations each).
+
+    bucket pads (T, N) up to a shape bucket (utils.compile, same contract
+    as `ssm.estimate_dfm_em`): padded series are fully masked with
+    monthly-pattern aggregation rows (inert in every moment), padded
+    periods are excluded from the factor-VAR moments via `PanelStats.tw`;
+    one compiled MF executable then serves every panel in the bucket.
     """
+    from ..utils.compile import (
+        bucket_shape,
+        configure_compilation_cache,
+        pad_panel,
+        resolve_buckets,
+    )
+
+    configure_compilation_cache()
+    buckets = resolve_buckets(bucket)
     if p < _N_AGG:
         raise ValueError(f"p={p} must be >= {_N_AGG} for Mariano-Murasawa lags")
     if accel not in (None, "squarem"):
@@ -339,7 +356,22 @@ def estimate_mixed_freq_dfm(
 
         from .emloop import run_em_loop
 
-        stats = compute_panel_stats(xz, m_arr)
+        T0, N0 = xz.shape
+        if buckets is not None:
+            Tb, Nb = bucket_shape(T0, N0, *buckets)
+            xz, m_arr, tw = pad_panel(xz, m_arr, Tb, Nb)
+            # padded series: zero loadings, unit R, monthly aggregation
+            # row (fully masked, so any valid agg pattern is inert)
+            agg_pad = jnp.zeros((Nb, _N_AGG), dtype).at[:N0].set(params.agg)
+            agg_pad = agg_pad.at[N0:, 0].set(1.0)
+            params = params._replace(
+                lam=jnp.zeros((Nb, r), dtype).at[:N0].set(params.lam),
+                R=jnp.ones(Nb, dtype).at[:N0].set(params.R),
+                agg=agg_pad,
+            )
+            stats = compute_panel_stats(xz, m_arr)._replace(tw=tw)
+        else:
+            stats = compute_panel_stats(xz, m_arr)
         step = em_step_mf_stats
         if accel == "squarem":
             from .emaccel import squarem, squarem_state
@@ -374,11 +406,17 @@ def estimate_mixed_freq_dfm(
         if accel == "squarem":
             params = params.params  # unwrap SquaremState
 
+        # bucketed path: smooth at the bucket shape, then slice the
+        # readout (and the params) back to the raw panel
         s_sm, x_hat = _smooth_xhat_mf(params, xz, m_arr)
+        if buckets is not None:
+            params = params._replace(
+                lam=params.lam[:N0], R=params.R[:N0], agg=params.agg[:N0]
+            )
         return MFResults(
             params=params,
-            factors=s_sm[:, :r],
-            x_hat=x_hat,
+            factors=s_sm[:T0, :r],
+            x_hat=x_hat[:T0, :N0],
             loglik_path=llpath,
             n_iter=it,
             stds=stds,

@@ -542,6 +542,20 @@ def ms_standard_errors(
             f"{float(params.sigma2[0])!r}; rescale sigma2 by sigma2[0] "
             "(and fold the scale into lam/R) before requesting SEs"
         )
+    R_np = np.asarray(params.R)
+    bad_R = (R_np < np.exp(-12.0)) | (R_np > np.exp(12.0))
+    if bad_R.any():
+        # _pack clips log(R) to [-12, 12]; an R outside that range would be
+        # silently projected onto the clip boundary, the scores evaluated at
+        # the projected (wrong) point, and the clip's zero gradient would
+        # make that coordinate's SE spuriously zero/NaN
+        idx = np.flatnonzero(bad_R)
+        raise ValueError(
+            f"params.R outside the packable range [e^-12, e^12] at series "
+            f"{idx.tolist()} (values {R_np[idx].tolist()}); such a fit is "
+            "degenerate (near-zero or explosive idiosyncratic variance) — "
+            "rescale the panel or refit before requesting SEs"
+        )
     theta0 = _pack(params)
     struct_keys = ("mu0", "log_dmu", "atanh_phi", "log_P", "log_sig")
     if which == "structural":

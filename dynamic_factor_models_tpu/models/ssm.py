@@ -223,6 +223,15 @@ class PanelStats(NamedTuple):
     x16: jnp.ndarray | None = None  # (T, N)
     mT16: jnp.ndarray | None = None  # (N, T)
     xT16: jnp.ndarray | None = None  # (N, T)
+    # optional (T,) time-validity weight for shape-bucketed panels
+    # (utils.compile.pad_panel): 1 on real periods, 0 on padding.  Padded
+    # periods are fully masked, so every observation-side statistic is
+    # already exact; tw exists for the ONE term that sums over time
+    # without a mask — the M-step's factor-VAR moments, whose padded
+    # forecast states would otherwise contaminate A and Q (see
+    # `_var_moments`).  None on unbucketed panels (the exact legacy
+    # program).
+    tw: jnp.ndarray | None = None
 
 
 def _with_bf16_twins(stats: PanelStats, x) -> PanelStats:
@@ -806,15 +815,40 @@ def _em_m_step(params: SSMParams, x, m, s_sm, P_sm, lag1, stats=None):
     lam, R = _solve_loadings_and_R(Sff, Sxf, Sxx, n_i)
 
     # --- factor VAR blocks + Q from smoothed second moments ---
-    S11 = (jnp.einsum("tr,ts->rs", s_sm[1:, :r], s_sm[1:, :r])
-           + P_sm[1:, :r, :r].sum(axis=0))
-    S00 = (jnp.einsum("tk,tl->kl", s_sm[:-1], s_sm[:-1]) + P_sm[:-1].sum(axis=0))
-    S10 = (jnp.einsum("tr,tk->rk", s_sm[1:, :r], s_sm[:-1])
-           + lag1[:, :r, :].sum(axis=0))
+    tw = None if stats is None else stats.tw
+    S11, S00, S10, Tn_eff = _var_moments(s_sm, P_sm, lag1, r, Tn, tw)
     Ak = S10 @ jnp.linalg.pinv(S00, hermitian=True)  # (r, k)
-    Q = _psd_floor((S11 - Ak @ S10.T) / (Tn - 1))
+    Q = _psd_floor((S11 - Ak @ S10.T) / (Tn_eff - 1))
     A = jnp.stack([Ak[:, i * r : (i + 1) * r] for i in range(p)])
     return SSMParams(lam, R, A, Q)
+
+
+def _var_moments(s_sm, P_sm, lag1, r: int, Tn: int, tw=None):
+    """Smoothed second-moment blocks of the factor-VAR regression.
+
+    This is the one EM statistic that sums over TIME without an
+    observation mask, so on a shape-bucketed panel (utils.compile) the
+    padded trailing periods — whose smoothed states are pure forecasts —
+    would bias A and Q.  `tw` (PanelStats.tw, 1 on real periods) weights
+    each transition pair by the validity of its LATER period (padding is a
+    contiguous suffix, so tw[t] = 1 implies tw[t-1] = 1) and replaces the
+    Tn divisor with the real-period count; tw=None is the exact
+    legacy program, term for term.
+    """
+    s1, s0 = s_sm[1:, :r], s_sm[:-1]
+    if tw is None:
+        S11 = jnp.einsum("tr,ts->rs", s1, s1) + P_sm[1:, :r, :r].sum(axis=0)
+        S00 = jnp.einsum("tk,tl->kl", s0, s0) + P_sm[:-1].sum(axis=0)
+        S10 = jnp.einsum("tr,tk->rk", s1, s0) + lag1[:, :r, :].sum(axis=0)
+        return S11, S00, S10, Tn
+    w1 = tw[1:]
+    S11 = (jnp.einsum("t,tr,ts->rs", w1, s1, s1)
+           + jnp.einsum("t,trs->rs", w1, P_sm[1:, :r, :r]))
+    S00 = (jnp.einsum("t,tk,tl->kl", w1, s0, s0)
+           + jnp.einsum("t,tkl->kl", w1, P_sm[:-1]))
+    S10 = (jnp.einsum("t,tr,tk->rk", w1, s1, s0)
+           + jnp.einsum("t,trk->rk", w1, lag1[:, :r, :]))
+    return S11, S00, S10, tw.sum()
 
 
 @jax.jit
@@ -980,6 +1014,7 @@ def estimate_dfm_em(
     checkpoint_every: int = 25,
     accel: str | None = None,
     gram_dtype: str | None = None,
+    bucket=None,
 ) -> EMResults:
     """State-space DFM via EM on the standardized included panel
     (BASELINE.json config 2: `State-space DFM via EM + Kalman smoother`).
@@ -1004,7 +1039,27 @@ def estimate_dfm_em(
     loglik-guarded, never worse than two plain EM steps) — n_iter then
     counts cycles, and the same fixed point is reached in materially fewer
     map evaluations on slow-converging (persistent-factor) panels.
+
+    bucket (sequential method only) pads the panel up to a shape bucket
+    (utils.compile) so ONE compiled EM executable serves every panel in
+    the bucket: None reads the ``DFM_SHAPE_BUCKETS`` env default, True
+    uses the default bucket tables, (t_buckets, n_buckets) is explicit.
+    Padding is exact — padded cells are fully masked (inert in every
+    observation statistic) and `PanelStats.tw` keeps padded periods out
+    of the factor-VAR moments; results match the unbucketed run to
+    numerical precision (pinned by tests/test_compile_cache.py).
     """
+    from ..utils.compile import (
+        bucket_shape,
+        configure_compilation_cache,
+        pad_panel,
+        pad_ssm_params,
+        resolve_buckets,
+        unpad_ssm_params,
+    )
+
+    configure_compilation_cache()
+    buckets = resolve_buckets(bucket)
     if method not in _FILTER_METHODS:
         raise ValueError(f"method must be one of {_FILTER_METHODS}, got {method!r}")
     if accel not in (None, "squarem"):
@@ -1017,6 +1072,11 @@ def estimate_dfm_em(
         raise ValueError("gram_dtype requires method='sequential' (the stats path)")
     if gram_dtype is not None and checkpoint_path is not None:
         raise ValueError("gram_dtype is not combinable with checkpoint_path")
+    if buckets is not None and method != "sequential":
+        raise ValueError(
+            "bucket requires method='sequential' (the PanelStats path "
+            "carries the time-validity weight padding needs)"
+        )
     with on_backend(backend):
         data = jnp.asarray(data)
         inclcode = np.asarray(inclcode)
@@ -1031,9 +1091,21 @@ def estimate_dfm_em(
 
         from .emloop import run_em_loop
 
+        T0, N0 = xz.shape
         if method == "sequential":
             step = em_step_stats
-            args = (xz, m_arr, compute_panel_stats(xz, m_arr))
+            if buckets is not None:
+                # pad up to the bucket; even at exact size the bucketed
+                # program carries tw, so every panel in the bucket shares
+                # ONE compiled executable (same avals, same pytree)
+                Tb, Nb = bucket_shape(T0, N0, *buckets)
+                xz_b, m_b, tw = pad_panel(xz, m_arr, Tb, Nb)
+                params = pad_ssm_params(params, Nb)
+                stats = compute_panel_stats(xz_b, m_b)._replace(tw=tw)
+                xz, m_arr = xz_b, m_b
+            else:
+                stats = compute_panel_stats(xz, m_arr)
+            args = (xz, m_arr, stats)
         else:
             step = {
                 "associative": em_step_assoc,
@@ -1075,11 +1147,16 @@ def estimate_dfm_em(
 
         if accel == "squarem":
             params = params.params  # unwrap SquaremState
+        # on the bucketed path the smoother also runs at the bucket shape
+        # (padded cells are NaN -> missing; trailing all-missing periods
+        # add no information at real times), then the readout slices back
         means, covs, _ = kalman_smoother(params, jnp.where(m_arr, xz, jnp.nan))
+        if buckets is not None:
+            params = unpad_ssm_params(params, N0)
         return EMResults(
             params=params,
-            factors=means[:, :r],
-            factor_covs=covs[:, :r, :r],
+            factors=means[:T0, :r],
+            factor_covs=covs[:T0, :r, :r],
             loglik_path=llpath,
             n_iter=n_iter,
             stds=stds,

@@ -247,6 +247,9 @@ def estimate_dfm_em_ar(
     per loop iteration (`emaccel.squarem`; n_iter then counts cycles of
     three EM-map evaluations each).
     """
+    from ..utils.compile import configure_compilation_cache
+
+    configure_compilation_cache()
     if accel not in (None, "squarem"):
         raise ValueError(f"accel must be None or 'squarem', got {accel!r}")
     with on_backend(backend):

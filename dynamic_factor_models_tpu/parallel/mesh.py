@@ -14,7 +14,37 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-__all__ = ["make_mesh", "shard_over", "replicate", "P", "Mesh", "NamedSharding"]
+__all__ = [
+    "make_mesh",
+    "rep_pad",
+    "shard_over",
+    "replicate",
+    "P",
+    "Mesh",
+    "NamedSharding",
+]
+
+
+def rep_pad(n_reps: int, n_dev: int, bucket: int | None = None) -> int:
+    """Padded replication count: round n_reps up to a device multiple,
+    then (optionally) up to a multiple of `bucket` so every bootstrap
+    batch size in a session maps onto ONE compiled executable —
+    `jax.random.split` prefix stability makes the first n_reps draws of a
+    padded batch identical to the unpadded batch, so callers slice
+    `[:n_reps]` and results are exact (pinned in tests/test_favar.py).
+
+    bucket=None reads ``DFM_REP_BUCKET`` (0 disables; e.g. 256 buckets
+    every count into {256, 512, ...} multiples).
+    """
+    if bucket is None:
+        import os
+
+        bucket = int(os.environ.get("DFM_REP_BUCKET", "0"))
+    n = ((n_reps + n_dev - 1) // n_dev) * n_dev
+    if bucket > 0:
+        step = -(-bucket // n_dev) * n_dev  # lcm-ish: keep device multiple
+        n = ((n + step - 1) // step) * step
+    return n
 
 
 def make_mesh(n_devices: int | None = None, axis_names=("rep",), shape=None) -> Mesh:

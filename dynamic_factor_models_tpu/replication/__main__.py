@@ -69,6 +69,12 @@ def main(argv=None) -> int:
     if args.x64:
         jax.config.update("jax_enable_x64", True)
 
+    # after the platform/precision config (both change compiled programs,
+    # so they must be settled before any cache key is computed)
+    from ..utils.compile import configure_compilation_cache
+
+    configure_compilation_cache()
+
     from ..utils.backend import on_backend
     from . import stock_watson as sw
 

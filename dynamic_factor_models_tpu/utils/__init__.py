@@ -1,1 +1,14 @@
 from .backend import on_backend, resolve_device
+from .compile import (
+    BASELINE_PANEL_SHAPES,
+    CompileSpec,
+    bucket_shape,
+    configure_compilation_cache,
+    counters,
+    donation_enabled,
+    pad_panel,
+    persistent_cache_events,
+    precompile,
+    reset_counters,
+    resolve_buckets,
+)

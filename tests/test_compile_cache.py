@@ -1,0 +1,221 @@
+"""Compile-once execution layer (utils/compile.py): shape bucketing is
+EXACT, the AOT registry serves every BASELINE panel from one executable,
+and donated EM carries don't corrupt results.
+
+The bucketing exactness argument (pinned numerically here): padded cells
+are fully masked so every observation statistic is inert, and the one
+unmasked time-sum in the M-step — the factor-VAR moments — is weighted by
+`PanelStats.tw` so padded periods drop out of S11/S00/S10 and the
+effective sample size.  The smoother readout at the bucket shape is exact
+at real times because trailing all-missing periods add no information.
+"""
+
+import numpy as np
+import pytest
+
+from dynamic_factor_models_tpu.models.dfm import DFMConfig
+from dynamic_factor_models_tpu.models.ssm import estimate_dfm_em
+from dynamic_factor_models_tpu.parallel.mesh import rep_pad
+from dynamic_factor_models_tpu.utils import compile as cc
+
+
+def _panel(T, N, r=4, seed=0, missing=0.0):
+    rng = np.random.default_rng(seed)
+    f = rng.standard_normal((T, r))
+    lam = rng.standard_normal((N, r))
+    x = f @ lam.T + 0.5 * rng.standard_normal((T, N))
+    if missing:
+        # ragged missingness on the tail columns; keep a fully-balanced
+        # block so the ALS PCA init has complete series to work with
+        x[rng.random((T, N)) < missing * (np.arange(N) >= r + 4)] = np.nan
+    return x
+
+
+@pytest.fixture(autouse=True)
+def _clean_compile_env(monkeypatch):
+    for var in ("DFM_SHAPE_BUCKETS", "DFM_T_BUCKETS", "DFM_N_BUCKETS",
+                "DFM_REP_BUCKET"):
+        monkeypatch.delenv(var, raising=False)
+    monkeypatch.setenv("DFM_DONATE", "0")
+
+
+def test_baseline_shapes_share_one_bucket():
+    buckets = {
+        cc.bucket_shape(T, N)
+        for T, N in cc.BASELINE_PANEL_SHAPES.values()
+    }
+    assert buckets == {(256, 256)}
+    # the large-panel bench regime maps to itself (no padding waste)
+    assert cc.bucket_shape(2048, 4096) == (2048, 4096)
+    # beyond the largest bucket: pass through unpadded rather than fail
+    assert cc.bucket_shape(5000, 10000) == (5000, 10000)
+
+
+def test_pad_panel_exact_structure():
+    import jax.numpy as jnp
+
+    x = jnp.asarray(np.arange(12.0).reshape(3, 4))
+    m = jnp.ones((3, 4), bool).at[1, 2].set(False)
+    xp, mp, tw = cc.pad_panel(x, m, 8, 16)
+    assert xp.shape == (8, 16) and mp.shape == (8, 16) and tw.shape == (8,)
+    np.testing.assert_array_equal(np.asarray(xp[:3, :4]), np.asarray(x))
+    assert not np.asarray(mp[3:]).any() and not np.asarray(mp[:, 4:]).any()
+    np.testing.assert_array_equal(
+        np.asarray(tw), [1, 1, 1, 0, 0, 0, 0, 0]
+    )
+    with pytest.raises(ValueError, match="bucket"):
+        cc.pad_panel(x, m, 2, 16)
+
+
+def test_rep_pad_arithmetic():
+    assert rep_pad(1000, 8) == 1000
+    assert rep_pad(1001, 8) == 1008
+    assert rep_pad(7, 1) == 7
+    assert rep_pad(100, 8, bucket=256) == 256
+    assert rep_pad(300, 8, bucket=256) == 512
+    assert rep_pad(100, 8, bucket=0) == 104
+
+
+def test_bucketed_em_matches_unbucketed():
+    """The tentpole exactness bar: bucketed == unbucketed at numerical
+    precision (f64 here via conftest; the padded program is a different
+    schedule, so exact-zero is not expected — 1e-10 is the documented
+    bar, measured ~1e-14)."""
+    x = _panel(90, 17, seed=3, missing=0.1)
+    incl = np.ones(x.shape[1])
+    cfg = DFMConfig(nfac_u=2, n_factorlag=2)
+    base = estimate_dfm_em(x, incl, 0, x.shape[0] - 1, cfg,
+                           max_em_iter=25, bucket=False)
+    buck = estimate_dfm_em(x, incl, 0, x.shape[0] - 1, cfg,
+                           max_em_iter=25, bucket=True)
+    assert buck.factors.shape == base.factors.shape
+    assert buck.params.lam.shape == base.params.lam.shape
+    np.testing.assert_allclose(
+        np.asarray(buck.loglik_path), np.asarray(base.loglik_path),
+        atol=1e-10,
+    )
+    np.testing.assert_allclose(
+        np.asarray(buck.factors), np.asarray(base.factors), atol=1e-10
+    )
+    np.testing.assert_allclose(
+        np.asarray(buck.params.lam), np.asarray(base.params.lam), atol=1e-10
+    )
+
+
+@pytest.mark.slow
+def test_bucketed_mixed_freq_matches_unbucketed():
+    """Same exactness bar for the mixed-frequency path, whose padding also
+    extends the aggregation matrix (padded rows get the monthly identity
+    row so the augmented state stays well-posed)."""
+    from dynamic_factor_models_tpu.models.mixed_freq import (
+        estimate_mixed_freq_dfm,
+    )
+
+    rng = np.random.default_rng(0)
+    T, N, r = 90, 14, 1
+    f = np.cumsum(0.3 * rng.standard_normal((T, r)), axis=0) * 0.3
+    lam = rng.standard_normal((N, r))
+    x = f @ lam.T + 0.5 * rng.standard_normal((T, N))
+    isq = np.zeros(N, bool)
+    isq[10:] = True
+    # quarterly series observed only in quarter-end months
+    x[(np.arange(T) % 3 != 2)[:, None] & isq[None, :]] = np.nan
+    base = estimate_mixed_freq_dfm(x, isq, r=r, max_em_iter=15, bucket=False)
+    buck = estimate_mixed_freq_dfm(x, isq, r=r, max_em_iter=15, bucket=True)
+    assert buck.factors.shape == base.factors.shape
+    assert buck.x_hat.shape == base.x_hat.shape
+    np.testing.assert_allclose(
+        np.asarray(buck.loglik_path), np.asarray(base.loglik_path),
+        atol=1e-10,
+    )
+    np.testing.assert_allclose(
+        np.asarray(buck.factors), np.asarray(base.factors), atol=1e-10
+    )
+    np.testing.assert_allclose(
+        np.asarray(buck.x_hat), np.asarray(base.x_hat), atol=1e-10
+    )
+
+
+def test_precompile_counters_and_registry_hits():
+    cc.reset_counters()
+    spec = cc.CompileSpec(
+        T=60, N=12, r=2, p=1, dtype=str(np.dtype(float)),
+        kernels=("em_step",), max_em_iter=4,
+    )
+    r1 = cc.precompile(spec)
+    assert not r1["kernels"]["em_step"]["aot_cached"]
+    assert r1["kernels"]["em_step"]["compile_s"] > 0
+    assert cc.counters()["em_step"]["compiles"] == 1
+    # second precompile of the identical spec: served from the in-process
+    # registry, zero XLA work
+    r2 = cc.precompile(spec)
+    assert r2["kernels"]["em_step"]["aot_cached"]
+    assert r2["compile_s_total"] == 0.0
+    c = cc.counters()["em_step"]
+    assert c["compiles"] == 1 and c["aot_hits"] == 1
+
+
+def test_one_executable_serves_all_baseline_configs():
+    """Acceptance pin: after ONE precompile for the shared bucket, the EM
+    loop of every BASELINE panel shape dispatches the SAME executable —
+    zero recompiles, counter-verified."""
+    cc.reset_counters()
+    spec = cc.CompileSpec(
+        T=224, N=139, dtype=str(np.dtype(float)),
+        kernels=("em_loop",), max_em_iter=8,
+    )
+    assert spec.padded_shape() == (256, 256)
+    cc.precompile(spec, warmup=False)
+    assert cc.counters()["em_loop"]["compiles"] == 1
+
+    cfg = DFMConfig(nfac_u=4, tol=1e-5, max_iter=300)
+    for i, (T, N) in enumerate(cc.BASELINE_PANEL_SHAPES.values()):
+        x = _panel(T, N, seed=10 + i)
+        estimate_dfm_em(x, np.ones(N), 0, T - 1, cfg,
+                        max_em_iter=8, bucket=True)
+    c = cc.counters()["em_loop"]
+    assert c["compiles"] == 1, "a BASELINE config recompiled the EM loop"
+    assert c["aot_misses"] == 0
+    assert c["aot_hits"] == len(cc.BASELINE_PANEL_SHAPES)
+    assert c["runs"] == len(cc.BASELINE_PANEL_SHAPES)
+    assert c["run_s"] > 0
+
+
+def test_donated_carry_matches_undonated(monkeypatch):
+    """DFM_DONATE=1 compiles the donated while-loop variant (on CPU XLA
+    falls back to copying); results must be identical to the undonated
+    program, and the caller's params must survive (run_em_loop copies
+    before donating the carry)."""
+    x = _panel(80, 15, seed=5, missing=0.08)
+    incl = np.ones(x.shape[1])
+    cfg = DFMConfig(nfac_u=2, n_factorlag=1)
+
+    monkeypatch.setenv("DFM_DONATE", "0")
+    base = estimate_dfm_em(x, incl, 0, x.shape[0] - 1, cfg, max_em_iter=12)
+    monkeypatch.setenv("DFM_DONATE", "1")
+    don = estimate_dfm_em(x, incl, 0, x.shape[0] - 1, cfg, max_em_iter=12)
+
+    assert don.n_iter == base.n_iter
+    np.testing.assert_allclose(
+        np.asarray(don.loglik_path), np.asarray(base.loglik_path),
+        atol=1e-12,
+    )
+    np.testing.assert_allclose(
+        np.asarray(don.factors), np.asarray(base.factors), atol=1e-12
+    )
+
+
+@pytest.mark.slow
+def test_configure_compilation_cache_round_trip(tmp_path, monkeypatch):
+    """An explicit cache dir is created, adopted, and sticky for later
+    default calls; DFM_COMPILE_CACHE=0 disables."""
+    d = str(tmp_path / "jax_cache")
+    active = cc.configure_compilation_cache(cache_dir=d)
+    assert active == d
+    import os
+
+    assert os.path.isdir(d)
+    # idempotent default call returns the configured dir
+    assert cc.configure_compilation_cache() == d
+    monkeypatch.setenv("DFM_COMPILE_CACHE", "0")
+    assert cc.configure_compilation_cache() is None

@@ -67,11 +67,11 @@ def test_polish_reaches_fixed_point_and_canonical_form():
     xc = np.where(m > 0, xs - mu, 0.0)
     sd = np.sqrt((m * xc**2).sum(0) / m.sum(0))
     xz = np.where(m > 0, xc / sd, 0.0)
-    F2, _, _, n_it = _polish_fixed_point_f64(
+    F2, _, _, n_it, converged = _polish_fixed_point_f64(
         xz, m, np.ones(x.shape[1]), F, tol=1e-13, max_iter=50
     )
     np.testing.assert_allclose(F2, F, atol=1e-7)
-    assert n_it < 50  # converged, not capped
+    assert n_it < 50 and converged  # converged, not capped
 
 
 def test_polish_loading_gram_is_descending_diagonal():
@@ -84,7 +84,7 @@ def test_polish_loading_gram_is_descending_diagonal():
     sd = np.sqrt((m * xc**2).sum(0) / m.sum(0))
     xz = np.where(m > 0, xc / sd, 0.0)
     f0 = xz[:, :3].copy()
-    F, lam, _, _ = _polish_fixed_point_f64(xz, m, np.ones(x.shape[1]), f0)
+    F, lam, _, _, _ = _polish_fixed_point_f64(xz, m, np.ones(x.shape[1]), f0)
     LtL = lam.T @ lam
     off = LtL - np.diag(np.diag(LtL))
     assert np.abs(off).max() < 1e-7 * np.abs(np.diag(LtL)).max()
@@ -132,7 +132,7 @@ def test_polish_of_raw_iterate_matches_api_path():
     xstd, _ = standardize_data(xw)
     m = np.asarray(mask_of(xstd), float)
     lam_ok = m.sum(axis=0) >= cfg.nt_min_factor
-    F_pol_w, _, _, _ = _polish_fixed_point_f64(
+    F_pol_w, _, _, _, _ = _polish_fixed_point_f64(
         np.asarray(fillz(xstd)), m, lam_ok, np.asarray(F_raw)[init : last + 1]
     )
     np.testing.assert_allclose(

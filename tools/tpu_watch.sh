@@ -57,7 +57,14 @@ import jax, jax.numpy as jnp
 assert jax.devices()[0].platform in ('tpu', 'axon')
 jax.block_until_ready(jnp.ones(8).sum())
 " >/dev/null 2>&1; then
-    echo "$(date -u +%FT%TZ) watcher probe LIVE — running bench.py --run-tpu-remainder" >> "$LOG"
+    echo "$(date -u +%FT%TZ) watcher probe LIVE — warming compile cache, then bench.py --run-tpu-remainder" >> "$LOG"
+    # warm the persistent compile cache for the BASELINE bucket FIRST:
+    # every later section then loads executables instead of spending the
+    # scarce live window inside XLA.  Best-effort — a wedge here must not
+    # eat the window (short timeout, rc ignored).
+    timeout -k 10 600 python bench.py --warm-cache \
+      > /tmp/tpu_warm_cache.out 2> /tmp/tpu_warm_cache.err
+    echo "$(date -u +%FT%TZ) watcher warm-cache rc=$? (log /tmp/tpu_warm_cache.out)" >> "$LOG"
     DFM_BENCH_PARTIAL=/tmp/tpu_remainder_partial.json \
       timeout -k 30 5400 python bench.py --run-tpu-remainder \
       > /tmp/tpu_remainder.out 2> /tmp/tpu_remainder.err
