@@ -1666,7 +1666,15 @@ def main():
     ap.add_argument("--run-compile-split", action="store_true")
     ap.add_argument("--cache-dir")
     ap.add_argument("--warm-cache", action="store_true")
+    ap.add_argument("--telemetry", metavar="PATH",
+                    help="record a RunRecord JSONL for every estimation "
+                         "call (sets DFM_TELEMETRY; inherited by bench "
+                         "child processes)")
     args = ap.parse_args()
+    if args.telemetry:
+        path = os.path.abspath(args.telemetry)
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        os.environ["DFM_TELEMETRY"] = path
     if args.run_compile_split:
         run_compile_split(args.cache_dir)
         return

@@ -40,7 +40,7 @@ from ..ops.linalg import (
 )
 from ..ops.masking import compact, fillz, mask_of
 from ..utils.backend import on_backend
-from ..utils.profiling import annotate
+from ..utils.telemetry import span
 from .constraints import LambdaConstraint, apply_constraint_batch
 from .var import VARResults, estimate_var
 
@@ -504,13 +504,22 @@ def estimate_factor(
                 f"({np.asarray(data).shape[0]} rows, the window is sliced "
                 f"internally), got {observed_factor.shape[0]} rows"
             )
-    with on_backend(backend):
+    from ..utils.telemetry import run_record
+
+    with on_backend(backend), run_record(
+        "estimate_factor",
+        config={
+            "gram_dtype": gram_dtype, "polish": polish,
+            "constrained": constraint is not None, "nfac_o": config.nfac_o,
+        },
+    ) as rec:
         data = jnp.asarray(data)
         inclcode = np.asarray(inclcode)
         est = data[:, inclcode == 1]
         xw = est[initperiod : lastperiod + 1]
         Tw, ns = xw.shape
         nfac = config.nfac_u
+        rec.set(shapes={"T": int(Tw), "N": int(ns), "r": int(nfac)})
 
         xstd, stds = standardize_data(xw)
         mask = mask_of(xstd)
@@ -553,7 +562,7 @@ def estimate_factor(
                 c_R=constraint.R,
                 c_r=constraint.standardized(stds),
             )
-        with annotate("als_core"):
+        with span("als_core"):
             tol_scaled = config.tol * Tw * ns
             cap = max_iter if max_iter is not None else config.max_iter
             phase2_kwargs = {}
@@ -599,7 +608,7 @@ def estimate_factor(
 
         polish_converged = None
         if polish is not None:
-            with annotate("als_polish_f64"):
+            with span("als_polish_f64"):
                 f_np, lam_np, ssr_np, _, polish_converged = (
                     _polish_fixed_point_f64(
                         np.asarray(xz),
@@ -619,6 +628,13 @@ def estimate_factor(
         fes = FactorEstimateStats(
             Tw, ns, nobs, tss, ssr, R2, n_iter, polish_converged
         )
+        if rec.active:  # int()/float() force a device sync — telemetry only
+            rec.set(
+                n_iter=int(n_iter),
+                converged=bool(int(n_iter) < cap),
+                final_loglik=None,  # ALS objective is SSR, not a loglik
+                ssr=float(ssr),
+            )
         return factor, fes
 
 
@@ -755,7 +771,7 @@ def estimate_factor_batch(
         ok_b = put(np.stack(oks), 2)
         f0_b = put(np.stack(f0s), 3)
         tol_b = put(np.stack(tols).astype(xzs[0].dtype), 1)
-        with annotate("als_core_batch"):
+        with span("als_core_batch"):
             f, lam, ssr, n_iter, r2 = _als_core_batch(
                 xz_b,
                 m_b,

@@ -21,18 +21,24 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..utils.profiling import ConvergenceTrace, annotate
+from ..utils.profiling import ConvergenceTrace
+from ..utils.telemetry import _heartbeat_cb, heartbeat_every, run_record, span
 
 __all__ = ["run_em_loop", "run_bulk_then_exact"]
 
 
-def _em_while_impl(step, carry, args, tol, max_em_iter: int, stop_at):
+def _em_while_impl(
+    step, carry, args, tol, max_em_iter: int, stop_at, heartbeat_every: int = 0
+):
     """On-device EM loop.  Semantics match the host loop exactly: iterate
     `params, ll = step(params, *args)`; after iteration it >= 2, stop when
     |ll - ll_prev| < tol * (1 + |ll_prev|); always stop at max_em_iter.
     `stop_at` <= max_em_iter (a traced scalar, so chunked checkpointing
     reuses one compilation) bounds this invocation so a checkpointing
-    driver can run the loop in chunks without changing its semantics."""
+    driver can run the loop in chunks without changing its semantics.
+    `heartbeat_every` > 0 (static, DFM_HEARTBEAT) adds a host progress
+    callback every that-many iterations; at the default 0 the compiled
+    program contains no callback at all."""
     dtype = jnp.result_type(tol)
 
     def cond(c):
@@ -46,13 +52,23 @@ def _em_while_impl(step, carry, args, tol, max_em_iter: int, stop_at):
         params, _, ll, it, path = c
         new_params, ll_new = step(params, *args)
         path = path.at[it].set(ll_new.astype(dtype))
+        if heartbeat_every:
+            # unordered callback: the device never waits on the host —
+            # progress reporting without a sync on the iteration path
+            jax.lax.cond(
+                (it + 1) % heartbeat_every == 0,
+                lambda i, v: jax.debug.callback(_heartbeat_cb, i, v),
+                lambda i, v: None,
+                it + 1,
+                ll_new,
+            )
         return new_params, ll, ll_new.astype(dtype), it + 1, path
 
     return jax.lax.while_loop(cond, body, carry)
 
 
 _em_while_plain = partial(
-    jax.jit, static_argnames=("step", "max_em_iter")
+    jax.jit, static_argnames=("step", "max_em_iter", "heartbeat_every")
 )(_em_while_impl)
 # donated variant: the carry (params + convergence scalars + the
 # max_em_iter-long loglik path) is input-output aliased, so XLA reuses
@@ -60,7 +76,9 @@ _em_while_plain = partial(
 # chunk's output into the next.  Unsupported on CPU (XLA warns and
 # copies), hence the utils.compile.donation_enabled() gate in callers.
 _em_while_donated = partial(
-    jax.jit, static_argnames=("step", "max_em_iter"), donate_argnums=(1,)
+    jax.jit,
+    static_argnames=("step", "max_em_iter", "heartbeat_every"),
+    donate_argnums=(1,),
 )(_em_while_impl)
 
 
@@ -137,8 +155,10 @@ def run_em_loop(
     if max_em_iter == 0:
         # zero-iteration contract (the DGR two-step estimator): parameters
         # pass through untouched — the while body cannot even be traced
-        # against a zero-length loglik path
-        return params, np.empty(0), 0, None
+        # against a zero-length loglik path.  collect_path still gets the
+        # (empty) ConvergenceTrace the docstring promises.
+        trace = ConvergenceTrace(trace_name) if collect_path else None
+        return params, np.empty(0), 0, trace
     if checkpoint_path is not None and collect_path:
         raise ValueError(
             "collect_path=True uses a host-synced loop that does not "
@@ -148,13 +168,24 @@ def run_em_loop(
         raise ValueError(f"checkpoint_every must be >= 1, got {checkpoint_every}")
     if checkpoint_path is not None and stop_at is not None:
         raise ValueError("stop_at and checkpoint_path are mutually exclusive")
+    rec = run_record(
+        "run_em_loop",
+        config={
+            "step": getattr(step, "__qualname__", repr(step)),
+            "tol": tol,
+            "max_em_iter": max_em_iter,
+            "collect_path": collect_path,
+            "trace_name": trace_name,
+            "checkpointed": checkpoint_path is not None,
+        },
+    )
     if collect_path:
         host_cap = max_em_iter if stop_at is None else min(max_em_iter, int(stop_at))
         trace = ConvergenceTrace(trace_name)
         llpath = []
         ll_prev = -np.inf
         it = 0
-        with annotate(trace_name):
+        with rec, span(trace_name):
             for it in range(1, host_cap + 1):
                 params, ll = step(params, *args)
                 ll = float(ll)
@@ -163,73 +194,106 @@ def run_em_loop(
                 if it > 1 and abs(ll - ll_prev) < tol * (1.0 + abs(ll_prev)):
                     break
                 ll_prev = ll
+            rec.set(
+                n_iter=it,
+                converged=it < host_cap,
+                final_loglik=llpath[-1] if llpath else None,
+            )
         return params, np.asarray(llpath), it, trace
 
     from ..utils.compile import aot_call, aot_statics, donation_enabled
 
-    tol_arr = jnp.asarray(tol, jnp.result_type(float))
-    donate = donation_enabled()
-    fp_params = params
-    if donate:
-        # the donated program may reuse every carry buffer, including the
-        # caller-visible init params — hand the carry a copy so the
-        # caller's arrays stay valid (run_bulk_then_exact re-reads the
-        # init when the bulk phase goes non-finite)
-        params = jax.tree.map(jnp.copy, params)
-    carry = _fresh_carry(params, tol_arr, max_em_iter)
-    del params  # donated with the carry; only the carry's copy is live
-    loop = _em_while_jit(donate)
-    statics = aot_statics(step, max_em_iter, donate)
+    with rec:
+        tol_arr = jnp.asarray(tol, jnp.result_type(float))
+        donate = donation_enabled()
+        heartbeat = heartbeat_every()
+        fp_params = params
+        if donate:
+            # the donated program may reuse every carry buffer, including the
+            # caller-visible init params — hand the carry a copy so the
+            # caller's arrays stay valid (run_bulk_then_exact re-reads the
+            # init when the bulk phase goes non-finite)
+            params = jax.tree.map(jnp.copy, params)
+        carry = _fresh_carry(params, tol_arr, max_em_iter)
+        del params  # donated with the carry; only the carry's copy is live
+        loop = _em_while_jit(donate)
+        # the heartbeat interval changes the compiled program, so it is part
+        # of the dispatch key (utils.compile._kernel_plan mirrors the 0)
+        statics = aot_statics(step, max_em_iter, donate, heartbeat)
 
-    def _run(carry, bound):
-        # dispatches to a utils.compile.precompile'd executable when one
-        # matches (kernel "em_loop"); otherwise the live jit, whose
-        # compile hits the persistent cache for a known program
-        return aot_call(
-            "em_loop",
-            lambda c, a, t, s: loop(step, c, a, t, max_em_iter, s),
-            carry, args, tol_arr, jnp.asarray(bound, jnp.int32),
-            statics=statics,
+        def _run(carry, bound):
+            # dispatches to a utils.compile.precompile'd executable when one
+            # matches (kernel "em_loop"); otherwise the live jit, whose
+            # compile hits the persistent cache for a known program
+            return aot_call(
+                "em_loop",
+                lambda c, a, t, s: loop(
+                    step, c, a, t, max_em_iter, s, heartbeat
+                ),
+                carry, args, tol_arr, jnp.asarray(bound, jnp.int32),
+                statics=statics,
+            )
+
+        if checkpoint_path is None:
+            bound = max_em_iter if stop_at is None else stop_at
+            with span(trace_name):
+                carry = _run(carry, bound)
+        else:
+            import os
+            import uuid
+
+            from ..utils.checkpoint import load_pytree, save_pytree
+
+            fp = _fingerprint(args, tol, max_em_iter, params=fp_params)
+            if os.path.exists(checkpoint_path):
+                stored = load_pytree(checkpoint_path, {"carry": carry, "fp": ""})
+                if str(stored["fp"]) != fp:
+                    raise ValueError(
+                        f"checkpoint {checkpoint_path!r} was written for "
+                        "different inputs (data/tol/max_em_iter fingerprint "
+                        "mismatch); delete it or use another path"
+                    )
+                carry = jax.tree.map(jnp.asarray, stored["carry"])
+            with span(trace_name):
+                while True:
+                    it = int(carry[3])
+                    if it >= max_em_iter:
+                        break
+                    # reassign unconditionally: under donation the input
+                    # carry's buffers are dead after the call (the output is
+                    # value-identical when cond is false on entry, so keeping
+                    # it preserves the old semantics)
+                    carry = _run(carry, min(it + checkpoint_every, max_em_iter))
+                    if int(carry[3]) == it:  # converged (cond false on entry)
+                        break
+                    # per-writer unique temp name: two concurrent runs
+                    # sharing a checkpoint path must never clobber each
+                    # other's half-written archive before the atomic rename
+                    tmp = (
+                        f"{checkpoint_path}.tmp."
+                        f"{os.getpid()}.{uuid.uuid4().hex[:8]}.npz"
+                    )
+                    try:
+                        save_pytree(tmp, {"carry": carry, "fp": fp})
+                        os.replace(tmp, checkpoint_path)
+                    except BaseException:
+                        try:  # a failed save must not leak its temp file
+                            os.remove(tmp)
+                        except OSError:
+                            pass
+                        raise
+
+        params, _, _, n_iter, path = carry
+        n_iter = int(n_iter)
+        llpath = np.asarray(path)[:n_iter]
+        rec.set(
+            n_iter=n_iter,
+            converged=n_iter < max_em_iter,
+            final_loglik=float(llpath[-1]) if n_iter else None,
+            donate=donate,
+            heartbeat_every=heartbeat,
         )
-
-    if checkpoint_path is None:
-        bound = max_em_iter if stop_at is None else stop_at
-        with annotate(trace_name):
-            carry = _run(carry, bound)
-    else:
-        import os
-
-        from ..utils.checkpoint import load_pytree, save_pytree
-
-        fp = _fingerprint(args, tol, max_em_iter, params=fp_params)
-        if os.path.exists(checkpoint_path):
-            stored = load_pytree(checkpoint_path, {"carry": carry, "fp": ""})
-            if str(stored["fp"]) != fp:
-                raise ValueError(
-                    f"checkpoint {checkpoint_path!r} was written for "
-                    "different inputs (data/tol/max_em_iter fingerprint "
-                    "mismatch); delete it or use another path"
-                )
-            carry = jax.tree.map(jnp.asarray, stored["carry"])
-        with annotate(trace_name):
-            while True:
-                it = int(carry[3])
-                if it >= max_em_iter:
-                    break
-                # reassign unconditionally: under donation the input
-                # carry's buffers are dead after the call (the output is
-                # value-identical when cond is false on entry, so keeping
-                # it preserves the old semantics)
-                carry = _run(carry, min(it + checkpoint_every, max_em_iter))
-                if int(carry[3]) == it:  # converged (cond false on entry)
-                    break
-                tmp = checkpoint_path + ".tmp.npz"
-                save_pytree(tmp, {"carry": carry, "fp": fp})
-                os.replace(tmp, checkpoint_path)
-
-    params, _, _, n_iter, path = carry
-    n_iter = int(n_iter)
-    return params, np.asarray(path)[:n_iter], n_iter, None
+    return params, llpath, n_iter, None
 
 
 def run_bulk_then_exact(

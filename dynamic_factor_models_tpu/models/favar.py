@@ -359,10 +359,21 @@ def _bootstrap_driver(
 ) -> BootstrapIRFs:
     """Shared bootstrap frame: window prep -> point IRFs -> mesh default ->
     vmapped replications (`resample` picks the scheme) -> quantiles."""
+    from ..utils.telemetry import run_record, span
+
     configure_compilation_cache()
-    with on_backend(backend):
+    with on_backend(backend), run_record(
+        "bootstrap_irfs",
+        config={
+            "resample": getattr(resample, "__name__", repr(resample)),
+            "nlag": nlag, "horizon": horizon, "n_reps": n_reps, "seed": seed,
+        },
+    ) as rec:
         # drop leading incomplete rows (factor windows start with NaN lags)
         yw = _prepare_window(y, initperiod, lastperiod)
+        rec.set(shapes={
+            "T": int(yw.shape[0]), "N": int(yw.shape[1]), "n_reps": n_reps,
+        })
 
         var = estimate_var(yw, nlag, 0, yw.shape[0] - 1, withconst=True)
         point = impulse_response(var, "all", horizon)
@@ -371,10 +382,18 @@ def _bootstrap_driver(
         mesh = _default_mesh(mesh)
         # the replication program is embarrassingly parallel: GSPMD shards the
         # vmapped body over the mesh's "rep" axis
-        draws = _run_core(yw, key, nlag, horizon, n_reps, mesh, resample)
+        with span("bootstrap_core"):
+            draws = _run_core(yw, key, nlag, horizon, n_reps, mesh, resample)
 
         q = jnp.nanquantile(draws, jnp.asarray(quantile_levels), axis=0)
         n_finite, frac = _finite_rep_stats(draws, n_reps)
+        rec.set(
+            n_iter=n_reps,
+            converged=bool(frac >= 0.99),
+            final_loglik=None,
+            n_finite=n_finite,
+            finite_fraction=round(frac, 6),
+        )
         return BootstrapIRFs(
             point, draws, q, np.asarray(quantile_levels), n_finite, frac
         )
@@ -436,9 +455,21 @@ def wild_bootstrap_irfs_resumable(
     """
     import hashlib
     import os
+    import uuid
 
-    with on_backend(backend):
+    from ..utils.telemetry import run_record, span
+
+    with on_backend(backend), run_record(
+        "wild_bootstrap_irfs_resumable",
+        config={
+            "nlag": nlag, "horizon": horizon, "n_reps": n_reps,
+            "chunk_reps": chunk_reps, "seed": seed,
+        },
+    ) as rec:
         yw = _prepare_window(y, initperiod, lastperiod)
+        rec.set(shapes={
+            "T": int(yw.shape[0]), "N": int(yw.shape[1]), "n_reps": n_reps,
+        })
         var = estimate_var(yw, nlag, 0, yw.shape[0] - 1, withconst=True)
         point = impulse_response(var, "all", horizon)
         mesh = _default_mesh(mesh)
@@ -462,24 +493,42 @@ def wild_bootstrap_irfs_resumable(
                     done = list(z["draws"][:start_chunk])
 
         key = jax.random.PRNGKey(seed)
+        rec.set(start_chunk=start_chunk, n_chunks=n_chunks)
         for c in range(start_chunk, n_chunks):
-            draws_c = _run_core(
-                yw, jax.random.fold_in(key, c), nlag, horizon, chunk_reps, mesh
-            )
+            with span("bootstrap_chunk"):
+                draws_c = _run_core(
+                    yw, jax.random.fold_in(key, c), nlag, horizon, chunk_reps, mesh
+                )
             done.append(np.asarray(draws_c))
-            tmp = checkpoint_path + ".tmp.npz"  # explicit suffix: savez won't rename
-            np.savez(
-                tmp,
-                draws=np.stack(done),
-                next_chunk=c + 1,
-                spec=spec,
-                fingerprint=fingerprint,
-            )
-            os.replace(tmp, checkpoint_path)
+            # unique suffix: concurrent runs against the same checkpoint path
+            # must not clobber each other's half-written temp file
+            tmp = f"{checkpoint_path}.tmp.{os.getpid()}.{uuid.uuid4().hex[:8]}.npz"
+            try:
+                np.savez(
+                    tmp,
+                    draws=np.stack(done),
+                    next_chunk=c + 1,
+                    spec=spec,
+                    fingerprint=fingerprint,
+                )
+                os.replace(tmp, checkpoint_path)
+            except BaseException:
+                try:
+                    os.remove(tmp)
+                except OSError:
+                    pass
+                raise
 
         draws = jnp.asarray(np.concatenate(done, axis=0)[:n_reps])
         q = jnp.nanquantile(draws, jnp.asarray(quantile_levels), axis=0)
         n_finite, frac = _finite_rep_stats(draws, n_reps)
+        rec.set(
+            n_iter=n_reps,
+            converged=bool(frac >= 0.99),
+            final_loglik=None,
+            n_finite=n_finite,
+            finite_fraction=round(frac, 6),
+        )
         return BootstrapIRFs(
             point, draws, q, np.asarray(quantile_levels), n_finite, frac
         )
@@ -585,8 +634,18 @@ def bootstrap_forecast_fan(
     last `nlag` rows (identical to `forecast.forecast_factors` on the same
     VAR); the fan's median tracks it.
     """
-    with on_backend(backend):
+    from ..utils.telemetry import run_record, span
+
+    with on_backend(backend), run_record(
+        "bootstrap_forecast_fan",
+        config={
+            "nlag": nlag, "horizon": horizon, "n_reps": n_reps, "seed": seed,
+        },
+    ) as rec:
         yw = _prepare_window(y, initperiod, lastperiod)
+        rec.set(shapes={
+            "T": int(yw.shape[0]), "N": int(yw.shape[1]), "n_reps": n_reps,
+        })
         betahat, _, _ = _fit_dense_var(yw, nlag)
         point = _wild_recursion(
             yw[-nlag:], betahat,
@@ -595,10 +654,12 @@ def bootstrap_forecast_fan(
 
         key = jax.random.PRNGKey(seed)
         mesh = _default_mesh(mesh)
-        draws = _dispatch_reps(
-            _fan_core, _sharded_fan_core, mesh, n_reps, (yw, key, nlag, horizon)
-        )
+        with span("fan_core"):
+            draws = _dispatch_reps(
+                _fan_core, _sharded_fan_core, mesh, n_reps, (yw, key, nlag, horizon)
+            )
         q = jnp.nanquantile(draws, jnp.asarray(quantile_levels), axis=0)
+        rec.set(n_iter=n_reps, converged=True, final_loglik=None)
         return ForecastFan(point, draws, q, np.asarray(quantile_levels))
 
 

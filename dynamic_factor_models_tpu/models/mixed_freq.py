@@ -315,7 +315,16 @@ def estimate_mixed_freq_dfm(
         )
     if gram_dtype is not None and checkpoint_path is not None:
         raise ValueError("gram_dtype is not combinable with checkpoint_path")
-    with on_backend(backend):
+    from ..utils.telemetry import run_record
+
+    with on_backend(backend), run_record(
+        "estimate_mixed_freq_dfm",
+        config={
+            "accel": accel, "gram_dtype": gram_dtype, "tol": tol,
+            "max_em_iter": max_em_iter,
+            "checkpointed": checkpoint_path is not None,
+        },
+    ) as rec:
         x = jnp.asarray(x)
         is_q = np.asarray(is_quarterly, bool)
         if is_q.shape != (x.shape[1],):
@@ -357,8 +366,13 @@ def estimate_mixed_freq_dfm(
         from .emloop import run_em_loop
 
         T0, N0 = xz.shape
+        rec.set(shapes={
+            "T": T0, "N": N0, "r": r, "p": p,
+            "n_quarterly": int(is_q.sum()),
+        })
         if buckets is not None:
             Tb, Nb = bucket_shape(T0, N0, *buckets)
+            rec.set(bucket=[Tb, Nb])
             xz, m_arr, tw = pad_panel(xz, m_arr, Tb, Nb)
             # padded series: zero loadings, unit R, monthly aggregation
             # row (fully masked, so any valid agg pattern is inert)
@@ -405,6 +419,11 @@ def estimate_mixed_freq_dfm(
             )
         if accel == "squarem":
             params = params.params  # unwrap SquaremState
+        rec.set(
+            n_iter=it,
+            converged=it < max_em_iter,
+            final_loglik=float(llpath[-1]) if len(llpath) else None,
+        )
 
         # bucketed path: smooth at the bucket shape, then slice the
         # readout (and the params) back to the raw panel

@@ -306,10 +306,20 @@ def fit_ms_dfm(
     variances (Kim-Nelson switching volatility; sigma2[0] = 1 stays the
     scale anchor, so the RATIOS are what is identified and fitted).
     """
-    with on_backend(backend):
+    from ..utils.telemetry import run_record
+
+    with on_backend(backend), run_record(
+        "fit_ms_dfm",
+        config={
+            "n_regimes": n_regimes, "n_steps": n_steps, "lr": lr,
+            "n_restarts": n_restarts,
+            "switching_variance": switching_variance,
+        },
+    ) as rec:
         from ..ops.linalg import standardize_data
 
         x = jnp.asarray(x)
+        rec.set(shapes={"T": int(x.shape[0]), "N": int(x.shape[1]), "r": 1})
         xstd, stds = standardize_data(x)  # preserves the NaN pattern
         mask = mask_of(xstd)
         n_mean = (fillz(x) * mask).sum(axis=0) / jnp.maximum(mask.sum(axis=0), 1)
@@ -397,6 +407,13 @@ def fit_ms_dfm(
             candidates, key=lambda c: c[0]
         )
         losses = losses_all[best]
+        rec.set(
+            n_iter=n_steps,
+            converged=len(candidates) == n_restarts,
+            final_loglik=float(ll),
+            n_finite_restarts=len(candidates),
+            best_restart=int(best),
+        )
         smoothed = kim_smoother_probs(params, filt_probs, pred_probs)
         factor = (filt_probs * (params.mu[None, :] + m_filt)).sum(axis=1)
         return MSDFMResults(

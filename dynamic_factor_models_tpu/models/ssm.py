@@ -1077,7 +1077,16 @@ def estimate_dfm_em(
             "bucket requires method='sequential' (the PanelStats path "
             "carries the time-validity weight padding needs)"
         )
-    with on_backend(backend):
+    from ..utils.telemetry import run_record
+
+    with on_backend(backend), run_record(
+        "estimate_dfm_em",
+        config={
+            "method": method, "accel": accel, "gram_dtype": gram_dtype,
+            "tol": tol, "max_em_iter": max_em_iter,
+            "checkpointed": checkpoint_path is not None,
+        },
+    ) as rec:
         data = jnp.asarray(data)
         inclcode = np.asarray(inclcode)
         xz, m_arr, stds, n_mean = _window_panel(
@@ -1092,6 +1101,7 @@ def estimate_dfm_em(
         from .emloop import run_em_loop
 
         T0, N0 = xz.shape
+        rec.set(shapes={"T": T0, "N": N0, "r": r, "p": config.n_factorlag})
         if method == "sequential":
             step = em_step_stats
             if buckets is not None:
@@ -1099,6 +1109,7 @@ def estimate_dfm_em(
                 # program carries tw, so every panel in the bucket shares
                 # ONE compiled executable (same avals, same pytree)
                 Tb, Nb = bucket_shape(T0, N0, *buckets)
+                rec.set(bucket=[Tb, Nb])
                 xz_b, m_b, tw = pad_panel(xz, m_arr, Tb, Nb)
                 params = pad_ssm_params(params, Nb)
                 stats = compute_panel_stats(xz_b, m_b)._replace(tw=tw)
@@ -1147,6 +1158,11 @@ def estimate_dfm_em(
 
         if accel == "squarem":
             params = params.params  # unwrap SquaremState
+        rec.set(
+            n_iter=n_iter,
+            converged=n_iter < max_em_iter,
+            final_loglik=float(llpath[-1]) if len(llpath) else None,
+        )
         # on the bucketed path the smoother also runs at the bucket shape
         # (padded cells are NaN -> missing; trailing all-missing periods
         # add no information at real times), then the readout slices back

@@ -252,7 +252,15 @@ def estimate_dfm_em_ar(
     configure_compilation_cache()
     if accel not in (None, "squarem"):
         raise ValueError(f"accel must be None or 'squarem', got {accel!r}")
-    with on_backend(backend):
+    from ..utils.telemetry import run_record
+
+    with on_backend(backend), run_record(
+        "estimate_dfm_em_ar",
+        config={
+            "accel": accel, "tol": tol, "max_em_iter": max_em_iter,
+            "checkpointed": checkpoint_path is not None,
+        },
+    ) as rec:
         data = jnp.asarray(data)
         inclcode = np.asarray(inclcode)
         em0 = estimate_dfm_em(
@@ -275,6 +283,10 @@ def estimate_dfm_em_ar(
 
         from .emloop import run_em_loop
 
+        rec.set(shapes={
+            "T": int(xz.shape[0]), "N": int(xz.shape[1]),
+            "r": config.nfac_u, "p": config.n_factorlag,
+        })
         step = em_step_ar
         if accel == "squarem":
             from .emaccel import squarem, squarem_state
@@ -288,6 +300,11 @@ def estimate_dfm_em_ar(
         )
         if accel == "squarem":
             params = params.params  # unwrap SquaremState
+        rec.set(
+            n_iter=it,
+            converged=it < max_em_iter,
+            final_loglik=float(llpath[-1]) if len(llpath) else None,
+        )
 
         means, covs, pmeans, pcovs, _ = _filter_ar(params, xz, m_arr)
         s_sm, _, _ = _smoother_ar(params, means, covs, pmeans, pcovs)

@@ -56,7 +56,15 @@ def main(argv=None) -> int:
                     help="also render the beyond-reference capability "
                          "panels (SV volatility, posterior IRFs, TVP "
                          "loadings, coherence) — adds a few minutes")
+    ap.add_argument("--telemetry", metavar="PATH", default=None,
+                    help="record a RunRecord JSONL for every estimation "
+                         "call (sets DFM_TELEMETRY for this run)")
     args = ap.parse_args(argv)
+
+    if args.telemetry:
+        path = os.path.abspath(args.telemetry)
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        os.environ["DFM_TELEMETRY"] = path
 
     import jax
 

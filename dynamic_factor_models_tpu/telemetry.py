@@ -1,0 +1,16 @@
+"""Alias + CLI entry for the telemetry layer (implementation:
+utils/telemetry.py).
+
+    python -m dynamic_factor_models_tpu.telemetry summarize run.jsonl
+
+renders per-run and aggregate tables from a ``DFM_TELEMETRY`` JSONL file;
+``--entry`` filters to one entry point, ``--json`` dumps raw records.
+"""
+
+from .utils.telemetry import *  # noqa: F401,F403
+from .utils.telemetry import main
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -1,3 +1,4 @@
+from . import telemetry
 from .backend import on_backend, resolve_device
 from .compile import (
     BASELINE_PANEL_SHAPES,

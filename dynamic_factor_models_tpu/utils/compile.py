@@ -630,8 +630,9 @@ def _kernel_plan(spec: CompileSpec):
              _sds((), jnp.int32)),
             {},
             # must mirror run_em_loop's dispatch key exactly: (step,
-            # max_em_iter, donate)
-            aot_statics(ssm.em_step_stats, spec.max_em_iter, donate),
+            # max_em_iter, donate, heartbeat_every) — precompiled loops
+            # are heartbeat-free, so a DFM_HEARTBEAT run recompiles live
+            aot_statics(ssm.em_step_stats, spec.max_em_iter, donate, 0),
             loop_inputs,
         )
 

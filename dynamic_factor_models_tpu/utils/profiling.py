@@ -42,4 +42,7 @@ class ConvergenceTrace:
     def iters_per_sec(self) -> float:
         if len(self.times) < 2:
             return float("nan")
-        return (len(self.times) - 1) / (self.times[-1] - self.times[0])
+        dt = self.times[-1] - self.times[0]
+        if dt <= 0.0:  # sub-tick loop: rate is indeterminate, not an error
+            return float("nan")
+        return (len(self.times) - 1) / dt

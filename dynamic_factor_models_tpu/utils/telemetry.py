@@ -1,0 +1,710 @@
+"""Structured telemetry layer: metrics registry, spans, and RunRecords.
+
+The reference's only observability was a commented-out ``println("diff =
+...")``; `utils.profiling.ConvergenceTrace` replaced that for a single
+loop, but the compile-once layer (utils/compile.py) made runtime behavior
+— AOT hits, bucket padding, donation, checkpoint chunking, bf16->exact
+phase handoffs — far too rich to debug from one iters/sec number.  This
+module makes every estimation call leave a machine-readable trace, in the
+BlackJAX spirit of keeping the inference loop separate from its
+instrumentation:
+
+metrics registry
+    Process-wide counters / gauges / timers (``inc``, ``gauge_set``,
+    ``observe``), snapshot via ``snapshot()``.  A ``jax.monitoring``
+    bridge folds JAX's own events — including the persistent
+    compilation-cache hits/misses utils/compile.py counts — into the
+    same registry (event names keyed as ``jax/...``).
+
+spans
+    ``span(name)`` pairs a ``jax.profiler.TraceAnnotation`` (visible in
+    Perfetto/TensorBoard traces) with wall-clock recording into the
+    registry AND into every RunRecord open on the current thread, so a
+    record's ``phase_s`` splits its wall time by named phase (e.g.
+    ``em_dfm_sequential_bf16`` vs ``em_dfm_sequential``).
+
+RunRecords
+    ``run_record(entry, ...)`` brackets an estimation entry point.  On
+    exit it captures wall time, platform/device/precision/donation,
+    per-phase span seconds, per-kernel compile/run/AOT counter DELTAS
+    (utils.compile.counters) plus persistent-cache event deltas, and
+    device memory stats (``device.memory_stats()`` with a live-buffer
+    fallback).  Records append to an in-process ring buffer (``records``)
+    and, when ``DFM_TELEMETRY=<path>`` is set, to a JSONL file — one
+    line per run, written with a single append so concurrent writers
+    interleave at line granularity.  ``DFM_PROFILE_DIR=<dir>`` wraps the
+    OUTERMOST record in ``jax.profiler`` start/stop, so one env var
+    yields a Perfetto trace with the spans as named regions.
+
+heartbeat
+    ``DFM_HEARTBEAT=k`` (off by default) adds a ``jax.debug.callback``
+    every k EM iterations inside the on-device ``lax.while_loop``
+    (models/emloop.py), reporting (iteration, loglik) into the registry
+    without a host sync on the default path — the default program
+    contains no callback at all.
+
+Disabled-path guarantee: with neither env var set and no explicit
+``enable()``, ``run_record`` returns a shared no-op singleton — no
+allocation, no registry traffic, nothing on the EM hot path (pinned by
+tests/test_perf_regression.py).
+
+CLI: ``python -m dynamic_factor_models_tpu.telemetry summarize run.jsonl``
+renders per-run and per-entry aggregate tables (docs/observability.md).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import uuid
+
+import numpy as np
+
+import jax
+
+__all__ = [
+    "enabled",
+    "enable",
+    "disable",
+    "inc",
+    "gauge_set",
+    "observe",
+    "snapshot",
+    "reset",
+    "records",
+    "span",
+    "run_record",
+    "sink_path",
+    "device_memory_stats",
+    "register_jax_monitoring_bridge",
+    "heartbeat_every",
+    "summarize",
+    "main",
+]
+
+_lock = threading.RLock()
+_tls = threading.local()
+
+# explicit override: None = follow the env vars, True/False = forced
+_explicit_enabled: bool | None = None
+_explicit_sink: str | None = None
+
+_counters: dict[str, float] = {}
+_gauges: dict[str, float] = {}
+# timers: name -> [n, total_s, min_s, max_s]
+_timers: dict[str, list] = {}
+_records: list[dict] = []
+_MAX_RECORDS = 256
+
+_profile_depth = 0
+_profile_active = False
+_bridge_registered = False
+
+
+# ---------------------------------------------------------------------------
+# enablement
+# ---------------------------------------------------------------------------
+
+
+def enabled() -> bool:
+    """Telemetry is on when ``DFM_TELEMETRY`` or ``DFM_PROFILE_DIR`` is set,
+    or after an explicit ``enable()``; ``disable()`` forces off."""
+    if _explicit_enabled is not None:
+        return _explicit_enabled
+    return bool(
+        os.environ.get("DFM_TELEMETRY") or os.environ.get("DFM_PROFILE_DIR")
+    )
+
+
+def enable(sink: str | None = None) -> None:
+    """Force telemetry on in-process; ``sink`` optionally points the JSONL
+    file without touching the environment."""
+    global _explicit_enabled, _explicit_sink
+    _explicit_enabled = True
+    if sink is not None:
+        _explicit_sink = sink
+    register_jax_monitoring_bridge()
+
+
+def disable() -> None:
+    global _explicit_enabled, _explicit_sink
+    _explicit_enabled = False
+    _explicit_sink = None
+
+
+def sink_path() -> str | None:
+    """The active JSONL sink path (``enable(sink=...)`` override, else the
+    ``DFM_TELEMETRY`` env var), or None."""
+    return _explicit_sink or os.environ.get("DFM_TELEMETRY") or None
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+
+def inc(name: str, value: float = 1.0) -> None:
+    with _lock:
+        _counters[name] = _counters.get(name, 0.0) + value
+
+
+def gauge_set(name: str, value: float) -> None:
+    with _lock:
+        _gauges[name] = float(value)
+
+
+def observe(name: str, seconds: float) -> None:
+    """Record one duration into the named timer (count/total/min/max)."""
+    with _lock:
+        t = _timers.get(name)
+        if t is None:
+            _timers[name] = [1, seconds, seconds, seconds]
+        else:
+            t[0] += 1
+            t[1] += seconds
+            t[2] = min(t[2], seconds)
+            t[3] = max(t[3], seconds)
+
+
+def snapshot() -> dict:
+    """In-process view of every metric: counters, gauges, timers (as
+    n/total/min/max dicts), record count, and the compile-layer counters."""
+    from .compile import counters as compile_counters
+    from .compile import persistent_cache_events
+
+    with _lock:
+        return {
+            "counters": dict(_counters),
+            "gauges": dict(_gauges),
+            "timers": {
+                k: {"n": t[0], "total_s": t[1], "min_s": t[2], "max_s": t[3]}
+                for k, t in _timers.items()
+            },
+            "n_records": len(_records),
+            "compile": compile_counters(),
+            "persistent_cache": persistent_cache_events(),
+        }
+
+
+def reset() -> None:
+    """Clear the registry and the in-process record buffer (the
+    compile-layer counters have their own ``reset_counters``)."""
+    with _lock:
+        _counters.clear()
+        _gauges.clear()
+        _timers.clear()
+        _records.clear()
+
+
+def records() -> list[dict]:
+    """The in-process RunRecord ring buffer (most recent last)."""
+    with _lock:
+        return list(_records)
+
+
+# ---------------------------------------------------------------------------
+# jax.monitoring bridge
+# ---------------------------------------------------------------------------
+
+
+def _on_event(event: str, **kwargs) -> None:
+    if enabled():
+        inc("jax" + event)
+
+
+def _on_event_duration(event: str, duration_secs: float, **kwargs) -> None:
+    if enabled():
+        observe("jax" + event, float(duration_secs))
+
+
+def register_jax_monitoring_bridge() -> None:
+    """Fold jax.monitoring events (compilation-cache hits/misses, backend
+    compile durations, ...) into the registry.  Idempotent; listeners stay
+    registered for the process lifetime but record only while enabled."""
+    global _bridge_registered
+    with _lock:
+        if _bridge_registered:
+            return
+        try:
+            jax.monitoring.register_event_listener(_on_event)
+            jax.monitoring.register_event_duration_secs_listener(
+                _on_event_duration
+            )
+            _bridge_registered = True
+        except Exception:  # monitoring API moved/absent: registry still works
+            pass
+
+
+# ---------------------------------------------------------------------------
+# spans
+# ---------------------------------------------------------------------------
+
+
+def _record_stack() -> list:
+    s = getattr(_tls, "stack", None)
+    if s is None:
+        s = _tls.stack = []
+    return s
+
+
+class _Span:
+    """`with span("phase"): ...` — TraceAnnotation + wall clock into the
+    registry and every open RunRecord on this thread."""
+
+    __slots__ = ("name", "_t0", "_ann")
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def __enter__(self):
+        self._ann = jax.profiler.TraceAnnotation(self.name)
+        self._ann.__enter__()
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        dt = time.perf_counter() - self._t0
+        self._ann.__exit__(exc_type, exc, tb)
+        if enabled():
+            observe("span." + self.name, dt)
+        for rec in _record_stack():
+            rec.add_phase(self.name, dt)
+        return False
+
+
+def span(name: str) -> _Span:
+    return _Span(name)
+
+
+# ---------------------------------------------------------------------------
+# heartbeat (models/emloop.py wires this into the on-device while_loop)
+# ---------------------------------------------------------------------------
+
+
+def heartbeat_every() -> int:
+    """``DFM_HEARTBEAT=k`` -> k (>=1) EM iterations between on-device
+    progress callbacks; 0 (default/unset/invalid) keeps the compiled loop
+    callback-free."""
+    raw = os.environ.get("DFM_HEARTBEAT", "0") or "0"
+    try:
+        return max(0, int(raw))
+    except ValueError:
+        return 0
+
+
+def _heartbeat_cb(it, ll) -> None:
+    """Host-side target of the ``jax.debug.callback`` in the EM while-loop
+    body.  Gated by DFM_HEARTBEAT itself, so it records even when the
+    JSONL sink is unconfigured."""
+    try:
+        it_i, ll_f = int(it), float(ll)
+    except (TypeError, ValueError):
+        return
+    inc("em_heartbeat_events")
+    gauge_set("em_heartbeat_iter", it_i)
+    gauge_set("em_heartbeat_loglik", ll_f)
+    if os.environ.get("DFM_HEARTBEAT_STDERR"):
+        import sys
+
+        print(f"dfm heartbeat: iter={it_i} loglik={ll_f:.6g}",
+              file=sys.stderr, flush=True)
+
+
+# ---------------------------------------------------------------------------
+# device memory
+# ---------------------------------------------------------------------------
+
+
+def device_memory_stats(device=None) -> dict:
+    """Allocator stats of the (default) device: ``memory_stats()`` where
+    the backend implements it (TPU/GPU), else a live-buffer byte count
+    (CPU's allocator is untracked), else ``{"source": "unavailable"}``."""
+    try:
+        d = device if device is not None else jax.devices()[0]
+    except Exception:
+        return {"source": "unavailable"}
+    try:
+        ms = d.memory_stats()
+    except Exception:
+        ms = None
+    if ms:
+        out = {"source": "memory_stats"}
+        for k in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit",
+                  "largest_alloc_size"):
+            if k in ms:
+                out[k] = int(ms[k])
+        return out
+    try:
+        total = 0
+        n = 0
+        for a in jax.live_arrays():
+            try:
+                if d in a.devices():
+                    total += int(a.nbytes)
+                    n += 1
+            except Exception:
+                continue
+        return {"source": "live_buffers", "bytes_in_use": total, "n_buffers": n}
+    except Exception:
+        return {"source": "unavailable"}
+
+
+# ---------------------------------------------------------------------------
+# RunRecords
+# ---------------------------------------------------------------------------
+
+
+def _jsonable(obj):
+    if obj is None or isinstance(obj, (bool, int, float, str)):
+        return obj
+    if isinstance(obj, dict):
+        return {str(k): _jsonable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_jsonable(v) for v in obj]
+    if isinstance(obj, np.ndarray):
+        return _jsonable(obj.tolist())
+    if hasattr(obj, "item") and getattr(obj, "ndim", None) in (0, None):
+        try:
+            return _jsonable(obj.item())
+        except Exception:
+            return repr(obj)
+    return repr(obj)
+
+
+def _counters_delta(before: dict, after: dict) -> dict:
+    out = {}
+    for kernel, c in after.items():
+        b = before.get(kernel, {})
+        d = {}
+        for field, v in c.items():
+            dv = v - b.get(field, 0)
+            if dv:
+                d[field] = round(dv, 6) if isinstance(dv, float) else dv
+        if d:
+            out[kernel] = d
+    return out
+
+
+def _flat_delta(before: dict, after: dict) -> dict:
+    return {
+        k: after[k] - before.get(k, 0)
+        for k in after
+        if after[k] - before.get(k, 0)
+    }
+
+
+def _maybe_start_profile() -> None:
+    global _profile_depth, _profile_active
+    pdir = os.environ.get("DFM_PROFILE_DIR")
+    with _lock:
+        _profile_depth += 1
+        if not pdir or _profile_active or _profile_depth != 1:
+            return
+        try:
+            jax.profiler.start_trace(pdir)
+            _profile_active = True
+        except Exception:  # a trace already running elsewhere: skip, not die
+            pass
+
+
+def _maybe_stop_profile() -> None:
+    global _profile_depth, _profile_active
+    with _lock:
+        _profile_depth = max(0, _profile_depth - 1)
+        if _profile_depth != 0 or not _profile_active:
+            return
+        try:
+            jax.profiler.stop_trace()
+        except Exception:
+            pass
+        _profile_active = False
+
+
+def _emit(data: dict) -> None:
+    with _lock:
+        _records.append(data)
+        del _records[:-_MAX_RECORDS]
+    inc("records." + data.get("entry", "?"))
+    observe("run." + data.get("entry", "?"), data.get("wall_s", 0.0))
+    path = sink_path()
+    if not path:
+        return
+    line = json.dumps(data, separators=(",", ":"), default=repr) + "\n"
+    try:
+        parent = os.path.dirname(path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        # one append-mode write per record: concurrent writers (bench
+        # children, watcher runs) interleave whole lines, never fragments
+        with open(path, "a") as f:
+            f.write(line)
+    except OSError:
+        pass  # a broken sink must never fail the estimation itself
+
+
+class RunRecord:
+    """Context manager bracketing one estimation call.  Entry points call
+    ``rec.set(...)`` as facts become known (shapes, bucket, n_iter,
+    converged, final_loglik); everything environmental is captured here."""
+
+    __slots__ = ("data", "phase_s", "_t0", "_c0", "_p0")
+
+    active = True  # guard for callers whose rec.set args would force a sync
+
+    def __init__(self, entry: str, fields: dict):
+        self.data = {
+            "run_id": uuid.uuid4().hex[:12],
+            "entry": entry,
+            "time_unix": round(time.time(), 3),
+        }
+        for k, v in fields.items():
+            self.data[k] = _jsonable(v)
+        self.phase_s: dict[str, float] = {}
+
+    def set(self, **kwargs) -> "RunRecord":
+        for k, v in kwargs.items():
+            self.data[k] = _jsonable(v)
+        return self
+
+    def add_phase(self, name: str, dt: float) -> None:
+        self.phase_s[name] = round(self.phase_s.get(name, 0.0) + dt, 6)
+
+    def __enter__(self):
+        from .compile import counters, persistent_cache_events
+
+        stack = _record_stack()
+        if stack:
+            self.data.setdefault("parent", stack[-1].data["run_id"])
+        stack.append(self)
+        self._c0 = counters()
+        self._p0 = persistent_cache_events()
+        _maybe_start_profile()
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        wall = time.perf_counter() - self._t0
+        _maybe_stop_profile()
+        stack = _record_stack()
+        if self in stack:
+            stack.remove(self)
+        from .compile import counters, donation_enabled
+        from .compile import persistent_cache_events
+
+        d = self.data
+        d["wall_s"] = round(wall, 6)
+        d["phase_s"] = dict(self.phase_s)
+        d["counters_delta"] = _counters_delta(self._c0, counters())
+        d["persistent_cache_delta"] = _flat_delta(
+            self._p0, persistent_cache_events()
+        )
+        try:
+            d.setdefault("platform", jax.default_backend())
+            dev = jax.devices()[0]
+            d.setdefault("device_kind", dev.device_kind)
+            d.setdefault("n_devices", jax.device_count())
+        except Exception:
+            d.setdefault("platform", "unknown")
+            d.setdefault("device_kind", "unknown")
+            d.setdefault("n_devices", 0)
+        d.setdefault("x64", bool(jax.config.jax_enable_x64))
+        try:
+            d.setdefault("donate", donation_enabled())
+        except Exception:
+            d.setdefault("donate", False)
+        d["memory"] = device_memory_stats()
+        if exc_type is not None:
+            d["error"] = f"{exc_type.__name__}: {exc}"
+        _emit(d)
+        return False
+
+
+class _NullRecord:
+    """Shared no-op record: the unconfigured path allocates nothing and
+    touches no shared state (`run_record` returns this singleton)."""
+
+    __slots__ = ()
+
+    active = False
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        return False
+
+    def set(self, **kwargs):
+        return self
+
+    def add_phase(self, name, dt):
+        return None
+
+
+_NULL_RECORD = _NullRecord()
+
+
+def run_record(entry: str, **fields):
+    """Bracket one estimation call; returns the no-op singleton when
+    telemetry is unconfigured (see module docstring)."""
+    if not enabled():
+        return _NULL_RECORD
+    register_jax_monitoring_bridge()
+    return RunRecord(entry, fields)
+
+
+# ---------------------------------------------------------------------------
+# summarize CLI
+# ---------------------------------------------------------------------------
+
+
+def _load_jsonl(path: str) -> list[dict]:
+    out = []
+    with open(path) as f:
+        for ln, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError:
+                out.append({"entry": f"<unparseable line {ln}>", "error": "bad json"})
+    return out
+
+
+def _fmt_table(headers: list[str], rows: list[list[str]]) -> str:
+    widths = [len(h) for h in headers]
+    for row in rows:
+        for i, cell in enumerate(row):
+            widths[i] = max(widths[i], len(cell))
+    def line(cells):
+        return "  ".join(c.ljust(w) for c, w in zip(cells, widths)).rstrip()
+    sep = "  ".join("-" * w for w in widths)
+    return "\n".join([line(headers), sep] + [line(r) for r in rows])
+
+
+def _shape_str(rec: dict) -> str:
+    s = rec.get("shapes") or {}
+    if "T" in s and "N" in s:
+        extra = "".join(
+            f",{k}={s[k]}" for k in ("r", "p", "n_reps") if k in s
+        )
+        return f"{s['T']}x{s['N']}{extra}"
+    return ",".join(f"{k}={v}" for k, v in s.items()) or "-"
+
+
+def _mem_mb(rec: dict) -> str:
+    m = rec.get("memory") or {}
+    b = m.get("peak_bytes_in_use", m.get("bytes_in_use"))
+    return f"{b / 1e6:.1f}" if isinstance(b, (int, float)) else "-"
+
+
+def _aot_hm(rec: dict) -> tuple[int, int]:
+    h = m = 0
+    for c in (rec.get("counters_delta") or {}).values():
+        h += c.get("aot_hits", 0)
+        m += c.get("aot_misses", 0)
+    return h, m
+
+
+def summarize(path: str, entry: str | None = None) -> str:
+    """Per-run and per-entry aggregate tables of a RunRecord JSONL file."""
+    recs = _load_jsonl(path)
+    if entry:
+        recs = [r for r in recs if r.get("entry") == entry]
+    if not recs:
+        return f"no records in {path}" + (f" for entry {entry!r}" if entry else "")
+
+    rows = []
+    for r in recs:
+        ts = time.strftime(
+            "%H:%M:%S", time.localtime(r.get("time_unix", 0))
+        )
+        h, m = _aot_hm(r)
+        ll = r.get("final_loglik")
+        rows.append([
+            ts,
+            str(r.get("entry", "?")),
+            str(r.get("platform", "?")),
+            _shape_str(r),
+            str(r.get("n_iter", "-")),
+            {True: "y", False: "n"}.get(r.get("converged"), "-"),
+            f"{ll:.5g}" if isinstance(ll, (int, float)) else "-",
+            f"{r.get('wall_s', 0.0):.3f}",
+            _mem_mb(r),
+            f"{h}/{m}",
+            "ERR" if r.get("error") else "",
+        ])
+    per_run = _fmt_table(
+        ["time", "entry", "plat", "shape", "iters", "conv", "loglik",
+         "wall_s", "peak_MB", "aot h/m", ""],
+        rows,
+    )
+
+    agg: dict[str, dict] = {}
+    for r in recs:
+        a = agg.setdefault(r.get("entry", "?"), {
+            "runs": 0, "errors": 0, "wall": 0.0, "iters": 0, "conv": 0,
+            "compile_s": 0.0, "hits": 0, "misses": 0,
+        })
+        a["runs"] += 1
+        a["errors"] += 1 if r.get("error") else 0
+        a["wall"] += r.get("wall_s", 0.0) or 0.0
+        a["iters"] += r.get("n_iter") or 0
+        a["conv"] += 1 if r.get("converged") else 0
+        for c in (r.get("counters_delta") or {}).values():
+            a["compile_s"] += c.get("compile_s", 0.0)
+        h, m = _aot_hm(r)
+        a["hits"] += h
+        a["misses"] += m
+    arows = [
+        [
+            e,
+            str(a["runs"]),
+            str(a["errors"]),
+            f"{a['wall']:.3f}",
+            f"{a['wall'] / a['runs']:.3f}",
+            f"{a['iters'] / a['runs']:.1f}",
+            f"{100.0 * a['conv'] / a['runs']:.0f}%",
+            f"{a['compile_s']:.3f}",
+            f"{a['hits']}/{a['misses']}",
+        ]
+        for e, a in sorted(agg.items())
+    ]
+    aggregate = _fmt_table(
+        ["entry", "runs", "err", "wall_s", "mean_s", "mean_iters",
+         "conv%", "compile_s", "aot h/m"],
+        arows,
+    )
+    return (
+        f"{len(recs)} record(s) in {path}\n\n{per_run}\n\n"
+        f"aggregate by entry\n{aggregate}"
+    )
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m dynamic_factor_models_tpu.telemetry",
+        description="Inspect RunRecord JSONL files written via DFM_TELEMETRY.",
+    )
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    sm = sub.add_parser("summarize", help="per-run + aggregate tables")
+    sm.add_argument("path", help="RunRecord .jsonl file")
+    sm.add_argument("--entry", default=None, help="filter to one entry point")
+    sm.add_argument("--json", action="store_true",
+                    help="dump the parsed records as a JSON array instead")
+    args = ap.parse_args(argv)
+    if not os.path.exists(args.path):
+        print(f"no such file: {args.path}")
+        return 1
+    if args.json:
+        recs = _load_jsonl(args.path)
+        if args.entry:
+            recs = [r for r in recs if r.get("entry") == args.entry]
+        print(json.dumps(recs, indent=1))
+        return 0
+    print(summarize(args.path, entry=args.entry))
+    return 0

@@ -158,3 +158,37 @@ def test_budget_has_teeth():
         (BUDGET_EM_MF_MS, 68.0),
     ):
         assert 2.0 * calibrated > budget, (budget, calibrated)
+
+
+@pytest.mark.telemetry
+def test_disabled_telemetry_path_is_free(monkeypatch):
+    """The observability layer must cost nothing when unconfigured: every
+    `run_record()` call returns the same no-op singleton (no per-call
+    allocation), a disabled with-block leaves the registry untouched, and
+    ~20k disabled record cycles complete in well under the time one real
+    JSONL write would take."""
+    from dynamic_factor_models_tpu.utils import telemetry as T
+
+    monkeypatch.delenv("DFM_TELEMETRY", raising=False)
+    monkeypatch.delenv("DFM_PROFILE_DIR", raising=False)
+    monkeypatch.setattr(T, "_explicit_enabled", None)
+    monkeypatch.setattr(T, "_explicit_sink", None)
+    assert not T.enabled()
+
+    a = T.run_record("x", config={"k": 1})
+    b = T.run_record("y")
+    assert a is b and a.active is False
+
+    before = T.snapshot()
+    t0 = time.perf_counter()
+    for _ in range(20_000):
+        with T.run_record("z") as rec:
+            rec.set(n_iter=1)
+            rec.add_phase("p", 0.0)
+    dt = time.perf_counter() - t0
+    after = T.snapshot()
+    assert after["counters"] == before["counters"]
+    assert after["timers"] == before["timers"]
+    # 20k no-op cycles: generous 0.5 s ceiling (~25 us/cycle) — a path that
+    # accidentally allocates records or touches the filesystem blows this
+    assert dt < 0.5, f"disabled-path run_record cost {dt:.3f}s for 20k cycles"

@@ -1,0 +1,427 @@
+"""Observability layer (utils/telemetry.py): RunRecord field completeness
+for every estimation entry point, JSONL round-trip + line atomicity,
+compile-counter delta correctness, heartbeat parity, the disabled-path
+singleton, the summarize CLI, and the satellite fixes that rode along
+(zero-iteration trace contract, iters_per_sec guard, checkpoint temp-file
+hygiene)."""
+
+import json
+import os
+import threading
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dynamic_factor_models_tpu.utils import telemetry as T
+
+pytestmark = pytest.mark.telemetry
+
+# every RunRecord must carry these regardless of entry point (ISSUE
+# acceptance bar); entry points add shapes/bucket/n_iter detail on top
+REQUIRED_FIELDS = {
+    "entry", "run_id", "time_unix", "wall_s", "platform", "device_kind",
+    "n_devices", "x64", "donate", "shapes", "n_iter", "converged",
+    "phase_s", "counters_delta", "persistent_cache_delta", "memory",
+}
+
+
+@pytest.fixture
+def sink(tmp_path, monkeypatch):
+    """Point DFM_TELEMETRY at a fresh JSONL file and clear the registry."""
+    path = str(tmp_path / "runs.jsonl")
+    monkeypatch.setenv("DFM_TELEMETRY", path)
+    monkeypatch.delenv("DFM_PROFILE_DIR", raising=False)
+    monkeypatch.setattr(T, "_explicit_enabled", None)
+    monkeypatch.setattr(T, "_explicit_sink", None)
+    T.reset()
+    return path
+
+
+def _recs(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def _by_entry(path, entry):
+    out = [r for r in _recs(path) if r["entry"] == entry]
+    assert out, f"no record for entry {entry!r}"
+    return out
+
+
+def _assert_complete(rec):
+    missing = REQUIRED_FIELDS - set(rec)
+    assert not missing, f"record {rec['entry']} missing fields: {missing}"
+    assert rec["wall_s"] > 0
+    assert isinstance(rec["phase_s"], dict)
+    assert isinstance(rec["counters_delta"], dict)
+    assert rec["memory"].get("source") in (
+        "memory_stats", "live_buffers", "unavailable"
+    )
+
+
+# ---------------------------------------------------------------------------
+# per-entry-point field completeness
+# ---------------------------------------------------------------------------
+
+
+def test_estimate_dfm_em_record(sink, rng):
+    from dynamic_factor_models_tpu.models.ssm import DFMConfig, estimate_dfm_em
+
+    y = rng.standard_normal((48, 10))
+    estimate_dfm_em(y, np.ones(10), 0, 47,
+                    DFMConfig(nfac_u=2, n_factorlag=1), max_em_iter=3)
+    (rec,) = _by_entry(sink, "estimate_dfm_em")
+    _assert_complete(rec)
+    assert rec["shapes"] == {"T": 48, "N": 10, "r": 2, "p": 1}
+    assert rec["n_iter"] == 3 and rec["converged"] is False
+    assert isinstance(rec["final_loglik"], float)
+    assert rec["phase_s"], "outer record should aggregate phase spans"
+    # the inner EM loop leaves its own child record linked to the outer one
+    (child,) = _by_entry(sink, "run_em_loop")
+    assert child["parent"] == rec["run_id"]
+    assert child["n_iter"] == 3
+    assert child["config"]["checkpointed"] is False
+
+
+def test_estimate_dfm_em_ar_record(sink, rng):
+    from dynamic_factor_models_tpu.models.ssm_ar import (
+        DFMConfig, estimate_dfm_em_ar,
+    )
+
+    y = rng.standard_normal((40, 8))
+    estimate_dfm_em_ar(y, np.ones(8), 0, 39,
+                       DFMConfig(nfac_u=1, n_factorlag=1), max_em_iter=3)
+    (rec,) = _by_entry(sink, "estimate_dfm_em_ar")
+    _assert_complete(rec)
+    assert rec["shapes"]["T"] == 40 and rec["shapes"]["N"] == 8
+    assert rec["n_iter"] == 3
+
+
+def test_estimate_mixed_freq_record(sink, rng):
+    from dynamic_factor_models_tpu.models.mixed_freq import (
+        estimate_mixed_freq_dfm,
+    )
+
+    T_, N = 48, 7
+    x = rng.standard_normal((T_, N))
+    x[np.arange(T_) % 3 != 2, N - 2:] = np.nan  # quarterly tail
+    is_q = np.zeros(N, bool)
+    is_q[N - 2:] = True
+    estimate_mixed_freq_dfm(x, is_q, r=1, p=5, max_em_iter=3)
+    (rec,) = _by_entry(sink, "estimate_mixed_freq_dfm")
+    _assert_complete(rec)
+    assert rec["shapes"]["n_quarterly"] == 2
+    assert rec["n_iter"] == 3
+
+
+def test_estimate_factor_record(sink, rng):
+    from dynamic_factor_models_tpu.models.dfm import DFMConfig, estimate_factor
+
+    y = rng.standard_normal((48, 10))
+    estimate_factor(y, np.ones(10), 0, 47, DFMConfig(nfac_u=2))
+    (rec,) = _by_entry(sink, "estimate_factor")
+    _assert_complete(rec)
+    assert rec["shapes"] == {"T": 48, "N": 10, "r": 2}
+    assert rec["n_iter"] >= 1
+    assert isinstance(rec["ssr"], float)
+    assert "als_core" in rec["phase_s"]
+
+
+def test_fit_ms_dfm_record(sink, rng):
+    from dynamic_factor_models_tpu.models.msdfm import fit_ms_dfm
+
+    x = rng.standard_normal((60, 5))
+    fit_ms_dfm(x, n_steps=30, n_restarts=2, seed=0)
+    (rec,) = _by_entry(sink, "fit_ms_dfm")
+    _assert_complete(rec)
+    assert rec["shapes"]["T"] == 60 and rec["shapes"]["N"] == 5
+    assert rec["n_iter"] == 30
+    assert rec["n_finite_restarts"] >= 1
+
+
+def test_bootstrap_records(sink, rng):
+    from dynamic_factor_models_tpu.models.favar import wild_bootstrap_irfs
+
+    y = rng.standard_normal((60, 3)) * 0.3
+    wild_bootstrap_irfs(y, 1, 0, 59, horizon=4, n_reps=8, seed=0)
+    (rec,) = _by_entry(sink, "bootstrap_irfs")
+    _assert_complete(rec)
+    assert rec["config"]["resample"]  # scheme name captured
+    assert rec["shapes"]["n_reps"] == 8 and rec["n_iter"] == 8
+    assert 0.0 <= rec["finite_fraction"] <= 1.0
+
+
+def test_bootstrap_resumable_record_and_tmp_hygiene(sink, tmp_path, rng):
+    from dynamic_factor_models_tpu.models.favar import (
+        wild_bootstrap_irfs_resumable,
+    )
+
+    y = rng.standard_normal((60, 3)) * 0.3
+    ck = str(tmp_path / "boot.npz")
+    wild_bootstrap_irfs_resumable(y, 1, 0, 59, ck, horizon=4,
+                                  n_reps=8, chunk_reps=4, seed=0)
+    (rec,) = _by_entry(sink, "wild_bootstrap_irfs_resumable")
+    _assert_complete(rec)
+    assert rec["n_chunks"] == 2 and rec["start_chunk"] == 0
+    # atomic rename left the final checkpoint and zero temp files behind
+    leftovers = [p for p in os.listdir(tmp_path) if ".tmp." in p]
+    assert not leftovers, leftovers
+    assert os.path.exists(ck)
+
+
+# ---------------------------------------------------------------------------
+# sink mechanics
+# ---------------------------------------------------------------------------
+
+
+def test_jsonl_roundtrip_and_line_atomicity(sink):
+    """Concurrent writers through the single-append path produce exactly
+    n_threads * n_each parseable lines — whole lines, never fragments."""
+    n_threads, n_each = 8, 25
+
+    def work(i):
+        for j in range(n_each):
+            with T.run_record("thread_entry", config={"i": i, "j": j}):
+                pass
+
+    threads = [threading.Thread(target=work, args=(i,)) for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    recs = _recs(sink)  # json.loads raises on any torn line
+    assert len(recs) == n_threads * n_each
+    seen = {(r["config"]["i"], r["config"]["j"]) for r in recs}
+    assert len(seen) == n_threads * n_each
+    run_ids = {r["run_id"] for r in recs}
+    assert len(run_ids) == n_threads * n_each
+
+
+def test_counter_deltas_bracket_the_record(sink, rng):
+    """counters_delta is the per-kernel difference across the record's
+    lifetime: a second identical run reuses compiled programs, so its
+    record shows runs but no fresh compiles for the EM kernels."""
+    from dynamic_factor_models_tpu.models.ssm import DFMConfig, estimate_dfm_em
+    from dynamic_factor_models_tpu.utils.compile import counters
+
+    y = rng.standard_normal((48, 10))
+    cfg = DFMConfig(nfac_u=2, n_factorlag=1)
+    c0 = counters()
+    estimate_dfm_em(y, np.ones(10), 0, 47, cfg, max_em_iter=3)
+    estimate_dfm_em(y, np.ones(10), 0, 47, cfg, max_em_iter=3)
+    first, second = _by_entry(sink, "estimate_dfm_em")
+    # the record deltas, summed, reproduce the registry-level difference
+    c1 = counters()
+    for kernel, d in first["counters_delta"].items():
+        for field, v in d.items():
+            total = c1.get(kernel, {}).get(field, 0) - c0.get(kernel, {}).get(field, 0)
+            assert total >= v - 1e-9, (kernel, field)
+    em1 = first["counters_delta"].get("em_loop", {})
+    em2 = second["counters_delta"].get("em_loop", {})
+    assert em1.get("runs", 0) >= 1
+    assert em2.get("runs", 0) >= 1
+    assert em2.get("compiles", 0) == 0, (
+        "second identical run must not recompile the EM loop"
+    )
+
+
+def test_heartbeat_parity_and_counter(sink, monkeypatch, rng):
+    """DFM_HEARTBEAT=k compiles a different (callback-bearing) loop with
+    IDENTICAL numerics, and the callback lands in the registry."""
+    from dynamic_factor_models_tpu.models.ssm import DFMConfig, estimate_dfm_em
+
+    y = rng.standard_normal((48, 10))
+    cfg = DFMConfig(nfac_u=2, n_factorlag=1)
+    base = estimate_dfm_em(y, np.ones(10), 0, 47, cfg, max_em_iter=6)
+    T.reset()
+    monkeypatch.setenv("DFM_HEARTBEAT", "2")
+    hb = estimate_dfm_em(y, np.ones(10), 0, 47, cfg, max_em_iter=6)
+    np.testing.assert_array_equal(
+        np.asarray(hb.loglik_path), np.asarray(base.loglik_path)
+    )
+    snap = T.snapshot()
+    assert snap["counters"].get("em_heartbeat_events", 0) >= 1
+    assert "em_heartbeat_loglik" in snap["gauges"]
+    child = _by_entry(sink, "run_em_loop")[-1]
+    assert child["heartbeat_every"] == 2
+
+
+def test_disabled_path_returns_singleton(monkeypatch):
+    monkeypatch.delenv("DFM_TELEMETRY", raising=False)
+    monkeypatch.delenv("DFM_PROFILE_DIR", raising=False)
+    monkeypatch.setattr(T, "_explicit_enabled", None)
+    monkeypatch.setattr(T, "_explicit_sink", None)
+    assert not T.enabled()
+    a = T.run_record("anything", config={"x": 1})
+    assert a is T.run_record("other")
+    assert a.active is False
+    with a as rec:
+        rec.set(n_iter=1).add_phase("p", 0.1)
+
+
+def test_explicit_enable_without_env(tmp_path, monkeypatch):
+    monkeypatch.delenv("DFM_TELEMETRY", raising=False)
+    monkeypatch.setattr(T, "_explicit_enabled", None)
+    monkeypatch.setattr(T, "_explicit_sink", None)
+    path = str(tmp_path / "explicit.jsonl")
+    T.enable(sink=path)
+    try:
+        assert T.enabled() and T.sink_path() == path
+        with T.run_record("explicit_entry"):
+            pass
+        assert _by_entry(path, "explicit_entry")
+    finally:
+        T.disable()
+        monkeypatch.setattr(T, "_explicit_enabled", None)
+
+
+def test_broken_sink_never_raises(monkeypatch):
+    monkeypatch.setenv("DFM_TELEMETRY", "/proc/definitely/not/writable.jsonl")
+    with T.run_record("doomed_sink"):
+        pass  # OSError on the append is swallowed; estimation must survive
+    assert T.records()[-1]["entry"] == "doomed_sink"
+
+
+def test_record_error_field(sink):
+    with pytest.raises(RuntimeError, match="boom"):
+        with T.run_record("exploding"):
+            raise RuntimeError("boom")
+    (rec,) = _by_entry(sink, "exploding")
+    assert rec["error"] == "RuntimeError: boom"
+    assert rec["wall_s"] >= 0
+
+
+# ---------------------------------------------------------------------------
+# summarize CLI
+# ---------------------------------------------------------------------------
+
+
+def test_summarize_cli(sink, rng, capsys):
+    from dynamic_factor_models_tpu.models.dfm import DFMConfig, estimate_factor
+
+    y = rng.standard_normal((48, 10))
+    estimate_factor(y, np.ones(10), 0, 47, DFMConfig(nfac_u=2))
+    assert T.main(["summarize", sink]) == 0
+    out = capsys.readouterr().out
+    assert "estimate_factor" in out and "aggregate by entry" in out
+    assert "48x10,r=2" in out
+    # --json mode round-trips
+    assert T.main(["summarize", sink, "--json"]) == 0
+    parsed = json.loads(capsys.readouterr().out)
+    assert parsed and parsed[0]["entry"] == "estimate_factor"
+    # --entry filter, and a clean exit on a missing file
+    assert T.main(["summarize", sink, "--entry", "nope"]) == 0
+    assert "no records" in capsys.readouterr().out
+    assert T.main(["summarize", str(sink) + ".missing"]) == 1
+
+
+def test_module_cli_shim():
+    """`python -m dynamic_factor_models_tpu.telemetry` resolves to the same
+    implementation (the package-level shim re-exports utils.telemetry)."""
+    from dynamic_factor_models_tpu import telemetry as shim
+
+    assert shim.main is T.main
+    assert shim.run_record is T.run_record
+
+
+# ---------------------------------------------------------------------------
+# satellites: zero-iteration trace, iters_per_sec guard, checkpoint hygiene
+# ---------------------------------------------------------------------------
+
+
+def test_zero_iter_collect_path_returns_empty_trace(rng):
+    from dynamic_factor_models_tpu.models.emloop import run_em_loop
+    from dynamic_factor_models_tpu.models.ssm import SSMParams, em_step
+    from dynamic_factor_models_tpu.utils.profiling import ConvergenceTrace
+
+    xz = jnp.asarray(rng.standard_normal((20, 4)))
+    m = jnp.ones((20, 4), bool)
+    params = SSMParams(lam=jnp.ones((4, 1)), R=jnp.ones(4),
+                       A=0.4 * jnp.eye(1)[None], Q=jnp.eye(1))
+    p_out, llpath, n_iter, trace = run_em_loop(
+        em_step, params, (xz, m), 1e-8, 0, collect_path=True
+    )
+    assert n_iter == 0 and llpath.size == 0
+    assert isinstance(trace, ConvergenceTrace)
+    assert trace.values == [] and np.isnan(trace.iters_per_sec)
+    # and without collect_path the trace stays None, params untouched
+    _, _, n2, tr2 = run_em_loop(em_step, params, (xz, m), 1e-8, 0)
+    assert n2 == 0 and tr2 is None
+
+
+def test_iters_per_sec_zero_dt_is_nan():
+    from dynamic_factor_models_tpu.utils.profiling import ConvergenceTrace
+
+    tr = ConvergenceTrace("t")
+    assert np.isnan(tr.iters_per_sec)  # no iterations at all
+    tr.times = [5.0]
+    tr.values = [-1.0]
+    assert np.isnan(tr.iters_per_sec)  # single sample: zero elapsed
+    tr.times = [5.0, 5.0]
+    tr.values = [-1.0, -0.5]
+    assert np.isnan(tr.iters_per_sec)  # clock didn't advance
+
+
+def test_checkpoint_failed_save_cleans_temp(tmp_path, monkeypatch, rng):
+    """A save_pytree failure mid-run must propagate AND leave no temp file
+    next to the checkpoint path."""
+    from dynamic_factor_models_tpu.models.emloop import run_em_loop
+    from dynamic_factor_models_tpu.models.ssm import SSMParams, em_step
+    from dynamic_factor_models_tpu.utils import checkpoint as ck_mod
+
+    xz = jnp.asarray(rng.standard_normal((30, 5)))
+    m = jnp.ones((30, 5), bool)
+    params = SSMParams(
+        lam=jnp.asarray(rng.standard_normal((5, 2)) * 0.5),
+        R=jnp.ones(5), A=0.4 * jnp.eye(2)[None], Q=jnp.eye(2),
+    )
+    real_save = ck_mod.save_pytree
+
+    def failing_save(path, tree):
+        real_save(path, tree)  # the temp file exists on disk...
+        raise OSError("disk full")  # ...when the failure hits
+
+    monkeypatch.setattr(ck_mod, "save_pytree", failing_save)
+    ck = str(tmp_path / "em.npz")
+    with pytest.raises(OSError, match="disk full"):
+        run_em_loop(em_step, params, (xz, m), 1e-10, 20,
+                    checkpoint_path=ck, checkpoint_every=5)
+    leftovers = [p for p in os.listdir(tmp_path) if ".tmp." in p]
+    assert not leftovers, leftovers
+    assert not os.path.exists(ck)
+
+
+def test_checkpoint_temp_names_are_unique(tmp_path, rng):
+    """Two runs against the same checkpoint path generate distinct temp
+    names (pid+uuid suffix), so neither can clobber the other's
+    half-written archive."""
+    from dynamic_factor_models_tpu.models.emloop import run_em_loop
+    from dynamic_factor_models_tpu.models.ssm import SSMParams, em_step
+    from dynamic_factor_models_tpu.utils import checkpoint as ck_mod
+
+    xz = jnp.asarray(rng.standard_normal((30, 5)))
+    m = jnp.ones((30, 5), bool)
+    params = SSMParams(
+        lam=jnp.asarray(rng.standard_normal((5, 2)) * 0.5),
+        R=jnp.ones(5), A=0.4 * jnp.eye(2)[None], Q=jnp.eye(2),
+    )
+    seen = []
+    real_save = ck_mod.save_pytree
+
+    def spying_save(path, tree):
+        seen.append(os.path.basename(path))
+        return real_save(path, tree)
+
+    import unittest.mock as mock
+
+    with mock.patch.object(ck_mod, "save_pytree", spying_save):
+        ck = str(tmp_path / "em.npz")
+        run_em_loop(em_step, params, (xz, m), 1e-10, 10,
+                    checkpoint_path=ck, checkpoint_every=3)
+        os.remove(ck)
+        run_em_loop(em_step, params, (xz, m), 1e-10, 10,
+                    checkpoint_path=ck, checkpoint_every=3)
+    assert len(seen) >= 2
+    assert len(set(seen)) == len(seen), f"temp names collided: {seen}"
+    assert all(".tmp." in s and s.endswith(".npz") for s in seen)

@@ -10,6 +10,10 @@ set -u
 cd "$(dirname "$0")/.."
 LOG=docs/tpu_probe_r05.log
 INTERVAL="${PROBE_INTERVAL_S:-300}"
+# every estimation call inside the live window leaves a RunRecord in the
+# evidence dir (env is inherited by every bench child process); the
+# summarize digest is appended to $LOG after the remainder completes
+export DFM_TELEMETRY="${DFM_TELEMETRY:-docs/telemetry_live_r05.jsonl}"
 
 # stage the CPU parity leg whenever it is missing or its code rev has
 # drifted (edits to any hashed source invalidate it) so none of the scarce
@@ -70,6 +74,11 @@ jax.block_until_ready(jnp.ones(8).sum())
       > /tmp/tpu_remainder.out 2> /tmp/tpu_remainder.err
     rc=$?
     echo "$(date -u +%FT%TZ) watcher remainder rc=$rc (logs /tmp/tpu_remainder.{out,err})" >> "$LOG"
+    if [ -s "$DFM_TELEMETRY" ]; then
+      echo "$(date -u +%FT%TZ) watcher telemetry digest ($DFM_TELEMETRY):" >> "$LOG"
+      python -m dynamic_factor_models_tpu.telemetry summarize "$DFM_TELEMETRY" 2>/dev/null \
+        | tail -n 40 >> "$LOG"
+    fi
     if [ "$rc" -eq 0 ]; then
       echo "$(date -u +%FT%TZ) watcher remainder COMPLETE — docs/TPU_EVIDENCE.json has every TPU field" >> "$LOG"
       exit 0
