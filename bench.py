@@ -63,6 +63,13 @@ JSON fields beyond the headline:
   E-step — the f32-precision option's speed cost made visible.
 - em_iters_per_sec_mf_monthly           mixed-frequency EM on the real
   672x207 monthly panel (io.readin_data_monthly).
+- em_iters_per_sec_steady / em_steady_speedup / riccati_doubling_iters /
+  steady_tail_frac / steady_t_star       steady-state fast-path EM
+  (models/steady.py: DARE fixed point + constant-gain tail).  Measured on
+  the real panel when its mask is head-ragged-only, else on a
+  reference-scale complete-tail synthetic panel with sequential re-timed
+  on the same panel (em_iters_per_sec_steady_baseline); all keys null when
+  the fast path is gated off everywhere (steady_bench_panel names the leg).
 - als_large_* / em_large_*              synthetic large-panel section
   (T=2048, N=4096, r=8 — the regime ops/pallas_gram.py targets): iters/sec,
   a documented FLOPs-model throughput, and the MFU estimate against the
@@ -592,6 +599,121 @@ def mixed_freq_section():
         "em_iters_per_sec_mf_monthly": round(n_iter / dt, 2),
         "mf_monthly_panel": list(x.shape),
     }
+
+
+def steady_section(xz, m, params, stats, em_ips_seq, n_dev_iter=100):
+    """Steady-state fast-path EM throughput (models/steady.py).
+
+    Tries the real panel first; its interior/trailing missingness gates the
+    fast path off (`ssm._steady_plan` returns None — only ragged HEADS are
+    compatible with a converged constant-gain tail), so the measured leg is
+    a reference-scale complete-tail synthetic panel (T=224, N=139, ragged
+    heads on a third of the series), with `method="sequential"` re-timed on
+    the SAME panel so the speedup ratio is apples-to-apples.  All keys stay
+    present-but-null when the fast path is gated off everywhere, keeping
+    BENCH JSON schemas comparable across rounds.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dynamic_factor_models_tpu.models.emloop import run_em_loop
+    from dynamic_factor_models_tpu.models.ssm import (
+        SteadyEMState,
+        _steady_block_for,
+        _steady_plan,
+        _steady_step_for,
+        em_step_stats,
+    )
+
+    fields = {
+        "em_iters_per_sec_steady": None,
+        "em_iters_per_sec_steady_baseline": None,
+        "em_steady_speedup": None,
+        "riccati_doubling_iters": None,
+        "steady_tail_frac": None,
+        "steady_t_star": None,
+        "steady_bench_panel": None,
+    }
+
+    def _try(pxz, pm, pparams, pstats, label):
+        plan = _steady_plan(pparams, np.asarray(pm, bool))
+        if plan is None:
+            return None
+        t_star, st0, _rho = plan
+        T0 = pxz.shape[0]
+        block = _steady_block_for(T0 - t_star)
+        step = _steady_step_for(t_star, block)
+        carry0 = SteadyEMState(
+            pparams,
+            jnp.asarray(st0.Pp, pxz.dtype),
+            jnp.asarray(0, jnp.int32),
+        )
+        args = (pxz, pm, pstats)
+        run_em_loop(step, carry0, args, 0.0, n_dev_iter)  # compile
+        t1 = time.perf_counter()
+        out, _, n_ran, _ = run_em_loop(step, carry0, args, 0.0, n_dev_iter)
+        ips = n_ran / (time.perf_counter() - t1)
+        fields.update(
+            {
+                "em_iters_per_sec_steady": round(ips, 2),
+                "riccati_doubling_iters": round(
+                    int(out.riccati_iters) / max(n_ran, 1), 2
+                ),
+                "steady_tail_frac": round((T0 - t_star) / T0, 4),
+                "steady_t_star": int(t_star),
+                "steady_bench_panel": label,
+            }
+        )
+        return ips
+
+    ips = _try(xz, m, params, stats, "real")
+    if ips is not None:
+        fields["em_iters_per_sec_steady_baseline"] = round(em_ips_seq, 2)
+        fields["em_steady_speedup"] = round(ips / em_ips_seq, 2)
+        return fields
+
+    # synthetic reference-scale complete-tail panel (BASELINE pca_real
+    # dims), sequential re-timed on the same panel for an honest ratio
+    from dynamic_factor_models_tpu.models.ssm import (
+        SSMParams,
+        compute_panel_stats,
+    )
+
+    rng = np.random.default_rng(0)
+    T, N, r, p = 224, 139, 4, 4
+    dt_ = xz.dtype
+    f = np.zeros((T + 8, r))
+    for t in range(1, T + 8):
+        f[t] = 0.6 * f[t - 1] + rng.standard_normal(r)
+    lam_true = rng.standard_normal((N, r))
+    xs = f[8:] @ lam_true.T + rng.standard_normal((T, N))
+    ms = np.ones((T, N), bool)
+    for i in range(N // 3):  # ragged heads, complete tail
+        ms[: rng.integers(4, 20), i] = False
+    xs = jnp.asarray(np.where(ms, xs, 0.0), dt_)
+    msj = jnp.asarray(ms.astype(np.asarray(xz).dtype))
+    sparams = SSMParams(
+        lam=jnp.zeros((N, r), dt_).at[:, 0].set(1.0),
+        R=jnp.ones(N, dt_),
+        A=jnp.concatenate(
+            [0.5 * jnp.eye(r, dtype=dt_)[None], jnp.zeros((p - 1, r, r), dt_)]
+        ),
+        Q=jnp.eye(r, dtype=dt_),
+    )
+    sstats = compute_panel_stats(xs, msj)
+    ips = _try(xs, msj, sparams, sstats, "synthetic_ref")
+    if ips is None:
+        return fields
+    run_em_loop(em_step_stats, sparams, (xs, msj, sstats), 0.0, n_dev_iter)
+    t1 = time.perf_counter()
+    _, _, n_ran, _ = run_em_loop(
+        em_step_stats, sparams, (xs, msj, sstats), 0.0, n_dev_iter
+    )
+    seq_ips = n_ran / (time.perf_counter() - t1)
+    fields["em_iters_per_sec_steady_baseline"] = round(seq_ips, 2)
+    fields["em_steady_speedup"] = round(ips / seq_ips, 2)
+    return fields
 
 
 def _gram_loop_seconds(fn, X, Y, W, n: int, n_timing: int = 5):
@@ -1193,6 +1315,9 @@ def bench_main(force_cpu: bool):
         }
     )
     _persist_partial(partial)
+    steady = steady_section(xz, m, params, stats, em_ips["seq"])
+    partial.update(steady)
+    _persist_partial(partial)
 
     def _persist_large(fields):
         snap = dict(partial)
@@ -1235,6 +1360,7 @@ def bench_main(force_cpu: bool):
         "em_iters_per_sec_host_sync": round(em_ips_host, 2),
         "em_iters_per_sec_assoc": round(em_ips["assoc"], 2),
         "em_iters_per_sec_sqrt": round(em_ips["sqrt"], 2),
+        **steady,
         **mf,
         **large,
         **pallas,

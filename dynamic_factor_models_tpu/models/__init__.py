@@ -52,18 +52,28 @@ from .ssm import (
     EMResults,
     PanelStats,
     SSMParams,
+    SteadyEMState,
     compute_panel_stats,
     em_step,
     em_step_assoc,
     em_step_sqrt,
     em_step_sqrt_collapsed,
     em_step_stats,
+    em_step_steady,
     estimate_dfm_em,
     estimate_dfm_mle,
     estimate_dfm_twostep,
     ssm_standard_errors,
     kalman_filter,
     kalman_smoother,
+)
+from .steady import (
+    PeriodicSteadyState,
+    SteadyState,
+    dare_doubling,
+    linear_recursion,
+    periodic_dare,
+    steady_state,
 )
 from .favar import (
     BootstrapIRFs,
@@ -99,7 +109,12 @@ from .ssm_ar import (
     estimate_dfm_em_ar,
     nowcast_em_ar,
 )
-from .mixed_freq import MFResults, MixedFreqParams, estimate_mixed_freq_dfm
+from .mixed_freq import (
+    MFResults,
+    MixedFreqParams,
+    estimate_mixed_freq_dfm,
+    steady_gains,
+)
 from .news import NowcastNews, nowcast_news
 from .bayes import (
     BayesModelComparison,

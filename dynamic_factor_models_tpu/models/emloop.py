@@ -180,6 +180,15 @@ def run_em_loop(
         },
     )
     if collect_path:
+        if isinstance(stop_at, jax.core.Tracer):
+            # int(tracer) below would raise an opaque
+            # TracerIntegerConversionError from deep inside the loop setup
+            raise ValueError(
+                "collect_path=True runs a host loop and needs a concrete "
+                "stop_at; pass a Python int (or None), or use "
+                "collect_path=False — the on-device loop accepts a traced "
+                "stop_at bound"
+            )
         host_cap = max_em_iter if stop_at is None else min(max_em_iter, int(stop_at))
         trace = ConvergenceTrace(trace_name)
         llpath = []

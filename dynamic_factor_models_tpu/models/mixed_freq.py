@@ -51,6 +51,7 @@ __all__ = [
     "em_step_mf",
     "em_step_mf_stats",
     "estimate_mixed_freq_dfm",
+    "steady_gains",
     "MFResults",
 ]
 
@@ -132,6 +133,57 @@ def _filter_mf(params: MixedFreqParams, x, mask, stats=None):
         Tm, Qs, (C, b, ld_R, xRx, n_obs), obs_step, s0, P0
     )
     return means, covs, pmeans, pcovs, lls.sum() + ll_corr
+
+
+def steady_gains(params: MixedFreqParams, pattern=None):
+    """Cyclostationary steady-state gain set for the mixed-frequency
+    observation cycle (steady.periodic_dare over the monthly/quarterly
+    mask pattern).
+
+    The mixed-freq panel is never time-invariant — quarterly series are
+    observed only every third month — so there is no single Riccati fixed
+    point, but the mask IS periodic, so the Riccati recursion converges to
+    a period-3 cycle of covariances/gains.  This returns that cycle as a
+    `steady.PeriodicSteadyState` whose phase-j information matrix is
+
+        C_j = H5' diag(pattern_j / R) H5       (embedded in the full state)
+
+    with H5 the dense (N, 5r) observation block of `_obs_matrix`.
+
+    pattern: (d, N) per-phase observation indicators.  Default: the
+    canonical 3-month cycle implied by `params.agg` — monthly series
+    (agg row = (1,0,0,0,0)) observed in every phase, quarterly series
+    only in the quarter-end phase d-1.  Phase j of the result then
+    describes month `t` with `t % 3 == j` under the convention that
+    quarter-end months are t % 3 == 2.
+
+    Constant-gain tails for mixed-freq filtering consume `K[j][:, :q5]`
+    and `Abar[j]` phase-by-phase; this function only derives the gain
+    set (the mixed-freq EM loop itself stays on the exact path — ragged
+    real-world publication lags rarely leave a long periodic tail).
+    """
+    r, p = params.r, params.p
+    q5 = _N_AGG * r
+    k = r * p
+    dtype = params.lam.dtype
+    if pattern is None:
+        is_q = jnp.any(params.agg[:, 1:] != 0.0, axis=1)
+        monthly = (~is_q).astype(dtype)
+        pattern = jnp.stack([monthly, monthly, jnp.ones_like(monthly)])
+    pattern = jnp.asarray(pattern, dtype)
+    if pattern.ndim != 2 or pattern.shape[1] != params.lam.shape[0]:
+        raise ValueError(
+            f"pattern must be (d, N) with N={params.lam.shape[0]}, "
+            f"got {pattern.shape}"
+        )
+    from .steady import periodic_dare
+
+    Tm, Qs = _companion(_as_ssm(params))
+    H5 = _obs_matrix(params)[:, :q5]
+    # per-phase collapsed information matrices, embedded in the full state
+    C5 = jnp.einsum("nq,dn,ns->dqs", H5 / params.R[:, None], pattern, H5)
+    Cs = jnp.zeros((pattern.shape[0], k, k), dtype).at[:, :q5, :q5].set(C5)
+    return periodic_dare(Tm, Cs, Qs)
 
 
 def _em_mf_impl(params: MixedFreqParams, x, mask, stats):

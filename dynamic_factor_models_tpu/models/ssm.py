@@ -24,7 +24,7 @@ TPU-first design choices:
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 from typing import NamedTuple
 
 import jax
@@ -49,6 +49,8 @@ __all__ = [
     "em_step_assoc",
     "em_step_sqrt",
     "em_step_sqrt_collapsed",
+    "em_step_steady",
+    "SteadyEMState",
     "estimate_dfm_em",
     "estimate_dfm_twostep",
     "estimate_dfm_mle",
@@ -631,7 +633,214 @@ def _filter_scan_full(params: SSMParams, x, mask, qdiag=None):
     return KalmanResult(lls.sum(), means, covs, pmeans, pcovs)
 
 
-_FILTER_METHODS = ("sequential", "associative", "sqrt", "sqrt_collapsed")
+_FILTER_METHODS = ("sequential", "associative", "sqrt", "sqrt_collapsed", "steady")
+
+
+# ---------------------------------------------------------------------------
+# Steady-state fast path (method="steady")
+#
+# The model is time-invariant, so on any stretch of the sample where the
+# observation pattern is also time-invariant (every series observed — the
+# "complete tail" of a ragged-edge macro panel) the filter covariances
+# converge geometrically to the DARE fixed point (models/steady.py).  The
+# split program runs an EXACT head of t* steps — `_info_filter_scan`'s
+# collapsed step, byte-for-byte the sequential update — and a constant-gain
+# tail s_t = Ā s_{t-1} + K∞ b_t with no factorizations at all; smoother
+# covariances on the tail are the closed-form constants Ps∞ (interior) and
+# Ps∞ + J∞^j(Pu∞-Ps∞)J∞'^j (right boundary), so the E-step covariance
+# reductions collapse to (T-t*)·P∞-style O(1) terms plus the head sum.
+# t* is a SHAPE (the head scan length): computed host-side per estimate
+# call (`_steady_plan`), never traced.
+# ---------------------------------------------------------------------------
+
+
+def _steady_collapse(params: SSMParams, x, stats: PanelStats, t_star: int):
+    """Collapsed observation statistics for the split program: exact
+    per-step (C_t, ld_R_t) on the head rows only — a (t*, N) GEMM — and
+    the complete-tail constants C∞ = Lam'R^-1Lam, ld_R∞ = Σ_i log R_i
+    (the masked GEMM's all-ones row: one column sum replaces the tail's
+    share of the (T, N) product).  b_t is still needed at every t (the
+    tail recursion consumes it), so that GEMM stays full-T."""
+    r = params.r
+    iu, iv, unpack = _sym_pack_idx(r)
+    lam, R = params.lam, params.R
+    pair_R = jnp.concatenate(
+        [(lam[:, iu] * lam[:, iv]) / R[:, None], jnp.log(R)[:, None]], axis=1
+    )
+    Cu_head = stats.m[:t_star] @ pair_R
+    C_head = Cu_head[:, unpack].reshape(-1, r, r)
+    ld_R_head = Cu_head[:, -1]
+    pairsum = pair_R.sum(axis=0)
+    C_inf = pairsum[unpack].reshape(r, r)
+    ld_R_inf = pairsum[-1]
+    b = x @ (lam / R[:, None])
+    ll_corr = -0.5 * (stats.Sxx / R).sum()
+    return C_head, ld_R_head, C_inf, ld_R_inf, b, ll_corr
+
+
+def _steady_core(params: SSMParams, x, stats: PanelStats, Pp0, t_star: int, block: int):
+    """Shared forward pass of the steady path: DARE solve (warm-started
+    from Pp0 when given), exact collapsed head of t* steps, constant-gain
+    tail.  Returns (steady, head scan outputs, tail filtered means, tail
+    per-step lls, ll correction, Tm)."""
+    from .steady import steady_state, steady_tail
+
+    Tm, Qs = _companion(params)
+    k = Tm.shape[0]
+    r = params.r
+    dtype = x.dtype
+    s0, P0 = _init_state(params)
+    C_head, ld_R_head, C_inf, ld_R_inf, b, ll_corr = _steady_collapse(
+        params, x, stats, t_star
+    )
+    st = steady_state(Tm, C_inf, Qs, q=r, Pp0=Pp0)
+
+    def obs_step(inp, sp):
+        Ct, bt, ld, xr, no = inp
+        f = sp[:r]
+        Cf = jnp.zeros((k, k), dtype).at[:r, :r].set(Ct)
+        rhs = jnp.zeros(k, dtype).at[:r].set(bt - Ct @ f)
+        quad0 = xr - 2.0 * (f @ bt) + f @ Ct @ f
+        return Cf, rhs, ld, quad0, no
+
+    head = _info_filter_scan(
+        Tm,
+        Qs,
+        (
+            C_head,
+            b[:t_star],
+            ld_R_head,
+            jnp.zeros(t_star, dtype),
+            stats.n_obs[:t_star],
+        ),
+        obs_step,
+        s0,
+        P0,
+    )
+    ld_const = ld_R_inf + st.ld_pp - st.ld_pu
+    su_tail, lls_tail = steady_tail(
+        Tm, C_inf, st.Pu[:r, :r], st.K, st.Abar, b[t_star:],
+        head[0][-1], stats.n_obs[t_star:], ld_const, block=block,
+    )
+    return st, head, su_tail, lls_tail, ll_corr, Tm
+
+
+@partial(jax.jit, static_argnames=("t_star", "block"))
+def _steady_filter(params: SSMParams, x, mask, stats: PanelStats, t_star: int, block: int):
+    """Steady-path masked Kalman filter (cold DARE solve in-graph).  The
+    tail covariances of the returned KalmanResult are the broadcast
+    constants Pu∞ / Pp∞ — exact up to the convergence tolerance the
+    dispatch (`_steady_plan`) verified."""
+    params = params._replace(Q=_psd_floor(params.Q))
+    st, head, su_tail, lls_tail, ll_corr, Tm = _steady_core(
+        params, x, stats, None, t_star, block
+    )
+    means_h, covs_h, pmeans_h, pcovs_h, lls_h = head
+    n_tail = su_tail.shape[0]
+    sp_tail = jnp.concatenate([means_h[-1:], su_tail[:-1]]) @ Tm.T
+    return KalmanResult(
+        lls_h.sum() + lls_tail.sum() + ll_corr,
+        jnp.concatenate([means_h, su_tail]),
+        jnp.concatenate(
+            [covs_h, jnp.broadcast_to(st.Pu, (n_tail, *st.Pu.shape))]
+        ),
+        jnp.concatenate([pmeans_h, sp_tail]),
+        jnp.concatenate(
+            [pcovs_h, jnp.broadcast_to(st.Pp, (n_tail, *st.Pp.shape))]
+        ),
+    )
+
+
+@partial(jax.jit, static_argnames=("t_star", "block"))
+def _steady_smoother(params: SSMParams, x, mask, stats: PanelStats, t_star: int, block: int):
+    """Steady-path smoother: exact RTS over the head (closed at the
+    boundary by the steady smoothed covariance Ps∞), the backward
+    constant-gain mean recursion over the tail, and closed-form tail
+    covariances Ps∞ + J∞^j(Pu∞-Ps∞)J∞'^j.  Returns (means, covs, ll)."""
+    from .steady import steady_smooth_tail
+
+    params = params._replace(Q=_psd_floor(params.Q))
+    st, head, su_tail, lls_tail, ll_corr, Tm = _steady_core(
+        params, x, stats, None, t_star, block
+    )
+    means_h, covs_h, pmeans_h, pcovs_h, lls_h = head
+    n_tail = su_tail.shape[0]
+    s_sm_tail = steady_smooth_tail(Tm, st.J, su_tail, block=block)
+    s_all, P_all, _ = _rts_scan(
+        Tm,
+        jnp.concatenate([means_h, s_sm_tail[:1]]),
+        jnp.concatenate([covs_h, st.Ps[None]]),
+        jnp.concatenate([pmeans_h, (Tm @ means_h[-1])[None]]),
+        jnp.concatenate([pcovs_h, st.Pp[None]]),
+    )
+    W = st.Pu - st.Ps
+
+    def dev_step(D, _):
+        return st.J @ D @ st.J.T, D
+
+    _, devs = jax.lax.scan(dev_step, W, None, length=n_tail)
+    means = jnp.concatenate([s_all[:t_star], s_sm_tail])
+    covs = jnp.concatenate([P_all[:t_star], st.Ps[None] + devs[::-1]])
+    return means, covs, lls_h.sum() + lls_tail.sum() + ll_corr
+
+
+def _steady_block_for(n_tail: int) -> int:
+    """Tail-kernel block size: 0 (lax.scan of matvecs) below the length
+    where the blocked einsum form pays for its W-operator setup; 32 past
+    it.  DFM_STEADY_BLOCK overrides (the bench sweeps it)."""
+    env = _os.environ.get("DFM_STEADY_BLOCK")
+    if env is not None:
+        return int(env)
+    return 32 if n_tail >= 1024 else 0
+
+
+def _steady_plan(params: SSMParams, mask, min_tail: int = 8):
+    """Host-side dispatch decision for method="steady".
+
+    The fast path applies when (a) the mask has a COMPLETE TAIL — from
+    some period on, every series is observed (ragged heads are fine: they
+    extend the exact head; interior missingness keeps the gains
+    time-varying and falls back to sequential), (b) the init-params DARE
+    solve converges with spectral radius ρ(Ā) < 1, and (c) the verified
+    convergence horizon — padded by a 1.5x + 8 safety margin, since EM
+    moves the parameters between horizon computations — leaves a tail at
+    least as long as itself (the closed-form tail moment sums truncate
+    infinite series whose remainder decays like ρ^{2·n_tail}).
+
+    Returns (t_star, SteadyState at the init params, ρ(Ā)) or None when
+    gated off.  t_star becomes a static scan length; this never runs
+    under jit."""
+    from .steady import convergence_horizon, steady_state
+
+    m_np = np.asarray(mask)
+    T = int(m_np.shape[0])
+    full = m_np.all(axis=1)
+    nz = np.nonzero(~full)[0]
+    complete_from = 0 if nz.size == 0 else int(nz[-1]) + 1
+    if complete_from >= T:
+        return None
+    params = params._replace(Q=_psd_floor(params.Q))
+    Tm, Qs = _companion(params)
+    C_inf = (params.lam.T * (1.0 / params.R)) @ params.lam
+    st = steady_state(Tm, C_inf, Qs, q=params.r)
+    if not bool(st.converged):
+        return None
+    _, P0 = _init_state(params)
+    t_model, rho = convergence_horizon(
+        Tm, C_inf, Qs, st, P0, t_max=max(4 * T, 64)
+    )
+    if t_model > T:
+        return None
+    t_pad = int(np.ceil(1.5 * t_model)) + 8
+    # the horizon clock starts where the mask becomes complete: ragged-head
+    # steps carry PARTIAL information (C_t < C∞), so the covariance there
+    # can be farther from the fixed point than the complete-data recursion
+    # the horizon verified — but never farther than the diffuse P0 the
+    # verification started from, so complete_from + t_pad is safe
+    t_star = max(complete_from + t_pad, 2)
+    if T - t_star < max(t_pad, min_tail):
+        return None
+    return t_star, st, rho
 
 
 def kalman_filter(
@@ -648,7 +857,12 @@ def kalman_filter(
     f32 (the accuracy option; O((N+k)^3) per step); "sqrt_collapsed" is
     the collapsed square-root form (`_sqrt_filter_scan_collapsed`) —
     exact posteriors at O((r+k)^3) per step, but f32 accuracy at
-    information-filter level (the compression squares the conditioning).
+    information-filter level (the compression squares the conditioning);
+    "steady" runs the exact collapsed head to the Riccati convergence
+    horizon, then the constant-gain factorization-free tail
+    (models/steady.py) — requires a complete-tail observation pattern and
+    a mixing model, and falls back to "sequential" silently when the
+    dispatch (`_steady_plan`) gates it off.
     """
     if method not in _FILTER_METHODS:
         raise ValueError(f"method must be one of {_FILTER_METHODS}, got {method!r}")
@@ -666,6 +880,15 @@ def kalman_filter(
             return _sqrt_filter_scan(params, fillz(x), mask)
         if method == "sqrt_collapsed":
             return _sqrt_filter_scan_collapsed(params, fillz(x), mask)
+        if method == "steady":
+            plan = _steady_plan(params, mask)
+            if plan is not None:
+                t_star = plan[0]
+                xz = fillz(x)
+                return _steady_filter(
+                    params, xz, mask, compute_panel_stats(xz, mask),
+                    t_star, _steady_block_for(xz.shape[0] - t_star),
+                )
         return _filter_scan(params, fillz(x), mask)
 
 
@@ -725,6 +948,17 @@ def kalman_smoother(
                 params, fillz(x), mask_of(x)
             )
             return means, covs, ll
+        if method == "steady":
+            mask = mask_of(x)
+            plan = _steady_plan(params, mask)
+            if plan is not None:
+                t_star = plan[0]
+                xz = fillz(x)
+                return _steady_smoother(
+                    params, xz, mask, compute_panel_stats(xz, mask),
+                    t_star, _steady_block_for(xz.shape[0] - t_star),
+                )
+            method = "sequential"  # gated off: exact fallback
         filt_fn = {
             "sqrt": _sqrt_filter_scan,
             "sqrt_collapsed": _sqrt_filter_scan_collapsed,
@@ -940,6 +1174,139 @@ def em_step_assoc(params: SSMParams, x, mask):
     return _em_m_step(params, x, m, s_sm, P_sm, lag1), ll
 
 
+class SteadyEMState(NamedTuple):
+    """EM-loop carry of the steady path: the model parameters plus the
+    previous iteration's steady predicted covariance Pp∞ — the warm start
+    that turns each doubling solve into 2-3 iterations instead of a cold
+    6-8 — and the cumulative doubling count (telemetry `riccati_iters`).
+    Rides `run_em_loop`'s opaque params pytree exactly as
+    emaccel.SquaremState does; `estimate_dfm_em` wraps and unwraps it."""
+
+    params: SSMParams
+    Pp: jnp.ndarray  # (k, k) previous steady predicted covariance
+    riccati_iters: jnp.ndarray  # () i32 cumulative doubling steps
+
+
+def _em_step_steady_impl(
+    state: SteadyEMState, x, mask, stats: PanelStats, t_star: int, block: int
+):
+    """One steady-path EM iteration: exact head + constant-gain tail
+    E-step, closed-form tail covariance moments, shared M-step solves.
+
+    The E-step sufficient statistics split at t*: head sums run over
+    materialized smoothed paths exactly as `_em_m_step` does, tail sums
+    use Σ_{t>=t*} P_sm_t = n_tail·Ps∞ + S_dev (S_dev the right-boundary
+    deviation sum; the series truncation error decays like ρ^{2·n_tail},
+    which `_steady_plan` keeps below tolerance), the endpoint identity
+    P_sm_{T-1} = Pu∞, and Σ lag1 = (Σ_{u>t*} P_sm_u) J∞' — all O(1) in T.
+    """
+    from .steady import steady_smooth_tail
+
+    params = state.params._replace(Q=_psd_floor(state.params.Q))
+    r, p = params.r, params.p
+    Tn = x.shape[0]
+    st, head, su_tail, lls_tail, ll_corr, Tm = _steady_core(
+        params, x, stats, state.Pp, t_star, block
+    )
+    means_h, covs_h, pmeans_h, pcovs_h, lls_h = head
+    n_tail = Tn - t_star
+
+    # --- backward pass: tail means by constant-gain recursion, head by the
+    # exact RTS scan closed at the boundary with (s_sm_{t*}, Ps∞) ---
+    s_sm_tail = steady_smooth_tail(Tm, st.J, su_tail, block=block)
+    s_all, P_head, lag1_h = _rts_scan(
+        Tm,
+        jnp.concatenate([means_h, s_sm_tail[:1]]),
+        jnp.concatenate([covs_h, st.Ps[None]]),
+        jnp.concatenate([pmeans_h, (Tm @ means_h[-1])[None]]),
+        jnp.concatenate([pcovs_h, st.Pp[None]]),
+    )
+    f_sm = jnp.concatenate([s_all[:t_star], s_sm_tail])  # (T, k)
+    P_head = P_head[:t_star]
+
+    # --- loadings/R: the (N, T) Gram contraction shrinks to (N, t*) ---
+    iu, iv, unpack = _sym_pack_idx(r)
+    f = f_sm[:, :r]
+    Eff_head = f[:t_star, iu] * f[:t_star, iv] + P_head[:, :r, :r][:, iu, iv]
+    Psum_tail = n_tail * st.Ps + st.Sdev  # Σ_{t>=t*} P_sm_t, closed form
+    eff_tail = (f[t_star:, iu] * f[t_star:, iv]).sum(axis=0) + Psum_tail[
+        :r, :r
+    ][iu, iv]
+    Sff = (stats.mT[:, :t_star] @ Eff_head + eff_tail[None, :])[
+        :, unpack
+    ].reshape(-1, r, r)
+    Sxf = stats.xT @ f
+    lam, R = _solve_loadings_and_R(Sff, Sxf, stats.Sxx, stats.n_i)
+
+    # --- factor VAR moments: head sums + closed-form tail constants ---
+    s1, s0_ = f_sm[1:, :r], f_sm[:-1]
+    S11 = (
+        jnp.einsum("tr,ts->rs", s1, s1)
+        + P_head[1:, :r, :r].sum(axis=0)
+        + Psum_tail[:r, :r]
+    )
+    # Σ_{t<=T-2} P_sm: the tail sum minus the exact endpoint P_sm_{T-1} = Pu∞
+    S00 = (
+        jnp.einsum("tk,tl->kl", s0_, s0_)
+        + P_head.sum(axis=0)
+        + Psum_tail
+        - st.Pu
+    )
+    # tail lag-one sum: Σ_{t>=t*} Cov(s_{t+1}, s_t) = (Σ_{u>t*} P_sm_u) J∞'
+    S10 = (
+        jnp.einsum("tr,tk->rk", s1, s0_)
+        + lag1_h[:, :r, :].sum(axis=0)
+        + ((Psum_tail - st.Ps) @ st.J.T)[:r, :]
+    )
+    Ak = S10 @ jnp.linalg.pinv(S00, hermitian=True)
+    Q = _psd_floor((S11 - Ak @ S10.T) / (Tn - 1))
+    A = jnp.stack([Ak[:, i * r : (i + 1) * r] for i in range(p)])
+
+    ll = lls_h.sum() + lls_tail.sum() + ll_corr
+    return (
+        SteadyEMState(
+            SSMParams(lam, R, A, Q),
+            st.Pp,
+            state.riccati_iters + st.riccati_iters,
+        ),
+        ll,
+    )
+
+
+@lru_cache(maxsize=None)
+def _steady_step_for(t_star: int, block: int = 0):
+    """The jitted steady EM step specialized to a static convergence
+    horizon (the head length is a scan SHAPE) and tail block size.
+    lru_cached so repeated estimates at one horizon share a traced
+    program, and named per specialization so `run_em_loop`'s AOT-registry
+    statics key (utils.compile.aot_statics uses __module__ + __qualname__)
+    distinguishes horizons."""
+
+    def step(state: SteadyEMState, x, mask, stats: PanelStats):
+        return _em_step_steady_impl(state, x, mask, stats, t_star, block)
+
+    step.__name__ = step.__qualname__ = f"em_step_steady_t{t_star}_b{block}"
+    step.__module__ = __name__
+    return jax.jit(step)
+
+
+def em_step_steady(state, x, mask, stats: PanelStats, t_star: int, block: int = 0):
+    """One steady-path EM iteration (see `_em_step_steady_impl`): exact
+    head of `t_star` steps, constant-gain factorization-free tail, E-step
+    tail moments in closed form.  `state` is a `SteadyEMState`; a bare
+    `SSMParams` is wrapped with a cold-start carry.  Returns
+    (SteadyEMState, loglik) — `run_em_loop`-compatible via
+    `_steady_step_for(t_star, block)`."""
+    if not isinstance(state, SteadyEMState):
+        k = state.r * state.p
+        state = SteadyEMState(
+            params=state,
+            Pp=jnp.zeros((k, k), state.lam.dtype),
+            riccati_iters=jnp.asarray(0, jnp.int32),
+        )
+    return _steady_step_for(int(t_star), int(block))(state, x, mask, stats)
+
+
 class EMResults(NamedTuple):
     params: SSMParams
     factors: jnp.ndarray  # (T, r) smoothed factors (standardized units)
@@ -1024,7 +1391,14 @@ def estimate_dfm_em(
     collect_path=True switches to a host loop whose per-iteration wall
     clock is recorded in EMResults.trace.  method="associative" swaps the
     E-step for the parallel-in-time scans (`em_step_assoc`); method="sqrt"
-    uses the square-root array E-step (`em_step_sqrt`, f32-accurate).
+    uses the square-root array E-step (`em_step_sqrt`, f32-accurate);
+    method="steady" runs the steady-state fast path (`em_step_steady`:
+    exact head to the Riccati convergence horizon, constant-gain
+    factorization-free tail, closed-form tail covariance moments, with
+    the previous iteration's Pp∞ carried through the loop to warm-start
+    each DARE solve) when the panel has a complete-tail observation
+    pattern, and falls back to the sequential program otherwise
+    (telemetry records `steady_gated`).
 
     gram_dtype="bfloat16" (sequential method only) runs a mixed-precision
     bulk phase first — the iteration's four panel GEMMs (collapse C/b,
@@ -1064,6 +1438,12 @@ def estimate_dfm_em(
         raise ValueError(f"method must be one of {_FILTER_METHODS}, got {method!r}")
     if accel not in (None, "squarem"):
         raise ValueError(f"accel must be None or 'squarem', got {accel!r}")
+    if accel is not None and method == "steady":
+        raise ValueError(
+            "accel is not combinable with method='steady': the steady EM "
+            "carry (SteadyEMState: params + warm-start Pp∞ + solver "
+            "counters) is not an extrapolable parameter vector"
+        )
     if gram_dtype not in (None, "bfloat16"):
         raise ValueError(
             f"gram_dtype must be None or 'bfloat16', got {gram_dtype!r}"
@@ -1117,6 +1497,32 @@ def estimate_dfm_em(
             else:
                 stats = compute_panel_stats(xz, m_arr)
             args = (xz, m_arr, stats)
+        elif method == "steady":
+            stats = compute_panel_stats(xz, m_arr)
+            args = (xz, m_arr, stats)
+            plan = _steady_plan(params, m_arr)
+            if plan is None:
+                # gated off (incomplete tail / slow mixing / short sample):
+                # the exact sequential program, same args
+                step = em_step_stats
+                rec.set(steady_gated=True, steady_frac=0.0)
+            else:
+                t_star, st0, rho = plan
+                block = _steady_block_for(T0 - t_star)
+                step = _steady_step_for(t_star, block)
+                params = SteadyEMState(
+                    params=params,
+                    # warm-start iteration 1 from the init-params solve the
+                    # dispatch already paid for
+                    Pp=jnp.asarray(st0.Pp, xz.dtype),
+                    riccati_iters=jnp.asarray(0, jnp.int32),
+                )
+                rec.set(
+                    t_star=t_star,
+                    steady_frac=float(T0 - t_star) / float(T0),
+                    riccati_rho=float(rho),
+                    steady_block=block,
+                )
         else:
             step = {
                 "associative": em_step_assoc,
@@ -1158,6 +1564,9 @@ def estimate_dfm_em(
 
         if accel == "squarem":
             params = params.params  # unwrap SquaremState
+        if isinstance(params, SteadyEMState):
+            rec.set(riccati_iters=int(params.riccati_iters))
+            params = params.params
         rec.set(
             n_iter=n_iter,
             converged=n_iter < max_em_iter,

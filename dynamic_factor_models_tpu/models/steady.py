@@ -1,0 +1,504 @@
+"""Steady-state Kalman machinery: DARE fixed point + constant-gain tail.
+
+The Stock-Watson state-space model (PAPER.md) is time-invariant, so the
+filter's Riccati recursion Pp_{t+1} = Tm (Pp_t^-1 + C)^-1 Tm' + Qs converges
+geometrically to a fixed point Pp∞ — typically within a few dozen of the 224
+sample quarters.  Past that horizon every per-step Cholesky in the filter,
+the smoother's per-step gain solve, and the E-step's O(T k^2) covariance
+reductions are recomputing constants.  This module holds the model-agnostic
+pieces of the `method="steady"` execution path (ssm.py wires them into the
+DFM estimator):
+
+  * `dare_doubling` — a jittable structure-preserving doubling solver (SDA;
+    Chu-Fan-Lin 2005) for the filter-form DARE
+
+        X = H + A' X (I + G X)^-1 A,        A = Tm', G = C, H = Qs,
+
+    whose iterates satisfy H_k = Phi^{2^k}(0): quadratic convergence, ~6-8
+    doublings cold.  The same recursion tracks the COMPOSED map applied to
+    an arbitrary start, X_k = Phi^{2^k}(X0) = H_k + A_k' X0 (I+G_k X0)^-1 A_k,
+    which is what makes EM warm starts cheap: with X0 the previous
+    iteration's Pp∞ the transient is tiny and the early-exit fires after
+    2-3 doublings instead of a cold solve.
+  * `steady_state` — derived constants at the fixed point: Pu∞, the steady
+    gain K∞ on the collapsed observation, the closed-loop transition
+    Ā = (I - Pu∞C)Tm (so s_t = Ā s_{t-1} + K∞ b_t), the steady RTS gain
+    J∞ = Pu∞Tm'Pp∞^-1, the steady smoothed covariance Ps∞ (a Stein
+    equation, solved by Smith doubling), the right-boundary deviation sum
+    S_dev = Σ_{j>=0} J∞^j (Pu∞ - Ps∞) J∞'^j, and the log-det constants of
+    the steady per-step likelihood.
+  * `convergence_horizon` — host-side t*: the number of exact head steps
+    after which the time-varying recursion is within `tol` of the fixed
+    point, from the spectral radius of Ā (forward and backward transients
+    share it: rho(J∞) = rho(Ā) because J∞ = Pu∞Tm'Pp∞^-1 and
+    Ā = Pu∞Pp∞^-1Tm have equal spectra) and verified by running the exact
+    recursion.  t* is a SHAPE (the head scan length), so it is computed
+    once per estimate call, never traced.
+  * `linear_recursion` / `steady_tail` / `steady_smooth_tail` — the
+    factorization-free tail kernels: a time-invariant linear recursion
+    s_t = M s_{t-1} + g_t evaluated either as a `lax.scan` of matvecs or
+    block-parallel (precomputed M^d powers, one einsum per block — the
+    MXU-shaped form), plus the vectorized constant-gain per-step
+    log-likelihood and the backward smoothed-mean recursion
+    e_t = J∞ e_{t+1} + (I - J∞Tm) su_t.  Their jitted HLO contains no
+    cholesky / triangular_solve ops (pinned by tests/test_perf_regression).
+  * `periodic_dare` — the cyclostationary generalization for the
+    mixed-frequency monthly/quarterly observation pattern: the mask cycle
+    makes C_t periodic with period d, the Riccati map converges to a
+    d-cycle of fixed points, and mixed_freq.steady_gains exposes the
+    per-phase gain set.
+
+Validated against `scipy.linalg.solve_discrete_are` in tests/test_steady.py.
+"""
+
+from __future__ import annotations
+
+import os as _os
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "SteadyState",
+    "PeriodicSteadyState",
+    "dare_doubling",
+    "stein_sum",
+    "steady_state",
+    "convergence_horizon",
+    "periodic_dare",
+    "linear_recursion",
+    "steady_tail",
+    "steady_smooth_tail",
+]
+
+# same env knob as the ssm scans (read once at import; see ssm._SCAN_UNROLL)
+_SCAN_UNROLL = int(_os.environ.get("DFM_SCAN_UNROLL", "8"))
+
+
+def _sym(X):
+    return 0.5 * (X + X.swapaxes(-1, -2))
+
+
+def _default_tol(dtype) -> float:
+    """Relative fixed-point tolerance: ~1e-12 in f64, ~2e-6 in f32."""
+    return float(jnp.finfo(dtype).eps) ** 0.75
+
+
+# ---------------------------------------------------------------------------
+# DARE: structure-preserving doubling
+# ---------------------------------------------------------------------------
+
+
+def dare_doubling(Tm, C, Qs, X0=None, tol: float | None = None, max_iter: int = 64):
+    """Solve the filter-form DARE by structure-preserving doubling.
+
+    Fixed point of the information-form covariance recursion
+
+        Pp = Tm (Pp^-1 + C)^-1 Tm' + Qs
+           = Qs + Tm Pp (I + C Pp)^-1 Tm',
+
+    i.e. X = H + A' X (I + G X)^-1 A with A = Tm', G = C, H = Qs.  The SDA
+    iteration doubles the map each step,
+
+        M_k     = (I + G_k H_k)^-1
+        A_{k+1} = A_k M_k A_k
+        G_{k+1} = G_k + A_k M_k G_k A_k'
+        H_{k+1} = H_k + A_k' H_k M_k A_k,
+
+    and the triple represents the 2^k-fold composed Riccati map
+    Phi^{2^k}(X) = H_k + A_k' X (I + G_k X)^-1 A_k.  The iterate tracked
+    for convergence is X_k = Phi^{2^k}(X0): with X0 = 0 (cold) X_k = H_k
+    is the classical SDA sequence; with X0 a previous solve (EM warm
+    start) the early-exit fires after the transient — 2-3 doublings —
+    instead of the full cold count.  Quadratic convergence either way.
+
+    Everything is `lax.while_loop`-jittable: pass concrete arrays for a
+    host solve or call under jit for the in-graph EM warm start.
+
+    Returns (X, iters, converged): the fixed point (symmetrized), the
+    number of doubling steps taken (i32), and a bool.  Requires Tm stable
+    (spectral radius < 1) and Qs PSD with the pair detectable — the
+    conditions the DFM's stationary factor VAR satisfies.
+    """
+    dtype = Tm.dtype
+    k = Tm.shape[0]
+    eye = jnp.eye(k, dtype=dtype)
+    tol = _default_tol(dtype) if tol is None else float(tol)
+    A0 = Tm.T
+    G0 = _sym(jnp.asarray(C, dtype))
+    H0 = _sym(jnp.asarray(Qs, dtype))
+    X0 = jnp.zeros((k, k), dtype) if X0 is None else _sym(jnp.asarray(X0, dtype))
+
+    def apply_map(A, G, H):
+        # Phi^{2^k}(X0) = H + A' X0 (I + G X0)^-1 A
+        Z = jnp.linalg.solve(eye + G @ X0, A)
+        return _sym(H + A.T @ X0 @ Z)
+
+    def body(carry):
+        A, G, H, X, _, it = carry
+        M = jnp.linalg.solve(eye + G @ H, eye)
+        AM = A @ M
+        A1 = AM @ A
+        G1 = _sym(G + AM @ G @ A.T)
+        H1 = _sym(H + A.T @ H @ M @ A)
+        return A1, G1, H1, apply_map(A1, G1, H1), X, it + 1
+
+    def cond(carry):
+        _, _, _, X, X_prev, it = carry
+        num = jnp.linalg.norm(X - X_prev)
+        den = jnp.maximum(jnp.linalg.norm(X), jnp.asarray(1.0, dtype))
+        return (num > tol * den) & (it < max_iter)
+
+    init = (A0, G0, H0, apply_map(A0, G0, H0), X0, jnp.asarray(0, jnp.int32))
+    A, G, H, X, X_prev, iters = jax.lax.while_loop(cond, body, init)
+    num = jnp.linalg.norm(X - X_prev)
+    den = jnp.maximum(jnp.linalg.norm(X), jnp.asarray(1.0, dtype))
+    return X, iters, num <= tol * den
+
+
+def stein_sum(J, W, tol: float | None = None, max_iter: int = 48):
+    """Sum the geometric matrix series X = Σ_{j>=0} J^j W J'^j by Smith
+    doubling: X_{m+1} = X_m + J_m X_m J_m', J_{m+1} = J_m^2 — each step
+    doubles the number of terms, so a spectral radius rho needs
+    ~log2(log(tol)/log(rho)) iterations (6-8 in practice).  X solves the
+    Stein equation X = W + J X J'.  Requires rho(J) < 1."""
+    dtype = J.dtype
+    tol = _default_tol(dtype) if tol is None else float(tol)
+
+    def body(carry):
+        Jc, X, it = carry
+        X1 = _sym(X + Jc @ X @ Jc.T)
+        return Jc @ Jc, X1, it + 1
+
+    def cond(carry):
+        Jc, X, it = carry
+        # remaining terms are bounded by ||J_c||^2 * ||X||-scale
+        return (jnp.linalg.norm(Jc) > tol) & (it < max_iter)
+
+    _, X, _ = jax.lax.while_loop(
+        cond, body, (J, _sym(W), jnp.asarray(0, jnp.int32))
+    )
+    return X
+
+
+# ---------------------------------------------------------------------------
+# Steady-state constants
+# ---------------------------------------------------------------------------
+
+
+class SteadyState(NamedTuple):
+    """Constants of the converged filter/smoother, for a collapsed
+    observation loading only the first q state dims (ssm.py: q = r).
+
+    Pp/Pu: steady predicted/updated covariances (k, k); K: steady gain on
+    the collapsed observation b_t (k, q) — s_t = Abar s_{t-1} + K b_t;
+    Abar: closed-loop transition (I - Pu C)Tm; J: steady RTS gain
+    Pu Tm' Pp^-1; Ps: steady smoothed covariance (interior); Sdev:
+    Σ_{j>=0} J^j (Pu - Ps) J'^j, the right-boundary smoothed-covariance
+    deviation sum (P_sm_{T-1-j} = Ps + J^j (Pu - Ps) J'^j); ld_pp/ld_pu:
+    log|Pp| / log|Pu| (the per-step likelihood constant is
+    ld_R∞ + ld_pp - ld_pu); riccati_iters: doubling steps of the DARE
+    solve; converged: solver flag."""
+
+    Pp: jnp.ndarray
+    Pu: jnp.ndarray
+    K: jnp.ndarray
+    Abar: jnp.ndarray
+    J: jnp.ndarray
+    Ps: jnp.ndarray
+    Sdev: jnp.ndarray
+    ld_pp: jnp.ndarray
+    ld_pu: jnp.ndarray
+    riccati_iters: jnp.ndarray
+    converged: jnp.ndarray
+
+
+def _steady_from_pp(Tm, Cq, Pp, q: int, riccati_iters, converged) -> SteadyState:
+    """Derive every SteadyState constant from the DARE solution Pp.
+    Factorizations happen HERE, once — never in the tail kernels."""
+    k = Tm.shape[0]
+    dtype = Tm.dtype
+    eye = jnp.eye(k, dtype=dtype)
+    Cf = jnp.zeros((k, k), dtype).at[:q, :q].set(Cq)
+    Lp = jnp.linalg.cholesky(_sym(Pp))
+    Ppinv = jax.scipy.linalg.cho_solve((Lp, True), eye)
+    M = _sym(Ppinv + Cf)
+    Lm = jnp.linalg.cholesky(M)
+    Pu = _sym(jax.scipy.linalg.cho_solve((Lm, True), eye))
+    ld_pp = 2.0 * jnp.log(jnp.diagonal(Lp)).sum()
+    ld_pu = -2.0 * jnp.log(jnp.diagonal(Lm)).sum()
+    K = Pu[:, :q]
+    Abar = Tm - (K @ Cq) @ Tm[:q, :]  # (I - Pu Cf) Tm without the k^3 zero block
+    J = jax.scipy.linalg.cho_solve((Lp, True), Tm @ Pu).T  # Pu Tm' Pp^-1
+    # steady smoothed covariance: Ps = Pu + J (Ps - Pp) J'  =>  Stein with
+    # W = Pu - J Pp J'
+    Ps = stein_sum(J, _sym(Pu - J @ Pp @ J.T))
+    Sdev = stein_sum(J, _sym(Pu - Ps))
+    return SteadyState(
+        Pp=Pp, Pu=Pu, K=K, Abar=Abar, J=J, Ps=Ps, Sdev=Sdev,
+        ld_pp=ld_pp, ld_pu=ld_pu,
+        riccati_iters=riccati_iters, converged=converged,
+    )
+
+
+def steady_state(
+    Tm, Cq, Qs, q: int | None = None, Pp0=None,
+    tol: float | None = None, max_iter: int = 64,
+) -> SteadyState:
+    """Solve the DARE for the collapsed model and derive all steady
+    constants.  `Cq` is the (q, q) leading block of the information matrix
+    C = Lam'R^-1Lam (q = r for ssm.py; q inferred from Cq when omitted);
+    `Pp0` warm-starts the doubling (pass the previous EM iteration's Pp∞).
+    Jittable end-to-end."""
+    q = Cq.shape[0] if q is None else q
+    k = Tm.shape[0]
+    dtype = Tm.dtype
+    Cf = jnp.zeros((k, k), dtype).at[:q, :q].set(Cq)
+    Pp, iters, ok = dare_doubling(Tm, Cf, Qs, X0=Pp0, tol=tol, max_iter=max_iter)
+    return _steady_from_pp(Tm, Cq, Pp, q, iters, ok)
+
+
+def convergence_horizon(
+    Tm, Cq, Qs, steady: SteadyState, P0, tol: float | None = None,
+    t_max: int = 4096,
+):
+    """Host-side convergence horizon t*: the first t at which the exact
+    time-varying recursion started from P0 has ||Pu_t - Pu∞||_max <= tol.
+
+    The spectral gap gives the a-priori estimate — deviations contract
+    like rho(Ā)^{2t} (the covariance transient is quadratic in the state
+    transient) — and the exact information-form recursion, run here in
+    NumPy at k x k cost, confirms it; the returned t* is the verified
+    count.  Returns (t_star, rho); t_star = t_max + 1 when the recursion
+    has not converged within t_max (callers gate the fast path off), and
+    immediately when rho >= 1 - 1e-6 (no usable steady state).
+
+    t* is a static quantity (it becomes the head scan LENGTH), which is
+    why this runs on host with concrete arrays, never under jit.
+    """
+    Tm = np.asarray(Tm, np.float64)
+    Cq = np.asarray(Cq, np.float64)
+    Qs = np.asarray(Qs, np.float64)
+    P0 = np.asarray(P0, np.float64)
+    Pu_inf = np.asarray(steady.Pu, np.float64)
+    Abar = np.asarray(steady.Abar, np.float64)
+    k = Tm.shape[0]
+    q = Cq.shape[0]
+    if tol is None:
+        tol = _default_tol(np.asarray(steady.Pu).dtype)
+    rho = float(np.max(np.abs(np.linalg.eigvals(Abar))))
+    if not np.isfinite(rho) or rho >= 1.0 - 1e-6:
+        return t_max + 1, rho
+    Cf = np.zeros((k, k))
+    Cf[:q, :q] = Cq
+    eye = np.eye(k)
+    scale = max(np.max(np.abs(Pu_inf)), 1.0)
+    P = P0
+    for t in range(1, t_max + 1):
+        Pp = Tm @ P @ Tm.T + Qs
+        Pp = 0.5 * (Pp + Pp.T)
+        Pu = np.linalg.solve(np.linalg.inv(Pp) + Cf, eye)
+        P = 0.5 * (Pu + Pu.T)
+        if np.max(np.abs(P - Pu_inf)) <= tol * scale:
+            return t, rho
+    return t_max + 1, rho
+
+
+# ---------------------------------------------------------------------------
+# Periodic (cyclostationary) DARE — mixed-frequency mask cycles
+# ---------------------------------------------------------------------------
+
+
+class PeriodicSteadyState(NamedTuple):
+    """Per-phase steady constants of a period-d observation cycle.  Phase j
+    holds the quantities of a step whose measurement uses C_j: Pp[j] is the
+    covariance PREDICTED INTO phase j (from phase j-1 mod d), Pu[j] the
+    updated covariance, K[j] the gain (on the full state — slice [:, :q]
+    for a q-dim collapsed observation), Abar[j] the closed-loop transition
+    INTO phase j.  cycles counts full period sweeps of the solver."""
+
+    Pp: jnp.ndarray  # (d, k, k)
+    Pu: jnp.ndarray  # (d, k, k)
+    K: jnp.ndarray  # (d, k, k)  = Pu[j] (information form: gain on b rides Pu)
+    Abar: jnp.ndarray  # (d, k, k)
+    J: jnp.ndarray  # (d, k, k)  RTS gain pairing phase j with phase j+1's Pp
+    cycles: jnp.ndarray
+    converged: jnp.ndarray
+
+
+def periodic_dare(
+    Tm, Cs, Qs, tol: float | None = None, max_cycles: int = 512,
+) -> PeriodicSteadyState:
+    """Fixed cycle of the Riccati recursion under a period-d observation
+    pattern: C_t = Cs[t mod d] (full (k, k) information matrices).  The
+    composed d-phase Riccati map is iterated (linear convergence at
+    rho^(2d) per sweep — a handful of sweeps in practice) until the
+    phase-0 predicted covariance stops moving, then one recording sweep
+    materializes the per-phase constants.  Jittable."""
+    Cs = jnp.asarray(Cs)
+    d = Cs.shape[0]
+    k = Tm.shape[0]
+    dtype = Tm.dtype
+    eye = jnp.eye(k, dtype=dtype)
+    tol = _default_tol(dtype) if tol is None else float(tol)
+
+    def riccati_phase(Pp, Cj):
+        # update with Cj, then predict — returns (Pu_j, Pp into next phase)
+        Lp = jnp.linalg.cholesky(_sym(Pp))
+        Ppinv = jax.scipy.linalg.cho_solve((Lp, True), eye)
+        Lm = jnp.linalg.cholesky(_sym(Ppinv + Cj))
+        Pu = _sym(jax.scipy.linalg.cho_solve((Lm, True), eye))
+        return Pu, _sym(Tm @ Pu @ Tm.T + Qs)
+
+    def sweep(Pp0):
+        def phase(Pp, Cj):
+            Pu, Pp_next = riccati_phase(Pp, Cj)
+            return Pp_next, (Pp, Pu)
+
+        Pp_end, (Pps, Pus) = jax.lax.scan(phase, Pp0, Cs)
+        return Pp_end, Pps, Pus
+
+    def body(carry):
+        Pp0, _, it = carry
+        Pp1, _, _ = sweep(Pp0)
+        return Pp1, Pp0, it + 1
+
+    def cond(carry):
+        Pp0, Pp_prev, it = carry
+        num = jnp.linalg.norm(Pp0 - Pp_prev)
+        den = jnp.maximum(jnp.linalg.norm(Pp0), jnp.asarray(1.0, dtype))
+        return (num > tol * den) & (it < max_cycles)
+
+    Pp_init = _sym(Tm @ Qs @ Tm.T + Qs) + eye
+    Pp0, Pp_prev, cycles = jax.lax.while_loop(
+        cond, body, (Pp_init, Pp_init + eye, jnp.asarray(0, jnp.int32))
+    )
+    num = jnp.linalg.norm(Pp0 - Pp_prev)
+    den = jnp.maximum(jnp.linalg.norm(Pp0), jnp.asarray(1.0, dtype))
+    ok = num <= tol * den
+    # recording sweep at the fixed cycle
+    _, Pps, Pus = sweep(Pp0)
+    Abar = jnp.einsum("dij,jl->dil", eye[None] - jnp.einsum(
+        "dij,djl->dil", Pus, Cs), Tm)
+    # J[j] pairs phase j's update with phase j+1's prediction:
+    # J_j = Pu_j Tm' Pp_{j+1}^-1
+    Pp_next = jnp.roll(Pps, -1, axis=0)
+    J = jax.vmap(
+        lambda Pu, Ppn: jax.scipy.linalg.cho_solve(
+            (jnp.linalg.cholesky(_sym(Ppn)), True), Tm @ Pu
+        ).T
+    )(Pus, Pp_next)
+    return PeriodicSteadyState(
+        Pp=Pps, Pu=Pus, K=Pus, Abar=Abar, J=J,
+        cycles=cycles, converged=ok,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Factorization-free tail kernels
+# ---------------------------------------------------------------------------
+
+
+def linear_recursion(M, g, s_init, block: int = 0):
+    """Evaluate the time-invariant linear recursion
+
+        s_0 = M s_init + g_0,     s_t = M s_{t-1} + g_t
+
+    over g (n, k), returning (n, k).  block == 0 runs a `lax.scan` of
+    matvecs (the right shape for small n on CPU); block >= 2 runs the
+    block-parallel MXU form: precompute the powers M^0..M^block once,
+    build the lower-triangular block operator W[j, i] = M^{j-i}, and each
+    length-`block` chunk is ONE einsum
+
+        out[j] = Σ_{i<=j} M^{j-i} g_i + M^{j+1} s_carry
+
+    — a (B, B, k, k) x (B, k) contraction plus a (B, k, k) x (k) carry
+    term, scanned over ceil(n / block) chunks.  Identical results (same
+    f64 bits up to matmul reassociation); no factorizations either way.
+    """
+    n, k = g.shape
+    dtype = g.dtype
+    if block <= 1 or n < 2 * block:
+
+        def step(s, gt):
+            s2 = M @ s + gt
+            return s2, s2
+
+        _, out = jax.lax.scan(step, s_init, g, unroll=_SCAN_UNROLL)
+        return out
+
+    nb = -(-n // block)  # ceil
+    pad = nb * block - n
+    gp = jnp.concatenate([g, jnp.zeros((pad, k), dtype)]) if pad else g
+    # M^0 .. M^block (block is static: unrolled python loop at trace time)
+    powers = [jnp.eye(k, dtype=dtype)]
+    for _ in range(block):
+        powers.append(M @ powers[-1])
+    P = jnp.stack(powers)  # (block+1, k, k)
+    idx = np.arange(block)[:, None] - np.arange(block)[None, :]  # j - i
+    W = jnp.where(
+        jnp.asarray(idx >= 0)[:, :, None, None],
+        P[jnp.asarray(np.clip(idx, 0, block))],
+        jnp.zeros((), dtype),
+    )  # (B, B, k, k) lower-triangular in (j, i)
+    Pcarry = P[1:]  # (B, k, k): M^{j+1}
+
+    def chunk(s, gblk):
+        out = jnp.einsum("jiab,ib->ja", W, gblk) + jnp.einsum(
+            "jab,b->ja", Pcarry, s
+        )
+        return out[-1], out
+
+    _, out = jax.lax.scan(chunk, s_init, gp.reshape(nb, block, k))
+    return out.reshape(nb * block, k)[:n]
+
+
+def steady_tail(Tm, Cq, Pu_qq, K, Abar, b, s_init, n_obs_const, ld_const, block: int = 0):
+    """Constant-gain filter tail: filtered means + per-step log-likelihood
+    terms for the steps past the convergence horizon.  All inputs are
+    steady constants except b (n, q) — the collapsed observations — and
+    s_init, the last exact-head filtered state.  Returns (su (n, k),
+    lls (n,)).
+
+    ll_t = -1/2 (n_obs log2pi + ld_const + quad_t) with
+    ld_const = ld_R∞ + log|Pp∞| - log|Pu∞| and
+
+        quad_t = -2 f_p'b_t + f_p'C f_p - rhs'Pu rhs,   rhs = b_t - C f_p,
+
+    exactly `_info_filter_scan`'s likelihood with the covariances pinned
+    at the fixed point (the x'R^-1x piece rides the PanelStats ll_corr as
+    in the sequential path).  Contains matmuls and einsums only — the
+    compiled HLO is factorization-free by construction.
+    """
+    q = Cq.shape[0]
+    dtype = b.dtype
+    log2pi = jnp.asarray(np.log(2.0 * np.pi), dtype)
+    su = linear_recursion(Abar, b @ K.T, s_init, block=block)
+    s_prev = jnp.concatenate([s_init[None], su[:-1]])
+    fp = (s_prev @ Tm.T)[:, :q]
+    rhs = b - fp @ Cq
+    quad = (
+        -2.0 * (fp * b).sum(axis=1)
+        + jnp.einsum("ti,ij,tj->t", fp, Cq, fp)
+        - jnp.einsum("ti,ij,tj->t", rhs, Pu_qq, rhs)
+    )
+    lls = -0.5 * (n_obs_const * log2pi + ld_const + quad)
+    return su, lls
+
+
+def steady_smooth_tail(Tm, J, su, block: int = 0):
+    """Backward smoothed means over the tail from its filtered means:
+    e_{T-1} = su_{T-1} (the smoothed mean equals the filtered mean at the
+    sample end) and, with the steady RTS gain,
+
+        e_t = J e_{t+1} + (I - J Tm) su_t.
+
+    Runs as the SAME linear recursion as the forward pass, time-reversed —
+    factorization-free.  Returns the (n, k) smoothed means."""
+    g = su @ (jnp.eye(Tm.shape[0], dtype=su.dtype) - J @ Tm).T
+    if su.shape[0] == 1:
+        return su
+    e_rev = linear_recursion(J, g[:-1][::-1], su[-1], block=block)
+    return jnp.concatenate([e_rev[::-1], su[-1:]])

@@ -435,6 +435,8 @@ class CompileSpec:
         "als_core",
         "bootstrap_core",
         "em_loop",
+        "em_step_steady",
+        "em_loop@steady",
     )
     max_em_iter: int = 200
     als_max_iter: int = 200_000
@@ -442,6 +444,12 @@ class CompileSpec:
     horizon: int = 24
     n_reps: int = 1000
     ns: int | None = None  # bootstrap system width (default: r)
+    # steady-state fast path (models/steady.py): the exact-head length t*
+    # is a STATIC of the steady EM step (it sizes the head scan), so the
+    # executable is only reusable for runs whose `_steady_plan` lands on
+    # the same t_star.  None (default) skips the steady kernels entirely.
+    t_star: int | None = None
+    steady_block: int = 0
 
     def padded_shape(self) -> tuple:
         if not self.bucket:
@@ -524,6 +532,72 @@ def _kernel_plan(spec: CompileSpec):
                 {},
                 (),
                 lambda: em_inputs()[:3],
+            )
+
+    if spec.t_star is not None and (
+        "em_step_steady" in spec.kernels or "em_loop@steady" in spec.kernels
+    ):
+        # the steady EM step is a per-(t_star, block) jitted function
+        # (ssm._steady_step_for names it em_step_steady_t{t}_b{b}, so the
+        # aot_statics rendering of the step is stable across processes)
+        steady_step = ssm._steady_step_for(spec.t_star, spec.steady_block)
+        k = r * p
+        scarry_params_s = ssm.SteadyEMState(
+            params_s, _sds((k, k), dt), _sds((), jnp.int32)
+        )
+
+        def steady_inputs():
+            pa, x, mask, stats = em_inputs()
+            st = ssm.SteadyEMState(
+                pa, jnp.zeros((k, k), dt), jnp.asarray(0, jnp.int32)
+            )
+            return st, x, mask, stats
+
+        if "em_step_steady" in spec.kernels:
+            plans["em_step_steady"] = (
+                steady_step,
+                (scarry_params_s, x_s, mask_s, stats_s),
+                {},
+                (),
+                steady_inputs,
+            )
+
+        if "em_loop@steady" in spec.kernels:
+            # the on-device EM while-loop specialized to the steady step:
+            # registered under the "em_loop" name (the `@steady` suffix is
+            # stripped by `precompile`), distinguished from the sequential
+            # loop by the statics key run_em_loop reproduces at dispatch
+            from ..models import emloop
+
+            ld = jnp.result_type(float)
+            scarry_s = (
+                scarry_params_s,
+                _sds((), ld),
+                _sds((), ld),
+                _sds((), jnp.int32),
+                _sds((spec.max_em_iter,), ld),
+            )
+
+            def steady_loop_inputs():
+                st, x, mask, stats = steady_inputs()
+                carry = emloop._fresh_carry(
+                    st, jnp.asarray(1e-6, ld), spec.max_em_iter
+                )
+                return (
+                    carry,
+                    (x, mask, stats),
+                    jnp.asarray(1e-6, ld),
+                    jnp.asarray(2, jnp.int32),
+                )
+
+            sdonate = donation_enabled()
+            plans["em_loop@steady"] = (
+                emloop._em_while_jit(sdonate),
+                (steady_step, scarry_s, (x_s, mask_s, stats_s), _sds((), ld),
+                 spec.max_em_iter, _sds((), jnp.int32)),
+                {},
+                aot_statics(steady_step, spec.max_em_iter, sdonate, 0),
+                steady_loop_inputs,
             )
 
     if "em_step_ar" in spec.kernels:
@@ -660,6 +734,10 @@ def precompile(spec: CompileSpec, warmup: bool = True) -> dict:
     for name, (fn, lower_args, lower_kwargs, statics, mk_inputs) in (
         _kernel_plan(spec).items()
     ):
+        # plan keys may carry an "@variant" suffix ("em_loop@steady"); the
+        # registry/counter name a production aot_call reproduces is the
+        # prefix — variants of one kernel differ only in their statics key
+        reg = name.split("@", 1)[0]
         traced_only = tuple(
             a for a in lower_args
             if any(
@@ -667,20 +745,20 @@ def precompile(spec: CompileSpec, warmup: bool = True) -> dict:
                 for leaf in jax.tree.leaves(a)
             )
         )
-        key = (name, statics, _sig(traced_only))
+        key = (reg, statics, _sig(traced_only))
         with _lock:
             cached = key in _AOT
         entry = {"aot_cached": cached, "compile_s": 0.0, "run_s": None}
         if cached:
             with _lock:
-                _counter(name)["aot_hits"] += 1
+                _counter(reg)["aot_hits"] += 1
         else:
             t0 = time.perf_counter()
             compiled = fn.lower(*lower_args, **lower_kwargs).compile()
             entry["compile_s"] = round(time.perf_counter() - t0, 4)
             with _lock:
                 _AOT[key] = compiled
-                c = _counter(name)
+                c = _counter(reg)
                 c["compiles"] += 1
                 c["compile_s"] += entry["compile_s"]
             total_c += entry["compile_s"]
@@ -691,7 +769,7 @@ def precompile(spec: CompileSpec, warmup: bool = True) -> dict:
             jax.block_until_ready(compiled(*inputs))
             entry["run_s"] = round(time.perf_counter() - t0, 4)
             with _lock:
-                c = _counter(name)
+                c = _counter(reg)
                 c["runs"] += 1
                 c["run_s"] += entry["run_s"]
             total_r += entry["run_s"]

@@ -160,6 +160,85 @@ def test_budget_has_teeth():
         assert 2.0 * calibrated > budget, (budget, calibrated)
 
 
+def test_steady_tail_hlo_is_factorization_free():
+    """The steady-state constant-gain tail must compile to pure linear
+    algebra: no cholesky / triangular_solve in the stableHLO and no
+    factorization kernels (potrf/trsm) in the compiled program, in both
+    the lax.scan and the block-parallel formulations.  This is the whole
+    point of the fast path — a tail step is one matvec (or one matmul per
+    block) where every exact method pays two factorizations per step."""
+    from dynamic_factor_models_tpu.models.steady import (
+        linear_recursion,
+        steady_tail,
+    )
+
+    k, q, Tt = 16, 4, 176
+    dt = jnp.float32
+    rng = np.random.default_rng(0)
+    arrs = dict(
+        Tm=jnp.asarray(rng.standard_normal((k, k)), dt),
+        Cq=jnp.asarray(rng.standard_normal((q, q)), dt),
+        Pu_qq=jnp.asarray(np.eye(q), dt),
+        K=jnp.asarray(rng.standard_normal((k, q)), dt),
+        Abar=jnp.asarray(0.05 * rng.standard_normal((k, k)), dt),
+        b=jnp.asarray(rng.standard_normal((Tt, q)), dt),
+        s_init=jnp.zeros(k, dt),
+        n_obs=jnp.ones(Tt, dt),
+        ld=jnp.asarray(1.0, dt),
+    )
+    for block in (0, 32):
+        fn = jax.jit(
+            lambda Tm, Cq, Pu_qq, K, Abar, b, s_init, n_obs, ld, _b=block: (
+                steady_tail(Tm, Cq, Pu_qq, K, Abar, b, s_init, n_obs, ld, block=_b)
+            )
+        )
+        lowered = fn.lower(*arrs.values())
+        hlo = lowered.as_text()
+        assert "cholesky" not in hlo, f"cholesky in steady tail (block={block})"
+        assert "triangular" not in hlo, (
+            f"triangular_solve in steady tail (block={block})"
+        )
+        compiled = lowered.compile().as_text().lower()
+        for op in ("potrf", "trsm", "cholesky", "triangular"):
+            assert op not in compiled, (
+                f"factorization kernel {op!r} in compiled steady tail "
+                f"(block={block})"
+            )
+        # and the recursion primitive alone, same property
+        rec = jax.jit(
+            lambda M, g, s0, _b=block: linear_recursion(M, g, s0, block=_b)
+        ).lower(arrs["Abar"], arrs["b"] @ arrs["K"].T, arrs["s_init"])
+        assert "cholesky" not in rec.as_text()
+        assert "triangular" not in rec.as_text()
+
+
+def test_sequential_program_unchanged_by_steady_path():
+    """Requesting the steady path must not perturb the default program:
+    the stableHLO of `em_step_stats` at reference scale is byte-identical
+    before and after the steady machinery compiles and runs."""
+    from dynamic_factor_models_tpu.models.ssm import (
+        SteadyEMState,
+        _steady_step_for,
+        compute_panel_stats,
+        em_step_stats,
+    )
+
+    xz, m = _panel(224, 139, 0.0, seed=3)
+    params = _ssm_params(139, 4, 4)
+    stats = compute_panel_stats(xz, m)
+    before = em_step_stats.lower(params, xz, m, stats).as_text()
+    # exercise the steady path end to end (compile + execute)
+    step = _steady_step_for(48, 0)
+    st = SteadyEMState(
+        params,
+        jnp.zeros((16, 16), xz.dtype),
+        jnp.asarray(0, jnp.int32),
+    )
+    jax.block_until_ready(step(st, xz, m, stats))
+    after = em_step_stats.lower(params, xz, m, stats).as_text()
+    assert before == after, "sequential EM program changed by steady path"
+
+
 @pytest.mark.telemetry
 def test_disabled_telemetry_path_is_free(monkeypatch):
     """The observability layer must cost nothing when unconfigured: every

@@ -1,0 +1,385 @@
+"""Steady-state Kalman fast path (models/steady.py + ssm method="steady").
+
+Three claims are pinned here:
+
+1. the structure-preserving doubling solver lands on the same DARE fixed
+   point as `scipy.linalg.solve_discrete_are` (f64, 1e-8) on random
+   stable systems, and a warm start from a nearby solution needs no more
+   doublings than a cold solve;
+2. the steady filter/smoother/E-step — exact covariance head of length
+   t*, constant-gain factorization-free tail, closed-form tail covariance
+   moments — matches `method="sequential"` to 1e-10 in f64 on
+   complete-tail panels with ragged missing heads, through a full
+   `estimate_dfm_em` run (warm-started doubling in the EM carry included);
+3. the mask gate is sound: interior missingness falls back to the exact
+   sequential path bit-for-bit, and the periodic (cyclostationary) gain
+   set reproduces the mixed-frequency filter's late-time covariance cycle.
+"""
+
+import numpy as np
+import pytest
+import scipy.linalg
+
+import jax
+import jax.numpy as jnp
+
+from dynamic_factor_models_tpu.models.ssm import (
+    SSMParams,
+    SteadyEMState,
+    _companion,
+    _steady_plan,
+    compute_panel_stats,
+    em_step_stats,
+    em_step_steady,
+    estimate_dfm_em,
+    kalman_filter,
+    kalman_smoother,
+)
+from dynamic_factor_models_tpu.models.steady import (
+    dare_doubling,
+    linear_recursion,
+    periodic_dare,
+    steady_state,
+)
+
+
+def _random_stable_system(rng, k, n, rho=0.7):
+    """(Tm, Qs, H, R) with spectral radius rho: the generic dense test
+    system for the DARE solver (the DFM companion form is a special case)."""
+    Tm = rng.standard_normal((k, k))
+    Tm *= rho / max(abs(np.linalg.eigvals(Tm)))
+    G = rng.standard_normal((k, k))
+    Qs = G @ G.T / k + 0.1 * np.eye(k)
+    H = rng.standard_normal((n, k))
+    R = 0.5 + rng.random(n)
+    return Tm, Qs, H, R
+
+
+def _dgp(seed=3, T=224, N=60, r=3, p=2, n_ragged=20):
+    """Complete-tail panel with ragged missing heads — the regime the
+    steady gate admits — plus a deliberately rough parameter start."""
+    rng = np.random.default_rng(seed)
+    A1 = 0.6 * np.eye(r) + 0.05 * rng.standard_normal((r, r))
+    lam = rng.standard_normal((N, r))
+    f = np.zeros((T + 10, r))
+    for t in range(1, T + 10):
+        f[t] = A1 @ f[t - 1] + rng.standard_normal(r) * 0.5
+    x = f[10:] @ lam.T + rng.standard_normal((T, N)) * 0.8
+    mask = np.ones((T, N), bool)
+    for i in range(n_ragged):
+        mask[: rng.integers(5, 30), i] = False
+    xz = jnp.asarray(np.where(mask, x, 0.0))
+    m = jnp.asarray(mask)
+    params = SSMParams(
+        lam=jnp.asarray(lam + 0.3 * rng.standard_normal((N, r))),
+        R=jnp.ones(N, xz.dtype),
+        A=jnp.concatenate(
+            [0.5 * jnp.eye(r, dtype=xz.dtype)[None], jnp.zeros((p - 1, r, r))]
+        ),
+        Q=jnp.eye(r, dtype=xz.dtype),
+    )
+    return params, xz, m, x
+
+
+@pytest.fixture(scope="module")
+def dgp():
+    return _dgp()
+
+
+# ---------------------------------------------------------------------------
+# DARE doubling vs scipy
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("seed,k,n", [(0, 6, 10), (1, 8, 5), (2, 4, 12)])
+def test_dare_doubling_matches_scipy(seed, k, n):
+    rng = np.random.default_rng(seed)
+    Tm, Qs, H, R = _random_stable_system(rng, k, n)
+    C = (H.T / R) @ H
+    X, iters, converged = dare_doubling(
+        jnp.asarray(Tm), jnp.asarray(C), jnp.asarray(Qs)
+    )
+    assert bool(converged)
+    # filter-form DARE == scipy's control-form DARE with (A', H', Q, R)
+    X_ref = scipy.linalg.solve_discrete_are(Tm.T, H.T, Qs, np.diag(R))
+    np.testing.assert_allclose(np.asarray(X), X_ref, rtol=1e-8, atol=1e-8)
+
+
+def test_dare_warm_start_needs_no_more_doublings():
+    rng = np.random.default_rng(4)
+    Tm, Qs, H, R = _random_stable_system(rng, 6, 8)
+    C = jnp.asarray((H.T / R) @ H)
+    Tm, Qs = jnp.asarray(Tm), jnp.asarray(Qs)
+    X, cold_iters, _ = dare_doubling(Tm, C, Qs)
+    # perturb the fixed point slightly — the EM-carry situation, where the
+    # previous iteration's Pp is near the new parameters' fixed point
+    X0 = X + 1e-3 * jnp.eye(X.shape[0])
+    Xw, warm_iters, converged = dare_doubling(Tm, C, Qs, X0=X0)
+    assert bool(converged)
+    assert int(warm_iters) <= int(cold_iters)
+    np.testing.assert_allclose(np.asarray(Xw), np.asarray(X), atol=1e-10)
+
+
+def test_steady_state_fixed_point_identities(dgp):
+    params, xz, m, _ = dgp
+    r = params.r
+    Tm, Qs = _companion(params._replace(Q=params.Q))
+    C = jnp.asarray((params.lam.T * (1.0 / params.R)) @ params.lam)
+    st = steady_state(Tm, C, Qs, q=r)
+    assert bool(st.converged)
+    k = Tm.shape[0]
+    Pp, Pu = np.asarray(st.Pp), np.asarray(st.Pu)
+    Cf = np.zeros((k, k))
+    Cf[:r, :r] = np.asarray(C)
+    # update identity Pu = (Pp^-1 + C)^-1 and predict identity
+    np.testing.assert_allclose(
+        Pu, np.linalg.inv(np.linalg.inv(Pp) + Cf), atol=1e-12
+    )
+    np.testing.assert_allclose(
+        Pp, np.asarray(Tm) @ Pu @ np.asarray(Tm).T + np.asarray(Qs), atol=1e-12
+    )
+    # smoothed covariance solves its Stein equation Ps = Pu + J(Ps - Pp)J'
+    J, Ps = np.asarray(st.J), np.asarray(st.Ps)
+    np.testing.assert_allclose(Ps, Pu + J @ (Ps - Pp) @ J.T, atol=1e-12)
+
+
+def test_linear_recursion_blocked_matches_scan():
+    rng = np.random.default_rng(5)
+    k, T = 8, 173  # deliberately not a multiple of the block size
+    M = rng.standard_normal((k, k))
+    M *= 0.8 / max(abs(np.linalg.eigvals(M)))
+    g = jnp.asarray(rng.standard_normal((T, k)))
+    s0 = jnp.asarray(rng.standard_normal(k))
+    M = jnp.asarray(M)
+    ref = linear_recursion(M, g, s0, block=0)
+    for block in (8, 32, 256):
+        out = linear_recursion(M, g, s0, block=block)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-12)
+
+
+# ---------------------------------------------------------------------------
+# steady filter / smoother / EM parity vs sequential
+# ---------------------------------------------------------------------------
+
+
+def test_steady_filter_matches_sequential(dgp):
+    params, xz, m, x_raw = dgp
+    xnan = jnp.where(m, jnp.asarray(x_raw), jnp.nan)
+    ref = kalman_filter(params, xnan, method="sequential")
+    out = kalman_filter(params, xnan, method="steady")
+    assert _steady_plan(params, np.asarray(m)) is not None  # fast path taken
+    np.testing.assert_allclose(
+        float(out.loglik), float(ref.loglik), rtol=1e-10
+    )
+    np.testing.assert_allclose(
+        np.asarray(out.means), np.asarray(ref.means), atol=1e-10
+    )
+    np.testing.assert_allclose(
+        np.asarray(out.covs), np.asarray(ref.covs), atol=1e-10
+    )
+
+
+def test_steady_smoother_matches_sequential(dgp):
+    params, xz, m, x_raw = dgp
+    xnan = jnp.where(m, jnp.asarray(x_raw), jnp.nan)
+    means_ref, covs_ref, ll_ref = kalman_smoother(params, xnan, method="sequential")
+    means, covs, ll = kalman_smoother(params, xnan, method="steady")
+    np.testing.assert_allclose(float(ll), float(ll_ref), rtol=1e-10)
+    np.testing.assert_allclose(
+        np.asarray(means), np.asarray(means_ref), atol=1e-10
+    )
+    np.testing.assert_allclose(
+        np.asarray(covs), np.asarray(covs_ref), atol=1e-10
+    )
+
+
+@pytest.mark.parametrize("block", [0, 16])
+def test_em_step_steady_matches_sequential(dgp, block):
+    params, xz, m, _ = dgp
+    stats = compute_panel_stats(xz, m)
+    plan = _steady_plan(params, np.asarray(m))
+    assert plan is not None
+    t_star, _, _ = plan
+    new_ref, ll_ref = em_step_stats(params, xz, m, stats)
+    out, ll = em_step_steady(params, xz, m, stats, t_star, block=block)
+    assert isinstance(out, SteadyEMState)
+    np.testing.assert_allclose(float(ll), float(ll_ref), rtol=1e-10)
+    for a, b in zip(out.params, new_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-10)
+    # the carry exposes the warm-startable fixed point + solver effort
+    assert int(out.riccati_iters) > 0
+    # second (warm) step: Pp carried from the first solve
+    out2, _ = em_step_steady(out, xz, m, stats, t_star, block=block)
+    ref2, _ = em_step_stats(new_ref, xz, m, stats)
+    for a, b in zip(out2.params, ref2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-10)
+
+
+def test_estimate_dfm_em_steady_end_to_end(dgp, monkeypatch, tmp_path):
+    from dynamic_factor_models_tpu.models.dfm import DFMConfig
+
+    _, xz, m, x_raw = dgp
+    xm = np.where(np.asarray(m), x_raw, np.nan)
+    incl = np.ones(xm.shape[1], int)
+    cfg = DFMConfig(nfac_u=3, n_factorlag=2)
+    tele = tmp_path / "steady.jsonl"
+    monkeypatch.setenv("DFM_TELEMETRY", str(tele))
+    T = xm.shape[0]
+    res_seq = estimate_dfm_em(xm, incl, 0, T - 1, cfg, max_em_iter=12, tol=0.0)
+    res_st = estimate_dfm_em(
+        xm, incl, 0, T - 1, cfg, max_em_iter=12, tol=0.0, method="steady"
+    )
+    np.testing.assert_allclose(
+        res_st.loglik_path, res_seq.loglik_path, rtol=1e-10
+    )
+    np.testing.assert_allclose(
+        np.asarray(res_st.factors), np.asarray(res_seq.factors), atol=1e-10
+    )
+    np.testing.assert_allclose(
+        np.asarray(res_st.factor_covs),
+        np.asarray(res_seq.factor_covs),
+        atol=1e-10,
+    )
+    # the steady result carries plain SSMParams (the carry is unwrapped)
+    assert isinstance(res_st.params, SSMParams)
+    # telemetry: t_star / steady_frac / riccati_iters land in the RunRecord
+    import json
+
+    recs = [json.loads(l) for l in tele.read_text().splitlines()]
+    st_recs = [
+        r for r in recs
+        if r.get("entry") == "estimate_dfm_em"
+        and r.get("config", {}).get("method") == "steady"
+    ]
+    assert st_recs, "no steady RunRecord emitted"
+    rec = st_recs[-1]
+    assert rec["t_star"] >= 2
+    assert 0.0 < rec["steady_frac"] < 1.0
+    assert rec["riccati_iters"] > 0
+
+
+def test_steady_gate_falls_back_on_interior_missing(dgp):
+    params, xz, m, x_raw = dgp
+    rng = np.random.default_rng(9)
+    mask = np.asarray(m).copy()
+    mask[rng.random(mask.shape) < 0.05] = False  # interior holes
+    assert _steady_plan(params, mask) is None
+    xnan = jnp.where(jnp.asarray(mask), jnp.asarray(x_raw), jnp.nan)
+    # the public entry points silently take the exact sequential path
+    ref = kalman_filter(params, xnan, method="sequential")
+    out = kalman_filter(params, xnan, method="steady")
+    assert float(out.loglik) == float(ref.loglik)
+    means_ref, _, _ = kalman_smoother(params, xnan, method="sequential")
+    means, _, _ = kalman_smoother(params, xnan, method="steady")
+    np.testing.assert_array_equal(np.asarray(means), np.asarray(means_ref))
+
+
+def test_steady_rejects_accel(dgp):
+    from dynamic_factor_models_tpu.models.dfm import DFMConfig
+
+    _, xz, m, x_raw = dgp
+    xm = np.where(np.asarray(m), x_raw, np.nan)
+    with pytest.raises(ValueError, match="steady"):
+        estimate_dfm_em(
+            xm,
+            np.ones(xm.shape[1], int),
+            0,
+            xm.shape[0] - 1,
+            DFMConfig(nfac_u=3, n_factorlag=2),
+            method="steady",
+            accel="squarem",
+        )
+
+
+# ---------------------------------------------------------------------------
+# periodic (mixed-frequency) gain cycle
+# ---------------------------------------------------------------------------
+
+
+def test_periodic_dare_matches_mixed_freq_filter_cycle():
+    from dynamic_factor_models_tpu.models.mixed_freq import (
+        _MM_WEIGHTS,
+        MixedFreqParams,
+        _filter_mf,
+        steady_gains,
+    )
+
+    rng = np.random.default_rng(11)
+    T, N, r, p = 150, 24, 2, 5
+    is_q = np.zeros(N, bool)
+    is_q[16:] = True
+    agg = np.zeros((N, 5))
+    agg[~is_q, 0] = 1.0
+    agg[is_q] = _MM_WEIGHTS
+    params = MixedFreqParams(
+        lam=jnp.asarray(rng.standard_normal((N, r))),
+        R=jnp.ones(N),
+        A=jnp.concatenate(
+            [0.5 * jnp.eye(r)[None], jnp.zeros((p - 1, r, r))]
+        ),
+        Q=jnp.eye(r),
+        agg=jnp.asarray(agg),
+    )
+    st = steady_gains(params)  # default pattern: quarter-end at t % 3 == 2
+    assert bool(st.converged)
+    mask = np.ones((T, N), bool)
+    for t in range(T):
+        if t % 3 != 2:
+            mask[t, is_q] = False
+    x = rng.standard_normal((T, N)) * mask
+    _, covs, _, pcovs, _ = _filter_mf(
+        params, jnp.asarray(x), jnp.asarray(mask)
+    )
+    for j in range(3):
+        ts = [t for t in range(T - 12, T) if t % 3 == j]
+        for t in ts:
+            np.testing.assert_allclose(
+                np.asarray(covs[t]), np.asarray(st.Pu[j]), atol=1e-10
+            )
+            np.testing.assert_allclose(
+                np.asarray(pcovs[t]), np.asarray(st.Pp[j]), atol=1e-10
+            )
+
+
+def test_periodic_dare_constant_pattern_reduces_to_dare():
+    rng = np.random.default_rng(12)
+    Tm, Qs, H, R = _random_stable_system(rng, 6, 9)
+    C = (H.T / R) @ H
+    Tm, Qs, C = jnp.asarray(Tm), jnp.asarray(Qs), jnp.asarray(C)
+    st = steady_state(Tm, C, Qs)
+    per = periodic_dare(Tm, jnp.stack([C, C, C]), Qs)
+    for j in range(3):
+        np.testing.assert_allclose(
+            np.asarray(per.Pp[j]), np.asarray(st.Pp), atol=1e-10
+        )
+        np.testing.assert_allclose(
+            np.asarray(per.Pu[j]), np.asarray(st.Pu), atol=1e-10
+        )
+
+
+# ---------------------------------------------------------------------------
+# emloop satellite: traced stop_at under collect_path
+# ---------------------------------------------------------------------------
+
+
+def test_collect_path_traced_stop_at_raises_clear_error(dgp):
+    from dynamic_factor_models_tpu.models.emloop import run_em_loop
+
+    params, xz, m, _ = dgp
+    stats = compute_panel_stats(xz, m.astype(xz.dtype))
+
+    @jax.jit
+    def bad(bound):
+        out, _, _, _ = run_em_loop(
+            em_step_stats,
+            params,
+            (xz, m.astype(xz.dtype), stats),
+            1e-6,
+            4,
+            collect_path=True,
+            stop_at=bound,
+        )
+        return out
+
+    with pytest.raises(ValueError, match="collect_path"):
+        bad(jnp.asarray(2, jnp.int32))
