@@ -601,6 +601,226 @@ def mixed_freq_section():
     }
 
 
+def chaos_section():
+    """Guardrail cost + recovery drills (bench.py --chaos).
+
+    Three measurements on a reference-scale synthetic panel:
+
+    - guard overhead: guarded vs unguarded on-device EM iters/sec at a
+      fixed iteration count (acceptance bar: guarded within 5%);
+    - program isolation: the unguarded while-loop's stableHLO is
+      byte-identical before and after the guarded program compiles and
+      runs — guards off means the pre-guardrail program, bit for bit;
+    - recovery drills: one estimation per injectable fault kind
+      (DFM_FAULTS grammar), each reporting the ladder digest (rungs
+      used, final health) and the max |param delta| against the
+      uninjected run — transient faults must recover to ~0 delta.
+
+    Prints one JSON line and returns the dict.
+    """
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dynamic_factor_models_tpu.models.emloop import (
+        _em_while_jit,
+        _fresh_carry,
+        run_em_loop,
+    )
+    from dynamic_factor_models_tpu.models.ssm import (
+        SSMParams,
+        compute_panel_stats,
+        em_step_stats,
+    )
+    from dynamic_factor_models_tpu.ops.linalg import standardize_data
+    from dynamic_factor_models_tpu.ops.masking import fillz, mask_of
+    from dynamic_factor_models_tpu.utils import faults
+    from dynamic_factor_models_tpu.utils.compile import donation_enabled
+
+    T, N, r, p = 224, 139, 4, 4
+    x = _synthetic_large_panel(T, N, r, np.float32)
+    xstd, _ = standardize_data(jnp.asarray(x))
+    xz, m = fillz(xstd), mask_of(xstd).astype(xstd.dtype)
+    params = SSMParams(
+        lam=jnp.zeros((N, r), xz.dtype).at[:, 0].set(1.0),
+        R=jnp.ones(N, xz.dtype),
+        A=jnp.concatenate(
+            [0.5 * jnp.eye(r, dtype=xz.dtype)[None],
+             jnp.zeros((p - 1, r, r), xz.dtype)]
+        ),
+        Q=jnp.eye(r, dtype=xz.dtype),
+    )
+    stats = compute_panel_stats(xz, m)
+    args, n_iter = (xz, m, stats), 50
+
+    # the unguarded program, lowered exactly as _run_device_unguarded
+    # dispatches it (same statics, same traced operands)
+    def _unguarded_hlo():
+        tol_arr = jnp.asarray(0.0, jnp.result_type(float))
+        carry = _fresh_carry(params, tol_arr, n_iter)
+        return _em_while_jit(donation_enabled()).lower(
+            em_step_stats, carry, args, tol_arr, n_iter,
+            jnp.asarray(n_iter, jnp.int32), 0,
+        ).as_text()
+
+    hlo_before = _unguarded_hlo()
+
+    def _ips(guard):
+        run = lambda: jax.block_until_ready(
+            run_em_loop(em_step_stats, params, args, 0.0, n_iter,
+                        guard=guard).params
+        )
+        run()  # compile
+        return n_iter / _time_fixed_iters(run)
+
+    ips_unguarded = _ips(False)
+    ips_guarded = _ips(True)
+    overhead = ips_unguarded / ips_guarded - 1.0
+    hlo_identical = _unguarded_hlo() == hlo_before
+
+    clean = run_em_loop(em_step_stats, params, args, 0.0, n_iter, guard=True)
+
+    def _delta(res):
+        return max(
+            float(np.max(np.abs(np.asarray(a) - np.asarray(b))))
+            for a, b in zip(
+                jax.tree.leaves(clean.params), jax.tree.leaves(res.params)
+            )
+        )
+
+    drills = {}
+    for spec in ("nan_estep@5", "chol_fail@5"):
+        with faults.inject(spec):
+            res = run_em_loop(
+                em_step_stats, params, args, 0.0, n_iter, guard=True
+            )
+        drills[spec] = {
+            "n_iter": res.n_iter,
+            "final_health": int(res.health),
+            "faults_detected": res.faults_detected,
+            "recoveries": res.recoveries,
+            "rungs_used": list(res.rungs_used),
+            "max_param_delta_vs_clean": _delta(res),
+        }
+    with tempfile.TemporaryDirectory() as td:
+        ck = os.path.join(td, "chaos.npz")
+        # corrupt the LAST chunk's save (earlier saves would be healed by
+        # the atomic rewrite of later chunks before any resume reads them)
+        with faults.inject("ckpt_corrupt@5"):
+            run_em_loop(em_step_stats, params, args, 0.0, n_iter,
+                        guard=True, checkpoint_path=ck, checkpoint_every=10)
+        # the corrupted file quarantines on the resume attempt; the run
+        # restarts clean and must still match the uninjected result
+        res = run_em_loop(em_step_stats, params, args, 0.0, n_iter,
+                          guard=True, checkpoint_path=ck, checkpoint_every=10)
+        drills["ckpt_corrupt@5"] = {
+            "quarantined": os.path.exists(ck + ".corrupt"),
+            "max_param_delta_vs_clean": _delta(res),
+        }
+    with tempfile.TemporaryDirectory() as td:
+        ck = os.path.join(td, "chaos.npz")
+        try:
+            with faults.inject("preempt@2"):
+                run_em_loop(em_step_stats, params, args, 0.0, n_iter,
+                            guard=True, checkpoint_path=ck,
+                            checkpoint_every=10)
+            preempted = False
+        except faults.SimulatedPreemption:
+            preempted = True
+        res = run_em_loop(em_step_stats, params, args, 0.0, n_iter,
+                          guard=True, checkpoint_path=ck,
+                          checkpoint_every=10)
+        drills["preempt@2"] = {
+            "preempted": preempted,
+            "max_param_delta_vs_clean": _delta(res),
+        }
+
+    fields = {
+        "chaos_panel": [T, N, r, p],
+        "em_iters_per_sec_unguarded": round(ips_unguarded, 2),
+        "em_iters_per_sec_guarded": round(ips_guarded, 2),
+        "em_guard_overhead_frac": round(overhead, 4),
+        "em_guard_within_5pct": bool(overhead <= 0.05),
+        "em_unguarded_hlo_identical": hlo_identical,
+        "chaos_drills": drills,
+    }
+    print(json.dumps(fields))
+    return fields
+
+
+def chaos_preempt_drill():
+    """One injected-preemption resume (bench.py --chaos-preempt-drill).
+
+    A small-panel cut of chaos_section's preempt drill, sized for a
+    scarce live TPU window: kill a checkpointed EM run right after its
+    second chunk save, resume from the surviving checkpoint, and report
+    whether the resumed parameters are bit-identical to an unkilled run.
+    tools/tpu_watch.sh appends this JSON digest to its probe log once
+    per live window.
+    """
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dynamic_factor_models_tpu.models.emloop import run_em_loop
+    from dynamic_factor_models_tpu.models.ssm import (
+        SSMParams,
+        compute_panel_stats,
+        em_step_stats,
+    )
+    from dynamic_factor_models_tpu.ops.linalg import standardize_data
+    from dynamic_factor_models_tpu.ops.masking import fillz, mask_of
+    from dynamic_factor_models_tpu.utils import faults
+
+    T, N, r, p = 64, 24, 2, 1
+    x = _synthetic_large_panel(T, N, r, np.float32)
+    xstd, _ = standardize_data(jnp.asarray(x))
+    xz, m = fillz(xstd), mask_of(xstd).astype(xstd.dtype)
+    params = SSMParams(
+        lam=jnp.zeros((N, r), xz.dtype).at[:, 0].set(1.0),
+        R=jnp.ones(N, xz.dtype),
+        A=0.5 * jnp.eye(r, dtype=xz.dtype)[None],
+        Q=jnp.eye(r, dtype=xz.dtype),
+    )
+    stats = compute_panel_stats(xz, m)
+    args, n_iter = (xz, m, stats), 20
+
+    clean = run_em_loop(em_step_stats, params, args, 0.0, n_iter, guard=True)
+    with tempfile.TemporaryDirectory() as td:
+        ck = os.path.join(td, "preempt.npz")
+        try:
+            with faults.inject("preempt@2"):
+                run_em_loop(em_step_stats, params, args, 0.0, n_iter,
+                            guard=True, checkpoint_path=ck,
+                            checkpoint_every=5)
+            preempted = False
+        except faults.SimulatedPreemption:
+            preempted = True
+        res = run_em_loop(em_step_stats, params, args, 0.0, n_iter,
+                          guard=True, checkpoint_path=ck,
+                          checkpoint_every=5)
+    delta = max(
+        float(np.max(np.abs(np.asarray(a) - np.asarray(b))))
+        for a, b in zip(
+            jax.tree.leaves(clean.params), jax.tree.leaves(res.params)
+        )
+    )
+    fields = {
+        "preempt_panel": [T, N, r, p],
+        "preempted": preempted,
+        "resumed_n_iter": res.n_iter,
+        "final_health": int(res.health),
+        "max_param_delta_vs_unkilled": delta,
+        "resume_bit_identical": bool(preempted and delta == 0.0),
+    }
+    print(json.dumps(fields))
+    return fields
+
+
 def steady_section(xz, m, params, stats, em_ips_seq, n_dev_iter=100):
     """Steady-state fast-path EM throughput (models/steady.py).
 
@@ -1789,6 +2009,13 @@ def main():
     ap.add_argument("--grid", action="store_true")
     ap.add_argument("--stage-refscale", action="store_true")
     ap.add_argument("--refscale-staged-fresh", action="store_true")
+    ap.add_argument("--chaos", action="store_true",
+                    help="guardrail overhead + fault-injection recovery "
+                         "drills (chaos_section); prints one JSON line")
+    ap.add_argument("--chaos-preempt-drill", action="store_true",
+                    help="one injected-preemption resume on a small panel "
+                         "(tpu_watch live-window drill); prints one JSON "
+                         "line")
     ap.add_argument("--run-compile-split", action="store_true")
     ap.add_argument("--cache-dir")
     ap.add_argument("--warm-cache", action="store_true")
@@ -1801,6 +2028,12 @@ def main():
         path = os.path.abspath(args.telemetry)
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         os.environ["DFM_TELEMETRY"] = path
+    if args.chaos:
+        chaos_section()
+        return
+    if args.chaos_preempt_drill:
+        chaos_preempt_drill()
+        return
     if args.run_compile_split:
         run_compile_split(args.cache_dir)
         return

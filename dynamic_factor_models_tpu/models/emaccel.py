@@ -46,7 +46,7 @@ from typing import Any, NamedTuple
 import jax
 import jax.numpy as jnp
 
-__all__ = ["SquaremState", "squarem", "squarem_state"]
+__all__ = ["SquaremState", "squarem", "squarem_state", "unwrap_state"]
 
 _ALPHAMAX_INIT = 4.0
 
@@ -61,6 +61,19 @@ class SquaremState(NamedTuple):
 def squarem_state(params) -> SquaremState:
     """Wrap initial EM parameters for a `squarem`-accelerated loop."""
     return SquaremState(params, jnp.asarray(_ALPHAMAX_INIT))
+
+
+def unwrap_state(state):
+    """Strip step-transformer / fast-path wrappers down to the bare
+    parameter pytree: every augmented loop carry in this codebase
+    (SquaremState here, ssm.SteadyEMState) holds the real parameters
+    under ``.params``, and bare parameter types do not have that
+    attribute.  Used by the estimation entry points and the recovery
+    ladder's demote rung (emloop `fallback_unwrap`), which must peel
+    whatever wrapper the tripped loop happened to be running under."""
+    while hasattr(state, "params"):
+        state = state.params
+    return state
 
 
 def _sq_norm(tree):

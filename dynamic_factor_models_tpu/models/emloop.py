@@ -11,6 +11,19 @@ preallocated carry array so no observability is lost.
 ``collect_path=True`` is the escape hatch: a host-synced loop that
 additionally records wall-clock per iteration in a
 `utils.profiling.ConvergenceTrace` (iters/sec without hand-rolled timing).
+
+Numerical-health guardrails (utils/guards.py) ride the device loop by
+default: the guarded while-loop variant carries the previous iterate and a
+`health` flag, trips on any non-finite log-likelihood / parameter leaf or
+an EM monotonicity violation, and exits with the LAST-GOOD params rolled
+back on device.  `run_em_loop` then walks a bounded recovery ladder —
+ridge-jitter, jitter with grown epsilon, demote to the caller-supplied
+exact fallback step, promote f32 to f64 — each rung retried once, every
+trip and recovery recorded in telemetry.  `DFM_GUARDS=0` restores the
+PR-1 unguarded program bit-for-bit (its HLO is pinned byte-identical by
+the chaos bench).  Deterministic fault injection (utils/faults.py,
+`DFM_FAULTS`) is baked into the guarded program as statics, so the
+default program carries no injection code at all.
 """
 
 from __future__ import annotations
@@ -21,10 +34,18 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils import faults as _faults
+from ..utils import guards as _guards
 from ..utils.profiling import ConvergenceTrace
-from ..utils.telemetry import _heartbeat_cb, heartbeat_every, run_record, span
+from ..utils.telemetry import (
+    _heartbeat_cb,
+    heartbeat_every,
+    inc,
+    run_record,
+    span,
+)
 
-__all__ = ["run_em_loop", "run_bulk_then_exact"]
+__all__ = ["run_em_loop", "run_bulk_then_exact", "EMLoopResult"]
 
 
 def _em_while_impl(
@@ -88,6 +109,116 @@ def _em_while_jit(donate: bool):
     return _em_while_donated if donate else _em_while_plain
 
 
+def _em_while_guarded_impl(
+    step,
+    carry,
+    args,
+    tol,
+    drop_tol,
+    resume_from,
+    max_em_iter: int,
+    stop_at,
+    heartbeat_every: int = 0,
+    inject_nan_at: int = 0,
+    inject_chol_at: int = 0,
+):
+    """Guarded on-device EM loop: `_em_while_impl` semantics plus the
+    utils.guards sentinel folded into the carry.
+
+    Carry: (params, prev_params, ll_prev, ll, it, path, health).  Each
+    body call evaluates the step; when the new log-likelihood or any new
+    parameter leaf is non-finite, or the log-likelihood DROPS by more
+    than `drop_tol * (1 + |ll|)` (EM is monotone; the relative slack
+    covers f32 roundoff and the steady tail's approximate moments), the
+    carry is frozen with params rolled back to `prev_params`, `it` not
+    advanced, and `health` set (1 non-finite, 2 monotonicity) — the cond
+    then exits immediately and the host-side recovery ladder takes over.
+
+    `resume_from` (traced) is the iteration count at the last ladder
+    resume: the two-loglik convergence bootstrap and the monotonicity
+    baseline both restart there, so a rung's first post-resume step is
+    never judged against the pre-trip trajectory (0 for a fresh run,
+    reproducing `it <= 1` exactly).
+
+    `inject_nan_at` / `inject_chol_at` (static, from utils.faults) bake
+    a deterministic fault into THIS program: NaN the k-th iteration's
+    log-likelihood, or poison the innovation covariance entering the
+    k-th step so its Cholesky genuinely fails.  At the default 0 the
+    traced functions are identity and the program carries no fault code.
+    """
+    dtype = jnp.result_type(tol)
+
+    def cond(c):
+        _, _, ll_prev, ll, it, _, health = c
+        unconverged = (it <= resume_from + 1) | (
+            jnp.abs(ll - ll_prev) >= tol * (1.0 + jnp.abs(ll_prev))
+        )
+        return (health == 0) & unconverged & (it < stop_at)
+
+    def body(c):
+        params, prev_params, ll_prev, ll, it, path, health = c
+        step_in = params
+        if inject_chol_at:
+            step_in = _guards.poison_cov(step_in, it + 1 == inject_chol_at)
+        new_params, ll_new = step(step_in, *args)
+        if inject_nan_at:
+            ll_new = jnp.where(
+                it + 1 == inject_nan_at, jnp.full_like(ll_new, jnp.nan), ll_new
+            )
+        ll_new = ll_new.astype(dtype)
+        nonfinite = (~jnp.isfinite(ll_new)) | (~_guards.tree_finite(new_params))
+        drop = (it >= resume_from + 1) & (
+            ll - ll_new > drop_tol * (1.0 + jnp.abs(ll))
+        )
+        new_health = jnp.where(
+            nonfinite,
+            _guards.HEALTH_NONFINITE,
+            jnp.where(drop, _guards.HEALTH_DECREASE, _guards.HEALTH_OK),
+        ).astype(jnp.int32)
+        bad = new_health != 0
+        sel = lambda on_bad, on_ok: jax.tree.map(
+            lambda x, y: jnp.where(bad, x, y), on_bad, on_ok
+        )
+        if heartbeat_every:
+            jax.lax.cond(
+                (it + 1) % heartbeat_every == 0,
+                lambda i, v: jax.debug.callback(_heartbeat_cb, i, v),
+                lambda i, v: None,
+                it + 1,
+                ll_new,
+            )
+        return (
+            sel(prev_params, new_params),  # bad step: roll back to last-good
+            sel(prev_params, params),
+            jnp.where(bad, ll_prev, ll),
+            jnp.where(bad, ll, ll_new),
+            jnp.where(bad, it, it + 1),
+            path.at[it].set(jnp.where(bad, path[it], ll_new)),
+            new_health,
+        )
+
+    return jax.lax.while_loop(cond, body, carry)
+
+
+_GUARDED_STATICS = (
+    "step",
+    "max_em_iter",
+    "heartbeat_every",
+    "inject_nan_at",
+    "inject_chol_at",
+)
+_em_while_guarded_plain = partial(jax.jit, static_argnames=_GUARDED_STATICS)(
+    _em_while_guarded_impl
+)
+_em_while_guarded_donated = partial(
+    jax.jit, static_argnames=_GUARDED_STATICS, donate_argnums=(1,)
+)(_em_while_guarded_impl)
+
+
+def _em_while_guarded_jit(donate: bool):
+    return _em_while_guarded_donated if donate else _em_while_guarded_plain
+
+
 def _fresh_carry(params, tol, max_em_iter):
     dtype = jnp.result_type(tol)
     return (
@@ -97,6 +228,66 @@ def _fresh_carry(params, tol, max_em_iter):
         jnp.asarray(0, jnp.int32),
         jnp.full(max_em_iter, jnp.nan, dtype),
     )
+
+
+def _fresh_guarded_carry(params, tol, max_em_iter):
+    dtype = jnp.result_type(tol)
+    # prev_params gets its own buffers: under donation the whole carry is
+    # donated, and two leaves aliasing one buffer cannot both be donated
+    return (
+        params,
+        jax.tree.map(jnp.copy, params),
+        jnp.asarray(-jnp.inf, dtype),
+        jnp.asarray(jnp.nan, dtype),
+        jnp.asarray(0, jnp.int32),
+        jnp.full(max_em_iter, jnp.nan, dtype),
+        jnp.asarray(0, jnp.int32),
+    )
+
+
+class EMLoopResult(tuple):
+    """`run_em_loop` result: unpacks as the historical 4-tuple
+    (params, loglik_path, n_iter, trace) so every existing call site
+    keeps working, while carrying the guardrail outcome as attributes:
+
+    converged        True iff the relative-loglik tolerance actually broke
+                     the loop (NOT the old `n_iter < cap` proxy, which
+                     misreported convergence-on-the-final-iteration)
+    health           final utils.guards health code (0 = healthy)
+    faults_detected  sentinel trips over the whole run
+    recoveries       trips the ladder recovered from (run ended healthy)
+    ladder_rung      1-based index into guards.LADDER_RUNGS of the last
+                     rung applied (0 = ladder never engaged)
+    rungs_used       names of the rungs applied, in order
+    """
+
+    def __new__(
+        cls,
+        params,
+        llpath,
+        n_iter,
+        trace,
+        *,
+        converged,
+        health=0,
+        faults_detected=0,
+        recoveries=0,
+        ladder_rung=0,
+        rungs_used=(),
+    ):
+        self = super().__new__(cls, (params, llpath, n_iter, trace))
+        self.converged = bool(converged)
+        self.health = int(health)
+        self.faults_detected = int(faults_detected)
+        self.recoveries = int(recoveries)
+        self.ladder_rung = int(ladder_rung)
+        self.rungs_used = tuple(rungs_used)
+        return self
+
+    params = property(lambda self: self[0])
+    loglik_path = property(lambda self: self[1])
+    n_iter = property(lambda self: self[2])
+    trace = property(lambda self: self[3])
 
 
 def _fingerprint(args, tol, max_em_iter: int, params=None) -> str:
@@ -118,6 +309,89 @@ def _fingerprint(args, tol, max_em_iter: int, params=None) -> str:
     return h.hexdigest()
 
 
+def _tol_break(ll_prev, ll, tol) -> bool:
+    """Host-side replay of the loop's convergence test on the final two
+    loglik values — the actual tolerance break, not an iteration-count
+    proxy (a run converging exactly on the last permitted iteration is
+    converged)."""
+    ll_prev = float(ll_prev)
+    ll = float(ll)
+    return (
+        np.isfinite(ll)
+        and np.isfinite(ll_prev)
+        and abs(ll - ll_prev) < float(tol) * (1.0 + abs(ll_prev))
+    )
+
+
+class _CheckpointDriver:
+    """Chunked checkpoint persistence shared by the guarded and unguarded
+    device loops: resume (with corruption quarantine), atomic save, and
+    the utils.faults checkpoint fault sites (`ckpt_corrupt@n` damages the
+    archive after the n-th save of this run; `preempt@n` raises
+    SimulatedPreemption after the n-th save, the checkpoint already on
+    disk so the next run resumes)."""
+
+    def __init__(self, path, like_carry, fp, rec, plan):
+        self.path = path
+        self.fp = fp
+        self.rec = rec
+        self.plan = plan
+        self.saves = 0
+        self.like = like_carry
+
+    def resume(self, carry):
+        import os
+
+        from ..utils.checkpoint import CheckpointCorruptError, load_pytree
+
+        if not os.path.exists(self.path):
+            return carry
+        try:
+            stored = load_pytree(self.path, {"carry": self.like, "fp": ""})
+        except CheckpointCorruptError:
+            # the loader already quarantined the file to <path>.corrupt;
+            # restart cleanly from the fresh carry instead of crashing
+            inc("checkpoint.quarantined")
+            self.rec.set(checkpoint_quarantined=True)
+            return carry
+        if str(stored["fp"]) != self.fp:
+            raise ValueError(
+                f"checkpoint {self.path!r} was written for different "
+                "inputs (data/tol/max_em_iter fingerprint mismatch); "
+                "delete it or use another path"
+            )
+        return jax.tree.map(jnp.asarray, stored["carry"])
+
+    def save(self, carry):
+        import os
+        import uuid
+
+        from ..utils.checkpoint import save_pytree
+
+        # per-writer unique temp name: two concurrent runs sharing a
+        # checkpoint path must never clobber each other's half-written
+        # archive before the atomic rename
+        tmp = f"{self.path}.tmp.{os.getpid()}.{uuid.uuid4().hex[:8]}.npz"
+        try:
+            save_pytree(tmp, {"carry": carry, "fp": self.fp})
+            os.replace(tmp, self.path)
+        except BaseException:
+            try:  # a failed save must not leak its temp file
+                os.remove(tmp)
+            except OSError:
+                pass
+            raise
+        self.saves += 1
+        if self.plan.ckpt_corrupt is not None and self.saves == self.plan.ckpt_corrupt:
+            _faults.corrupt_file(self.path)
+        if self.plan.preempt is not None and self.saves == self.plan.preempt:
+            _faults.fault_fired("preempt")
+            raise _faults.SimulatedPreemption(
+                f"injected preemption after checkpoint chunk "
+                f"{self.saves} ({self.path})"
+            )
+
+
 def run_em_loop(
     step,
     params,
@@ -129,9 +403,14 @@ def run_em_loop(
     checkpoint_path: str | None = None,
     checkpoint_every: int = 25,
     stop_at=None,
+    fallback_step=None,
+    fallback_unwrap=None,
+    fallback_args=None,
+    guard: bool | None = None,
 ):
-    """Run an EM loop to convergence; returns (params, loglik_path, n_iter,
-    trace).  `step(params, *args) -> (new_params, loglik-of-current-params)`
+    """Run an EM loop to convergence; returns an `EMLoopResult`, which
+    unpacks as (params, loglik_path, n_iter, trace).
+    `step(params, *args) -> (new_params, loglik-of-current-params)`
     must be a module-level jitted function (it is a static jit argument).
 
     trace is a ConvergenceTrace when collect_path=True, else None.
@@ -148,7 +427,21 @@ def run_em_loop(
     (utils.checkpoint pytree round-trip, atomic rename); a rerun with the
     same path AND the same inputs (data/tol/max_em_iter, fingerprint-
     checked) resumes from the last completed chunk and produces the same
-    final state as an uninterrupted run.
+    final state as an uninterrupted run.  A corrupted/unreadable
+    checkpoint is quarantined to `<path>.corrupt` and the run restarts
+    cleanly.
+
+    `guard` (default: utils.guards.guards_enabled(), env DFM_GUARDS)
+    selects the guarded while-loop: a health sentinel trips on non-finite
+    values or an EM log-likelihood decrease, rolls back to the last-good
+    iterate, and escalates a bounded recovery ladder — ridge-jitter the
+    innovation covariance (twice, growing epsilon), demote to
+    `fallback_step` (the caller's exact sequential step; `fallback_unwrap`
+    converts the tripped loop state to the fallback's parameter type,
+    `fallback_args` its argument tuple when it differs), then promote f32
+    to f64.  Each rung is tried once; an exhausted ladder returns the
+    last-good params with `EMLoopResult.health != 0` rather than raising.
+    With guard=False the PR-1 unguarded program runs unchanged.
     """
     if max_em_iter < 0:
         raise ValueError(f"max_em_iter must be >= 0, got {max_em_iter}")
@@ -158,7 +451,7 @@ def run_em_loop(
         # against a zero-length loglik path.  collect_path still gets the
         # (empty) ConvergenceTrace the docstring promises.
         trace = ConvergenceTrace(trace_name) if collect_path else None
-        return params, np.empty(0), 0, trace
+        return EMLoopResult(params, np.empty(0), 0, trace, converged=False)
     if checkpoint_path is not None and collect_path:
         raise ValueError(
             "collect_path=True uses a host-synced loop that does not "
@@ -168,6 +461,8 @@ def run_em_loop(
         raise ValueError(f"checkpoint_every must be >= 1, got {checkpoint_every}")
     if checkpoint_path is not None and stop_at is not None:
         raise ValueError("stop_at and checkpoint_path are mutually exclusive")
+    guard_on = _guards.guards_enabled() if guard is None else bool(guard)
+    plan = _faults.active_plan()
     rec = run_record(
         "run_em_loop",
         config={
@@ -177,6 +472,7 @@ def run_em_loop(
             "collect_path": collect_path,
             "trace_name": trace_name,
             "checkpointed": checkpoint_path is not None,
+            "guarded": guard_on,
         },
     )
     if collect_path:
@@ -189,27 +485,87 @@ def run_em_loop(
                 "collect_path=False — the on-device loop accepts a traced "
                 "stop_at bound"
             )
-        host_cap = max_em_iter if stop_at is None else min(max_em_iter, int(stop_at))
-        trace = ConvergenceTrace(trace_name)
-        llpath = []
-        ll_prev = -np.inf
-        it = 0
-        with rec, span(trace_name):
-            for it in range(1, host_cap + 1):
-                params, ll = step(params, *args)
-                ll = float(ll)
-                llpath.append(ll)
-                trace.record(ll)
-                if it > 1 and abs(ll - ll_prev) < tol * (1.0 + abs(ll_prev)):
-                    break
-                ll_prev = ll
-            rec.set(
-                n_iter=it,
-                converged=it < host_cap,
-                final_loglik=llpath[-1] if llpath else None,
-            )
-        return params, np.asarray(llpath), it, trace
+        return _run_host_loop(
+            step, params, args, tol, max_em_iter, stop_at, trace_name,
+            rec, guard_on,
+        )
 
+    if not guard_on:
+        return _run_device_unguarded(
+            step, params, args, tol, max_em_iter, checkpoint_path,
+            checkpoint_every, stop_at, trace_name, rec, plan,
+        )
+    return _run_device_guarded(
+        step, params, args, tol, max_em_iter, checkpoint_path,
+        checkpoint_every, stop_at, trace_name, rec, plan,
+        fallback_step, fallback_unwrap, fallback_args,
+    )
+
+
+def _run_host_loop(
+    step, params, args, tol, max_em_iter, stop_at, trace_name, rec, guard_on
+):
+    """collect_path escape hatch: host-synced loop with per-iteration wall
+    clock.  Carries a lightweight sentinel (non-finite / monotonicity stop
+    preserving the last-good params) but NOT the recovery ladder — this
+    path exists for interactive diagnosis, where a preserved trip state is
+    worth more than an automatic retry."""
+    host_cap = max_em_iter if stop_at is None else min(max_em_iter, int(stop_at))
+    dtol = _guards.drop_tol()
+    trace = ConvergenceTrace(trace_name)
+    llpath = []
+    ll_prev = -np.inf
+    it = 0
+    hit_tol = False
+    health = _guards.HEALTH_OK
+    prev_params = params
+    with rec, span(trace_name):
+        for it in range(1, host_cap + 1):
+            new_params, ll = step(params, *args)
+            ll = float(ll)
+            if guard_on and not np.isfinite(ll):
+                health = _guards.HEALTH_NONFINITE
+            elif guard_on and it > 1 and (
+                ll_prev - ll > dtol * (1.0 + abs(ll_prev))
+            ):
+                health = _guards.HEALTH_DECREASE
+            if health != _guards.HEALTH_OK:
+                # `ll` certifies this call's INPUT params as bad: discard
+                # them (same two-state rollback as the device loop) and
+                # report the last iterate whose loglik was observed good
+                params = prev_params
+                it -= 1
+                inc("em_guard.faults_detected")
+                break
+            prev_params = params
+            params = new_params
+            llpath.append(ll)
+            trace.record(ll)
+            if it > 1 and abs(ll - ll_prev) < tol * (1.0 + abs(ll_prev)):
+                hit_tol = True
+                break
+            ll_prev = ll
+        rec.set(
+            n_iter=it,
+            converged=hit_tol,
+            final_loglik=llpath[-1] if llpath else None,
+            final_health=_guards.HEALTH_NAMES[health],
+            faults_detected=int(health != _guards.HEALTH_OK),
+        )
+    return EMLoopResult(
+        params, np.asarray(llpath), it, trace,
+        converged=hit_tol, health=health,
+        faults_detected=int(health != _guards.HEALTH_OK),
+    )
+
+
+def _run_device_unguarded(
+    step, params, args, tol, max_em_iter, checkpoint_path, checkpoint_every,
+    stop_at, trace_name, rec, plan,
+):
+    """The PR-1 on-device loop, program-for-program: when guards are off
+    the dispatched executable (kernel "em_loop", identical statics) and
+    its HLO are byte-identical to the pre-guardrail code path."""
     from ..utils.compile import aot_call, aot_statics, donation_enabled
 
     with rec:
@@ -248,21 +604,12 @@ def run_em_loop(
             with span(trace_name):
                 carry = _run(carry, bound)
         else:
-            import os
-            import uuid
-
-            from ..utils.checkpoint import load_pytree, save_pytree
-
-            fp = _fingerprint(args, tol, max_em_iter, params=fp_params)
-            if os.path.exists(checkpoint_path):
-                stored = load_pytree(checkpoint_path, {"carry": carry, "fp": ""})
-                if str(stored["fp"]) != fp:
-                    raise ValueError(
-                        f"checkpoint {checkpoint_path!r} was written for "
-                        "different inputs (data/tol/max_em_iter fingerprint "
-                        "mismatch); delete it or use another path"
-                    )
-                carry = jax.tree.map(jnp.asarray, stored["carry"])
+            ckpt = _CheckpointDriver(
+                checkpoint_path, carry,
+                _fingerprint(args, tol, max_em_iter, params=fp_params),
+                rec, plan,
+            )
+            carry = ckpt.resume(carry)
             with span(trace_name):
                 while True:
                     it = int(carry[3])
@@ -275,34 +622,229 @@ def run_em_loop(
                     carry = _run(carry, min(it + checkpoint_every, max_em_iter))
                     if int(carry[3]) == it:  # converged (cond false on entry)
                         break
-                    # per-writer unique temp name: two concurrent runs
-                    # sharing a checkpoint path must never clobber each
-                    # other's half-written archive before the atomic rename
-                    tmp = (
-                        f"{checkpoint_path}.tmp."
-                        f"{os.getpid()}.{uuid.uuid4().hex[:8]}.npz"
-                    )
-                    try:
-                        save_pytree(tmp, {"carry": carry, "fp": fp})
-                        os.replace(tmp, checkpoint_path)
-                    except BaseException:
-                        try:  # a failed save must not leak its temp file
-                            os.remove(tmp)
-                        except OSError:
-                            pass
-                        raise
+                    ckpt.save(carry)
 
-        params, _, _, n_iter, path = carry
+        params, ll_prev, ll, n_iter, path = carry
         n_iter = int(n_iter)
+        converged = n_iter >= 2 and _tol_break(ll_prev, ll, tol)
         llpath = np.asarray(path)[:n_iter]
         rec.set(
             n_iter=n_iter,
-            converged=n_iter < max_em_iter,
+            converged=converged,
             final_loglik=float(llpath[-1]) if n_iter else None,
             donate=donate,
             heartbeat_every=heartbeat,
         )
-    return params, llpath, n_iter, None
+    return EMLoopResult(params, llpath, n_iter, None, converged=converged)
+
+
+def _promote_args_f64(args):
+    return jax.tree.map(
+        lambda x: (
+            jnp.asarray(x, jnp.float64)
+            if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
+            and jnp.asarray(x).dtype != jnp.float64
+            else x
+        ),
+        args,
+    )
+
+
+def _has_f32_leaf(tree) -> bool:
+    return any(
+        jnp.asarray(x).dtype == jnp.float32 for x in jax.tree.leaves(tree)
+    )
+
+
+def _run_device_guarded(
+    step, params, args, tol, max_em_iter, checkpoint_path, checkpoint_every,
+    stop_at, trace_name, rec, plan,
+    fallback_step, fallback_unwrap, fallback_args,
+):
+    from ..utils.compile import aot_call, aot_statics, donation_enabled
+
+    with rec:
+        ld = jnp.result_type(float)
+        tol_arr = jnp.asarray(tol, ld)
+        drop_arr = jnp.asarray(_guards.drop_tol(), ld)
+        donate = donation_enabled()
+        heartbeat = heartbeat_every()
+        fp_params = params
+        if donate:
+            params = jax.tree.map(jnp.copy, params)
+        carry = _fresh_guarded_carry(params, tol_arr, max_em_iter)
+        del params
+        gloop = _em_while_guarded_jit(donate)
+        # in-loop injections are STATICS: with no fault plan the compiled
+        # guarded program contains no injection code, and its dispatch key
+        # (kernel "em_loop_guarded") matches the utils.compile plan
+        inj = (plan.nan_estep or 0, plan.chol_fail or 0)
+        cur_step, cur_args = step, args
+
+        def _run(carry, bound, resume_from, cur_step, cur_args, inj):
+            statics = aot_statics(
+                cur_step, max_em_iter, donate, heartbeat, inj[0], inj[1]
+            )
+            return aot_call(
+                "em_loop_guarded",
+                lambda c, a, t, d, r, s: gloop(
+                    cur_step, c, a, t, d, r, max_em_iter, s, heartbeat,
+                    inj[0], inj[1],
+                ),
+                carry, cur_args, tol_arr, drop_arr,
+                jnp.asarray(resume_from, jnp.int32),
+                jnp.asarray(bound, jnp.int32),
+                statics=statics,
+            )
+
+        ckpt = None
+        if checkpoint_path is not None:
+            ckpt = _CheckpointDriver(
+                checkpoint_path, carry,
+                _fingerprint(args, tol, max_em_iter, params=fp_params),
+                rec, plan,
+            )
+            carry = ckpt.resume(carry)
+
+        def _drive(carry, resume_from, cur_step, cur_args, inj):
+            """Run to completion / trip, in checkpoint chunks when asked;
+            a tripped chunk is NOT saved (the ladder resumes in-process
+            and later healthy chunks persist)."""
+            if ckpt is None:
+                bound = max_em_iter if stop_at is None else stop_at
+                return _run(carry, bound, resume_from, cur_step, cur_args, inj)
+            while True:
+                it = int(carry[4])
+                if it >= max_em_iter:
+                    return carry
+                carry = _run(
+                    carry, min(it + checkpoint_every, max_em_iter),
+                    resume_from, cur_step, cur_args, inj,
+                )
+                if int(carry[6]) != _guards.HEALTH_OK:
+                    return carry
+                if int(carry[4]) == it:  # converged (cond false on entry)
+                    return carry
+                ckpt.save(carry)
+
+        faults_detected = 0
+        rungs_used = []
+        resume_from = 0
+        final_health = _guards.HEALTH_OK
+        rung_skips = []
+        with span(trace_name):
+            while True:
+                # in-loop faults are compiled statics, so the host counts
+                # each attempt that dispatches a poisoned program
+                if inj[0]:
+                    _faults.fault_fired("nan_estep")
+                if inj[1]:
+                    _faults.fault_fired("chol_fail")
+                carry = _drive(carry, resume_from, cur_step, cur_args, inj)
+                health = int(carry[6])
+                if health == _guards.HEALTH_OK:
+                    final_health = health
+                    break
+                faults_detected += 1
+                inc("em_guard.faults_detected")
+                inc("em_guard.trip." + _guards.HEALTH_NAMES[health])
+                # pick the next applicable rung (each tried exactly once)
+                next_i = (
+                    _guards.LADDER_RUNGS.index(rungs_used[-1]) + 1
+                    if rungs_used else 0
+                )
+                rung = None
+                while next_i < len(_guards.LADDER_RUNGS):
+                    name = _guards.LADDER_RUNGS[next_i]
+                    if name == "demote" and fallback_step is None:
+                        rung_skips.append("demote:no_fallback")
+                    elif name == "promote_f64" and not jax.config.jax_enable_x64:
+                        rung_skips.append("promote_f64:x64_disabled")
+                    elif name == "promote_f64" and not _has_f32_leaf(carry[0]):
+                        rung_skips.append("promote_f64:already_f64")
+                    else:
+                        rung = name
+                        break
+                    next_i += 1
+                if rung is None:
+                    final_health = health  # ladder exhausted: return last-good
+                    inc("em_guard.exhausted")
+                    break
+                # the device loop already rolled back: carry[0] is last-good
+                last_good, it = carry[0], int(carry[4])
+                if rung == "jitter":
+                    new_params = _guards.ridge_jitter(last_good, 0)
+                elif rung == "jitter_grown":
+                    new_params = _guards.ridge_jitter(last_good, 1)
+                elif rung == "demote":
+                    new_params = (
+                        fallback_unwrap(last_good)
+                        if fallback_unwrap is not None else last_good
+                    )
+                    cur_step = fallback_step
+                    cur_args = args if fallback_args is None else fallback_args
+                else:  # promote_f64
+                    new_params = _guards.promote_f64(last_good)
+                    cur_args = _promote_args_f64(cur_args)
+                # a transient injected fault fires only in the first
+                # attempt's program; a persistent one (`kind@k+`) re-fires
+                # on same-program retries until demote/promote changes the
+                # step or dtype — then it no longer applies by construction
+                if rung in ("demote", "promote_f64"):
+                    inj = (0, 0)
+                else:
+                    inj = (
+                        inj[0] if "nan_estep" in plan.persistent else 0,
+                        inj[1] if "chol_fail" in plan.persistent else 0,
+                    )
+                resume_from = it
+                rungs_used.append(rung)
+                inc("em_guard.rung." + rung)
+                carry = (
+                    new_params,
+                    jax.tree.map(jnp.copy, new_params),
+                    carry[2], carry[3], carry[4], carry[5],
+                    jnp.asarray(0, jnp.int32),
+                )
+
+        params, _, ll_prev, ll, n_iter, path, _ = carry
+        n_iter = int(n_iter)
+        converged = (
+            final_health == _guards.HEALTH_OK
+            and n_iter >= max(2, resume_from + 2)
+            and _tol_break(ll_prev, ll, tol)
+        )
+        recoveries = faults_detected - int(final_health != _guards.HEALTH_OK)
+        if recoveries:
+            inc("em_guard.recoveries", recoveries)
+        llpath = np.asarray(path)[:n_iter]
+        rec.set(
+            n_iter=n_iter,
+            converged=converged,
+            final_loglik=float(llpath[-1]) if n_iter else None,
+            donate=donate,
+            heartbeat_every=heartbeat,
+            faults_detected=faults_detected,
+            recoveries=recoveries,
+            ladder_rung=(
+                _guards.LADDER_RUNGS.index(rungs_used[-1]) + 1
+                if rungs_used else 0
+            ),
+            final_health=_guards.HEALTH_NAMES[final_health],
+            rungs_used=list(rungs_used),
+            rung_skips=rung_skips or None,
+        )
+    return EMLoopResult(
+        params, llpath, n_iter, None,
+        converged=converged,
+        health=final_health,
+        faults_detected=faults_detected,
+        recoveries=recoveries,
+        ladder_rung=(
+            _guards.LADDER_RUNGS.index(rungs_used[-1]) + 1 if rungs_used else 0
+        ),
+        rungs_used=rungs_used,
+    )
 
 
 def run_bulk_then_exact(
@@ -315,6 +857,9 @@ def run_bulk_then_exact(
     max_em_iter: int,
     trace_name: str,
     collect_path: bool = False,
+    fallback_step=None,
+    fallback_unwrap=None,
+    fallback_args=None,
 ):
     """Mixed-precision two-phase EM driver (the single copy of the
     gram_dtype orchestration shared by `ssm.estimate_dfm_em` and
@@ -328,15 +873,18 @@ def run_bulk_then_exact(
     so it cannot certify the final output) falls back to the original
     init with the full budget.  Phase 2 runs `exact_step` on `exact_args`
     under the caller's tol for the remaining budget (always >= 1
-    iteration).  Returns (params, concatenated loglik path, total
-    n_iter, trace).
+    iteration).  Returns an EMLoopResult over (params, concatenated
+    loglik path, total n_iter, trace); convergence and guardrail health
+    are the EXACT phase's (the bulk phase optimizes a different
+    objective, so its outcome cannot certify the run), fault counters
+    are summed across both phases.
 
     The concatenated loglik path can DROP at the phase boundary (index
     `n_pre`): the bulk entries are logliks of the bf16-Gram (R-floored)
     map, the exact entries of the exact map — two different objectives.
     A one-step decrease at the seam is the precision gap being repaid,
-    not EM divergence; monotonicity diagnostics should treat the two
-    segments separately.
+    not EM divergence; the guarded loop never sees it (each phase is its
+    own run_em_loop call with its own monotonicity baseline).
 
     Build `bulk_args` inline in the call expression (don't bind the bf16
     twins in the caller): this function drops its reference before phase 2,
@@ -349,17 +897,22 @@ def run_bulk_then_exact(
     the same way (e.g. `squarem(bulk)` and `squarem(exact)`), the
     augmented loop state flows from the bulk phase into the exact phase
     unchanged — the caller wraps the initial params once and unwraps the
-    result once.
+    result once.  `fallback_*` pass through to the exact phase's recovery
+    ladder (the bulk phase's demote target would be the exact map, which
+    phase 2 already is).
     """
     if max_em_iter < 2:
         return run_em_loop(
             exact_step, params, exact_args, tol, max_em_iter,
             collect_path=collect_path, trace_name=trace_name,
+            fallback_step=fallback_step, fallback_unwrap=fallback_unwrap,
+            fallback_args=fallback_args,
         )
-    params_b, llpath_pre, n_pre, _ = run_em_loop(
+    pre = run_em_loop(
         bulk_step, params, bulk_args, max(tol, 1e-4), max_em_iter,
         trace_name=trace_name + "_bf16", stop_at=max(max_em_iter // 2, 1),
     )
+    params_b, llpath_pre, n_pre, _ = pre
     del bulk_args  # the bf16 twins: freed before the exact phase runs
     params_ok = all(
         bool(np.isfinite(np.asarray(leaf)).all())
@@ -371,9 +924,20 @@ def run_bulk_then_exact(
         n_pre = 0
         llpath_pre = np.empty(0)
     del params_b
-    params, llpath, n_iter, trace = run_em_loop(
+    res = run_em_loop(
         exact_step, params, exact_args, tol, max_em_iter,
         collect_path=collect_path, trace_name=trace_name,
         stop_at=max(max_em_iter - n_pre, 1) if n_pre else None,
+        fallback_step=fallback_step, fallback_unwrap=fallback_unwrap,
+        fallback_args=fallback_args,
     )
-    return params, np.concatenate([llpath_pre, llpath]), n_iter + n_pre, trace
+    params, llpath, n_iter, trace = res
+    return EMLoopResult(
+        params, np.concatenate([llpath_pre, llpath]), n_iter + n_pre, trace,
+        converged=res.converged,
+        health=res.health,
+        faults_detected=res.faults_detected + pre.faults_detected,
+        recoveries=res.recoveries + pre.recoveries,
+        ladder_rung=res.ladder_rung,
+        rungs_used=res.rungs_used,
+    )

@@ -166,6 +166,20 @@ def steady_gains(params: MixedFreqParams, pattern=None):
     q5 = _N_AGG * r
     k = r * p
     dtype = params.lam.dtype
+    # Gate on parameter health before deriving gains: periodic_dare iterates
+    # the Riccati map to a fixed cycle, and a NaN/Inf anywhere in (A, Q, lam,
+    # R) turns that into a silently-NaN gain set that poisons every filtered
+    # month downstream.  Only checkable on concrete values — inside a trace
+    # the guarded EM loop's own sentinel covers this.
+    leaves = [params.lam, params.R, params.A, params.Q]
+    if not any(isinstance(l, jax.core.Tracer) for l in leaves):
+        if not all(bool(jnp.all(jnp.isfinite(l))) for l in leaves):
+            raise ValueError(
+                "steady_gains: non-finite values in MixedFreqParams "
+                "(NaN/Inf in lam, R, A, or Q); the periodic Riccati "
+                "recursion would propagate them into every phase gain — "
+                "recover the parameters first (see utils.guards ladder)"
+            )
     if pattern is None:
         is_q = jnp.any(params.agg[:, 1:] != 0.0, axis=1)
         monthly = (~is_q).astype(dtype)
@@ -301,6 +315,8 @@ class MFResults(NamedTuple):
     stds: jnp.ndarray
     means: jnp.ndarray
     trace: object | None = None  # ConvergenceTrace when collect_path=True
+    converged: bool = False  # actual tolerance break (not n_iter < cap)
+    health: int = 0  # final utils.guards health code (0 = healthy)
 
 
 def _project_params_mf(params: MixedFreqParams) -> MixedFreqParams:
@@ -439,11 +455,17 @@ def estimate_mixed_freq_dfm(
         else:
             stats = compute_panel_stats(xz, m_arr)
         step = em_step_mf_stats
+        fallback_step = None
+        fallback_unwrap = None
         if accel == "squarem":
-            from .emaccel import squarem, squarem_state
+            from .emaccel import squarem, squarem_state, unwrap_state
 
             step = squarem(em_step_mf_stats, _project_params_mf)
             params = squarem_state(params)
+            # recovery ladder's demote rung: peel the SquaremState and
+            # continue on the exact sequential EM map
+            fallback_step = em_step_mf_stats
+            fallback_unwrap = unwrap_state
 
         if gram_dtype is not None:
             # mixed-precision bulk + exact polish — see
@@ -456,26 +478,43 @@ def estimate_mixed_freq_dfm(
                 # same wrapper on both phases: the SquaremState flows from
                 # the bulk loop into the exact loop unchanged
                 bulk_step = squarem(em_step_mf_stats_bulk, _project_params_mf)
-            params, llpath, it, trace = run_bulk_then_exact(
+            res = run_bulk_then_exact(
                 bulk_step, step, params,
                 (xz, m_arr, _with_bf16_twins(stats, xz)),
                 (xz, m_arr, stats), tol, max_em_iter,
                 trace_name="em_mixed_freq", collect_path=collect_path,
+                fallback_step=fallback_step,
+                fallback_unwrap=fallback_unwrap,
             )
         else:
-            params, llpath, it, trace = run_em_loop(
+            res = run_em_loop(
                 step, params, (xz, m_arr, stats), tol, max_em_iter,
                 collect_path=collect_path, trace_name="em_mixed_freq",
                 checkpoint_path=checkpoint_path,
                 checkpoint_every=checkpoint_every,
+                fallback_step=fallback_step,
+                fallback_unwrap=fallback_unwrap,
             )
+        params, llpath, it, trace = res
         if accel == "squarem":
-            params = params.params  # unwrap SquaremState
+            from .emaccel import SquaremState
+
+            if isinstance(params, SquaremState):  # demote may have peeled
+                params = params.params
         rec.set(
             n_iter=it,
-            converged=it < max_em_iter,
+            converged=res.converged,
             final_loglik=float(llpath[-1]) if len(llpath) else None,
         )
+        if res.faults_detected:
+            from ..utils.guards import HEALTH_NAMES
+
+            rec.set(
+                faults_detected=res.faults_detected,
+                recoveries=res.recoveries,
+                ladder_rung=res.ladder_rung,
+                final_health=HEALTH_NAMES[res.health],
+            )
 
         # bucketed path: smooth at the bucket shape, then slice the
         # readout (and the params) back to the raw panel
@@ -493,4 +532,6 @@ def estimate_mixed_freq_dfm(
             stds=stds,
             means=n_mean,
             trace=trace,
+            converged=res.converged,
+            health=res.health,
         )

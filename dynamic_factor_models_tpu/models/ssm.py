@@ -1316,6 +1316,10 @@ class EMResults(NamedTuple):
     stds: jnp.ndarray  # per-series standardization scale
     means: jnp.ndarray
     trace: object | None = None  # ConvergenceTrace when collect_path=True
+    # actual tolerance break of the EM loop (NOT the n_iter < cap proxy,
+    # which misreported a run converging on its final permitted iteration)
+    converged: bool = False
+    health: int = 0  # final utils.guards health code (0 = healthy)
 
 
 def _init_params_from_als(
@@ -1482,6 +1486,11 @@ def estimate_dfm_em(
 
         T0, N0 = xz.shape
         rec.set(shapes={"T": T0, "N": N0, "r": r, "p": config.n_factorlag})
+        # recovery-ladder demotion target (emloop guarded path): the exact
+        # sequential step the tripped method falls back to, with the loop
+        # state unwrapped to its bare parameter pytree
+        fallback_step = None
+        fallback_unwrap = None
         if method == "sequential":
             step = em_step_stats
             if buckets is not None:
@@ -1517,6 +1526,12 @@ def estimate_dfm_em(
                     Pp=jnp.asarray(st0.Pp, xz.dtype),
                     riccati_iters=jnp.asarray(0, jnp.int32),
                 )
+                # a tripped steady run demotes to the exact sequential
+                # step: same (xz, mask, stats) args, SteadyEMState peeled
+                from .emaccel import unwrap_state
+
+                fallback_step = em_step_stats
+                fallback_unwrap = unwrap_state
                 rec.set(
                     t_star=t_star,
                     steady_frac=float(T0 - t_star) / float(T0),
@@ -1530,11 +1545,16 @@ def estimate_dfm_em(
                 "sqrt_collapsed": em_step_sqrt_collapsed,
             }[method]
             args = (xz, m_arr)
+            # the exact sequential filter on the same (xz, mask) args
+            fallback_step = em_step
         if accel == "squarem":
-            from .emaccel import squarem, squarem_state
+            from .emaccel import squarem, squarem_state, unwrap_state
 
             step = squarem(step, _project_params)
             params = squarem_state(params)
+            if fallback_step is None:
+                fallback_step = em_step_stats  # plain map, SQUAREM peeled
+            fallback_unwrap = unwrap_state
 
         if gram_dtype is not None:
             # mixed-precision bulk + exact polish (emloop.run_bulk_then_exact
@@ -1548,30 +1568,46 @@ def estimate_dfm_em(
                 # same wrapper on both phases: the SquaremState flows from
                 # the bulk loop into the exact loop unchanged
                 bulk_step = squarem(em_step_stats_bulk, _project_params)
-            params, llpath, n_iter, trace = run_bulk_then_exact(
+            res = run_bulk_then_exact(
                 bulk_step, step, params,
                 (xz, m_arr, _with_bf16_twins(args[2], xz)), args,
                 tol, max_em_iter,
                 trace_name=f"em_dfm_{method}", collect_path=collect_path,
+                fallback_step=fallback_step, fallback_unwrap=fallback_unwrap,
             )
         else:
-            params, llpath, n_iter, trace = run_em_loop(
+            res = run_em_loop(
                 step, params, args, tol, max_em_iter,
                 collect_path=collect_path, trace_name=f"em_dfm_{method}",
                 checkpoint_path=checkpoint_path,
                 checkpoint_every=checkpoint_every,
+                fallback_step=fallback_step, fallback_unwrap=fallback_unwrap,
             )
+        params, llpath, n_iter, trace = res
 
-        if accel == "squarem":
-            params = params.params  # unwrap SquaremState
+        # unwrap by TYPE, not by the requested configuration: the recovery
+        # ladder's demote rung may already have peeled the loop state
+        from .emaccel import SquaremState
+
+        if isinstance(params, SquaremState):
+            params = params.params
         if isinstance(params, SteadyEMState):
             rec.set(riccati_iters=int(params.riccati_iters))
             params = params.params
         rec.set(
             n_iter=n_iter,
-            converged=n_iter < max_em_iter,
+            converged=res.converged,
             final_loglik=float(llpath[-1]) if len(llpath) else None,
         )
+        if res.faults_detected:
+            from ..utils.guards import HEALTH_NAMES
+
+            rec.set(
+                faults_detected=res.faults_detected,
+                recoveries=res.recoveries,
+                ladder_rung=res.ladder_rung,
+                final_health=HEALTH_NAMES[res.health],
+            )
         # on the bucketed path the smoother also runs at the bucket shape
         # (padded cells are NaN -> missing; trailing all-missing periods
         # add no information at real times), then the readout slices back
@@ -1587,6 +1623,8 @@ def estimate_dfm_em(
             stds=stds,
             means=n_mean,
             trace=trace,
+            converged=res.converged,
+            health=res.health,
         )
 
 

@@ -210,6 +210,8 @@ class EMARResults(NamedTuple):
     stds: jnp.ndarray
     means: jnp.ndarray
     trace: object | None = None  # ConvergenceTrace when collect_path=True
+    converged: bool = False  # actual tolerance break (not n_iter < cap)
+    health: int = 0  # final utils.guards health code (0 = healthy)
 
 
 def _project_params_ar(params: SSMARParams) -> SSMARParams:
@@ -288,23 +290,42 @@ def estimate_dfm_em_ar(
             "r": config.nfac_u, "p": config.n_factorlag,
         })
         step = em_step_ar
+        fallback_step = None
+        fallback_unwrap = None
         if accel == "squarem":
-            from .emaccel import squarem, squarem_state
+            from .emaccel import squarem, squarem_state, unwrap_state
 
             step = squarem(em_step_ar, _project_params_ar)
             params = squarem_state(params)
-        params, llpath, it, trace = run_em_loop(
+            # recovery-ladder demotion: drop the SQUAREM cycle back to the
+            # plain AR EM map on the same args
+            fallback_step = em_step_ar
+            fallback_unwrap = unwrap_state
+        res = run_em_loop(
             step, params, (xz, m_arr), tol, max_em_iter,
             collect_path=collect_path, trace_name="em_dfm_ar",
             checkpoint_path=checkpoint_path, checkpoint_every=checkpoint_every,
+            fallback_step=fallback_step, fallback_unwrap=fallback_unwrap,
         )
-        if accel == "squarem":
-            params = params.params  # unwrap SquaremState
+        params, llpath, it, trace = res
+        from .emaccel import SquaremState
+
+        if isinstance(params, SquaremState):  # by type: demote may have peeled
+            params = params.params
         rec.set(
             n_iter=it,
-            converged=it < max_em_iter,
+            converged=res.converged,
             final_loglik=float(llpath[-1]) if len(llpath) else None,
         )
+        if res.faults_detected:
+            from ..utils.guards import HEALTH_NAMES
+
+            rec.set(
+                faults_detected=res.faults_detected,
+                recoveries=res.recoveries,
+                ladder_rung=res.ladder_rung,
+                final_health=HEALTH_NAMES[res.health],
+            )
 
         means, covs, pmeans, pcovs, _ = _filter_ar(params, xz, m_arr)
         s_sm, _, _ = _smoother_ar(params, means, covs, pmeans, pcovs)
@@ -318,6 +339,8 @@ def estimate_dfm_em_ar(
             stds=stds,
             means=n_mean,
             trace=trace,
+            converged=res.converged,
+            health=res.health,
         )
 
 

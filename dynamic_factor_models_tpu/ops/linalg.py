@@ -21,6 +21,7 @@ from .masking import fillz, mask_of
 
 __all__ = [
     "solve_normal",
+    "chol_guarded",
     "ols",
     "ols_masked",
     "ols_batched_series",
@@ -38,8 +39,40 @@ def solve_normal(A: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
 
     A is symmetric PSD (a Gram matrix X'WX).  pinv(A) @ b equals the
     Moore-Penrose least-squares solution pinv(sqrt(W)X) sqrt(W)y.
+
+    A non-finite Gram matrix or right-hand side raises immediately with a
+    clear message when the inputs are concrete: the eigh inside pinv would
+    otherwise turn one NaN into silently-NaN OLS coefficients downstream.
+    Under jit/vmap the inputs are tracers and the check is skipped — there
+    the loop-level health sentinel (utils.guards) owns detection, keeping
+    the hot program free of host syncs.
     """
+    if not isinstance(A, jax.core.Tracer) and not isinstance(b, jax.core.Tracer):
+        if not (bool(jnp.all(jnp.isfinite(A))) and bool(jnp.all(jnp.isfinite(b)))):
+            raise ValueError(
+                "solve_normal: non-finite values in the normal equations "
+                "(NaN/Inf in the Gram matrix or right-hand side); the "
+                "eigh-based pinv would propagate them silently into the "
+                "OLS coefficients — clean or re-mask the inputs"
+            )
     return jnp.linalg.pinv(A, hermitian=True) @ b
+
+
+def chol_guarded(M: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Cholesky factorization that REPORTS failure instead of emitting NaNs.
+
+    Returns ``(L, ok)``: ``ok`` is a scalar bool, True iff the
+    factorization succeeded (M symmetric positive definite, all entries
+    finite).  On failure L is returned with non-finite entries zeroed, so
+    downstream linear algebra stays finite while the caller branches on
+    ``ok`` — the checkify-style contract the recovery ladder relies on
+    when it verifies a ridge-jittered covariance is factorizable before
+    resuming the loop.  Trace-safe: usable under jit/vmap (``ok`` is a
+    traced value, not a host assertion).
+    """
+    L = jnp.linalg.cholesky(M)
+    ok = jnp.all(jnp.isfinite(L))
+    return jnp.where(jnp.isfinite(L), L, 0.0), ok
 
 
 def ols(y: jnp.ndarray, X: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:

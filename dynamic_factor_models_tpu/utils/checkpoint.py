@@ -4,42 +4,117 @@ The reference recomputes everything from the xlsx each run (SURVEY.md
 section 5.4).  Here fitted models (pytrees of arrays) round-trip to a single
 .npz; long bootstrap/EM runs can checkpoint per-shard RNG keys and partial
 state the same way.
+
+Every archive carries a sha256 content checksum over the leaf bytes and
+the tree structure, verified on load.  A checkpoint that fails the
+checksum, or cannot be read at all (truncated write, media corruption), is
+QUARANTINED — renamed to ``<path>.corrupt`` so the evidence survives —
+and `CheckpointCorruptError` is raised; `run_em_loop`'s resume path
+catches it and restarts the run cleanly instead of crashing mid-resume.
+Structural mismatches against the caller's template stay ordinary
+ValueErrors: the file is intact, the caller is wrong.
 """
 
 from __future__ import annotations
+
+import hashlib
+import os
 
 import numpy as np
 
 import jax
 
-__all__ = ["save_pytree", "load_pytree"]
+__all__ = ["save_pytree", "load_pytree", "CheckpointCorruptError"]
 
 _SEP = "__"
+_CHECKSUM_KEY = "content_sha256"
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A checkpoint failed its content checksum or could not be read; the
+    file has been moved to ``<path>.corrupt`` (when possible)."""
+
+
+def _content_digest(leaves, treedef_str: str) -> str:
+    h = hashlib.sha256()
+    h.update(treedef_str.encode())
+    for leaf in leaves:
+        a = np.asarray(leaf)
+        h.update(repr((a.shape, str(a.dtype))).encode())
+        h.update(np.ascontiguousarray(a).tobytes())
+    return h.hexdigest()
+
+
+def _quarantine(path: str) -> str | None:
+    dest = path + ".corrupt"
+    try:
+        os.replace(path, dest)
+        return dest
+    except OSError:
+        return None
 
 
 def save_pytree(path: str, tree) -> None:
-    """Save an arbitrary pytree of arrays/scalars to one .npz file."""
+    """Save an arbitrary pytree of arrays/scalars to one .npz file,
+    including a sha256 checksum of the content for load-time verification."""
     leaves, treedef = jax.tree.flatten(tree)
-    payload = {f"leaf{_SEP}{i}": np.asarray(leaf) for i, leaf in enumerate(leaves)}
+    leaves = [np.asarray(leaf) for leaf in leaves]
+    payload = {f"leaf{_SEP}{i}": leaf for i, leaf in enumerate(leaves)}
     payload["treedef"] = np.array(str(treedef))
+    payload[_CHECKSUM_KEY] = np.array(_content_digest(leaves, str(treedef)))
     np.savez_compressed(path, **payload)
 
 
 def load_pytree(path: str, like):
     """Load a pytree saved by save_pytree; `like` supplies the structure
-    (e.g. a template DFMResults/SSMParams with dummy leaves)."""
-    z = np.load(path, allow_pickle=False)
+    (e.g. a template DFMResults/SSMParams with dummy leaves).
+
+    Raises CheckpointCorruptError (after quarantining the file to
+    ``<path>.corrupt``) when the archive is unreadable or its content
+    checksum does not match; raises ValueError when the archive is intact
+    but its structure does not match `like`.
+    """
+    import zipfile
+    import zlib
+
+    try:
+        z = np.load(path, allow_pickle=False)
+        files = set(z.files)
+        n = len([k for k in files if k.startswith("leaf" + _SEP)])
+        stored_def = str(z["treedef"]) if "treedef" in files else None
+        leaves = [z[f"leaf{_SEP}{i}"] for i in range(n)]
+        stored_sum = str(z[_CHECKSUM_KEY]) if _CHECKSUM_KEY in files else None
+    except (
+        OSError, ValueError, KeyError, EOFError, zipfile.BadZipFile, zlib.error,
+    ) as e:
+        dest = _quarantine(path)
+        raise CheckpointCorruptError(
+            f"checkpoint {path!r} is unreadable ({e}); "
+            + (f"quarantined to {dest!r}" if dest else "quarantine failed")
+        ) from e
+    if stored_def is None:
+        dest = _quarantine(path)
+        raise CheckpointCorruptError(
+            f"checkpoint {path!r} has no tree structure entry; "
+            + (f"quarantined to {dest!r}" if dest else "quarantine failed")
+        )
+    # checksum verifies content integrity BEFORE any structural comparison:
+    # a flipped byte must never masquerade as a template mismatch.  Archives
+    # from before checksums were stored load uncheck-summed.
+    if stored_sum is not None and stored_sum != _content_digest(leaves, stored_def):
+        dest = _quarantine(path)
+        raise CheckpointCorruptError(
+            f"checkpoint {path!r} failed its content checksum; "
+            + (f"quarantined to {dest!r}" if dest else "quarantine failed")
+        )
     leaves_like, treedef = jax.tree.flatten(like)
-    n = len([k for k in z.files if k.startswith("leaf" + _SEP)])
     if n != len(leaves_like):
         raise ValueError(
             f"checkpoint has {n} leaves but template expects {len(leaves_like)}"
         )
-    stored_def = str(z["treedef"])
     if stored_def != str(treedef):
         raise ValueError(
             "checkpoint tree structure does not match the template:\n"
             f"  stored:   {stored_def}\n  template: {treedef}"
         )
-    leaves = [z[f"leaf{_SEP}{i}"] for i in range(n)]
     return jax.tree.unflatten(treedef, leaves)

@@ -435,8 +435,10 @@ class CompileSpec:
         "als_core",
         "bootstrap_core",
         "em_loop",
+        "em_loop_guarded",
         "em_step_steady",
         "em_loop@steady",
+        "em_loop_guarded@steady",
     )
     max_em_iter: int = 200
     als_max_iter: int = 200_000
@@ -535,7 +537,9 @@ def _kernel_plan(spec: CompileSpec):
             )
 
     if spec.t_star is not None and (
-        "em_step_steady" in spec.kernels or "em_loop@steady" in spec.kernels
+        "em_step_steady" in spec.kernels
+        or "em_loop@steady" in spec.kernels
+        or "em_loop_guarded@steady" in spec.kernels
     ):
         # the steady EM step is a per-(t_star, block) jitted function
         # (ssm._steady_step_for names it em_step_steady_t{t}_b{b}, so the
@@ -598,6 +602,49 @@ def _kernel_plan(spec: CompileSpec):
                 {},
                 aot_statics(steady_step, spec.max_em_iter, sdonate, 0),
                 steady_loop_inputs,
+            )
+
+        if "em_loop_guarded@steady" in spec.kernels:
+            # guarded while-loop specialized to the steady step — same
+            # registry name "em_loop_guarded", distinguished by statics,
+            # so a guards-on method="steady" run AOT-hits like the
+            # unguarded steady loop does
+            from ..models import emloop
+
+            ld = jnp.result_type(float)
+            sgcarry_s = (
+                scarry_params_s,
+                scarry_params_s,
+                _sds((), ld),
+                _sds((), ld),
+                _sds((), jnp.int32),
+                _sds((spec.max_em_iter,), ld),
+                _sds((), jnp.int32),
+            )
+
+            def steady_guarded_loop_inputs():
+                st, x, mask, stats = steady_inputs()
+                carry = emloop._fresh_guarded_carry(
+                    st, jnp.asarray(1e-6, ld), spec.max_em_iter
+                )
+                return (
+                    carry,
+                    (x, mask, stats),
+                    jnp.asarray(1e-6, ld),
+                    jnp.asarray(1e-3, ld),
+                    jnp.asarray(0, jnp.int32),
+                    jnp.asarray(2, jnp.int32),
+                )
+
+            sgdonate = donation_enabled()
+            plans["em_loop_guarded@steady"] = (
+                emloop._em_while_guarded_jit(sgdonate),
+                (steady_step, sgcarry_s, (x_s, mask_s, stats_s), _sds((), ld),
+                 _sds((), ld), _sds((), jnp.int32), spec.max_em_iter,
+                 _sds((), jnp.int32)),
+                {},
+                aot_statics(steady_step, spec.max_em_iter, sgdonate, 0, 0, 0),
+                steady_guarded_loop_inputs,
             )
 
     if "em_step_ar" in spec.kernels:
@@ -708,6 +755,50 @@ def _kernel_plan(spec: CompileSpec):
             # are heartbeat-free, so a DFM_HEARTBEAT run recompiles live
             aot_statics(ssm.em_step_stats, spec.max_em_iter, donate, 0),
             loop_inputs,
+        )
+
+    if "em_loop_guarded" in spec.kernels:
+        from ..models import emloop
+
+        ld = jnp.result_type(float)
+        # guarded carry: (params, prev_params, ll_prev, ll, it, path, health)
+        gcarry_s = (
+            params_s,
+            params_s,
+            _sds((), ld),
+            _sds((), ld),
+            _sds((), jnp.int32),
+            _sds((spec.max_em_iter,), ld),
+            _sds((), jnp.int32),
+        )
+        gargs_s = (x_s, mask_s, stats_s)
+
+        def guarded_loop_inputs():
+            pa, x, mask, stats = em_inputs()
+            carry = emloop._fresh_guarded_carry(
+                pa, jnp.asarray(1e-6, ld), spec.max_em_iter
+            )
+            return (
+                carry,
+                (x, mask, stats),
+                jnp.asarray(1e-6, ld),
+                jnp.asarray(1e-3, ld),
+                jnp.asarray(0, jnp.int32),
+                jnp.asarray(2, jnp.int32),
+            )
+
+        gdonate = donation_enabled()
+        plans["em_loop_guarded"] = (
+            emloop._em_while_guarded_jit(gdonate),
+            (ssm.em_step_stats, gcarry_s, gargs_s, _sds((), ld), _sds((), ld),
+             _sds((), jnp.int32), spec.max_em_iter, _sds((), jnp.int32)),
+            {},
+            # mirrors the guarded dispatch key: (step, max_em_iter, donate,
+            # heartbeat_every, inject_nan_at, inject_chol_at) — precompiled
+            # loops are heartbeat- and injection-free; a DFM_FAULTS run
+            # compiles its injected program live
+            aot_statics(ssm.em_step_stats, spec.max_em_iter, gdonate, 0, 0, 0),
+            guarded_loop_inputs,
         )
 
     return plans

@@ -1,0 +1,233 @@
+"""Deterministic fault injection: the chaos half of the guardrail layer.
+
+`utils/guards.py` gives the EM loop a health sentinel and a recovery
+ladder; this module supplies the *reproducible faults* that prove the
+ladder works — every failure mode the guards claim to survive can be
+forced, at an exact iteration or checkpoint chunk, from one environment
+variable.  tests/test_chaos.py and `bench.py --chaos` are the consumers;
+`tools/tpu_watch.sh` runs one injected-preemption resume per live window.
+
+Spec grammar (``DFM_FAULTS``, also `inject()` below)::
+
+    DFM_FAULTS="<clause>[;<clause>...]"       # ';' or ',' separated
+    clause := kind [@ n ['+']]                # n: positive int site index
+
+    nan_estep@k     force the k-th EM iteration's log-likelihood to NaN
+                    (1-based; the sentinel sees a non-finite E-step)
+    chol_fail@k     poison the factor innovation covariance Q entering
+                    the k-th EM iteration with NaN, so the filter's
+                    Cholesky factorization fails and floods the step
+    ckpt_corrupt@n  after the n-th successful checkpoint chunk save,
+                    corrupt the archive in place (truncate to half) —
+                    the next resume must quarantine and restart
+    preempt@n       raise SimulatedPreemption immediately after the
+                    n-th checkpoint chunk save — a mid-run kill whose
+                    resume must be bit-identical to an unkilled run
+
+Unsuffixed ``ckpt_corrupt`` / ``preempt`` default to n=1; ``nan_estep`` /
+``chol_fail`` require an explicit iteration.
+
+By default an in-loop fault (`nan_estep`, `chol_fail`) is TRANSIENT: it
+is baked only into the FIRST guarded-loop attempt's program, so the
+recovery ladder's retries run clean — the chaos tests pin the recovered
+run against an uninjected one.  A trailing ``+`` (``nan_estep@3+``)
+makes it PERSISTENT: it re-fires on every same-program retry (the jitter
+rungs) and only stops applying when a rung changes the step or its dtype
+(demote / promote_f64) — the shape of a fault tied to one compiled
+program, used to exercise the deeper rungs deterministically.  The
+checkpoint faults fire once per `run_em_loop` call when the chunk
+counter hits n and ignore ``+``.
+
+Everything here is host-side and import-cheap; with no spec active every
+probe returns the empty plan and the guarded program is unchanged.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+from typing import NamedTuple
+
+__all__ = [
+    "FaultPlan",
+    "EMPTY_PLAN",
+    "SimulatedPreemption",
+    "parse_spec",
+    "active_plan",
+    "inject",
+    "fault_fired",
+    "corrupt_file",
+]
+
+_lock = threading.RLock()
+_override: "FaultPlan | None" = None
+
+_KINDS = ("nan_estep", "chol_fail", "ckpt_corrupt", "preempt")
+# kinds where a bare clause means "at the first site"
+_DEFAULT_SITE = {"ckpt_corrupt": 1, "preempt": 1}
+
+
+class SimulatedPreemption(RuntimeError):
+    """Raised by the checkpointing loop at an injected `preempt@n` site.
+
+    Deliberately NOT a KeyboardInterrupt subclass: tests and the watcher
+    catch it precisely, and nothing in the library may swallow it —
+    preemption recovery happens in the NEXT run, via checkpoint resume.
+    """
+
+
+class FaultPlan(NamedTuple):
+    """Parsed DFM_FAULTS spec: 1-based site index per kind (None = off)
+    plus the set of kinds flagged persistent with a trailing ``+``."""
+
+    nan_estep: int | None = None
+    chol_fail: int | None = None
+    ckpt_corrupt: int | None = None
+    preempt: int | None = None
+    persistent: frozenset = frozenset()
+
+    def any(self) -> bool:
+        return any(v is not None for v in self[:4])
+
+
+EMPTY_PLAN = FaultPlan()
+
+
+def parse_spec(spec: str | None) -> FaultPlan:
+    """Parse a DFM_FAULTS spec string into a FaultPlan.
+
+    Raises ValueError on an unknown kind, a malformed site index, or a
+    kind that needs an explicit site — a chaos run with a typo'd spec
+    must fail loudly, not silently run un-injected.
+    """
+    if not spec or not spec.strip():
+        return EMPTY_PLAN
+    plan: dict[str, int] = {}
+    persistent: set[str] = set()
+    for raw in spec.replace(",", ";").split(";"):
+        clause = raw.strip()
+        if not clause:
+            continue
+        kind, _, site = clause.partition("@")
+        kind = kind.strip()
+        site = site.strip()
+        if kind not in _KINDS:
+            raise ValueError(
+                f"DFM_FAULTS: unknown fault kind {kind!r} in clause "
+                f"{clause!r}; valid kinds: {', '.join(_KINDS)}"
+            )
+        persist = site.endswith("+")
+        if persist:
+            site = site[:-1].strip()
+        if site:
+            try:
+                n = int(site)
+            except ValueError:
+                raise ValueError(
+                    f"DFM_FAULTS: bad site index {site!r} in clause "
+                    f"{clause!r} (want a positive integer)"
+                ) from None
+        elif kind in _DEFAULT_SITE:
+            n = _DEFAULT_SITE[kind]
+        else:
+            raise ValueError(
+                f"DFM_FAULTS: {kind!r} needs an iteration, e.g. '{kind}@3'"
+            )
+        if n < 1:
+            raise ValueError(
+                f"DFM_FAULTS: site index must be >= 1 in clause {clause!r}"
+            )
+        if kind in plan:
+            raise ValueError(f"DFM_FAULTS: duplicate clause for {kind!r}")
+        plan[kind] = n
+        if persist:
+            if kind in _DEFAULT_SITE:
+                raise ValueError(
+                    f"DFM_FAULTS: '+' (persistent) only applies to in-loop "
+                    f"faults, not {kind!r}"
+                )
+            persistent.add(kind)
+    return FaultPlan(persistent=frozenset(persistent), **plan)
+
+
+def active_plan() -> FaultPlan:
+    """The currently active plan: an `inject()` override when one is
+    open, else the parsed ``DFM_FAULTS`` env var, else the empty plan."""
+    with _lock:
+        if _override is not None:
+            return _override
+    return parse_spec(os.environ.get("DFM_FAULTS"))
+
+
+@contextlib.contextmanager
+def inject(spec: str | FaultPlan):
+    """In-process fault activation for tests: ``with inject("nan_estep@3"):``
+    overrides the environment for the duration of the block."""
+    global _override
+    plan = parse_spec(spec) if isinstance(spec, str) else plan_check(spec)
+    with _lock:
+        prev = _override
+        _override = plan
+    try:
+        yield plan
+    finally:
+        with _lock:
+            _override = prev
+
+
+def plan_check(plan: FaultPlan) -> FaultPlan:
+    if not isinstance(plan, FaultPlan):
+        raise TypeError(f"expected FaultPlan or spec string, got {plan!r}")
+    return plan
+
+
+def fault_fired(kind: str) -> None:
+    """Count one injected fault in the telemetry registry (and per kind)."""
+    from .telemetry import inc
+
+    inc("faults_injected")
+    inc("faults_injected." + kind)
+
+
+def corrupt_file(path: str, mode: str = "truncate") -> None:
+    """Deterministically damage a file in place.
+
+    mode="truncate" halves it (an interrupted write); mode="flip" XORs a
+    byte in the middle (silent media corruption — defeats any parser that
+    doesn't checksum).  Used by the ckpt_corrupt injection site and the
+    chaos tests.
+    """
+    size = os.path.getsize(path)
+    if mode == "truncate":
+        with open(path, "r+b") as f:
+            f.truncate(max(size // 2, 1))
+    elif mode == "flip":
+        # The flipped byte must land inside a member's payload: zip
+        # archives carry alignment padding between members, and a flip
+        # there leaves the decoded content bit-identical (nothing to
+        # detect).  For a zip (.npz) aim at the middle of the first
+        # member's data; for anything else fall back to the file middle.
+        target = size // 2
+        import struct
+        import zipfile
+
+        try:
+            with zipfile.ZipFile(path) as z:
+                info = z.infolist()[0]
+                with open(path, "rb") as f:
+                    f.seek(info.header_offset)
+                    hdr = f.read(30)
+                name_len, extra_len = struct.unpack("<HH", hdr[26:30])
+                data_off = info.header_offset + 30 + name_len + extra_len
+                target = data_off + info.compress_size // 2
+        except (zipfile.BadZipFile, IndexError, struct.error):
+            pass
+        with open(path, "r+b") as f:
+            f.seek(target)
+            b = f.read(1)
+            f.seek(target)
+            f.write(bytes([b[0] ^ 0xFF]) if b else b"\xff")
+    else:
+        raise ValueError(f"unknown corrupt_file mode {mode!r}")
+    fault_fired("ckpt_corrupt")

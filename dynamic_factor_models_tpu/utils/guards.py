@@ -1,0 +1,211 @@
+"""Numerical-health guardrails for the EM/Kalman stack.
+
+Three pieces, consumed by `models/emloop.py`:
+
+* **Sentinel** — predicates folded into the guarded while-loop carry.
+  EM log-likelihood is non-decreasing in exact arithmetic, so a decrease
+  beyond `drop_tol()` (relative, covers the steady tail's approximate
+  moments) or any non-finite loglik / parameter leaf flips the carry's
+  `health` flag and exits the loop with the LAST-GOOD params preserved.
+  Health codes: 0 healthy, 1 non-finite, 2 monotonicity violation.
+
+* **Recovery ladder** — host-side escalation applied to the rolled-back
+  params when the sentinel trips, each rung retried once, in order:
+
+      1. ridge-jitter the innovation covariance, small epsilon
+      2. ridge-jitter again, grown epsilon (PSD-projected both times)
+      3. demote: drop method="steady" / accelerated EM to the exact
+         sequential step (caller supplies the fallback via run_em_loop)
+      4. promote f32 runs to f64
+
+  The ladder is bounded: when every rung is exhausted the loop returns
+  the last-good params with `final_health != 0` in telemetry rather
+  than raising — a degraded answer beats a dead serving process.
+
+* **Switches** — `DFM_GUARDS=0` disables the guarded program entirely
+  (run_em_loop then dispatches the PR-1 unguarded while-loop, whose HLO
+  is pinned byte-identical by the chaos bench); `DFM_GUARD_DROP_TOL`
+  overrides the relative monotonicity tolerance.
+
+All jnp helpers here are trace-safe (no python branching on values) so
+they can live inside the jitted loop body; the ladder itself is pure
+host code and runs only on the cold trip path.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "HEALTH_OK",
+    "HEALTH_NONFINITE",
+    "HEALTH_DECREASE",
+    "HEALTH_NAMES",
+    "LADDER_RUNGS",
+    "guards_enabled",
+    "drop_tol",
+    "tree_finite",
+    "psd_project",
+    "ridge_jitter",
+    "promote_f64",
+    "poison_cov",
+]
+
+HEALTH_OK = 0
+HEALTH_NONFINITE = 1
+HEALTH_DECREASE = 2
+HEALTH_NAMES = {
+    HEALTH_OK: "ok",
+    HEALTH_NONFINITE: "nonfinite",
+    HEALTH_DECREASE: "loglik_decrease",
+}
+
+# rung names in escalation order; telemetry's `ladder_rung` reports the
+# 1-based index of the last rung attempted (0 = never tripped)
+LADDER_RUNGS = ("jitter", "jitter_grown", "demote", "promote_f64")
+
+# rung epsilons for the two jitter attempts, scaled by mean diagonal
+_JITTER_EPS = (1e-8, 1e-4)
+
+
+def guards_enabled() -> bool:
+    """In-loop sentinel + ladder on by default; DFM_GUARDS=0 disables."""
+    return os.environ.get("DFM_GUARDS", "1").strip().lower() not in (
+        "0",
+        "false",
+        "off",
+        "no",
+        "",
+    )
+
+
+def drop_tol() -> float:
+    """Relative loglik-decrease tolerance before the sentinel trips.
+
+    The default 1e-3 is loose against f32 roundoff and the steady tail's
+    approximate E-step moments, but tight against genuine divergence
+    (a poisoned step typically moves loglik by orders of magnitude or
+    straight to NaN)."""
+    raw = os.environ.get("DFM_GUARD_DROP_TOL")
+    if raw is None or not raw.strip():
+        return 1e-3
+    v = float(raw)
+    if not v >= 0.0:  # also rejects NaN
+        raise ValueError(f"DFM_GUARD_DROP_TOL must be >= 0, got {raw!r}")
+    return v
+
+
+def tree_finite(tree) -> jnp.ndarray:
+    """Scalar bool: every inexact leaf of `tree` is finite everywhere.
+
+    Cheap relative to an EM step (one reduction per leaf, a handful of
+    leaves) and trace-safe, so it rides inside the guarded loop body."""
+    leaves = [
+        jnp.all(jnp.isfinite(x))
+        for x in jax.tree_util.tree_leaves(tree)
+        if jnp.issubdtype(jnp.asarray(x).dtype, jnp.inexact)
+    ]
+    if not leaves:
+        return jnp.asarray(True)
+    out = leaves[0]
+    for v in leaves[1:]:
+        out = out & v
+    return out
+
+
+def psd_project(M: jnp.ndarray, eps: float) -> jnp.ndarray:
+    """Symmetrize and clamp eigenvalues to >= eps*scale, NaN-proof.
+
+    Non-finite entries are zeroed before the eigh (NaN anywhere would
+    otherwise NaN the whole spectrum) so a poisoned covariance comes
+    back as a valid PSD matrix instead of propagating."""
+    M = jnp.where(jnp.isfinite(M), M, 0.0)
+    M = 0.5 * (M + M.T)
+    w, v = jnp.linalg.eigh(M)
+    scale = jnp.maximum(jnp.mean(jnp.abs(w)), 1.0)
+    w = jnp.maximum(w, eps * scale)
+    return (v * w) @ v.T
+
+
+def _map_cov(params, fn_sq, fn_diag):
+    """Apply fn_sq to the square innovation covariance `.Q` and fn_diag
+    to the diagonal observation variance `.R` (when present), recursing
+    through wrapper states that hold the real params under `.params`
+    (SteadyEMState, SquaremState).  Everything else passes through."""
+    if hasattr(params, "params") and not hasattr(params, "Q"):
+        return params._replace(params=_map_cov(params.params, fn_sq, fn_diag))
+    rep = {}
+    if hasattr(params, "Q"):
+        rep["Q"] = fn_sq(params.Q)
+    if hasattr(params, "R") and getattr(params, "R") is not None:
+        R = params.R
+        if getattr(R, "ndim", 0) == 1:
+            rep["R"] = fn_diag(R)
+    if hasattr(params, "sigv2"):
+        rep["sigv2"] = fn_diag(params.sigv2)
+    if not rep:
+        return params
+    return params._replace(**rep)
+
+
+def ridge_jitter(params, rung: int):
+    """Rung-`rung` (0 or 1) covariance repair on rolled-back params:
+    PSD-project Q with a growing eigenvalue floor, floor the diagonal
+    observation variances, and scrub any non-finite leaf back to zero
+    (the rollback params are last-good, so this is belt-and-braces).
+    The repaired Q is verified factorizable with ops.linalg.chol_guarded;
+    if even the projection cannot be factorized the covariance is
+    replaced by a trace-matched identity — maximally dull, always PD."""
+    from ..ops.linalg import chol_guarded
+
+    eps = _JITTER_EPS[min(rung, len(_JITTER_EPS) - 1)]
+    params = jax.tree_util.tree_map(
+        lambda x: (
+            jnp.where(jnp.isfinite(x), x, 0.0)
+            if jnp.issubdtype(jnp.asarray(x).dtype, jnp.inexact)
+            else x
+        ),
+        params,
+    )
+
+    def repair_sq(Q):
+        Qp = psd_project(Q, eps)
+        _, ok = chol_guarded(Qp)
+        scale = jnp.maximum(jnp.trace(Qp) / Qp.shape[0], eps)
+        return jnp.where(ok, Qp, scale * jnp.eye(Qp.shape[0], dtype=Qp.dtype))
+
+    return _map_cov(
+        params,
+        repair_sq,
+        lambda d: jnp.maximum(jnp.where(jnp.isfinite(d), d, eps), eps),
+    )
+
+
+def promote_f64(tree):
+    """Promote every floating leaf to float64 (ladder rung 4).  Returns
+    the tree unchanged when x64 is not enabled — the caller checks
+    `jax.config.jax_enable_x64` and skips the rung with a telemetry
+    note instead of silently retrying an identical f32 program."""
+    if not jax.config.jax_enable_x64:
+        return tree
+    return jax.tree_util.tree_map(
+        lambda x: (
+            jnp.asarray(x, jnp.float64)
+            if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
+            else x
+        ),
+        tree,
+    )
+
+
+def poison_cov(params, do):
+    """Fault-injection helper (chol_fail): where traced bool `do` is
+    set, replace the innovation covariance with NaN so the filter's
+    Cholesky factorization genuinely fails downstream.  An indefinite
+    Q would be rescued by the EM step's own PSD floor; NaN survives
+    `maximum` and eigh, which is exactly the point."""
+    nanify = lambda Q: jnp.where(do, jnp.full_like(Q, jnp.nan), Q)
+    return _map_cov(params, nanify, lambda d: d)

@@ -608,6 +608,21 @@ def _aot_hm(rec: dict) -> tuple[int, int]:
     return h, m
 
 
+def _health_str(rec: dict) -> str:
+    """Compact guard-ladder digest: '-' for a fault-free run, else
+    'detected/recovered' plus the final health name when the ladder was
+    exhausted (e.g. '2/2' healthy after two recoveries, '1/0:nonfinite'
+    unrecovered)."""
+    f = rec.get("faults_detected") or 0
+    if not f:
+        return "-"
+    s = f"{f}/{rec.get('recoveries') or 0}"
+    health = rec.get("final_health")
+    if health and health != "ok":
+        s += f":{health}"
+    return s
+
+
 def summarize(path: str, entry: str | None = None) -> str:
     """Per-run and per-entry aggregate tables of a RunRecord JSONL file."""
     recs = _load_jsonl(path)
@@ -634,11 +649,12 @@ def summarize(path: str, entry: str | None = None) -> str:
             f"{r.get('wall_s', 0.0):.3f}",
             _mem_mb(r),
             f"{h}/{m}",
+            _health_str(r),
             "ERR" if r.get("error") else "",
         ])
     per_run = _fmt_table(
         ["time", "entry", "plat", "shape", "iters", "conv", "loglik",
-         "wall_s", "peak_MB", "aot h/m", ""],
+         "wall_s", "peak_MB", "aot h/m", "faults", ""],
         rows,
     )
 
@@ -647,12 +663,18 @@ def summarize(path: str, entry: str | None = None) -> str:
         a = agg.setdefault(r.get("entry", "?"), {
             "runs": 0, "errors": 0, "wall": 0.0, "iters": 0, "conv": 0,
             "compile_s": 0.0, "hits": 0, "misses": 0,
+            "faults": 0, "recovered": 0, "unhealthy": 0,
         })
         a["runs"] += 1
         a["errors"] += 1 if r.get("error") else 0
         a["wall"] += r.get("wall_s", 0.0) or 0.0
         a["iters"] += r.get("n_iter") or 0
         a["conv"] += 1 if r.get("converged") else 0
+        a["faults"] += r.get("faults_detected") or 0
+        a["recovered"] += r.get("recoveries") or 0
+        a["unhealthy"] += (
+            1 if (r.get("final_health") or "ok") != "ok" else 0
+        )
         for c in (r.get("counters_delta") or {}).values():
             a["compile_s"] += c.get("compile_s", 0.0)
         h, m = _aot_hm(r)
@@ -669,12 +691,15 @@ def summarize(path: str, entry: str | None = None) -> str:
             f"{100.0 * a['conv'] / a['runs']:.0f}%",
             f"{a['compile_s']:.3f}",
             f"{a['hits']}/{a['misses']}",
+            (f"{a['faults']}/{a['recovered']}"
+             + (f" ({a['unhealthy']} bad)" if a["unhealthy"] else "")
+             if a["faults"] else "-"),
         ]
         for e, a in sorted(agg.items())
     ]
     aggregate = _fmt_table(
         ["entry", "runs", "err", "wall_s", "mean_s", "mean_iters",
-         "conv%", "compile_s", "aot h/m"],
+         "conv%", "compile_s", "aot h/m", "faults"],
         arows,
     )
     return (

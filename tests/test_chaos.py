@@ -1,0 +1,458 @@
+"""Chaos suite: numerical-health guardrails under deterministic fault
+injection (utils/guards + utils/faults + the guarded emloop).
+
+Selection contract (pytest.ini): everything here carries the `chaos`
+marker; the default subset uses toy module-level EM steps on tiny
+pytrees so it rides in the tier-1 fast lane, and the full-scale drills
+(real estimation entry points) are additionally marked `slow`.
+
+The toy step family below keeps the guarded while-loop's compile
+surface minimal: a two-parameter contraction with an analytically
+monotone "log-likelihood" (negative squared distance to the target),
+plus a diverging twin whose loglik genuinely decreases every iteration
+— the only way to exercise the DECREASE sentinel and ladder exhaustion
+deterministically without a pathological panel.
+"""
+
+import os
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dynamic_factor_models_tpu.models.emloop import run_em_loop
+from dynamic_factor_models_tpu.utils import faults, guards, telemetry
+
+pytestmark = pytest.mark.chaos
+
+
+class ToyParams(NamedTuple):
+    theta: jnp.ndarray  # (2,) the "estimate"
+    Q: jnp.ndarray  # (2, 2) innovation covariance (jitter/poison target)
+
+
+def _toy_params():
+    return ToyParams(
+        theta=jnp.asarray([1.0, -2.0]), Q=jnp.eye(2)
+    )
+
+
+def toy_step(params, target):
+    """Contraction toward `target`: loglik (of the INPUT, per the loop
+    contract) is -||theta - target||^2, strictly increasing along the
+    trajectory; Q passes through untouched."""
+    ll = -jnp.sum((params.theta - target) ** 2)
+    return ToyParams(
+        target + 0.5 * (params.theta - target), params.Q
+    ), ll
+
+
+def toy_step_diverging(params, target):
+    """Anti-contraction: theta moves AWAY from the target, so the
+    loglik DECREASES every iteration — a deterministic monotonicity
+    violation no jitter rung can repair."""
+    ll = -jnp.sum((params.theta - target) ** 2)
+    return ToyParams(
+        target + 2.0 * (params.theta - target), params.Q
+    ), ll
+
+
+_TARGET = jnp.asarray([0.5, 0.25])
+
+
+def _counters():
+    return telemetry.snapshot()["counters"]
+
+
+def _delta(a, b):
+    return max(
+        float(np.max(np.abs(np.asarray(x) - np.asarray(y))))
+        for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b))
+    )
+
+
+# ---------------------------------------------------------------------------
+# fault-spec grammar
+# ---------------------------------------------------------------------------
+
+
+def test_fault_spec_grammar():
+    plan = faults.parse_spec("nan_estep@3;chol_fail@7+")
+    assert plan.nan_estep == 3 and plan.chol_fail == 7
+    assert plan.persistent == frozenset({"chol_fail"})
+    assert plan.any()
+    # checkpoint kinds default to site 1; separators ';' and ',' both work
+    plan = faults.parse_spec("ckpt_corrupt, preempt@2")
+    assert plan.ckpt_corrupt == 1 and plan.preempt == 2
+    assert faults.parse_spec("") == faults.EMPTY_PLAN
+    assert not faults.EMPTY_PLAN.any()
+    for bad in (
+        "gamma_ray@3",  # unknown kind
+        "nan_estep",  # in-loop kinds need an explicit iteration
+        "nan_estep@0",  # sites are 1-based
+        "nan_estep@x",  # not an int
+        "nan_estep@2;nan_estep@3",  # duplicate clause
+        "preempt@1+",  # checkpoint kinds cannot be persistent
+    ):
+        with pytest.raises(ValueError):
+            faults.parse_spec(bad)
+
+
+def test_guard_env_switches(monkeypatch):
+    monkeypatch.delenv("DFM_GUARDS", raising=False)
+    assert guards.guards_enabled()
+    for off in ("0", "false", "off", "no", ""):
+        monkeypatch.setenv("DFM_GUARDS", off)
+        assert not guards.guards_enabled()
+    monkeypatch.setenv("DFM_GUARDS", "1")
+    assert guards.guards_enabled()
+    monkeypatch.delenv("DFM_GUARD_DROP_TOL", raising=False)
+    assert guards.drop_tol() == 1e-3
+    monkeypatch.setenv("DFM_GUARD_DROP_TOL", "0.5")
+    assert guards.drop_tol() == 0.5
+    monkeypatch.setenv("DFM_GUARD_DROP_TOL", "-1")
+    with pytest.raises(ValueError):
+        guards.drop_tol()
+    monkeypatch.setenv("DFM_GUARD_DROP_TOL", "nan")
+    with pytest.raises(ValueError):
+        guards.drop_tol()
+
+
+# ---------------------------------------------------------------------------
+# in-loop faults: detect, recover, match the clean run
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("spec,kind", [
+    ("nan_estep@3", "nan_estep"),
+    ("chol_fail@3", "chol_fail"),
+])
+def test_inloop_fault_recovers_to_clean_result(spec, kind):
+    """A transient injected fault must be detected, recovered via the
+    first ladder rung, and leave the final params within 1e-8 of the
+    uninjected run — the jitter epsilon is a no-op on an
+    already-well-conditioned covariance, so the retry replays the clean
+    trajectory."""
+    params, args = _toy_params(), (_TARGET,)
+    clean = run_em_loop(toy_step, params, args, 1e-9, 40, guard=True)
+    assert clean.health == guards.HEALTH_OK and clean.faults_detected == 0
+    c0 = _counters()
+    with faults.inject(spec):
+        res = run_em_loop(toy_step, params, args, 1e-9, 40, guard=True)
+    c1 = _counters()
+    assert res.health == guards.HEALTH_OK
+    assert res.faults_detected == 1 and res.recoveries == 1
+    assert list(res.rungs_used) == ["jitter"]
+    assert res.converged
+    assert _delta(res.params, clean.params) < 1e-8
+    # telemetry: detection, recovery, and the injection itself all count
+    assert c1["em_guard.faults_detected"] == c0.get(
+        "em_guard.faults_detected", 0) + 1
+    assert c1["em_guard.recoveries"] == c0.get("em_guard.recoveries", 0) + 1
+    assert c1["faults_injected." + kind] >= c0.get(
+        "faults_injected." + kind, 0) + 1
+
+
+def test_persistent_decrease_exhausts_ladder_returns_last_good():
+    """A genuinely diverging step trips the DECREASE sentinel on every
+    attempt: the ladder tries both jitter rungs (demote has no fallback
+    here; promote_f64 is skipped — params are already f64 under the test
+    config), then returns the LAST-GOOD params with health flagged,
+    never raising."""
+    params, args = _toy_params(), (_TARGET,)
+    res = run_em_loop(toy_step_diverging, params, args, 1e-9, 40, guard=True)
+    assert res.health == guards.HEALTH_DECREASE
+    assert not res.converged
+    assert list(res.rungs_used) == ["jitter", "jitter_grown"]
+    assert res.faults_detected == 3  # initial trip + one per jitter rung
+    assert res.recoveries == 2  # rung attempts that resumed the loop
+    # last-good: theta was never replaced by a diverged iterate (the
+    # jitter rungs only touch Q, and Q=I is a fixed point of the repair)
+    np.testing.assert_allclose(
+        np.asarray(res.params.theta), np.asarray(params.theta), atol=1e-12
+    )
+    assert bool(guards.tree_finite(res.params))
+
+
+def test_nan_estep_host_loop_sentinel():
+    """collect_path=True runs the host-synced diagnostic loop: the
+    sentinel stops on the poisoned iteration and preserves last-good
+    params, but does NOT run the ladder (preserved trip state beats an
+    automatic retry when a human is watching)."""
+    params, args = _toy_params(), (_TARGET,)
+    with faults.inject("nan_estep@4"):
+        # host loop has no injection machinery — drive the sentinel with
+        # the diverging step instead, which the DECREASE branch catches
+        res = run_em_loop(
+            toy_step_diverging, params, args, 1e-9, 10, guard=True,
+            collect_path=True,
+        )
+    assert res.health == guards.HEALTH_DECREASE
+    assert res.faults_detected == 1 and res.recoveries == 0
+    assert not res.converged
+    np.testing.assert_allclose(
+        np.asarray(res.params.theta), np.asarray(params.theta), atol=1e-12
+    )
+
+
+# ---------------------------------------------------------------------------
+# satellite: the converged flag reports the tolerance break, not the cap
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("guard", [True, False])
+def test_converged_flag_reports_tolerance_break_device(guard):
+    params, args = _toy_params(), (_TARGET,)
+    full = run_em_loop(toy_step, params, args, 1e-9, 40, guard=guard)
+    assert full.converged and full.n_iter < 40
+    # a cap below the tolerance break point must NOT report converged,
+    # even though n_iter < max_em_iter is impossible here (the old bug
+    # reported `it < host_cap` as convergence)
+    capped = run_em_loop(toy_step, params, args, 1e-9, 3, guard=guard)
+    assert capped.n_iter == 3 and not capped.converged
+    # convergence exactly on the final permitted iteration still counts:
+    # rerun with the cap set to the actual break iteration
+    exact = run_em_loop(toy_step, params, args, 1e-9, full.n_iter, guard=guard)
+    assert exact.n_iter == full.n_iter and exact.converged
+    # tol=0 never breaks: runs to the cap, not converged
+    never = run_em_loop(toy_step, params, args, 0.0, 5, guard=guard)
+    assert never.n_iter == 5 and not never.converged
+
+
+def test_converged_flag_reports_tolerance_break_host():
+    params, args = _toy_params(), (_TARGET,)
+    full = run_em_loop(
+        toy_step, params, args, 1e-9, 40, guard=True, collect_path=True
+    )
+    assert full.converged and full.n_iter < 40
+    capped = run_em_loop(
+        toy_step, params, args, 1e-9, 3, guard=True, collect_path=True
+    )
+    assert capped.n_iter == 3 and not capped.converged
+    exact = run_em_loop(
+        toy_step, params, args, 1e-9, full.n_iter, guard=True,
+        collect_path=True,
+    )
+    assert exact.converged
+
+
+# ---------------------------------------------------------------------------
+# checkpoint integrity: checksum, quarantine, clean restart, preemption
+# ---------------------------------------------------------------------------
+
+
+def test_checkpoint_checksum_roundtrip_and_quarantine(tmp_path):
+    from dynamic_factor_models_tpu.utils.checkpoint import (
+        CheckpointCorruptError,
+        load_pytree,
+        save_pytree,
+    )
+
+    tree = {"a": np.arange(6.0).reshape(2, 3), "b": np.ones(4, np.float32)}
+    p = str(tmp_path / "ok.npz")
+    save_pytree(p, tree)
+    back = load_pytree(p, tree)
+    np.testing.assert_array_equal(back["a"], tree["a"])
+
+    for mode in ("truncate", "flip"):
+        p2 = str(tmp_path / f"bad_{mode}.npz")
+        save_pytree(p2, tree)
+        faults.corrupt_file(p2, mode=mode)
+        with pytest.raises(CheckpointCorruptError):
+            load_pytree(p2, tree)
+        assert not os.path.exists(p2), "corrupt archive must be moved away"
+        assert os.path.exists(p2 + ".corrupt"), "quarantine file missing"
+
+    # structural mismatch is caller error, not corruption: still ValueError
+    p3 = str(tmp_path / "structural.npz")
+    save_pytree(p3, {"a": np.ones(3)})
+    with pytest.raises(ValueError):
+        load_pytree(p3, {"a": np.ones(3), "b": np.ones(2)})
+    assert os.path.exists(p3), "structural mismatch must not quarantine"
+
+
+def test_ckpt_corrupt_injection_quarantines_and_restarts(tmp_path):
+    params, args = _toy_params(), (_TARGET,)
+    clean = run_em_loop(toy_step, params, args, 0.0, 12, guard=True)
+    ck = str(tmp_path / "chaos.npz")
+    c0 = _counters()
+    # 12 iters / every 4 = 3 chunk saves; corrupt the LAST one (earlier
+    # corruption would be healed by the atomic rewrite of later chunks)
+    with faults.inject("ckpt_corrupt@3"):
+        run_em_loop(
+            toy_step, params, args, 0.0, 12, guard=True,
+            checkpoint_path=ck, checkpoint_every=4,
+        )
+    res = run_em_loop(
+        toy_step, params, args, 0.0, 12, guard=True,
+        checkpoint_path=ck, checkpoint_every=4,
+    )
+    c1 = _counters()
+    assert os.path.exists(ck + ".corrupt")
+    assert c1["checkpoint.quarantined"] == c0.get(
+        "checkpoint.quarantined", 0) + 1
+    assert _delta(res.params, clean.params) == 0.0
+    assert res.n_iter == clean.n_iter
+
+
+def test_preemption_resume_bit_identical(tmp_path):
+    params, args = _toy_params(), (_TARGET,)
+    clean = run_em_loop(toy_step, params, args, 0.0, 12, guard=True)
+    ck = str(tmp_path / "preempt.npz")
+    with pytest.raises(faults.SimulatedPreemption):
+        with faults.inject("preempt@1"):
+            run_em_loop(
+                toy_step, params, args, 0.0, 12, guard=True,
+                checkpoint_path=ck, checkpoint_every=4,
+            )
+    res = run_em_loop(
+        toy_step, params, args, 0.0, 12, guard=True,
+        checkpoint_path=ck, checkpoint_every=4,
+    )
+    assert res.n_iter == clean.n_iter
+    for a, b in zip(jax.tree.leaves(res.params), jax.tree.leaves(clean.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# ---------------------------------------------------------------------------
+# satellite: eigh-pinv non-finite error; unguarded program stability
+# ---------------------------------------------------------------------------
+
+
+def test_solve_normal_nonfinite_error_message():
+    from dynamic_factor_models_tpu.ops.linalg import solve_normal
+
+    A = jnp.eye(3).at[1, 1].set(jnp.nan)
+    b = jnp.ones(3)
+    with pytest.raises(ValueError, match="non-finite.*normal equations"):
+        solve_normal(A, b)
+    with pytest.raises(ValueError, match="pinv"):
+        solve_normal(jnp.eye(3), b.at[0].set(jnp.inf))
+    # finite inputs still solve
+    np.testing.assert_allclose(
+        np.asarray(solve_normal(2.0 * jnp.eye(3), b)), np.full(3, 0.5),
+        atol=1e-12,
+    )
+
+
+def test_chol_guarded_flags_failure_without_nan():
+    from dynamic_factor_models_tpu.ops.linalg import chol_guarded
+
+    L, ok = chol_guarded(jnp.eye(3) * 4.0)
+    assert bool(ok)
+    np.testing.assert_allclose(np.asarray(L), 2.0 * np.eye(3), atol=1e-12)
+    L, ok = chol_guarded(-jnp.eye(3))  # indefinite: factorization fails
+    assert not bool(ok)
+    assert bool(jnp.all(jnp.isfinite(L))), "guarded factor must be scrubbed"
+
+
+def test_unguarded_program_unchanged_by_guarded_machinery():
+    """The DFM_GUARDS=0 program is the pre-guardrail program: its
+    stableHLO is byte-identical before and after the guarded twin
+    compiles, runs, and trips its ladder."""
+    from dynamic_factor_models_tpu.models.emloop import (
+        _em_while_jit,
+        _fresh_carry,
+    )
+    from dynamic_factor_models_tpu.utils.compile import donation_enabled
+
+    params, args = _toy_params(), (_TARGET,)
+
+    def _hlo():
+        tol_arr = jnp.asarray(1e-9, jnp.result_type(float))
+        carry = _fresh_carry(params, tol_arr, 20)
+        return _em_while_jit(donation_enabled()).lower(
+            toy_step, carry, args, tol_arr, 20,
+            jnp.asarray(20, jnp.int32), 0,
+        ).as_text()
+
+    before = _hlo()
+    with faults.inject("nan_estep@2"):
+        run_em_loop(toy_step, params, args, 1e-9, 20, guard=True)
+    run_em_loop(toy_step_diverging, params, args, 1e-9, 20, guard=True)
+    assert _hlo() == before
+
+
+# ---------------------------------------------------------------------------
+# full-scale drills (slow lane): real entry points end to end
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_mf_nan_under_period3_mask_demotes_and_matches_sequential():
+    """Satellite drill: a persistent NaN E-step injected into the
+    SQUAREM-accelerated mixed-frequency fit must (1) survive, (2) recover
+    via the demote ("sequential") rung after both jitter retries re-trip,
+    and (3) land within 1e-8 of the clean sequential run — injection at
+    iteration 1 makes last-good the initial params, on which the jitter
+    repair is an exact no-op, so the demoted run replays the sequential
+    trajectory bit for bit.  steady_gains must gate off NaN params and
+    accept the recovered ones."""
+    from dynamic_factor_models_tpu.models.mixed_freq import (
+        estimate_mixed_freq_dfm,
+        steady_gains,
+    )
+
+    rng = np.random.default_rng(0)
+    T, N = 60, 8
+    f = rng.standard_normal((T, 1))
+    lam = rng.standard_normal((N, 1))
+    x = f @ lam.T + 0.5 * rng.standard_normal((T, N))
+    xq = x.copy()
+    is_q = np.zeros(N, bool)
+    is_q[-2:] = True
+    xq[:, -2:] = np.nan
+    xq[2::3, -2:] = x[2::3, -2:]  # quarter-end months: the period-3 mask
+
+    clean = estimate_mixed_freq_dfm(
+        xq, is_q, r=1, p=5, max_em_iter=40, tol=1e-7
+    )
+    with faults.inject("nan_estep@1+"):
+        res = estimate_mixed_freq_dfm(
+            xq, is_q, r=1, p=5, max_em_iter=40, tol=1e-7, accel="squarem"
+        )
+    assert res.health == guards.HEALTH_OK
+    assert _delta(res.params, clean.params) < 1e-8
+
+    # the recovered params feed the periodic-DARE gain set; NaN params
+    # must be rejected before the Riccati recursion can propagate them
+    gains = steady_gains(res.params)
+    assert gains is not None
+    with pytest.raises(ValueError, match="non-finite"):
+        steady_gains(res.params._replace(Q=res.params.Q * np.nan))
+
+
+@pytest.mark.slow
+def test_ssm_entry_point_reports_fault_telemetry():
+    """estimate_dfm_em end to end with an injected fault: the run
+    completes healthy, the results carry converged/health, and the
+    RunRecord surfaces the fault counters."""
+    from dynamic_factor_models_tpu.models.ssm import DFMConfig, estimate_dfm_em
+
+    rng = np.random.default_rng(1)
+    T, N, r = 50, 7, 1
+    x = (rng.standard_normal((T, r)) @ rng.standard_normal((r, N))
+         + 0.5 * rng.standard_normal((T, N)))
+    cfg = DFMConfig(nfac_u=r, n_factorlag=1)
+
+    telemetry.enable()
+    try:
+        clean = estimate_dfm_em(x, np.ones(N), 0, T - 1, cfg, max_em_iter=25)
+        with faults.inject("nan_estep@2"):
+            res = estimate_dfm_em(x, np.ones(N), 0, T - 1, cfg, max_em_iter=25)
+        recs = [
+            r_ for r_ in telemetry.records()
+            if r_.get("entry") == "estimate_dfm_em"
+            and r_.get("faults_detected")
+        ]
+    finally:
+        telemetry.disable()
+    assert res.health == guards.HEALTH_OK
+    assert res.converged == clean.converged
+    assert _delta(res.params, clean.params) < 1e-8
+    assert recs, "entry-point RunRecord must surface fault counters"
+    assert recs[-1]["faults_detected"] == 1
+    assert recs[-1]["recoveries"] == 1
+    assert recs[-1]["final_health"] == "ok"

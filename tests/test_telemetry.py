@@ -217,8 +217,9 @@ def test_counter_deltas_bracket_the_record(sink, rng):
         for field, v in d.items():
             total = c1.get(kernel, {}).get(field, 0) - c0.get(kernel, {}).get(field, 0)
             assert total >= v - 1e-9, (kernel, field)
-    em1 = first["counters_delta"].get("em_loop", {})
-    em2 = second["counters_delta"].get("em_loop", {})
+    # production default dispatches the health-guarded while-loop kernel
+    em1 = first["counters_delta"].get("em_loop_guarded", {})
+    em2 = second["counters_delta"].get("em_loop_guarded", {})
     assert em1.get("runs", 0) >= 1
     assert em2.get("runs", 0) >= 1
     assert em2.get("compiles", 0) == 0, (

@@ -69,6 +69,14 @@ jax.block_until_ready(jnp.ones(8).sum())
     timeout -k 10 600 python bench.py --warm-cache \
       > /tmp/tpu_warm_cache.out 2> /tmp/tpu_warm_cache.err
     echo "$(date -u +%FT%TZ) watcher warm-cache rc=$? (log /tmp/tpu_warm_cache.out)" >> "$LOG"
+    # one injected-preemption resume per live window: kill a small
+    # checkpointed EM run after its 2nd chunk save, resume it on real
+    # hardware, and log the recovery digest (resume must be
+    # bit-identical).  Best-effort chaos drill — short timeout, rc logged
+    # but never allowed to eat the window.
+    timeout -k 10 300 python bench.py --chaos-preempt-drill \
+      > /tmp/tpu_chaos_preempt.json 2> /tmp/tpu_chaos_preempt.err
+    echo "$(date -u +%FT%TZ) watcher preempt-resume drill rc=$? $(tail -n 1 /tmp/tpu_chaos_preempt.json 2>/dev/null)" >> "$LOG"
     DFM_BENCH_PARTIAL=/tmp/tpu_remainder_partial.json \
       timeout -k 30 5400 python bench.py --run-tpu-remainder \
       > /tmp/tpu_remainder.out 2> /tmp/tpu_remainder.err
