@@ -750,6 +750,128 @@ def chaos_section():
     return fields
 
 
+def serving_section():
+    """Multi-tenant serving throughput (bench.py --serving).
+
+    Three fields into the BENCH json (present-but-null when the section
+    fails — e.g. gated off-platform):
+
+    - serving_updates_per_sec: O(1) constant-gain online ticks through
+      the precompiled executable, timed over a request loop (includes
+      per-request dispatch overhead — the number a request loop sees);
+    - serving_batched_em_panels_per_sec: B same-bucket tenants refit in
+      ONE vmapped guarded EM loop, fixed iteration count;
+    - serving_batched_vs_sequential_x: that loop vs the same refits run
+      one tenant at a time (acceptance bar on CPU: >= 2x).
+
+    `serving_cpu_count` rides along so the ratio is interpretable:
+    batched and sequential refits execute identical FLOPs, so the
+    speedup comes from (a) amortizing per-tenant dispatch / while-loop
+    overhead and (b) XLA CPU threading the leading batch dimension of
+    every gemm/cholesky across cores.  On a single-core host only (a)
+    applies and the measured ratio tops out around 1.5-1.8x; the >= 2x
+    bar is about (b) and needs >= 2 cores.
+
+    Prints one JSON line and returns the dict.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import os
+
+    fields = {
+        "serving_updates_per_sec": None,
+        "serving_batched_em_panels_per_sec": None,
+        "serving_batched_vs_sequential_x": None,
+        "serving_cpu_count": os.cpu_count(),
+    }
+    try:
+        from dynamic_factor_models_tpu.serving.batch import (
+            RefitRequest,
+            refit_batch,
+            refit_sequential,
+        )
+        from dynamic_factor_models_tpu.serving.online import (
+            FilterState,
+            derive_serving_model,
+            online_tick,
+        )
+        from dynamic_factor_models_tpu.models.ssm import SSMParams
+        from dynamic_factor_models_tpu.utils.compile import (
+            CompileSpec,
+            bucket_shape,
+            precompile,
+        )
+
+        B, T, N, r, p = 8, 64, 16, 4, 4
+        n_em = 30
+        rng = np.random.default_rng(11)
+        dt = jnp.result_type(float)
+
+        def mk_params(scale=1.0):
+            return SSMParams(
+                lam=jnp.asarray(
+                    scale * rng.standard_normal((N, r)), dt
+                ),
+                R=jnp.ones(N, dt),
+                A=jnp.concatenate(
+                    [0.5 * jnp.eye(r, dtype=dt)[None],
+                     jnp.zeros((p - 1, r, r), dt)]
+                ),
+                Q=jnp.eye(r, dtype=dt),
+            )
+
+        # -- online ticks through the AOT-registered executable --------
+        _, n_pad = bucket_shape(T, N)
+        precompile(CompileSpec(
+            T=T, N=N, r=r, p=p, dtype=str(dt),
+            kernels=(), serving_period=1,
+        ))
+        model = derive_serving_model(mk_params(), n_pad=n_pad)
+        st = FilterState(
+            s=jnp.zeros(r * p, dt), t=jnp.asarray(0, jnp.int32)
+        )
+        rows = jnp.asarray(rng.standard_normal((64, n_pad)), dt)
+        mask_row = np.ones(n_pad, bool)
+        st = online_tick(model, st, rows[0], mask_row)  # warm
+
+        n_ticks = 2000
+
+        def tick_loop():
+            s = st
+            for i in range(n_ticks):
+                s = online_tick(model, s, rows[i % 64], mask_row)
+            return s
+
+        wall_ticks = _time_fixed_iters(tick_loop)
+        fields["serving_updates_per_sec"] = round(n_ticks / wall_ticks, 1)
+
+        # -- batched vs sequential refits ------------------------------
+        reqs = []
+        for i in range(B):
+            true = mk_params()
+            f = np.asarray(rng.standard_normal((T, r)).cumsum(0) * 0.3)
+            x = f @ np.asarray(true.lam).T + rng.standard_normal((T, N))
+            reqs.append(RefitRequest(
+                f"tenant{i}",
+                jnp.asarray(x, dt),
+                jnp.ones((T, N), bool),
+                mk_params(scale=0.1),
+            ))
+        kw = dict(tol=0.0, max_em_iter=n_em)  # fixed-iteration timing
+        refit_batch(reqs, **kw)  # compile both programs
+        refit_sequential(reqs, **kw)
+        wall_b = _time_fixed_iters(lambda: refit_batch(reqs, **kw))
+        wall_s = _time_fixed_iters(lambda: refit_sequential(reqs, **kw))
+        fields["serving_batched_em_panels_per_sec"] = round(B / wall_b, 2)
+        fields["serving_batched_vs_sequential_x"] = round(wall_s / wall_b, 2)
+    except Exception as e:  # present-but-null contract
+        fields["serving_error"] = f"{type(e).__name__}: {e}"
+    print(json.dumps(fields))
+    return fields
+
+
 def chaos_preempt_drill():
     """One injected-preemption resume (bench.py --chaos-preempt-drill).
 
@@ -2012,6 +2134,10 @@ def main():
     ap.add_argument("--chaos", action="store_true",
                     help="guardrail overhead + fault-injection recovery "
                          "drills (chaos_section); prints one JSON line")
+    ap.add_argument("--serving", action="store_true",
+                    help="multi-tenant serving throughput: O(1) online "
+                         "ticks + batched-vs-sequential EM refits "
+                         "(serving_section); prints one JSON line")
     ap.add_argument("--chaos-preempt-drill", action="store_true",
                     help="one injected-preemption resume on a small panel "
                          "(tpu_watch live-window drill); prints one JSON "
@@ -2030,6 +2156,9 @@ def main():
         os.environ["DFM_TELEMETRY"] = path
     if args.chaos:
         chaos_section()
+        return
+    if args.serving:
+        serving_section()
         return
     if args.chaos_preempt_drill:
         chaos_preempt_drill()

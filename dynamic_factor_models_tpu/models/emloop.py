@@ -29,6 +29,7 @@ default program carries no injection code at all.
 from __future__ import annotations
 
 from functools import partial
+from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -45,7 +46,13 @@ from ..utils.telemetry import (
     span,
 )
 
-__all__ = ["run_em_loop", "run_bulk_then_exact", "EMLoopResult"]
+__all__ = [
+    "run_em_loop",
+    "run_em_loop_batched",
+    "run_bulk_then_exact",
+    "EMLoopResult",
+    "BatchedEMResult",
+]
 
 
 def _em_while_impl(
@@ -288,6 +295,241 @@ class EMLoopResult(tuple):
     loglik_path = property(lambda self: self[1])
     n_iter = property(lambda self: self[2])
     trace = property(lambda self: self[3])
+
+
+def _batched_finite(tree) -> jnp.ndarray:
+    """(B,) bool: per-batch-member finiteness of every inexact leaf —
+    `guards.tree_finite` vectorized over a leading batch axis, so one
+    tenant's NaN flags only that tenant."""
+    checks = [
+        jnp.all(jnp.isfinite(x).reshape(x.shape[0], -1), axis=1)
+        for x in jax.tree.leaves(tree)
+        if jnp.issubdtype(jnp.asarray(x).dtype, jnp.inexact)
+    ]
+    out = checks[0]
+    for v in checks[1:]:
+        out = out & v
+    return out
+
+
+def _em_while_batched_impl(
+    step,
+    carry,
+    args,
+    tol,
+    drop_tol,
+    max_em_iter: int,
+    stop_at,
+    inject_nan_at: int = 0,
+):
+    """Vmapped multi-tenant EM loop: B panels of identical (bucketed)
+    shape advance together under ONE `lax.while_loop`, each tenant
+    carrying its own convergence scalars and utils.guards health flag.
+
+    Per-tenant semantics replicate the scalar guarded loop exactly: a
+    tenant is ACTIVE while healthy, unconverged (|ll - ll_prev| >=
+    tol * (1 + |ll_prev|), bootstrapped by it <= 1) and under `stop_at`;
+    the loop runs while any tenant is active.  Each body call evaluates
+    the vmapped step for the whole batch; a tenant whose new
+    log-likelihood or parameter leaves are non-finite, or whose
+    log-likelihood drops by more than drop_tol * (1 + |ll|), is rolled
+    back to its previous iterate and FROZEN with its health flag set —
+    the one-bad-tenant isolation contract: the other tenants keep
+    iterating, their carries untouched by the divergent panel (vmap is
+    elementwise across the batch axis).  Converged/frozen tenants still
+    ride through the vmapped step (batched shapes are static) but every
+    result is discarded by the per-tenant select.
+
+    Carry: (params_B, prev_params_B, ll_prev (B,), ll (B,), it (B,),
+    path (B, max_em_iter), health (B,)).  `inject_nan_at` (static, from
+    utils.faults nan_estep) NaNs TENANT 0's log-likelihood at that
+    iteration — the deterministic one-bad-tenant drill; 0 compiles no
+    injection code."""
+    dtype = jnp.result_type(tol)
+    vstep = jax.vmap(step)
+
+    def active_of(c):
+        _, _, ll_prev, ll, it, _, health = c
+        unconverged = (it <= 1) | (
+            jnp.abs(ll - ll_prev) >= tol * (1.0 + jnp.abs(ll_prev))
+        )
+        return (health == 0) & unconverged & (it < stop_at)
+
+    def cond(c):
+        return jnp.any(active_of(c))
+
+    def body(c):
+        params, prev_params, ll_prev, ll, it, path, health = c
+        act = active_of(c)
+        new_params, ll_new = vstep(params, *args)
+        ll_new = ll_new.astype(dtype)
+        if inject_nan_at:
+            ll_new = ll_new.at[0].set(
+                jnp.where(it[0] + 1 == inject_nan_at, jnp.nan, ll_new[0])
+            )
+        nonfinite = (~jnp.isfinite(ll_new)) | (~_batched_finite(new_params))
+        drop = (it >= 1) & (ll - ll_new > drop_tol * (1.0 + jnp.abs(ll)))
+        bad = act & (nonfinite | drop)
+        adv = act & ~bad
+
+        def bwhere(cnd, x, y):
+            return jax.tree.map(
+                lambda a, b: jnp.where(
+                    cnd.reshape(cnd.shape + (1,) * (a.ndim - 1)), a, b
+                ),
+                x,
+                y,
+            )
+
+        B = ll.shape[0]
+        rows = jnp.arange(B)
+        slot = jnp.minimum(it, max_em_iter - 1)
+        return (
+            bwhere(bad, prev_params, bwhere(adv, new_params, params)),
+            bwhere(bad, prev_params, bwhere(adv, params, prev_params)),
+            jnp.where(adv, ll, ll_prev),
+            jnp.where(adv, ll_new, ll),
+            jnp.where(adv, it + 1, it),
+            path.at[rows, slot].set(jnp.where(adv, ll_new, path[rows, slot])),
+            jnp.where(
+                bad,
+                jnp.where(
+                    nonfinite, _guards.HEALTH_NONFINITE, _guards.HEALTH_DECREASE
+                ),
+                health,
+            ).astype(jnp.int32),
+        )
+
+    return jax.lax.while_loop(cond, body, carry)
+
+
+_em_while_batched = partial(
+    jax.jit, static_argnames=("step", "max_em_iter", "inject_nan_at")
+)(_em_while_batched_impl)
+
+
+def _fresh_batched_carry(params_B, tol, max_em_iter, B: int):
+    dtype = jnp.result_type(tol)
+    return (
+        params_B,
+        jax.tree.map(jnp.copy, params_B),
+        jnp.full((B,), -jnp.inf, dtype),
+        jnp.full((B,), jnp.nan, dtype),
+        jnp.zeros((B,), jnp.int32),
+        jnp.full((B, max_em_iter), jnp.nan, dtype),
+        jnp.zeros((B,), jnp.int32),
+    )
+
+
+class BatchedEMResult(NamedTuple):
+    """`run_em_loop_batched` result, everything per-tenant along the
+    leading batch axis: `params` the stacked parameter pytree, `llpath`
+    (B, max_em_iter) log-likelihood paths (NaN past each tenant's
+    n_iter), `n_iter` (B,), `converged` (B,) the actual tolerance-break
+    replay, `health` (B,) utils.guards codes (0 healthy; a non-zero
+    tenant was rolled back to its last-good iterate and frozen)."""
+
+    params: object
+    llpath: np.ndarray
+    n_iter: np.ndarray
+    converged: np.ndarray
+    health: np.ndarray
+
+
+def run_em_loop_batched(
+    step,
+    params_B,
+    args_B: tuple,
+    tol: float,
+    max_em_iter: int,
+    stop_at=None,
+):
+    """Run EM to convergence for B same-shape panels in one vmapped
+    device loop (the serving layer's batched re-estimation path; panels
+    are made shape-identical by utils.compile.pad_panel).
+
+    `step` is the SCALAR per-panel step (e.g. ssm.em_step_stats) —
+    vmapping happens inside the compiled loop.  `params_B` / every leaf
+    of `args_B` carry a leading batch axis of size B.  Per-tenant
+    semantics match `run_em_loop(guard=True)` up to the recovery ladder:
+    the in-loop sentinel and rollback are identical, but a tripped
+    tenant is frozen at its last-good iterate (health reported in the
+    result) instead of escalating the host ladder — re-running one
+    divergent tenant alone is the caller's policy decision, and the
+    other B-1 tenants' results are unaffected either way.
+
+    Dispatches through the AOT registry (kernel "em_loop_batched") so a
+    `precompile` with CompileSpec(em_batch=B) serves the whole loop.
+    `DFM_FAULTS=nan_estep@k` injects a NaN into tenant 0's k-th
+    iteration (the chaos drill for one-bad-tenant isolation)."""
+    from ..utils.compile import aot_call, aot_statics
+
+    if max_em_iter < 1:
+        raise ValueError(f"max_em_iter must be >= 1, got {max_em_iter}")
+    B = int(jax.tree.leaves(params_B)[0].shape[0])
+    plan = _faults.active_plan()
+    inject_nan_at = plan.nan_estep or 0
+    rec = run_record(
+        "run_em_loop_batched",
+        kind="refit_batch",
+        config={
+            "step": getattr(step, "__qualname__", repr(step)),
+            "tol": tol,
+            "max_em_iter": max_em_iter,
+            "batch": B,
+        },
+    )
+    with rec:
+        if inject_nan_at:
+            _faults.fault_fired("nan_estep")
+        ld = jnp.result_type(float)
+        tol_arr = jnp.asarray(tol, ld)
+        drop_arr = jnp.asarray(_guards.drop_tol(), ld)
+        carry = _fresh_batched_carry(params_B, tol_arr, max_em_iter, B)
+        statics = aot_statics(step, max_em_iter, inject_nan_at)
+        bound = max_em_iter if stop_at is None else stop_at
+        with span("em_batched"):
+            carry = aot_call(
+                "em_loop_batched",
+                lambda c, a, t, d, s: _em_while_batched(
+                    step, c, a, t, d, max_em_iter, s, inject_nan_at
+                ),
+                carry, args_B, tol_arr, drop_arr,
+                jnp.asarray(bound, jnp.int32),
+                statics=statics,
+            )
+        params, _, ll_prev, ll, n_iter, path, health = carry
+        n_iter = np.asarray(n_iter)
+        health = np.asarray(health)
+        ll_prev = np.asarray(ll_prev)
+        ll = np.asarray(ll)
+        converged = np.array(
+            [
+                health[b] == _guards.HEALTH_OK
+                and n_iter[b] >= 2
+                and _tol_break(ll_prev[b], ll[b], tol)
+                for b in range(B)
+            ],
+            bool,
+        )
+        n_bad = int((health != _guards.HEALTH_OK).sum())
+        if n_bad:
+            inc("em_guard.faults_detected", n_bad)
+        rec.set(
+            n_iter=int(n_iter.max()) if B else 0,
+            n_iter_per_tenant=[int(v) for v in n_iter],
+            converged=bool(converged.all()),
+            final_loglik=float(np.nanmax(ll)) if B else None,
+            batch=B,
+            tenants_unhealthy=n_bad,
+        )
+    return BatchedEMResult(
+        params=params,
+        llpath=np.asarray(path),
+        n_iter=n_iter,
+        converged=converged,
+        health=health,
+    )
 
 
 def _fingerprint(args, tol, max_em_iter: int, params=None) -> str:

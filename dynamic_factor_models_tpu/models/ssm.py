@@ -135,7 +135,8 @@ def _init_state(params: SSMParams):
     return jnp.zeros(k, params.lam.dtype), 1e2 * jnp.eye(k, dtype=params.lam.dtype)
 
 
-def _info_filter_scan(Tm, Qs, obs_inputs, obs_step, s0, P0, qdiag=None):
+def _info_filter_scan(Tm, Qs, obs_inputs, obs_step, s0, P0, qdiag=None,
+                      want_pinv=False):
     """Generic masked information-form Kalman filter (shared scan body).
 
     `obs_inputs` is a tuple of (T, ...) arrays scanned over;
@@ -156,7 +157,10 @@ def _info_filter_scan(Tm, Qs, obs_inputs, obs_step, s0, P0, qdiag=None):
 
     Returns (means, covs, pred_means, pred_covs, lls) with lls the
     PER-STEP log-likelihood terms (T,) — callers sum; inference code
-    (OPG scores) differentiates them individually.
+    (OPG scores) differentiates them individually.  `want_pinv=True`
+    appends the per-step predicted-covariance inverses Pp⁻¹ (already a
+    byproduct of the information update) so an RTS pass can reuse them
+    instead of refactorizing — the EM E-step path does.
     """
     k = Tm.shape[0]
     dtype = s0.dtype
@@ -190,13 +194,14 @@ def _info_filter_scan(Tm, Qs, obs_inputs, obs_step, s0, P0, qdiag=None):
         ld_pu = -2.0 * jnp.log(jnp.diagonal(Lm)).sum()
         quad = quad0 - rhs @ Pu @ rhs
         ll = -0.5 * (n_obs * log2pi + ld_R + ld_pp - ld_pu + quad)
-        return (su, Pu), (su, Pu, sp, Pp, ll)
+        out = (su, Pu, sp, Pp, ll)
+        if want_pinv:
+            out = out + (Ppinv,)
+        return (su, Pu), out
 
     inputs = obs_inputs if qdiag is None else (*obs_inputs, qdiag)
-    (_, _), (means, covs, pmeans, pcovs, lls) = jax.lax.scan(
-        step, (s0, P0), inputs, unroll=_SCAN_UNROLL
-    )
-    return means, covs, pmeans, pcovs, lls
+    (_, _), outs = jax.lax.scan(step, (s0, P0), inputs, unroll=_SCAN_UNROLL)
+    return outs
 
 
 class PanelStats(NamedTuple):
@@ -545,8 +550,9 @@ def _sqrt_filter_scan(params: SSMParams, x, mask):
     return KalmanResult(lls.sum(), means, covs, pmeans, pcovs)
 
 
-@jax.jit
-def _filter_scan(params: SSMParams, x, mask, qdiag=None, stats=None):
+@partial(jax.jit, static_argnames=("want_pinv",))
+def _filter_scan(params: SSMParams, x, mask, qdiag=None, stats=None,
+                 want_pinv=False):
     """Collapsed masked Kalman filter; x (T, N) NaN-free, mask (T, N).
 
     Only the first r state dims load on observations, so the measurement
@@ -596,10 +602,13 @@ def _filter_scan(params: SSMParams, x, mask, qdiag=None, stats=None):
         quad0 = xr - 2.0 * (f @ bt) + f @ Ct @ f
         return Cf, rhs, ld, quad0, no
 
-    means, covs, pmeans, pcovs, lls = _info_filter_scan(
-        Tm, Qs, (C, b, ld_R, xRx, n_obs), obs_step, s0, P0, qdiag=qdiag
+    outs = _info_filter_scan(
+        Tm, Qs, (C, b, ld_R, xRx, n_obs), obs_step, s0, P0, qdiag=qdiag,
+        want_pinv=want_pinv,
     )
-    return KalmanResult(lls.sum() + ll_corr, means, covs, pmeans, pcovs)
+    means, covs, pmeans, pcovs, lls = outs[:5]
+    res = KalmanResult(lls.sum() + ll_corr, means, covs, pmeans, pcovs)
+    return (res, outs[5]) if want_pinv else res
 
 
 @jax.jit
@@ -892,16 +901,28 @@ def kalman_filter(
         return _filter_scan(params, fillz(x), mask)
 
 
-def _rts_scan(Tm, means, covs, pmeans, pcovs):
+def _rts_scan(Tm, means, covs, pmeans, pcovs, pinvs=None):
     """Rauch-Tung-Striebel backward pass (shared scan body); also returns
-    lag-one covariances lag1[t] = Cov(s_{t+1}, s_t | T) for t = 0..T-2."""
+    lag-one covariances lag1[t] = Cov(s_{t+1}, s_t | T) for t = 0..T-2.
+
+    `pinvs` (T, k, k) optionally supplies the predicted-covariance
+    inverses the information filter already formed (`want_pinv=True`);
+    the gain then needs only a matmul per step instead of a fresh
+    Cholesky + two triangular solves — the per-matrix factorizations are
+    the one part of the backward pass that does NOT batch well (looped
+    LAPACK calls under vmap on CPU), so the EM paths feeding the batched
+    multi-tenant loop always pass them."""
 
     def step(carry, inp):
         s_next, P_next = carry
-        su, Pu, sp_next, Pp_next = inp
-        # J = Pu Tm' Pp_next^{-1}; Pp_next PD, Pu symmetric, so solve the
-        # transposed system with Cholesky instead of forming a pinv
-        J = jsl.cho_solve((jnp.linalg.cholesky(Pp_next), True), Tm @ Pu).T
+        if pinvs is None:
+            su, Pu, sp_next, Pp_next = inp
+            # J = Pu Tm' Pp_next^{-1}; Pp_next PD, Pu symmetric, so solve
+            # the transposed system with Cholesky instead of forming a pinv
+            J = jsl.cho_solve((jnp.linalg.cholesky(Pp_next), True), Tm @ Pu).T
+        else:
+            su, Pu, sp_next, Pp_next, Pinv_next = inp
+            J = (Pinv_next @ (Tm @ Pu)).T
         s_sm = su + J @ (s_next - sp_next)
         P_sm = Pu + J @ (P_next - Pp_next) @ J.T
         lag1 = P_next @ J.T
@@ -910,6 +931,8 @@ def _rts_scan(Tm, means, covs, pmeans, pcovs):
     # iterate t = T-2 .. 0 pairing (filtered_t, predicted_{t+1}, smoothed_{t+1})
     last = (means[-1], covs[-1])
     inputs = (means[:-1], covs[:-1], pmeans[1:], pcovs[1:])
+    if pinvs is not None:
+        inputs = inputs + (pinvs[1:],)
     (_, _), (s_sm, P_sm, lag1) = jax.lax.scan(
         step, last, inputs, reverse=True, unroll=_SCAN_UNROLL
     )
@@ -919,10 +942,13 @@ def _rts_scan(Tm, means, covs, pmeans, pcovs):
 
 
 @jax.jit
-def _smoother_scan(params: SSMParams, filt: KalmanResult):
+def _smoother_scan(params: SSMParams, filt: KalmanResult, pinvs=None):
     """RTS backward pass for the SSMParams model (shared body: _rts_scan)."""
     Tm, _ = _companion(params)
-    return _rts_scan(Tm, filt.means, filt.covs, filt.pred_means, filt.pred_covs)
+    return _rts_scan(
+        Tm, filt.means, filt.covs, filt.pred_means, filt.pred_covs,
+        pinvs=pinvs,
+    )
 
 
 def kalman_smoother(
@@ -1094,8 +1120,8 @@ def em_step(params: SSMParams, x, mask):
     # Cholesky recursions need Q strictly PD (M-step outputs are pre-floored,
     # so for internal EM loops this is a no-op re-floor)
     params = params._replace(Q=_psd_floor(params.Q))
-    filt = _filter_scan(params, x, mask)
-    s_sm, P_sm, lag1 = _smoother_scan(params, filt)
+    filt, pinvs = _filter_scan(params, x, mask, want_pinv=True)
+    s_sm, P_sm, lag1 = _smoother_scan(params, filt, pinvs=pinvs)
     return _em_m_step(params, x, m, s_sm, P_sm, lag1), filt.loglik
 
 
@@ -1107,8 +1133,8 @@ def em_step_stats(params: SSMParams, x, mask, stats: PanelStats):
     `estimate_dfm_em(method="sequential")` and the large-panel benchmark.
     """
     params = params._replace(Q=_psd_floor(params.Q))
-    filt = _filter_scan(params, x, mask, stats=stats)
-    s_sm, P_sm, lag1 = _smoother_scan(params, filt)
+    filt, pinvs = _filter_scan(params, x, mask, stats=stats, want_pinv=True)
+    s_sm, P_sm, lag1 = _smoother_scan(params, filt, pinvs=pinvs)
     return (
         _em_m_step(params, x, stats.m, s_sm, P_sm, lag1, stats=stats),
         filt.loglik,

@@ -69,6 +69,7 @@ __all__ = [
     "convergence_horizon",
     "periodic_dare",
     "linear_recursion",
+    "constant_gain_tick",
     "steady_tail",
     "steady_smooth_tail",
 ]
@@ -453,6 +454,21 @@ def linear_recursion(M, g, s_init, block: int = 0):
 
     _, out = jax.lax.scan(chunk, s_init, gp.reshape(nb, block, k))
     return out.reshape(nb * block, k)[:n]
+
+
+def constant_gain_tick(Abar, K, s, b, phase):
+    """One O(1) online filter update at the steady (or periodic-steady)
+    fixed point: s' = Abar[j] s + K[j] b with j the observation phase.
+
+    `Abar` (d, k, k) and `K` (d, k, q) hold the per-phase closed-loop
+    transition and gain — d = 1 for a time-invariant observation pattern
+    (`steady_state`), d = 3 for the mixed-frequency monthly/quarterly
+    cycle (`periodic_dare` via mixed_freq.steady_gains).  `phase` is a
+    traced i32 already reduced mod d.  Two matvecs, no factorization,
+    no dependence on the sample length — the per-tick unit of the
+    serving layer (serving/online.py wraps it with the collapsed-
+    observation construction of b)."""
+    return Abar[phase] @ s + K[phase] @ b
 
 
 def steady_tail(Tm, Cq, Pu_qq, K, Abar, b, s_init, n_obs_const, ld_const, block: int = 0):

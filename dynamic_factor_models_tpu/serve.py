@@ -1,0 +1,15 @@
+"""CLI entry for the multi-tenant serving demo (implementation:
+serving/engine.py).
+
+    python -m dynamic_factor_models_tpu.serve --tenants 3 --ticks 12
+
+registers synthetic tenants, streams O(1) online ticks, serves a nowcast,
+and runs one batched EM refit flush, printing one JSON line per phase.
+"""
+
+from .serving.engine import ServingEngine, main  # noqa: F401
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -1,0 +1,47 @@
+"""Multi-tenant nowcast serving layer.
+
+Turns fitted DFMs into a request-serving system on top of the PR 1-4
+foundation:
+
+* `online` — `ServingModel` (steady-gain constants derived once per
+  refit) + the O(1) constant-gain tick `s_t = Abar s_{t-1} + K b_t` and
+  nowcast readout; no per-tick factorization, latency independent of T.
+* `batch` — full EM re-estimation batched across tenants sharing a
+  (T, N) compile bucket: one vmapped guarded while-loop over B stacked
+  panels (models/emloop.run_em_loop_batched).
+* `store` — per-tenant persisted state (params + filter state) through
+  utils/checkpoint's checksummed archives; corruption quarantines one
+  tenant, never the store.
+* `engine` — the synchronous request-loop driver routing tick / nowcast
+  / refit requests, each bracketed in a telemetry RunRecord; exposed as
+  ``python -m dynamic_factor_models_tpu.serve``.
+
+See docs/serving.md for the request types and state-store layout.
+"""
+
+from .batch import RefitResult, refit_batch, refit_sequential
+from .engine import ServingEngine
+from .online import (
+    FilterState,
+    ServingModel,
+    derive_serving_model,
+    derive_serving_model_mf,
+    nowcast,
+    online_tick,
+)
+from .store import TenantState, TenantStore
+
+__all__ = [
+    "FilterState",
+    "ServingModel",
+    "derive_serving_model",
+    "derive_serving_model_mf",
+    "nowcast",
+    "online_tick",
+    "RefitResult",
+    "refit_batch",
+    "refit_sequential",
+    "TenantState",
+    "TenantStore",
+    "ServingEngine",
+]

@@ -1,0 +1,287 @@
+"""Synchronous request-loop driver for multi-tenant nowcast serving.
+
+The engine owns per-tenant state (panel, fitted params, ServingModel,
+FilterState), routes requests, and brackets every request in a telemetry
+RunRecord so the `telemetry summarize` CLI sees serving traffic next to
+EM runs.  Request dicts:
+
+    {"kind": "tick",    "tenant": id, "x": (N,) row, "mask": (N,) bool}
+    {"kind": "nowcast", "tenant": id, "horizon": h}
+    {"kind": "refit",   "tenant": id}
+
+`tick` is the O(1) constant-gain update (serving/online.py) — no refit,
+no refactorization; `refit` only QUEUES the tenant, and `flush_refits()`
+executes the queue batched per (T, N) compile bucket (serving/batch.py).
+A tenant whose batched refit trips the health sentinel keeps its previous
+fit (the rollback already happened inside the loop; the engine just
+declines to install the frozen iterate) — its bucket-mates are installed
+normally.  State persists per tenant through serving/store.py.
+
+``python -m dynamic_factor_models_tpu.serve`` runs the demo loop below.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..models import ssm as _ssm
+from ..utils.compile import bucket_shape
+from ..utils.telemetry import run_record
+from .batch import RefitRequest, refit_batch
+from .online import (
+    FilterState,
+    derive_serving_model,
+    nowcast,
+    online_tick,
+)
+from .store import TenantState, TenantStore
+
+__all__ = ["ServingEngine", "default_params", "main"]
+
+
+def default_params(N: int, r: int = 4, p: int = 4, dtype=float) -> _ssm.SSMParams:
+    """Benign warm start for a tenant registered without a fit: unit
+    loading on the first factor, unit noise, mildly persistent stationary
+    factor VAR — the same shape bench.py's chaos section seeds with."""
+    dt = jnp.result_type(dtype)  # respects the x64 switch
+    lam = jnp.zeros((N, r), dt).at[:, 0].set(1.0)
+    A = jnp.zeros((p, r, r), dt).at[0].set(0.5 * jnp.eye(r, dtype=dt))
+    return _ssm.SSMParams(lam, jnp.ones((N,), dt), A, jnp.eye(r, dtype=dt))
+
+
+class _Tenant:
+    __slots__ = ("x", "mask", "params", "model", "state")
+
+    def __init__(self, x, mask, params, model, state):
+        self.x = x          # (T, N) np array, zero-filled at missing
+        self.mask = mask    # (T, N) np bool
+        self.params = params
+        self.model = model  # ServingModel
+        self.state = state  # FilterState
+
+
+class ServingEngine:
+    """Single-process, synchronous multi-tenant serving driver."""
+
+    def __init__(
+        self,
+        store_dir: str | None = None,
+        tol: float = 1e-6,
+        max_em_iter: int = 200,
+    ):
+        self.store = TenantStore(store_dir) if store_dir else None
+        self.tol = tol
+        self.max_em_iter = max_em_iter
+        self._tenants: dict[str, _Tenant] = {}
+        self._refit_queue: list[str] = []
+
+    # -- registration ----------------------------------------------------
+
+    def register(self, tenant_id: str, x, mask=None, params=None) -> None:
+        """Admit a tenant with its history panel.  `x` (T, N) may carry
+        NaNs at missing entries when `mask` is omitted; `params` defaults
+        to the benign warm start (call refit to actually fit).  Derives
+        the ServingModel (one DARE solve) and seeds the filter state from
+        one exact pass over the history — ticks are O(1) from here on."""
+        x = np.asarray(x, float)
+        if mask is None:
+            mask = np.isfinite(x)
+        mask = np.asarray(mask, bool)
+        xz = np.where(mask, x, 0.0)
+        if params is None:
+            params = default_params(x.shape[1])
+        self._install(tenant_id, xz, mask, params)
+
+    def _install(self, tenant_id, xz, mask, params) -> None:
+        """(Re)derive a tenant's serving constants from `params` and its
+        exact filter state from a full refilter of the panel."""
+        model = derive_serving_model(params)
+        xnan = np.where(mask, xz, np.nan)
+        filt = _ssm.kalman_filter(params, xnan)
+        state = FilterState(
+            s=jnp.asarray(filt.means[-1]),
+            t=jnp.asarray(xz.shape[0], jnp.int32),
+        )
+        self._tenants[tenant_id] = _Tenant(xz, mask, params, model, state)
+        if self.store is not None:
+            self.store.save(
+                tenant_id, TenantState(params=params, s=state.s, t=state.t)
+            )
+
+    def tenant_ids(self) -> list[str]:
+        return sorted(self._tenants)
+
+    # -- request routing -------------------------------------------------
+
+    def handle(self, req: dict):
+        """Route one request dict; returns the request's result (the new
+        FilterState for tick, the (N,) nowcast vector, or the refit-queue
+        position).  Unknown kinds / tenants raise ValueError."""
+        kind = req.get("kind")
+        tenant_id = req.get("tenant")
+        if tenant_id not in self._tenants:
+            raise ValueError(f"unknown tenant {tenant_id!r}")
+        if kind == "tick":
+            return self._tick(tenant_id, req["x"], req.get("mask"))
+        if kind == "nowcast":
+            return self._nowcast(tenant_id, int(req.get("horizon", 0)))
+        if kind == "refit":
+            return self._queue_refit(tenant_id)
+        raise ValueError(f"unknown request kind {kind!r}")
+
+    def _tick(self, tenant_id: str, x_t, mask_t=None) -> FilterState:
+        ten = self._tenants[tenant_id]
+        x_t = np.asarray(x_t, float)
+        if mask_t is None:
+            mask_t = np.isfinite(x_t)
+        mask_t = np.asarray(mask_t, bool)
+        with run_record("serving", kind="tick", config={"tenant": tenant_id}):
+            ten.state = online_tick(ten.model, ten.state, x_t, mask_t)
+        ten.x = np.vstack([ten.x, np.where(mask_t, x_t, 0.0)[None]])
+        ten.mask = np.vstack([ten.mask, mask_t[None]])
+        return ten.state
+
+    def _nowcast(self, tenant_id: str, horizon: int):
+        ten = self._tenants[tenant_id]
+        with run_record(
+            "serving", kind="nowcast",
+            config={"tenant": tenant_id, "horizon": horizon},
+        ):
+            return nowcast(ten.model, ten.state, horizon)
+
+    def _queue_refit(self, tenant_id: str) -> int:
+        if tenant_id not in self._refit_queue:
+            self._refit_queue.append(tenant_id)
+        return self._refit_queue.index(tenant_id)
+
+    # -- batched refits --------------------------------------------------
+
+    def flush_refits(self) -> dict:
+        """Execute the refit queue, batched per (T, N) compile bucket.
+
+        Healthy tenants get new params + re-derived serving constants +
+        an exact refiltered state; a tenant whose loop tripped keeps its
+        previous fit untouched.  Returns {tenant_id: RefitResult}."""
+        queue, self._refit_queue = self._refit_queue, []
+        if not queue:
+            return {}
+        reqs = [
+            RefitRequest(
+                tenant_id=tid,
+                x=jnp.asarray(self._tenants[tid].x),
+                mask=jnp.asarray(self._tenants[tid].mask),
+                params=self._tenants[tid].params,
+            )
+            for tid in queue
+        ]
+        with run_record(
+            "serving", kind="refit_flush", config={"n_tenants": len(reqs)},
+        ) as rec:
+            results = refit_batch(
+                reqs, tol=self.tol, max_em_iter=self.max_em_iter
+            )
+            installed = 0
+            for res in results:
+                ten = self._tenants[res.tenant_id]
+                if res.health == 0:
+                    self._install(res.tenant_id, ten.x, ten.mask, res.params)
+                    installed += 1
+            rec.set(n_installed=installed)
+        return {res.tenant_id: res for res in results}
+
+    # -- persistence -----------------------------------------------------
+
+    def resume(self, tenant_id: str, x, mask=None) -> bool:
+        """Re-admit a tenant from the store (params + filter clock); the
+        caller supplies the history panel (panels are not persisted —
+        they live in the tenant's data plane).  Returns False when the
+        store has no intact state for the id (never saved, or its archive
+        was quarantined as corrupt) — register() it afresh instead."""
+        if self.store is None:
+            return False
+        x = np.asarray(x, float)
+        if mask is None:
+            mask = np.isfinite(x)
+        mask = np.asarray(mask, bool)
+        N = x.shape[1]
+        from .store import template_state
+
+        like = template_state(N, 4, 4)
+        stored = self.store.load(tenant_id, like)
+        if stored is None:
+            return False
+        self._install(
+            tenant_id, np.where(mask, x, 0.0), mask, stored.params
+        )
+        return True
+
+
+# -- CLI demo ------------------------------------------------------------
+
+
+def _synthetic_panel(rng, T: int, N: int, r: int = 4):
+    f = rng.standard_normal((T, r)).cumsum(0) * 0.1
+    lam = rng.standard_normal((N, r))
+    x = f @ lam.T + 0.5 * rng.standard_normal((T, N))
+    return x
+
+
+def main(argv=None) -> int:
+    """Demo loop: register a few synthetic tenants, stream ticks, serve
+    nowcasts, run one batched refit flush; prints one JSON line per
+    phase.  ``python -m dynamic_factor_models_tpu.serve``."""
+    ap = argparse.ArgumentParser(
+        prog="python -m dynamic_factor_models_tpu.serve",
+        description="multi-tenant nowcast serving demo",
+    )
+    ap.add_argument("--tenants", type=int, default=3)
+    ap.add_argument("--T", type=int, default=96)
+    ap.add_argument("--N", type=int, default=8)
+    ap.add_argument("--ticks", type=int, default=12)
+    ap.add_argument("--store-dir", default=None)
+    ap.add_argument("--max-em-iter", type=int, default=30)
+    args = ap.parse_args(argv)
+
+    rng = np.random.default_rng(0)
+    eng = ServingEngine(store_dir=args.store_dir, max_em_iter=args.max_em_iter)
+    for i in range(args.tenants):
+        eng.register(f"tenant{i}", _synthetic_panel(rng, args.T, args.N))
+    print(json.dumps({
+        "phase": "register", "tenants": eng.tenant_ids(),
+        "bucket": list(bucket_shape(args.T, args.N)),
+    }))
+
+    for _ in range(args.ticks):
+        for tid in eng.tenant_ids():
+            row = rng.standard_normal(args.N)
+            eng.handle({"kind": "tick", "tenant": tid, "x": row})
+    nc = eng.handle({"kind": "nowcast", "tenant": "tenant0", "horizon": 0})
+    print(json.dumps({
+        "phase": "ticks", "n_ticks": args.ticks * args.tenants,
+        "nowcast0_head": [round(float(v), 4) for v in np.asarray(nc)[:4]],
+    }))
+
+    for tid in eng.tenant_ids():
+        eng.handle({"kind": "refit", "tenant": tid})
+    results = eng.flush_refits()
+    print(json.dumps({
+        "phase": "refit",
+        "results": {
+            tid: {
+                "n_iter": r.n_iter,
+                "converged": r.converged,
+                "health": r.health,
+            }
+            for tid, r in sorted(results.items())
+        },
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,137 @@
+"""Per-tenant state store: checksummed persistence with quarantine.
+
+One .npz archive per tenant under a store directory, written through
+utils/checkpoint's `save_pytree` (sha256 content digest over leaves +
+structure) with the same atomic-rename protocol the EM checkpoint driver
+uses: write to a per-writer unique temp name, `os.replace` into place, so
+a crashed save never leaves a half-written archive under a live id.  Loads
+inherit `load_pytree`'s verification: a corrupt archive is quarantined to
+``<id>.npz.corrupt`` and reported as missing — one tenant's bad disk
+sector (or an injected ``DFM_FAULTS=ckpt_corrupt@n``) costs that tenant a
+refit, never the store.  `checkpoint.list_entries` enumerates the live
+ids, naturally excluding quarantined and in-flight temp files.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import uuid
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..models.ssm import SSMParams
+from ..utils import faults as _faults
+from ..utils.checkpoint import (
+    CheckpointCorruptError,
+    list_entries,
+    load_pytree,
+    save_pytree,
+)
+from ..utils.telemetry import inc
+
+__all__ = ["TenantState", "TenantStore", "template_state"]
+
+_ID_RE = re.compile(r"^[A-Za-z0-9_\-]+$")
+
+
+class TenantState(NamedTuple):
+    """Everything a tenant needs to serve after a process restart: the
+    fitted `params`, the current filtered mean `s` (k,), and the absolute
+    time index `t` of the next tick (the observation phase is t mod d).
+    The ServingModel itself is NOT stored — it is a pure function of
+    `params` (one DARE solve) and is re-derived on load."""
+
+    params: SSMParams
+    s: jnp.ndarray
+    t: jnp.ndarray
+
+
+def template_state(N: int, r: int, p: int, dtype=float) -> TenantState:
+    """Structure-only template for `load_pytree` (dummy leaves)."""
+    dt = jnp.result_type(dtype)  # respects the x64 switch
+    k = r * p
+    return TenantState(
+        params=SSMParams(
+            jnp.zeros((N, r), dt),
+            jnp.ones((N,), dt),
+            jnp.zeros((p, r, r), dt),
+            jnp.eye(r, dtype=dt),
+        ),
+        s=jnp.zeros((k,), dt),
+        t=jnp.zeros((), jnp.int32),
+    )
+
+
+class TenantStore:
+    """Directory-backed map tenant_id -> TenantState.
+
+    ids are restricted to ``[A-Za-z0-9_-]+`` (they become file stems; no
+    separators, no traversal).  `load` returns None both for an id that
+    was never saved and for one whose archive failed verification — in
+    the latter case the archive has already been quarantined and the
+    `serving.store.quarantined` counter incremented, so the engine treats
+    the tenant as needing re-registration/refit."""
+
+    def __init__(self, directory: str):
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+        self._saves = 0
+
+    def _path(self, tenant_id: str) -> str:
+        if not _ID_RE.match(tenant_id):
+            raise ValueError(
+                f"invalid tenant id {tenant_id!r}: use [A-Za-z0-9_-]+ only"
+            )
+        return os.path.join(self.directory, tenant_id + ".npz")
+
+    def save(self, tenant_id: str, state: TenantState) -> None:
+        """Atomically persist one tenant (temp file + rename; a crash
+        mid-save leaves the previous archive intact).  Honors the
+        utils.faults ``ckpt_corrupt@n`` site: the n-th save through this
+        store instance is damaged after landing — the chaos drill the
+        quarantine path is pinned against."""
+        path = self._path(tenant_id)
+        tmp = f"{path}.tmp.{os.getpid()}.{uuid.uuid4().hex[:8]}.npz"
+        try:
+            save_pytree(tmp, state)
+            os.replace(tmp, path)
+        except BaseException:
+            try:  # a failed save must not leak its temp file
+                os.remove(tmp)
+            except OSError:
+                pass
+            raise
+        self._saves += 1
+        inc("serving.store.saves")
+        plan = _faults.active_plan()
+        if plan.ckpt_corrupt is not None and self._saves == plan.ckpt_corrupt:
+            _faults.corrupt_file(path)
+
+    def load(self, tenant_id: str, like: TenantState) -> TenantState | None:
+        """Load one tenant, or None when absent OR quarantined-corrupt.
+        `like` supplies the pytree structure (see `template_state`)."""
+        path = self._path(tenant_id)
+        if not os.path.exists(path):
+            return None
+        try:
+            state = load_pytree(path, like)
+        except CheckpointCorruptError:
+            # load_pytree already moved the file to <path>.corrupt
+            inc("serving.store.quarantined")
+            return None
+        return jax.tree.map(jnp.asarray, state)
+
+    def list(self) -> list[str]:
+        """Live tenant ids, sorted (quarantined archives excluded)."""
+        return list_entries(self.directory)
+
+    def delete(self, tenant_id: str) -> bool:
+        path = self._path(tenant_id)
+        try:
+            os.remove(path)
+            return True
+        except FileNotFoundError:
+            return False

@@ -24,7 +24,12 @@ import numpy as np
 
 import jax
 
-__all__ = ["save_pytree", "load_pytree", "CheckpointCorruptError"]
+__all__ = [
+    "save_pytree",
+    "load_pytree",
+    "list_entries",
+    "CheckpointCorruptError",
+]
 
 _SEP = "__"
 _CHECKSUM_KEY = "content_sha256"
@@ -52,6 +57,24 @@ def _quarantine(path: str) -> str | None:
         return dest
     except OSError:
         return None
+
+
+def list_entries(directory: str) -> list[str]:
+    """Names (stems) of the checkpoints currently live in `directory`,
+    sorted: every ``<name>.npz``, EXCLUDING quarantined ``*.corrupt``
+    files and in-flight ``*.npz.tmp.*`` temporaries from the atomic-write
+    protocol.  A missing directory is an empty store, not an error — the
+    serving tenant store enumerates ids with this before any save has
+    happened."""
+    try:
+        names = os.listdir(directory)
+    except FileNotFoundError:
+        return []
+    return sorted(
+        n[: -len(".npz")]
+        for n in names
+        if n.endswith(".npz") and ".npz.tmp." not in n
+    )
 
 
 def save_pytree(path: str, tree) -> None:

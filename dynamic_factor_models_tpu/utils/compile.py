@@ -452,6 +452,12 @@ class CompileSpec:
     # the same t_star.  None (default) skips the steady kernels entirely.
     t_star: int | None = None
     steady_block: int = 0
+    # serving layer (serving/): serving_period > 0 adds the O(1) online
+    # tick at that observation period (1 complete, 3 mixed-frequency);
+    # em_batch > 0 adds the vmapped multi-tenant EM loop over that many
+    # stacked panels.  Both default off so existing specs are unchanged.
+    serving_period: int = 0
+    em_batch: int = 0
 
     def padded_shape(self) -> tuple:
         if not self.bucket:
@@ -799,6 +805,102 @@ def _kernel_plan(spec: CompileSpec):
             # compiles its injected program live
             aot_statics(ssm.em_step_stats, spec.max_em_iter, gdonate, 0, 0, 0),
             guarded_loop_inputs,
+        )
+
+    if spec.serving_period > 0:
+        # lazy import: serving.online imports this module for aot_call
+        from ..serving import online
+
+        d = spec.serving_period
+        k = r * p
+        q = r if d == 1 else 5 * r
+        model_s = online.ServingModel(
+            Wb=_sds((Nb, q), dt),
+            H=_sds((Nb, q), dt),
+            Tm=_sds((k, k), dt),
+            Abar=_sds((d, k, k), dt),
+            K=_sds((d, k, q), dt),
+        )
+        state_s = online.FilterState(
+            s=_sds((k,), dt), t=_sds((), jnp.int32)
+        )
+
+        def tick_inputs():
+            rng = np.random.default_rng(2)
+            model = online.ServingModel(
+                Wb=jnp.asarray(0.1 * rng.standard_normal((Nb, q)), dt),
+                H=jnp.asarray(0.1 * rng.standard_normal((Nb, q)), dt),
+                Tm=0.5 * jnp.eye(k, dtype=dt),
+                Abar=jnp.broadcast_to(0.5 * jnp.eye(k, dtype=dt), (d, k, k)),
+                K=jnp.zeros((d, k, q), dt).at[:, :q, :].set(
+                    0.1 * jnp.eye(q, dtype=dt)
+                ),
+            )
+            state = online.FilterState(
+                s=jnp.zeros((k,), dt), t=jnp.asarray(0, jnp.int32)
+            )
+            x_t = jnp.asarray(0.1 * rng.standard_normal((Nb,)), dt)
+            return model, state, x_t, jnp.ones((Nb,), bool)
+
+        plans["serving_tick"] = (
+            online._tick,
+            (model_s, state_s, _sds((Nb,), dt), _sds((Nb,), jnp.bool_)),
+            {},
+            (),
+            tick_inputs,
+        )
+
+    if spec.em_batch > 0:
+        from ..models import emloop
+
+        B = spec.em_batch
+        ld = jnp.result_type(float)
+
+        def _bsds(s):
+            return _sds((B,) + tuple(s.shape), s.dtype)
+
+        bparams_s = jax.tree.map(_bsds, params_s)
+        bcarry_s = (
+            bparams_s,
+            bparams_s,
+            _sds((B,), ld),
+            _sds((B,), ld),
+            _sds((B,), jnp.int32),
+            _sds((B, spec.max_em_iter), ld),
+            _sds((B,), jnp.int32),
+        )
+        bargs_s = (
+            jax.tree.map(_bsds, x_s),
+            jax.tree.map(_bsds, mask_s),
+            jax.tree.map(_bsds, stats_s),
+        )
+
+        def batched_loop_inputs():
+            pa, x, mask, stats = em_inputs()
+            stk = lambda t: jax.tree.map(  # noqa: E731
+                lambda a: jnp.broadcast_to(a, (B,) + a.shape), t
+            )
+            carry = emloop._fresh_batched_carry(
+                stk(pa), jnp.asarray(1e-6, ld), spec.max_em_iter, B
+            )
+            return (
+                carry,
+                (stk(x), stk(mask), stk(stats)),
+                jnp.asarray(1e-6, ld),
+                jnp.asarray(1e-3, ld),
+                jnp.asarray(2, jnp.int32),
+            )
+
+        plans["em_loop_batched"] = (
+            emloop._em_while_batched,
+            (ssm.em_step_stats, bcarry_s, bargs_s, _sds((), ld), _sds((), ld),
+             spec.max_em_iter, _sds((), jnp.int32)),
+            {},
+            # mirrors run_em_loop_batched's dispatch key: (step,
+            # max_em_iter, inject_nan_at) — precompiled loops are
+            # injection-free; a DFM_FAULTS run compiles live
+            aot_statics(ssm.em_step_stats, spec.max_em_iter, 0),
+            batched_loop_inputs,
         )
 
     return plans

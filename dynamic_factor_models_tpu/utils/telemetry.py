@@ -638,37 +638,46 @@ def summarize(path: str, entry: str | None = None) -> str:
         )
         h, m = _aot_hm(r)
         ll = r.get("final_loglik")
+        # serving-tick / nowcast records are not EM runs: n_iter,
+        # converged, final_loglik are legitimately absent (or null) —
+        # render "-" rather than "None", and never assume wall_s exists
+        it = r.get("n_iter")
         rows.append([
             ts,
             str(r.get("entry", "?")),
+            str(r.get("kind") or "-"),
             str(r.get("platform", "?")),
             _shape_str(r),
-            str(r.get("n_iter", "-")),
+            str(it) if isinstance(it, (int, float)) else "-",
             {True: "y", False: "n"}.get(r.get("converged"), "-"),
             f"{ll:.5g}" if isinstance(ll, (int, float)) else "-",
-            f"{r.get('wall_s', 0.0):.3f}",
+            f"{r.get('wall_s') or 0.0:.3f}",
             _mem_mb(r),
             f"{h}/{m}",
             _health_str(r),
             "ERR" if r.get("error") else "",
         ])
     per_run = _fmt_table(
-        ["time", "entry", "plat", "shape", "iters", "conv", "loglik",
-         "wall_s", "peak_MB", "aot h/m", "faults", ""],
+        ["time", "entry", "kind", "plat", "shape", "iters", "conv",
+         "loglik", "wall_s", "peak_MB", "aot h/m", "faults", ""],
         rows,
     )
 
     agg: dict[str, dict] = {}
     for r in recs:
         a = agg.setdefault(r.get("entry", "?"), {
-            "runs": 0, "errors": 0, "wall": 0.0, "iters": 0, "conv": 0,
-            "compile_s": 0.0, "hits": 0, "misses": 0,
+            "runs": 0, "errors": 0, "wall": 0.0, "iters": 0, "iter_runs": 0,
+            "conv": 0, "compile_s": 0.0, "hits": 0, "misses": 0,
             "faults": 0, "recovered": 0, "unhealthy": 0,
         })
         a["runs"] += 1
         a["errors"] += 1 if r.get("error") else 0
         a["wall"] += r.get("wall_s", 0.0) or 0.0
-        a["iters"] += r.get("n_iter") or 0
+        # mean_iters averages over EM-style records only: a stream of
+        # online ticks must not drag an entry's mean toward zero
+        if isinstance(r.get("n_iter"), (int, float)):
+            a["iters"] += r["n_iter"]
+            a["iter_runs"] += 1
         a["conv"] += 1 if r.get("converged") else 0
         a["faults"] += r.get("faults_detected") or 0
         a["recovered"] += r.get("recoveries") or 0
@@ -687,7 +696,8 @@ def summarize(path: str, entry: str | None = None) -> str:
             str(a["errors"]),
             f"{a['wall']:.3f}",
             f"{a['wall'] / a['runs']:.3f}",
-            f"{a['iters'] / a['runs']:.1f}",
+            (f"{a['iters'] / a['iter_runs']:.1f}"
+             if a["iter_runs"] else "-"),
             f"{100.0 * a['conv'] / a['runs']:.0f}%",
             f"{a['compile_s']:.3f}",
             f"{a['hits']}/{a['misses']}",

@@ -24,6 +24,16 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 from dynamic_factor_models_tpu.io.cache import cached_dataset  # noqa: E402
+from dynamic_factor_models_tpu.utils.compile import (  # noqa: E402
+    configure_compilation_cache,
+)
+
+# Persist compiled executables across test runs (build/jax_cache, gitignored).
+# The suite compiles hundreds of XLA CPU programs; with a warm cache most are
+# deserialized from disk instead of recompiled, and the module-boundary
+# jax.clear_caches() below drops only the in-process caches — reloads still
+# hit the disk cache.  DFM_COMPILE_CACHE=0 disables (compile.py kill-switch).
+configure_compilation_cache()
 
 
 @pytest.fixture(autouse=True, scope="module")
