@@ -872,6 +872,122 @@ def serving_section():
     return fields
 
 
+def scenarios_section():
+    """Scenario-engine throughput (bench.py --scenarios).
+
+    Four fields into the BENCH json (present-but-null when the section
+    fails):
+
+    - scenario_draws_per_sec_1k / _10k: posterior-predictive forward
+      simulations through the vmapped "scenario_fan" kernel
+      (scenarios/fanout.forecast_fan — the posterior_forecast program)
+      at 1k and 10k parameter draws;
+    - scenario_chains_per_sec: guarded multi-chain Gibbs
+      (scenarios/gibbs.sample_chains), 4 chains in one
+      scan-outside/vmap-inside program;
+    - scenario_vs_sequential_x: the 1k-draw vmapped fan vs the same 1k
+      draws dispatched one at a time from a Python loop (acceptance
+      bar: >= 3x — the fan amortizes per-dispatch overhead and lets
+      XLA thread the draw axis).
+
+    Prints one JSON line and returns the dict.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    fields = {
+        "scenario_draws_per_sec_1k": None,
+        "scenario_draws_per_sec_10k": None,
+        "scenario_chains_per_sec": None,
+        "scenario_vs_sequential_x": None,
+    }
+    try:
+        from dynamic_factor_models_tpu.models.bayes import BayesPriors
+        from dynamic_factor_models_tpu.models.ssm import SSMParams
+        from dynamic_factor_models_tpu.scenarios.fanout import (
+            _forecast_fan_impl,
+        )
+        from dynamic_factor_models_tpu.scenarios.gibbs import sample_chains
+
+        T, N, r, p, h = 64, 16, 4, 4, 12
+        k = r * p
+        rng = np.random.default_rng(17)
+        dt = jnp.result_type(float)
+        params = SSMParams(
+            lam=jnp.asarray(rng.standard_normal((N, r)), dt),
+            R=jnp.ones(N, dt),
+            A=jnp.concatenate(
+                [0.5 * jnp.eye(r, dtype=dt)[None],
+                 jnp.zeros((p - 1, r, r), dt)]
+            ),
+            Q=jnp.eye(r, dtype=dt),
+        )
+
+        # -- vmapped forward-simulation fans ---------------------------
+        def fan_args(D):
+            stk = lambda a: jnp.broadcast_to(a, (D,) + a.shape)  # noqa: E731
+            return (
+                stk(params.lam), stk(params.R), stk(params.A),
+                stk(params.Q), jnp.zeros((D, k), dt),
+                jax.random.split(jax.random.PRNGKey(3), D),
+            )
+
+        walls = {}
+        for D, name in (
+            (1_000, "scenario_draws_per_sec_1k"),
+            (10_000, "scenario_draws_per_sec_10k"),
+        ):
+            args = fan_args(D)
+            jax.block_until_ready(
+                _forecast_fan_impl(*args, horizon=h)
+            )  # compile
+            walls[D] = _time_fixed_iters(lambda: jax.block_until_ready(
+                _forecast_fan_impl(*args, horizon=h)
+            ))
+            fields[name] = round(D / walls[D], 1)
+
+        # -- the same 1k draws, one Python dispatch per draw -----------
+        D = 1_000
+        args1k = fan_args(D)
+        one = tuple(a[:1] for a in args1k)
+        jax.block_until_ready(_forecast_fan_impl(*one, horizon=h))
+
+        def seq_loop():
+            for i in range(D):
+                jax.block_until_ready(_forecast_fan_impl(
+                    *(a[i:i + 1] for a in args1k), horizon=h
+                ))
+
+        wall_seq = _time_fixed_iters(seq_loop, n_timing_runs=2)
+        fields["scenario_vs_sequential_x"] = round(wall_seq / walls[D], 2)
+
+        # -- guarded multi-chain Gibbs ---------------------------------
+        C, n_burn, n_keep = 4, 30, 30
+        f = np.asarray(rng.standard_normal((T, r)).cumsum(0) * 0.3)
+        x = f @ np.asarray(params.lam).T + rng.standard_normal((T, N))
+        xz = jnp.asarray((x - x.mean(0)) / x.std(0), dt)
+        m = jnp.ones((T, N), dt)
+        pr = BayesPriors()
+        prior_t = (
+            float(pr.lam_scale), float(pr.r_shape), float(pr.r_rate),
+            float(pr.q_df_extra), float(pr.q_scale),
+        )
+        keys = jax.random.split(jax.random.PRNGKey(5), C)
+        kw = dict(n_burn=n_burn, n_keep=n_keep, thin=1, p=p,
+                  priors=prior_t)
+        sample_chains(keys, params, xz, m, **kw)  # compile
+        wall_g = _time_fixed_iters(
+            lambda: sample_chains(keys, params, xz, m, **kw),
+            n_timing_runs=2,
+        )
+        fields["scenario_chains_per_sec"] = round(C / wall_g, 2)
+    except Exception as e:  # present-but-null contract
+        fields["scenario_error"] = f"{type(e).__name__}: {e}"
+    print(json.dumps(fields))
+    return fields
+
+
 def chaos_preempt_drill():
     """One injected-preemption resume (bench.py --chaos-preempt-drill).
 
@@ -2138,6 +2254,10 @@ def main():
                     help="multi-tenant serving throughput: O(1) online "
                          "ticks + batched-vs-sequential EM refits "
                          "(serving_section); prints one JSON line")
+    ap.add_argument("--scenarios", action="store_true",
+                    help="scenario-engine throughput: vmapped draw fans "
+                         "vs python-looped dispatch + multi-chain Gibbs "
+                         "(scenarios_section); prints one JSON line")
     ap.add_argument("--chaos-preempt-drill", action="store_true",
                     help="one injected-preemption resume on a small panel "
                          "(tpu_watch live-window drill); prints one JSON "
@@ -2159,6 +2279,9 @@ def main():
         return
     if args.serving:
         serving_section()
+        return
+    if args.scenarios:
+        scenarios_section()
         return
     if args.chaos_preempt_drill:
         chaos_preempt_drill()

@@ -61,6 +61,7 @@ __all__ = [
     "posterior_irfs",
     "posterior_series_irfs",
     "rhat",
+    "ess",
 ]
 
 
@@ -81,15 +82,20 @@ class BayesPriors(NamedTuple):
 
 
 class BayesResults(NamedTuple):
-    factor_draws: jnp.ndarray  # (chains, keep, T, r)
-    lam_draws: jnp.ndarray  # (chains, keep, N, r)
-    r_draws: jnp.ndarray  # (chains, keep, N)
-    a_draws: jnp.ndarray  # (chains, keep, p, r, r)
-    q_draws: jnp.ndarray  # (chains, keep, r, r)
+    factor_draws: jnp.ndarray  # (chains_kept, keep, T, r)
+    lam_draws: jnp.ndarray  # (chains_kept, keep, N, r)
+    r_draws: jnp.ndarray  # (chains_kept, keep, N)
+    a_draws: jnp.ndarray  # (chains_kept, keep, p, r, r)
+    q_draws: jnp.ndarray  # (chains_kept, keep, r, r)
     loglik_path: np.ndarray  # (chains, total_iters) filter loglik per sweep
     rhat_loglik: float  # split-R-hat of the post-burn loglik path
     stds: jnp.ndarray  # per-series standardization scale
     means: jnp.ndarray  # per-series means (original units)
+    # appended with defaults so pre-scenario-engine construction sites and
+    # pickles keep working; draw arrays hold HEALTHY chains only, the
+    # loglik path keeps every chain (the diagnostic trace)
+    chain_health: np.ndarray | None = None  # (chains,) utils.guards codes
+    ess_loglik: float | None = None  # cross-chain ESS of the kept loglik
 
 
 def _draw_mvn(key, mean, cov):
@@ -362,9 +368,9 @@ def _sign_normalize(f, lam, A, Q):
     return f_n, lam_n, A_n, Q_n
 
 
-def rhat(draws) -> float:
-    """Split-R-hat (Gelman-Rubin) of a (chains, draws) scalar sample."""
-    x = np.asarray(draws, np.float64)
+def _split_rhat_2d(x: np.ndarray) -> float:
+    """Split-R-hat of a (chains, draws) float64 array (chains >= 1: each
+    chain is split in halves, so one chain still yields a diagnostic)."""
     c, n = x.shape
     half = n // 2
     x = x[:, : 2 * half].reshape(2 * c, half)
@@ -373,6 +379,67 @@ def rhat(draws) -> float:
     B = half * cm.var(ddof=1)
     var_plus = (half - 1) / half * W + B / half
     return float(np.sqrt(var_plus / W))
+
+
+def rhat(draws):
+    """Split-R-hat (Gelman-Rubin) of stacked posterior draws.
+
+    Accepts 1-D ``(n,)`` — a single chain, split in halves; 2-D
+    ``(chains, draws)`` — the classic scalar diagnostic; or
+    ``(chains, draws, ...)`` — per-component split-R-hat over the
+    trailing dims (e.g. ``rhat(res.lam_draws)`` -> (N, r) array).
+    Scalar inputs return a float, stacked inputs an ndarray of the
+    trailing shape."""
+    x = np.asarray(draws, np.float64)
+    if x.ndim == 1:
+        x = x[None, :]
+    if x.ndim == 2:
+        return _split_rhat_2d(x)
+    c, n = x.shape[:2]
+    flat = x.reshape(c, n, -1)
+    out = np.array(
+        [_split_rhat_2d(flat[:, :, j]) for j in range(flat.shape[2])]
+    )
+    return out.reshape(x.shape[2:])
+
+
+def ess(draws):
+    """Cross-chain effective sample size of stacked posterior draws.
+
+    Standard autocorrelation estimator: per-chain FFT autocovariances
+    averaged across chains, combined with the between-chain variance
+    into split-R-hat's var_plus, truncated by Geyer's initial positive
+    sequence.  Shapes as in `rhat`; returns min(c*n, c*n/tau)."""
+    x = np.asarray(draws, np.float64)
+    if x.ndim == 1:
+        x = x[None, :]
+    if x.ndim > 2:
+        c, n = x.shape[:2]
+        flat = x.reshape(c, n, -1)
+        out = np.array([ess(flat[:, :, j]) for j in range(flat.shape[2])])
+        return out.reshape(x.shape[2:])
+    c, n = x.shape
+    if n < 4:
+        return float(c * n)
+    xc = x - x.mean(axis=1, keepdims=True)
+    nfft = 1 << (2 * n - 1).bit_length()
+    f = np.fft.rfft(xc, nfft, axis=1)
+    acov = np.fft.irfft(f * np.conj(f), nfft, axis=1)[:, :n].real / n
+    mean_acov = acov.mean(axis=0)
+    W = mean_acov[0] * n / (n - 1.0)
+    B = n * x.mean(axis=1).var(ddof=1) if c > 1 else 0.0
+    var_plus = (n - 1.0) / n * W + B / n
+    if not var_plus > 0:
+        return float(c * n)
+    rho = 1.0 - (W - mean_acov * n / (n - 1.0)) / var_plus
+    tau, t = 1.0, 1
+    while t + 1 < n:
+        pair = rho[t] + rho[t + 1]
+        if pair < 0:
+            break
+        tau += 2.0 * pair
+        t += 2
+    return float(min(c * n, c * n / max(tau, 1e-12)))
 
 
 def estimate_dfm_bayes(
@@ -436,24 +503,48 @@ def estimate_dfm_bayes(
                 keys, NamedSharding(mesh, P(mesh.axis_names[0]))
             )
 
-        run = jax.vmap(
-            lambda k: _chain(
-                k, params0, xz, m_arr.astype(xz.dtype),
-                n_burn, n_keep, thin, p, prior_t,
-            )
+        # guarded multi-chain kernel (scenarios/gibbs.py): all chains in
+        # one scan-outside/vmap-inside program, per-chain health sentinel
+        # (lazy import: scenarios imports this module at load)
+        from ..scenarios.gibbs import sample_chains
+
+        mc = sample_chains(
+            keys, params0, xz, m_arr.astype(xz.dtype),
+            n_burn=n_burn, n_keep=n_keep, thin=thin, p=p, priors=prior_t,
         )
-        f_k, lam_k, r_k, a_k, q_k, ll_all = run(keys)
+        f_k, lam_k, r_k, a_k, q_k = (
+            mc.factor_draws, mc.lam_draws, mc.r_draws, mc.a_draws,
+            mc.q_draws,
+        )
 
         # normalize each draw's scale (unit-diag Q), rotation-align to the
         # (chain-shared) ALS init loadings, then fix signs: draws become
         # averageable across chains and sweeps (the likelihood is invariant
-        # along both the scale ridge and the rotation orbit)
+        # along both the scale ridge and the rotation orbit).  Normalize
+        # BEFORE dropping divergent chains: the per-draw maps are
+        # elementwise over the chain axis, so surviving chains stay
+        # bit-identical to a fault-free run of the same batch shape
         f_k, lam_k, a_k, q_k = _scale_normalize(f_k, lam_k, a_k, q_k)
         f_k, lam_k, a_k, q_k = _procrustes_align(
             f_k, lam_k, a_k, q_k, params0.lam
         )
         f_k, lam_k, a_k, q_k = _sign_normalize(f_k, lam_k, a_k, q_k)
-        ll_np = np.asarray(ll_all)
+
+        health = mc.health
+        healthy = health == 0
+        if not healthy.any():
+            raise RuntimeError(
+                "every Gibbs chain diverged (non-finite draws) — the "
+                "posterior is empty; loosen priors, reduce nfac_u, or "
+                "inspect the panel for pathological scaling"
+            )
+        ll_np = np.asarray(mc.loglik_path)
+        if not healthy.all():
+            hidx = np.nonzero(healthy)[0]
+            f_k, lam_k, r_k, a_k, q_k = (
+                a[hidx] for a in (f_k, lam_k, r_k, a_k, q_k)
+            )
+        ll_post = ll_np[healthy][:, n_burn:]
         return BayesResults(
             factor_draws=f_k,
             lam_draws=lam_k,
@@ -461,9 +552,11 @@ def estimate_dfm_bayes(
             a_draws=a_k,
             q_draws=q_k,
             loglik_path=ll_np,
-            rhat_loglik=rhat(ll_np[:, n_burn:]),
+            rhat_loglik=rhat(ll_post),
             stds=stds,
             means=n_mean,
+            chain_health=health,
+            ess_loglik=ess(ll_post),
         )
 
 
@@ -622,24 +715,12 @@ def posterior_forecast(
         n_draws = lam_d.shape[0]
         keys = jax.random.split(jax.random.PRNGKey(seed), n_draws)
 
-        def one_draw(lam_i, R_i, A_i, Q_i, s, key):
-            params = SSMParams(lam=lam_i, R=R_i, A=A_i, Q=_psd_floor(Q_i))
-            Tm, _ = _companion(params)
-            r = params.r
-            ku, ke = jax.random.split(key)
-            Lq = jnp.linalg.cholesky(params.Q)
-            u = jax.random.normal(ku, (horizon, r), x.dtype) @ Lq.T
+        # shared fan-out kernel (scenarios/fanout.py): posterior forecasts
+        # and scenario draw fans run the same AOT-registered program
+        from ..scenarios.fanout import forecast_fan
 
-            def step(s_prev, u_t):
-                s_t = (Tm @ s_prev).at[:r].add(u_t)
-                return s_t, s_t[:r]
-
-            _, f_path = jax.lax.scan(step, s, u)
-            eps = jax.random.normal(ke, (horizon, lam_i.shape[0]), x.dtype)
-            return f_path @ lam_i.T + eps * jnp.sqrt(R_i)
-
-        draws_std = jax.jit(jax.vmap(one_draw))(
-            lam_d, r_d, a_d, q_d, s_term, keys
+        draws_std = forecast_fan(
+            lam_d, r_d, a_d, q_d, s_term, keys, int(horizon)
         )
         # back to original units with the fit's moments
         draws = draws_std * results.stds[None, None, :] + results.means[None, None, :]

@@ -297,19 +297,9 @@ class EMLoopResult(tuple):
     trace = property(lambda self: self[3])
 
 
-def _batched_finite(tree) -> jnp.ndarray:
-    """(B,) bool: per-batch-member finiteness of every inexact leaf —
-    `guards.tree_finite` vectorized over a leading batch axis, so one
-    tenant's NaN flags only that tenant."""
-    checks = [
-        jnp.all(jnp.isfinite(x).reshape(x.shape[0], -1), axis=1)
-        for x in jax.tree.leaves(tree)
-        if jnp.issubdtype(jnp.asarray(x).dtype, jnp.inexact)
-    ]
-    out = checks[0]
-    for v in checks[1:]:
-        out = out & v
-    return out
+# (B,) per-batch-member finiteness; the shared sentinel primitive moved to
+# utils.guards so scenarios/gibbs.py reuses the identical check
+_batched_finite = _guards.batched_tree_finite
 
 
 def _em_while_batched_impl(

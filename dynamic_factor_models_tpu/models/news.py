@@ -32,7 +32,12 @@ from ..ops.masking import fillz, mask_of
 from ..utils.backend import on_backend
 from .ssm import SSMParams, _filter_scan, _psd_floor, _smoother_scan
 
-__all__ = ["NowcastNews", "nowcast_news"]
+__all__ = [
+    "NowcastNews",
+    "NowcastNewsBatch",
+    "nowcast_news",
+    "nowcast_news_batch",
+]
 
 
 @partial(jax.jit, static_argnames=("t_tgt", "i_tgt"))
@@ -47,6 +52,62 @@ def _nowcast_paths(params: SSMParams, xz, masks, t_tgt: int, i_tgt: int):
         return params.lam[i_tgt] @ sm[t_tgt, : params.r]
 
     return jax.vmap(nowcast_under)(masks)
+
+
+@jax.jit
+def _nowcast_paths_multi(params: SSMParams, xz, masks, tgt_rows, tgt_cols):
+    """Every target's nowcast under each stacked information set:
+    (K+1, n_tgt).  The targets ride as TRACED gather indices (not the
+    single-target version's static ints), so one compiled program serves
+    every target set of the same size — the scenario engine's batched
+    news kernel.  The smoother stack is shared across targets: n_tgt
+    extra nowcasts cost two gathers and a contraction, not n_tgt
+    smoother runs."""
+
+    def nowcast_under(mask_k):
+        filt = _filter_scan(params, xz * mask_k.astype(xz.dtype), mask_k)
+        sm, _, _ = _smoother_scan(params, filt)
+        f_t = sm[tgt_rows, : params.r]  # (n_tgt, r)
+        return jnp.einsum("kr,kr->k", params.lam[tgt_cols], f_t)
+
+    return jax.vmap(nowcast_under)(masks)
+
+
+def _validate_vintages(x_old, x_new):
+    """Shared nested-vintage validation; returns (m_old, m_new) numpy
+    masks.  Raises on shape mismatch, missing overlap observations, or
+    revised (not purely released) values."""
+    if x_old.shape != x_new.shape:
+        raise ValueError(
+            f"vintage shapes differ: {x_old.shape} vs {x_new.shape}"
+        )
+    m_old = np.asarray(mask_of(x_old))
+    m_new = np.asarray(mask_of(x_new))
+    if (m_old & ~m_new).any():
+        raise ValueError(
+            "x_new is missing observations present in x_old — vintages "
+            "must be nested"
+        )
+    vals_match = np.asarray(
+        jnp.where(mask_of(x_old), fillz(x_old) - fillz(x_new), 0.0)
+    )
+    if np.abs(vals_match).max() > 1e-10:
+        raise ValueError(
+            "overlapping observations differ between vintages; "
+            "nowcast_news decomposes pure releases, not revisions to "
+            "already-published values"
+        )
+    return m_old, m_new
+
+
+def _cumulative_masks(m_old, rel):
+    """K+1 stacked masks: info set 0 = old vintage, k = old + first k
+    releases (host-side; the device sees one boolean stack)."""
+    K = rel.shape[0]
+    masks = np.repeat(m_old[None], K + 1, axis=0)
+    for k in range(K):
+        masks[k + 1 :, rel[k, 0], rel[k, 1]] = True
+    return jnp.asarray(masks)
 
 
 class NowcastNews(NamedTuple):
@@ -82,26 +143,7 @@ def nowcast_news(
         params = params._replace(Q=_psd_floor(params.Q))
         x_old = jnp.asarray(x_old)
         x_new = jnp.asarray(x_new)
-        if x_old.shape != x_new.shape:
-            raise ValueError(
-                f"vintage shapes differ: {x_old.shape} vs {x_new.shape}"
-            )
-        m_old = np.asarray(mask_of(x_old))
-        m_new = np.asarray(mask_of(x_new))
-        if (m_old & ~m_new).any():
-            raise ValueError(
-                "x_new is missing observations present in x_old — vintages "
-                "must be nested"
-            )
-        vals_match = np.asarray(
-            jnp.where(mask_of(x_old), fillz(x_old) - fillz(x_new), 0.0)
-        )
-        if np.abs(vals_match).max() > 1e-10:
-            raise ValueError(
-                "overlapping observations differ between vintages; "
-                "nowcast_news decomposes pure releases, not revisions to "
-                "already-published values"
-            )
+        m_old, m_new = _validate_vintages(x_old, x_new)
         t_tgt, i_tgt = target
         if m_new[t_tgt, i_tgt]:
             raise ValueError(
@@ -115,13 +157,8 @@ def nowcast_news(
             if sorted(order.tolist()) != list(range(len(rel))):
                 raise ValueError("order must be a permutation of the releases")
             rel = rel[order]
-        K = rel.shape[0]
 
-        # cumulative masks: info set 0 = old vintage, k = old + first k
-        masks = np.repeat(m_old[None], K + 1, axis=0)
-        for k in range(K):
-            masks[k + 1 :, rel[k, 0], rel[k, 1]] = True
-        masks_j = jnp.asarray(masks)
+        masks_j = _cumulative_masks(m_old, rel)
         xz = fillz(x_new)
         path = _nowcast_paths(params, xz, masks_j, int(t_tgt), int(i_tgt))
         news = jnp.diff(path)
@@ -132,4 +169,80 @@ def nowcast_news(
             nowcast_path=path,
             old_nowcast=float(path[0]),
             new_nowcast=float(path[-1]),
+        )
+
+
+class NowcastNewsBatch(NamedTuple):
+    """Batched news: one smoother-stack run, every target's decomposition.
+
+    Per-target arrays carry the target axis LAST so `news[:, j]` is
+    target j's per-release contributions (summing to
+    `total_revision[j]`, the telescoping exactness of the scalar
+    decomposition — pinned per target by test)."""
+
+    targets: np.ndarray  # (n_tgt, 2) [row, series] per target
+    total_revision: np.ndarray  # (n_tgt,)
+    releases: np.ndarray  # (K, 2) shared release sequence
+    news: jnp.ndarray  # (K, n_tgt)
+    nowcast_path: jnp.ndarray  # (K+1, n_tgt)
+    old_nowcast: np.ndarray  # (n_tgt,)
+    new_nowcast: np.ndarray  # (n_tgt,)
+
+
+def nowcast_news_batch(
+    params: SSMParams,
+    x_old,
+    x_new,
+    targets,
+    order=None,
+    backend: str | None = None,
+) -> NowcastNewsBatch:
+    """`nowcast_news` for MANY target entries at once (the scenario
+    engine's batched decomposition): the K+1 masked-smoother runs are
+    shared across targets — total device work is one vmapped smoother
+    stack regardless of how many nowcasts are being attributed.
+
+    `targets`: (n_tgt, 2) [row, series] entries, each missing in the new
+    vintage.  Release sequencing (and its ordering caveat) is identical
+    to the scalar entry point."""
+    with on_backend(backend):
+        params = params._replace(Q=_psd_floor(params.Q))
+        x_old = jnp.asarray(x_old)
+        x_new = jnp.asarray(x_new)
+        m_old, m_new = _validate_vintages(x_old, x_new)
+        tgt = np.atleast_2d(np.asarray(targets, np.int64))
+        if tgt.shape[1] != 2:
+            raise ValueError(
+                f"targets must be (n_tgt, 2) [row, series], got "
+                f"{tgt.shape}"
+            )
+        observed = [tuple(t) for t in tgt if m_new[t[0], t[1]]]
+        if observed:
+            raise ValueError(
+                f"target entries {observed} are observed in the new "
+                "vintage — nothing to nowcast"
+            )
+
+        rel = np.argwhere(m_new & ~m_old)
+        if order is not None:
+            order = np.asarray(order)
+            if sorted(order.tolist()) != list(range(len(rel))):
+                raise ValueError("order must be a permutation of the releases")
+            rel = rel[order]
+
+        masks_j = _cumulative_masks(m_old, rel)
+        paths = _nowcast_paths_multi(
+            params, fillz(x_new), masks_j,
+            jnp.asarray(tgt[:, 0]), jnp.asarray(tgt[:, 1]),
+        )  # (K+1, n_tgt)
+        news = jnp.diff(paths, axis=0)
+        p_np = np.asarray(paths)
+        return NowcastNewsBatch(
+            targets=tgt,
+            total_revision=p_np[-1] - p_np[0],
+            releases=rel,
+            news=news,
+            nowcast_path=paths,
+            old_nowcast=p_np[0],
+            new_nowcast=p_np[-1],
         )

@@ -1,0 +1,33 @@
+"""Batched scenario engine: vmapped fan-out over the estimation zoo.
+
+Three pieces (the fourth ROADMAP pillar after compile-once, serving and
+guardrails):
+
+* `gibbs`  — multi-chain Gibbs for the Bayesian DFM: n_chains chains as
+  one scan-outside / vmap-inside program with the utils.guards health
+  sentinel vectorized per chain, so a divergent chain is rolled back,
+  frozen and dropped from the posterior without perturbing its lane-mates
+  (the `run_em_loop_batched` isolation contract, applied to MCMC).
+* `fanout` — simulation-smoother and forward-simulation fan-out kernels:
+  conditional-forecast fans, stress paths, posterior-predictive draw
+  fans, all one vmap instead of a host loop, AOT-registered through
+  utils.compile keyed on (bucket, n_draws).
+* `api`    — ScenarioRequest/ScenarioResult and the `run_scenario`
+  dispatcher the serving engine routes `kind="scenario"` requests to.
+"""
+
+from .api import ScenarioRequest, ScenarioResult, run_scenario
+from .fanout import conditional_fan, draw_fan, forecast_fan, stress_fan
+from .gibbs import MultiChainResult, sample_chains
+
+__all__ = [
+    "ScenarioRequest",
+    "ScenarioResult",
+    "run_scenario",
+    "conditional_fan",
+    "draw_fan",
+    "forecast_fan",
+    "stress_fan",
+    "MultiChainResult",
+    "sample_chains",
+]

@@ -1,0 +1,134 @@
+"""ScenarioRequest/ScenarioResult: the serving surface of the fan-out.
+
+One request describes one fan — a set of conditioning paths, stress
+shocks, or news targets plus an optional draw count — and `run_scenario`
+dispatches it to the right kernel.  `serving/engine.py` routes
+``{"kind": "scenario", "tenant": id, "scenario": {...}}`` dicts here,
+RunRecord-bracketed with kind="scenario" so scenario traffic shows up in
+`telemetry summarize` next to ticks and refits.
+
+Request kinds:
+
+    conditional_fan  S conditioning paths -> smoothed mean/sd fans
+                     (+ a posterior-predictive draw fan when n_draws > 0)
+    stress           S factor-shock vectors -> shifted forecast fans
+    draw_fan         S paths x n_draws simulation-smoother draws
+    news             batched nowcast-news decomposition over targets
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from ..models.ssm import SSMParams
+from ..utils.telemetry import run_record
+from . import fanout
+
+__all__ = ["ScenarioRequest", "ScenarioResult", "run_scenario", "KINDS"]
+
+KINDS = ("conditional_fan", "stress", "draw_fan", "news")
+
+
+class ScenarioRequest(NamedTuple):
+    """One scenario fan.  Unused fields stay None/0 per kind:
+    `conditions` (S, horizon, N) NaN-unconstrained paths
+    (conditional_fan / draw_fan; None = one unconditional lane);
+    `shocks` (S, r) factor-innovation impulses (stress); `x_new` +
+    `targets` the new vintage and (n_tgt, 2) target entries (news)."""
+
+    kind: str
+    horizon: int = 12
+    conditions: object | None = None
+    shocks: object | None = None
+    n_draws: int = 0
+    seed: int = 0
+    x_new: object | None = None
+    targets: object | None = None
+
+
+class ScenarioResult(NamedTuple):
+    """Fan output; populated fields depend on the request kind.  mean/sd
+    are (S, horizon, N); factor_mean (S, horizon, r); draws
+    (S, n_draws, horizon, N) posterior-predictive paths; news is a
+    models.news.NowcastNewsBatch for kind="news"."""
+
+    kind: str
+    mean: jnp.ndarray | None = None
+    sd: jnp.ndarray | None = None
+    factor_mean: jnp.ndarray | None = None
+    factor_cov: jnp.ndarray | None = None
+    draws: jnp.ndarray | None = None
+    factor_draws: jnp.ndarray | None = None
+    news: object | None = None
+
+
+def run_scenario(
+    params: SSMParams, x, req: ScenarioRequest
+) -> ScenarioResult:
+    """Dispatch one ScenarioRequest against a fitted model and its
+    (standardized) panel.  Each kind is one or two vmapped device
+    programs (scenarios/fanout.py) — never a host loop over scenarios
+    or draws."""
+    if req.kind not in KINDS:
+        raise ValueError(
+            f"unknown scenario kind {req.kind!r}; valid: {', '.join(KINDS)}"
+        )
+    with run_record(
+        "scenario",
+        kind=req.kind,
+        config={
+            "horizon": int(req.horizon),
+            "n_draws": int(req.n_draws or 0),
+        },
+    ) as rec:
+        if req.kind == "conditional_fan":
+            mean, sd, f, Pf = fanout.conditional_fan(
+                params, x, req.horizon, req.conditions
+            )
+            draws = f_draws = None
+            if req.n_draws:
+                f_draws, draws, _ = fanout.draw_fan(
+                    params, x, req.horizon, req.n_draws,
+                    conditions=req.conditions, seed=req.seed,
+                )
+            rec.set(n_paths=int(mean.shape[0]))
+            return ScenarioResult(
+                req.kind, mean=mean, sd=sd, factor_mean=f,
+                factor_cov=Pf, draws=draws, factor_draws=f_draws,
+            )
+        if req.kind == "stress":
+            if req.shocks is None:
+                raise ValueError("stress scenarios need `shocks` (S, r)")
+            mean, sd, f = fanout.stress_fan(
+                params, x, req.horizon, req.shocks
+            )
+            rec.set(n_paths=int(mean.shape[0]))
+            return ScenarioResult(
+                req.kind, mean=mean, sd=sd, factor_mean=f
+            )
+        if req.kind == "draw_fan":
+            n_draws = int(req.n_draws or 0)
+            if n_draws < 1:
+                raise ValueError("draw_fan needs n_draws >= 1")
+            f_draws, draws, _ = fanout.draw_fan(
+                params, x, req.horizon, n_draws,
+                conditions=req.conditions, seed=req.seed,
+            )
+            rec.set(n_paths=int(draws.shape[0]), n_draws=n_draws)
+            return ScenarioResult(
+                req.kind,
+                mean=draws.mean(axis=1),
+                sd=draws.std(axis=1),
+                draws=draws,
+                factor_draws=f_draws,
+            )
+        # news
+        if req.x_new is None or req.targets is None:
+            raise ValueError("news scenarios need `x_new` and `targets`")
+        from ..models.news import nowcast_news_batch
+
+        nb = nowcast_news_batch(params, x, req.x_new, req.targets)
+        rec.set(n_paths=int(nb.targets.shape[0]))
+        return ScenarioResult(req.kind, news=nb)

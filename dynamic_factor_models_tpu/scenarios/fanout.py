@@ -1,0 +1,254 @@
+"""Fan-out kernels: many scenarios / many draws as one vmapped program.
+
+The paper's conditional-forecast and simulation-smoother machinery
+(models/forecast.py, models/bayes.py) runs one scenario at a time; a
+"what if oil +30%, across 10k draws" serving request is a fan of
+thousands of such runs that differ only in a conditioning path or a PRNG
+key.  Everything here vmaps the existing cores over stacked inputs —
+no new numerics, just batch structure — and dispatches through the
+utils.compile AOT registry so a `precompile(CompileSpec(scenario_draws=
+...))` serves the whole fan from one executable, keyed on (bucket,
+n_draws) via the traced shapes + the static horizon:
+
+* `conditional_fan`  — S conditioning paths through the masked smoother
+  (the `conditional_forecast` math, exactly; parity pinned at 1e-12).
+* `draw_fan`         — S paths x D simulation-smoother draws: sampled
+  factor paths + posterior-predictive observable fans per scenario.
+* `stress_fan`       — S factor-shock vectors propagated through the
+  companion dynamics on top of the baseline forecast.
+* `forecast_fan`     — D forward-simulation draws from D parameter
+  draws (the `posterior_forecast` kernel; bayes routes through here so
+  posterior forecasts and scenario fans share one compiled program).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from ..models.bayes import _simulation_smoother_core
+from ..models.ssm import (
+    SSMParams,
+    _companion,
+    _filter_scan,
+    _psd_floor,
+    _smoother_scan,
+)
+from ..ops.masking import fillz, mask_of
+
+__all__ = [
+    "conditional_fan",
+    "draw_fan",
+    "forecast_fan",
+    "stress_fan",
+    "extend_panel",
+]
+
+
+def extend_panel(x, horizon: int, conditions=None):
+    """Stack S condition paths onto a shared history: (S, T+h, N) panels.
+
+    `conditions` (S, horizon, N) pins assumed future paths per scenario,
+    NaN = unconstrained (None = one unconditional lane); the validation
+    mirrors `forecast.conditional_forecast` so the fan and the loop
+    reject the same inputs."""
+    if horizon < 1:
+        raise ValueError(f"horizon must be >= 1, got {horizon}")
+    x = jnp.asarray(x)
+    N = x.shape[1]
+    if conditions is None:
+        cond = jnp.full((1, horizon, N), jnp.nan, x.dtype)
+    else:
+        cond = jnp.asarray(conditions, x.dtype)
+        if cond.ndim == 2:
+            cond = cond[None]
+        if cond.ndim != 3 or cond.shape[1:] != (horizon, N):
+            raise ValueError(
+                f"conditions must be (S, horizon, N) = (*, {horizon}, {N}), "
+                f"got {tuple(cond.shape)}"
+            )
+    S = cond.shape[0]
+    x_ext = jnp.concatenate(
+        [jnp.broadcast_to(x, (S,) + x.shape), cond], axis=1
+    )
+    return fillz(x_ext), mask_of(x_ext)
+
+
+@partial(jax.jit, static_argnames=("horizon",))
+def _conditional_fan_impl(params, xz_stack, mask_stack, horizon: int):
+    """(mean, sd, factor_mean, factor_cov) per scenario — the
+    `conditional_forecast` tail math vmapped over the stacked panels."""
+
+    def one(xe, me):
+        filt = _filter_scan(params, xe, me)
+        sm, cov, _ = _smoother_scan(params, filt)
+        r = params.r
+        f = sm[-horizon:, :r]
+        Pf = cov[-horizon:, :r, :r]
+        mean = f @ params.lam.T
+        var_common = jnp.einsum("nr,hrs,ns->hn", params.lam, Pf, params.lam)
+        sd = jnp.sqrt(var_common + params.R[None, :])
+        return mean, sd, f, Pf
+
+    return jax.vmap(one)(xz_stack, mask_stack)
+
+
+def conditional_fan(params: SSMParams, x, horizon: int, conditions=None):
+    """Conditional-forecast fan: S scenarios through ONE vmapped masked
+    smoother.  Returns (mean (S, h, N), sd, factor_mean (S, h, r),
+    factor_cov (S, h, r, r)); lane s equals
+    `conditional_forecast(params, x, horizon, conditions[s])` to float
+    tolerance (pinned at 1e-12)."""
+    from ..utils.compile import aot_call, aot_statics
+
+    params = params._replace(Q=_psd_floor(params.Q))
+    xz, mask = extend_panel(x, horizon, conditions)
+    return aot_call(
+        "scenario_cond_fan",
+        lambda pa, xe, me: _conditional_fan_impl(pa, xe, me, horizon),
+        params, xz, mask,
+        statics=aot_statics(horizon),
+    )
+
+
+@partial(jax.jit, static_argnames=("horizon",))
+def _draw_fan_impl(params, xz_stack, mask_stack, keys, horizon: int):
+    """Simulation-smoother fan: draws x scenarios, one double vmap.
+
+    keys (S, D, 2).  Returns (f_draws (S, D, h, r), y_draws (S, D, h, N),
+    loglik (S, D)); y adds measurement noise to the drawn common
+    component — genuine posterior-predictive paths per scenario."""
+
+    def one_path(xe, me, ks):
+        def one_draw(k):
+            kf, ke = jax.random.split(k)
+            f, ll = _simulation_smoother_core(params, xe, me, kf)
+            fh = f[-horizon:]
+            eps = jax.random.normal(
+                ke, (horizon, params.lam.shape[0]), xe.dtype
+            )
+            y = fh @ params.lam.T + eps * jnp.sqrt(params.R)
+            return fh, y, ll
+
+        return jax.vmap(one_draw)(ks)
+
+    return jax.vmap(one_path)(xz_stack, mask_stack, keys)
+
+
+def draw_fan(
+    params: SSMParams,
+    x,
+    horizon: int,
+    n_draws: int,
+    conditions=None,
+    seed: int = 0,
+):
+    """Sampled scenario fans: for each of S conditioning paths, D
+    Durbin-Koopman factor-path draws + posterior-predictive observable
+    paths over the horizon.  One compiled program for the whole
+    S x D fan (kernel "scenario_draw_fan")."""
+    from ..utils.compile import aot_call, aot_statics
+
+    if n_draws < 1:
+        raise ValueError(f"n_draws must be >= 1, got {n_draws}")
+    params = params._replace(Q=_psd_floor(params.Q))
+    xz, mask = extend_panel(x, horizon, conditions)
+    S = xz.shape[0]
+    keys = jax.random.split(
+        jax.random.PRNGKey(seed), S * n_draws
+    ).reshape(S, n_draws, 2)
+    return aot_call(
+        "scenario_draw_fan",
+        lambda pa, xe, me, ks: _draw_fan_impl(pa, xe, me, ks, horizon),
+        params, xz, mask, keys,
+        statics=aot_statics(horizon),
+    )
+
+
+@partial(jax.jit, static_argnames=("horizon",))
+def _stress_fan_impl(params, shocks, horizon: int):
+    """Factor-shock responses: propagate each (r,) innovation impulse
+    through the companion dynamics, map to observables.  (S, h, N)."""
+    Tm, _ = _companion(params)
+    k = Tm.shape[0]
+    r = params.r
+
+    def one(delta):
+        s0 = jnp.zeros((k,), delta.dtype).at[:r].set(delta)
+
+        def step(s, _):
+            return Tm @ s, s[:r]
+
+        _, fpath = jax.lax.scan(step, s0, None, length=horizon)
+        return fpath, fpath @ params.lam.T
+
+    return jax.vmap(one)(shocks)
+
+
+def stress_fan(params: SSMParams, x, horizon: int, shocks):
+    """Stress-path fan: shock the factor innovations by each row of
+    `shocks` (S, r) at the forecast origin and propagate.  Returns
+    (mean (S, h, N), sd (S, h, N), factor_mean (S, h, r)) where mean =
+    baseline conditional mean + shock response — linearity of the state
+    space makes the superposition exact, so one baseline smoother run
+    serves every stress lane."""
+    shocks = jnp.asarray(shocks)
+    if shocks.ndim == 1:
+        shocks = shocks[None]
+    if shocks.ndim != 2 or shocks.shape[1] != params.r:
+        raise ValueError(
+            f"shocks must be (S, r) = (*, {params.r}), got "
+            f"{tuple(shocks.shape)}"
+        )
+    base_mean, base_sd, base_f, _ = conditional_fan(params, x, horizon)
+    f_shift, y_shift = _stress_fan_impl(params, shocks, horizon)
+    return (
+        base_mean + y_shift,
+        jnp.broadcast_to(base_sd, y_shift.shape),
+        base_f + f_shift,
+    )
+
+
+def _forecast_one(lam_i, R_i, A_i, Q_i, s, key, horizon: int):
+    """One posterior-predictive forward simulation: iterate the factor
+    VAR from terminal companion state `s` with fresh innovations, add
+    measurement noise.  (h, N) in standardized units."""
+    params = SSMParams(lam=lam_i, R=R_i, A=A_i, Q=_psd_floor(Q_i))
+    Tm, _ = _companion(params)
+    r = params.r
+    ku, ke = jax.random.split(key)
+    Lq = jnp.linalg.cholesky(params.Q)
+    u = jax.random.normal(ku, (horizon, r), lam_i.dtype) @ Lq.T
+
+    def step(s_prev, u_t):
+        s_t = (Tm @ s_prev).at[:r].add(u_t)
+        return s_t, s_t[:r]
+
+    _, f_path = jax.lax.scan(step, s, u)
+    eps = jax.random.normal(ke, (horizon, lam_i.shape[0]), lam_i.dtype)
+    return f_path @ lam_i.T + eps * jnp.sqrt(R_i)
+
+
+@partial(jax.jit, static_argnames=("horizon",))
+def _forecast_fan_impl(lam_d, r_d, a_d, q_d, s_term, keys, horizon: int):
+    return jax.vmap(
+        lambda l, R, A, Q, s, k: _forecast_one(l, R, A, Q, s, k, horizon)
+    )(lam_d, r_d, a_d, q_d, s_term, keys)
+
+
+def forecast_fan(lam_d, r_d, a_d, q_d, s_term, keys, horizon: int):
+    """Forward-simulation fan over D parameter draws (kernel
+    "scenario_fan"): the `posterior_forecast` device program, shared
+    with scenario draw requests.  lam_d (D, N, r), r_d (D, N), a_d
+    (D, p, r, r), q_d (D, r, r), s_term (D, r*p), keys (D, 2); returns
+    (D, h, N) standardized predictive draws."""
+    from ..utils.compile import aot_call, aot_statics
+
+    return aot_call(
+        "scenario_fan",
+        lambda *a: _forecast_fan_impl(*a, horizon=horizon),
+        lam_d, r_d, a_d, q_d, s_term, keys,
+        statics=aot_statics(horizon),
+    )

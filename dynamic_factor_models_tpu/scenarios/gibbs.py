@@ -1,0 +1,190 @@
+"""Guarded multi-chain Gibbs: n_chains DFM samplers in one device program.
+
+`models/bayes._chain` runs one Gibbs chain as a pair of ``lax.scan``s
+(carry-only burn-in, then a keep-phase scan materializing every thin-th
+sweep).  Here the same sweep — key-split for key-split, so a healthy run
+reproduces the single-chain draws — advances ALL chains together: the
+scans stay on the outside and every sweep body is one
+``jax.vmap(_gibbs_sweep)`` over the chain axis, the structure of the
+batched multi-tenant EM loop (models/emloop._em_while_batched_impl).
+
+The point of the restructure is the per-chain health sentinel.  Gibbs
+log-likelihoods are stochastic, so unlike EM there is no monotonicity
+check — but a non-finite draw (exploding factor path, failed Cholesky)
+means the chain has left the posterior and every subsequent sweep is
+garbage.  After each vmapped sweep a per-lane finiteness check
+(utils.guards.batched_tree_finite) marks such chains: the lane's carry is
+rolled back to the last-good (key, params) and FROZEN — subsequent
+sweeps still ride through the vmapped body (batched shapes are static)
+but every result is discarded by the per-lane select, so surviving
+chains' draws are bit-identical to a run without the divergence (vmap is
+elementwise across lanes; pinned by tests/test_scenario_engine.py).  The
+caller drops frozen chains from the posterior host-side.
+
+``DFM_FAULTS=nan_draw@k`` (utils/faults) NaNs chain 0's k-th sweep's
+factor draw — the deterministic divergent-chain drill.  The injection is
+a compiled STATIC: 0 compiles no injection code, so production programs
+are byte-identical to pre-guard ones.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.bayes import _gibbs_sweep
+from ..utils import faults as _faults
+from ..utils import guards as _guards
+from ..utils.telemetry import run_record
+
+__all__ = ["MultiChainResult", "sample_chains"]
+
+
+class MultiChainResult(NamedTuple):
+    """Stacked multi-chain Gibbs output, chain axis leading everywhere.
+
+    `health` carries utils.guards codes per chain (0 ok; HEALTH_NONFINITE
+    means the chain was rolled back and frozen at the flagged sweep — its
+    draws are stale repeats of the last-good state and must be excluded
+    from the posterior).  `loglik_path` keeps ALL chains, frozen included
+    (a frozen lane shows the injected/diverged sweep, then a constant
+    tail) — the diagnostic trace, not the posterior."""
+
+    factor_draws: jnp.ndarray  # (chains, keep, T, r)
+    lam_draws: jnp.ndarray  # (chains, keep, N, r)
+    r_draws: jnp.ndarray  # (chains, keep, N)
+    a_draws: jnp.ndarray  # (chains, keep, p, r, r)
+    q_draws: jnp.ndarray  # (chains, keep, r, r)
+    loglik_path: jnp.ndarray  # (chains, n_burn + n_keep*thin)
+    health: np.ndarray  # (chains,) guards codes
+
+
+@partial(
+    jax.jit, static_argnames=("n_burn", "n_keep", "thin", "p", "inject_at")
+)
+def _multi_chain(
+    keys,
+    params0,
+    xz,
+    m,
+    n_burn: int,
+    n_keep: int,
+    thin: int,
+    p: int,
+    priors: tuple,
+    inject_at: int = 0,
+):
+    """All chains through the burn + keep scans together, guarded.
+
+    `keys` (C, 2) per-chain PRNG keys (shard this axis over a mesh to
+    spread chains across devices); `params0` the shared init (broadcast
+    to the chain axis inside).  Sweep indices ride the scans as xs so the
+    global 1-based sweep number reaches the injection site; memory holds
+    n_keep draws per chain, exactly like the single-chain program."""
+    C = keys.shape[0]
+    params_C = jax.tree_util.tree_map(
+        lambda a: jnp.broadcast_to(a, (C,) + a.shape), params0
+    )
+    vsweep = jax.vmap(
+        lambda k, pa: _gibbs_sweep((k, pa), xz, m, p, priors)
+    )
+
+    def gsweep(carry, i):
+        (ks, ps), health = carry
+        (nks, nps), (f, lam, R, A, Q, ll) = vsweep(ks, ps)
+        if inject_at:
+            hit = i + 1 == inject_at
+            f = f.at[0].set(
+                jnp.where(hit, jnp.full_like(f[0], jnp.nan), f[0])
+            )
+            ll = ll.at[0].set(jnp.where(hit, jnp.nan, ll[0]))
+        finite = _guards.batched_tree_finite((f, lam, R, A, Q)) & (
+            jnp.isfinite(ll)
+        )
+        ok = health == _guards.HEALTH_OK
+        adv = ok & finite
+        ks2, ps2 = _guards.batched_where(adv, (nks, nps), (ks, ps))
+        health = jnp.where(
+            ok & ~finite, _guards.HEALTH_NONFINITE, health
+        ).astype(jnp.int32)
+        return ((ks2, ps2), health), (f, lam, R, A, Q, ll)
+
+    def sweep_ll(carry, i):
+        carry, outs = gsweep(carry, i)
+        return carry, outs[5]
+
+    def keep_body(carry, base):
+        carry, lls_thin = jax.lax.scan(
+            sweep_ll, carry, base + jnp.arange(thin - 1)
+        )
+        carry, outs = gsweep(carry, base + thin - 1)
+        return carry, (
+            outs[:5],
+            jnp.concatenate([lls_thin, outs[5][None]], axis=0),
+        )
+
+    carry = ((keys, params_C), jnp.zeros((C,), jnp.int32))
+    carry, ll_burn = jax.lax.scan(sweep_ll, carry, jnp.arange(n_burn))
+    bases = n_burn + jnp.arange(n_keep) * thin
+    carry, (kept, ll_keep) = jax.lax.scan(keep_body, carry, bases)
+    _, health = carry
+    # scan stacks sweeps leading: (keep, C, ...) -> (C, keep, ...);
+    # lls (n_burn, C) + (keep, thin, C) -> (C, n_burn + keep*thin)
+    kept = tuple(jnp.swapaxes(a, 0, 1) for a in kept)
+    lls = jnp.concatenate(
+        [ll_burn, ll_keep.reshape(-1, C)], axis=0
+    ).T
+    return kept + (lls, health)
+
+
+def sample_chains(
+    keys,
+    params0,
+    xz,
+    m,
+    n_burn: int,
+    n_keep: int,
+    thin: int,
+    p: int,
+    priors: tuple,
+) -> MultiChainResult:
+    """Run the guarded multi-chain sampler; the `estimate_dfm_bayes`
+    device path.  Applies the active fault plan (``nan_draw@k``) as a
+    compile-time static and brackets the run in a RunRecord so divergent
+    chains show up in `telemetry summarize` next to EM faults."""
+    plan = _faults.active_plan()
+    inject_at = plan.nan_draw or 0
+    C = int(keys.shape[0])
+    with run_record(
+        "gibbs_multichain",
+        kind="scenario",
+        config={
+            "n_chains": C,
+            "n_sweeps": n_burn + n_keep * thin,
+            "n_keep": n_keep,
+        },
+    ) as rec:
+        if inject_at:
+            _faults.fault_fired("nan_draw")
+        f, lam, R, A, Q, lls, health = _multi_chain(
+            keys, params0, xz, m, n_burn, n_keep, thin, p, priors,
+            inject_at,
+        )
+        health = np.asarray(health)
+        n_bad = int((health != _guards.HEALTH_OK).sum())
+        if n_bad:
+            from ..utils.telemetry import inc
+
+            inc("gibbs_guard.chains_dropped", n_bad)
+        rec.set(
+            final_loglik=float(np.asarray(lls)[health == 0, -1].max())
+            if (health == 0).any()
+            else None,
+            chains_unhealthy=n_bad,
+            faults_detected=n_bad or None,
+        )
+    return MultiChainResult(f, lam, R, A, Q, lls, health)

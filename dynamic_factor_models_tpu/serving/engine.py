@@ -5,13 +5,17 @@ FilterState), routes requests, and brackets every request in a telemetry
 RunRecord so the `telemetry summarize` CLI sees serving traffic next to
 EM runs.  Request dicts:
 
-    {"kind": "tick",    "tenant": id, "x": (N,) row, "mask": (N,) bool}
-    {"kind": "nowcast", "tenant": id, "horizon": h}
-    {"kind": "refit",   "tenant": id}
+    {"kind": "tick",     "tenant": id, "x": (N,) row, "mask": (N,) bool}
+    {"kind": "nowcast",  "tenant": id, "horizon": h}
+    {"kind": "refit",    "tenant": id}
+    {"kind": "scenario", "tenant": id, "scenario": {"kind": ..., ...}}
 
 `tick` is the O(1) constant-gain update (serving/online.py) — no refit,
 no refactorization; `refit` only QUEUES the tenant, and `flush_refits()`
 executes the queue batched per (T, N) compile bucket (serving/batch.py).
+`scenario` hands the inner dict to scenarios.run_scenario against the
+tenant's current fit and panel — conditional/stress/draw fans and
+batched news, each one vmapped device program (see docs/scenarios.md).
 A tenant whose batched refit trips the health sentinel keeps its previous
 fit (the rollback already happened inside the loop; the engine just
 declines to install the frozen iterate) — its bucket-mates are installed
@@ -132,6 +136,8 @@ class ServingEngine:
             return self._nowcast(tenant_id, int(req.get("horizon", 0)))
         if kind == "refit":
             return self._queue_refit(tenant_id)
+        if kind == "scenario":
+            return self._scenario(tenant_id, req.get("scenario") or {})
         raise ValueError(f"unknown request kind {kind!r}")
 
     def _tick(self, tenant_id: str, x_t, mask_t=None) -> FilterState:
@@ -153,6 +159,27 @@ class ServingEngine:
             config={"tenant": tenant_id, "horizon": horizon},
         ):
             return nowcast(ten.model, ten.state, horizon)
+
+    def _scenario(self, tenant_id: str, spec: dict):
+        """Run a scenario fan against the tenant's current fit + panel.
+        `spec` supplies ScenarioRequest fields by name; unknown fields
+        raise (TypeError from the NamedTuple) rather than being dropped
+        silently."""
+        from ..scenarios import ScenarioRequest, run_scenario
+
+        ten = self._tenants[tenant_id]
+        req = ScenarioRequest(**spec)
+        with run_record(
+            "serving", kind="scenario",
+            config={
+                "tenant": tenant_id,
+                "scenario": req.kind,
+                "horizon": int(req.horizon),
+                "n_draws": int(req.n_draws or 0),
+            },
+        ):
+            x = np.where(ten.mask, ten.x, np.nan)
+            return run_scenario(ten.params, x, req)
 
     def _queue_refit(self, tenant_id: str) -> int:
         if tenant_id not in self._refit_queue:
@@ -279,6 +306,18 @@ def main(argv=None) -> int:
             }
             for tid, r in sorted(results.items())
         },
+    }))
+
+    sc = eng.handle({
+        "kind": "scenario", "tenant": "tenant0",
+        "scenario": {
+            "kind": "stress", "horizon": 6,
+            "shocks": np.eye(4)[:2].tolist(),
+        },
+    })
+    print(json.dumps({
+        "phase": "scenario", "scenario": "stress",
+        "fan_shape": list(np.asarray(sc.mean).shape),
     }))
     return 0
 

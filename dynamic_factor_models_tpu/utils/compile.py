@@ -458,6 +458,17 @@ class CompileSpec:
     # stacked panels.  Both default off so existing specs are unchanged.
     serving_period: int = 0
     em_batch: int = 0
+    # scenario engine (scenarios/): scenario_draws > 0 adds the fan-out
+    # kernels — "scenario_fan" (the posterior_forecast / draw-fan forward
+    # simulation over scenario_draws parameter draws), "scenario_cond_fan"
+    # and "scenario_draw_fan" (scenario_paths conditioning lanes through
+    # the masked smoother at scenario_horizon).  The registry key carries
+    # the bucketed panel shape via the traced avals and the draw/path
+    # counts via the leading axes, so one spec serves every request of
+    # the same fan size.  Default off so existing specs are unchanged.
+    scenario_draws: int = 0
+    scenario_paths: int = 8
+    scenario_horizon: int = 12
 
     def padded_shape(self) -> tuple:
         if not self.bucket:
@@ -901,6 +912,65 @@ def _kernel_plan(spec: CompileSpec):
             # injection-free; a DFM_FAULTS run compiles live
             aot_statics(ssm.em_step_stats, spec.max_em_iter, 0),
             batched_loop_inputs,
+        )
+
+    if spec.scenario_draws > 0:
+        # lazy import: scenarios.fanout imports this module for aot_call
+        from ..scenarios import fanout
+
+        D = spec.scenario_draws
+        S = spec.scenario_paths
+        h = spec.scenario_horizon
+        k = r * p
+        xs_s = _sds((S, Tb + h, Nb), dt)
+        ms_s = _sds((S, Tb + h, Nb), jnp.bool_)
+
+        def cond_inputs():
+            pa, x, mask, _ = em_inputs()
+            return (pa,) + fanout.extend_panel(
+                jnp.where(mask, x, jnp.nan), h,
+                jnp.full((S, h, Nb), jnp.nan, dt),
+            )
+
+        plans["scenario_cond_fan"] = (
+            fanout._conditional_fan_impl,
+            (params_s, xs_s, ms_s),
+            {"horizon": h},
+            aot_statics(h),
+            cond_inputs,
+        )
+
+        def draw_inputs():
+            keys = jax.random.split(
+                jax.random.PRNGKey(0), S * D
+            ).reshape(S, D, 2)
+            return cond_inputs() + (keys,)
+
+        plans["scenario_draw_fan"] = (
+            fanout._draw_fan_impl,
+            (params_s, xs_s, ms_s, _sds((S, D, 2), jnp.uint32)),
+            {"horizon": h},
+            aot_statics(h),
+            draw_inputs,
+        )
+
+        def fan_inputs():
+            pa, _, _, _ = em_inputs()
+            stk = lambda a: jnp.broadcast_to(a, (D,) + a.shape)  # noqa: E731
+            return (
+                stk(pa.lam), stk(pa.R), stk(pa.A), stk(pa.Q),
+                jnp.zeros((D, k), dt),
+                jax.random.split(jax.random.PRNGKey(1), D),
+            )
+
+        plans["scenario_fan"] = (
+            fanout._forecast_fan_impl,
+            (_sds((D, Nb, r), dt), _sds((D, Nb), dt),
+             _sds((D, p, r, r), dt), _sds((D, r, r), dt),
+             _sds((D, k), dt), _sds((D, 2), jnp.uint32)),
+            {"horizon": h},
+            aot_statics(h),
+            fan_inputs,
         )
 
     return plans

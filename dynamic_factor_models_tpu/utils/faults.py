@@ -17,6 +17,9 @@ Spec grammar (``DFM_FAULTS``, also `inject()` below)::
     chol_fail@k     poison the factor innovation covariance Q entering
                     the k-th EM iteration with NaN, so the filter's
                     Cholesky factorization fails and floods the step
+    nan_draw@k      force chain 0's k-th Gibbs sweep to draw a NaN
+                    factor path (scenarios/gibbs.py multi-chain
+                    sampler) — the divergent-chain drop drill
     ckpt_corrupt@n  after the n-th successful checkpoint chunk save,
                     corrupt the archive in place (truncate to half) —
                     the next resume must quarantine and restart
@@ -25,7 +28,7 @@ Spec grammar (``DFM_FAULTS``, also `inject()` below)::
                     resume must be bit-identical to an unkilled run
 
 Unsuffixed ``ckpt_corrupt`` / ``preempt`` default to n=1; ``nan_estep`` /
-``chol_fail`` require an explicit iteration.
+``chol_fail`` / ``nan_draw`` require an explicit iteration.
 
 By default an in-loop fault (`nan_estep`, `chol_fail`) is TRANSIENT: it
 is baked only into the FIRST guarded-loop attempt's program, so the
@@ -63,7 +66,7 @@ __all__ = [
 _lock = threading.RLock()
 _override: "FaultPlan | None" = None
 
-_KINDS = ("nan_estep", "chol_fail", "ckpt_corrupt", "preempt")
+_KINDS = ("nan_estep", "chol_fail", "nan_draw", "ckpt_corrupt", "preempt")
 # kinds where a bare clause means "at the first site"
 _DEFAULT_SITE = {"ckpt_corrupt": 1, "preempt": 1}
 
@@ -85,10 +88,11 @@ class FaultPlan(NamedTuple):
     chol_fail: int | None = None
     ckpt_corrupt: int | None = None
     preempt: int | None = None
+    nan_draw: int | None = None
     persistent: frozenset = frozenset()
 
     def any(self) -> bool:
-        return any(v is not None for v in self[:4])
+        return any(v is not None for v in self[:5])
 
 
 EMPTY_PLAN = FaultPlan()

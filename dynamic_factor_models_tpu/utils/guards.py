@@ -48,6 +48,8 @@ __all__ = [
     "guards_enabled",
     "drop_tol",
     "tree_finite",
+    "batched_tree_finite",
+    "batched_where",
     "psd_project",
     "ridge_jitter",
     "promote_f64",
@@ -114,6 +116,36 @@ def tree_finite(tree) -> jnp.ndarray:
     for v in leaves[1:]:
         out = out & v
     return out
+
+
+def batched_tree_finite(tree) -> jnp.ndarray:
+    """(B,) bool: per-batch-member finiteness of every inexact leaf —
+    `tree_finite` vectorized over a leading batch axis, so one lane's
+    NaN flags only that lane.  The shared sentinel of the vmapped
+    multi-tenant EM loop (models/emloop.py) and the multi-chain Gibbs
+    sampler (scenarios/gibbs.py)."""
+    checks = [
+        jnp.all(jnp.isfinite(x).reshape(x.shape[0], -1), axis=1)
+        for x in jax.tree_util.tree_leaves(tree)
+        if jnp.issubdtype(jnp.asarray(x).dtype, jnp.inexact)
+    ]
+    out = checks[0]
+    for v in checks[1:]:
+        out = out & v
+    return out
+
+
+def batched_where(cnd, x, y):
+    """Per-lane pytree select: `cnd` (B,) broadcast against every leaf's
+    leading batch axis — lane b takes x's leaves where cnd[b], else y's.
+    Trace-safe; the freeze/rollback select of the batched loops."""
+    return jax.tree_util.tree_map(
+        lambda a, b: jnp.where(
+            cnd.reshape(cnd.shape + (1,) * (a.ndim - 1)), a, b
+        ),
+        x,
+        y,
+    )
 
 
 def psd_project(M: jnp.ndarray, eps: float) -> jnp.ndarray:

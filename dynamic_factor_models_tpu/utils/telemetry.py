@@ -640,15 +640,24 @@ def summarize(path: str, entry: str | None = None) -> str:
         ll = r.get("final_loglik")
         # serving-tick / nowcast records are not EM runs: n_iter,
         # converged, final_loglik are legitimately absent (or null) —
-        # render "-" rather than "None", and never assume wall_s exists
+        # render "-" rather than "None", and never assume wall_s exists.
+        # Scenario records carry fan sizes instead of iterations: show
+        # "<D>d" (draws) or "<S>p" (paths) in the iters column so fans
+        # are sized at a glance next to EM runs.
         it = r.get("n_iter")
+        if it is None:
+            for key, suffix in (("n_draws", "d"), ("n_paths", "p")):
+                v = r.get(key)
+                if isinstance(v, (int, float)) and v:
+                    it = f"{int(v)}{suffix}"
+                    break
         rows.append([
             ts,
             str(r.get("entry", "?")),
             str(r.get("kind") or "-"),
             str(r.get("platform", "?")),
             _shape_str(r),
-            str(it) if isinstance(it, (int, float)) else "-",
+            str(it) if isinstance(it, (int, float, str)) else "-",
             {True: "y", False: "n"}.get(r.get("converged"), "-"),
             f"{ll:.5g}" if isinstance(ll, (int, float)) else "-",
             f"{r.get('wall_s') or 0.0:.3f}",

@@ -1,0 +1,358 @@
+"""Scenario engine (scenarios/): vmapped multi-chain Gibbs with guarded
+divergence dropping, conditional/stress/draw fan-out, batched news, and
+the serving + AOT wiring.
+
+The two load-bearing pins:
+
+* chain parity — every lane of the scan-outside/vmap-inside multi-chain
+  program reproduces a sequential `models.bayes._chain` run of the same
+  key (1e-10);
+* the divergence drill — a ``nan_draw@k`` injection freezes exactly the
+  hit chain, and the surviving chains' draws are BIT-identical to a
+  fault-free run (vmap lanes are elementwise; dropping happens host-side
+  after normalization, never by reshaping the device batch).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dynamic_factor_models_tpu.models.bayes import (
+    BayesPriors,
+    _chain,
+    ess,
+    estimate_dfm_bayes,
+    rhat,
+)
+from dynamic_factor_models_tpu.models.dfm import DFMConfig
+from dynamic_factor_models_tpu.models.forecast import conditional_forecast
+from dynamic_factor_models_tpu.models.news import (
+    nowcast_news,
+    nowcast_news_batch,
+)
+from dynamic_factor_models_tpu.models.ssm import SSMParams
+from dynamic_factor_models_tpu.scenarios import (
+    ScenarioRequest,
+    conditional_fan,
+    draw_fan,
+    run_scenario,
+    sample_chains,
+    stress_fan,
+)
+from dynamic_factor_models_tpu.utils import faults
+
+pytestmark = pytest.mark.scenario_engine
+
+
+def _params(N=8, r=2, p=2, seed=0):
+    rng = np.random.default_rng(seed)
+    return SSMParams(
+        lam=jnp.asarray(rng.standard_normal((N, r))),
+        R=jnp.ones(N),
+        A=jnp.zeros((p, r, r)).at[0].set(0.5 * jnp.eye(r)),
+        Q=jnp.eye(r),
+    )
+
+
+def _panel(params, T=60, miss=0.1, seed=0):
+    rng = np.random.default_rng(seed)
+    N, r = params.lam.shape
+    f = rng.standard_normal((T, r)).cumsum(0) * 0.3
+    x = f @ np.asarray(params.lam).T + rng.standard_normal((T, N))
+    x[rng.random((T, N)) < miss] = np.nan
+    return x
+
+
+def _prior_tuple():
+    pr = BayesPriors()
+    return (
+        float(pr.lam_scale), float(pr.r_shape), float(pr.r_rate),
+        float(pr.q_df_extra), float(pr.q_scale),
+    )
+
+
+@pytest.fixture(scope="module")
+def gibbs_setup():
+    params = _params()
+    x = _panel(params, miss=0.0)
+    xz = jnp.asarray((x - x.mean(0)) / x.std(0))
+    m = jnp.ones(xz.shape)
+    kw = dict(n_burn=10, n_keep=8, thin=2, p=2, priors=_prior_tuple())
+    keys = jax.random.split(jax.random.PRNGKey(0), 4)
+    return params, xz, m, keys, kw
+
+
+class TestMultiChainGibbs:
+    def test_parity_with_sequential_chains(self, gibbs_setup):
+        """Each vmapped lane == a sequential single-chain run (1e-10)."""
+        params, xz, m, keys, kw = gibbs_setup
+        mc = sample_chains(keys, params, xz, m, **kw)
+        assert (mc.health == 0).all()
+        stack = (mc.factor_draws, mc.lam_draws, mc.r_draws,
+                 mc.a_draws, mc.q_draws)
+        for c in range(4):
+            ref = _chain(keys[c], params, xz, m, **kw)
+            for a, b in zip(ref[:5], stack):
+                np.testing.assert_allclose(
+                    np.asarray(a), np.asarray(b[c]), atol=1e-10
+                )
+            np.testing.assert_allclose(
+                np.asarray(ref[5]), np.asarray(mc.loglik_path[c]),
+                atol=1e-10,
+            )
+
+    def test_divergent_chain_frozen_survivors_bit_identical(
+        self, gibbs_setup
+    ):
+        """nan_draw@3 hits chain 0 at global sweep 3: that chain is
+        flagged and frozen (finite stale draws, constant loglik tail),
+        and chains 1..3 match the clean run bit for bit."""
+        params, xz, m, keys, kw = gibbs_setup
+        clean = sample_chains(keys, params, xz, m, **kw)
+        with faults.inject("nan_draw@3"):
+            inj = sample_chains(keys, params, xz, m, **kw)
+        assert inj.health[0] == 1 and (inj.health[1:] == 0).all()
+        for a, b in zip(clean[:5], inj[:5]):
+            np.testing.assert_array_equal(
+                np.asarray(a[1:]), np.asarray(b[1:])
+            )
+        ll0 = np.asarray(inj.loglik_path[0])
+        assert np.isnan(ll0[2])  # the injected sweep (1-based 3)
+        # frozen: post-hit sweeps rerun from the rolled-back state
+        assert np.ptp(ll0[3:]) == 0.0
+        # stale-but-finite kept draws (keep phase starts after the hit)
+        assert np.isfinite(np.asarray(inj.factor_draws[0])).all()
+
+    def test_estimate_drops_divergent_chain(self):
+        """Public API: the hit chain is excluded from the posterior,
+        health and the full loglik trace are reported."""
+        x = _panel(_params(N=12, r=1), T=120, miss=0.0)
+        args = (
+            jnp.asarray(x), np.ones(12, np.int64), 0, 119,
+            DFMConfig(nfac_u=1, n_factorlag=1),
+        )
+        kw = dict(n_keep=10, n_burn=10, n_chains=3, seed=0)
+        clean = estimate_dfm_bayes(*args, **kw)
+        with faults.inject("nan_draw@5"):
+            res = estimate_dfm_bayes(*args, **kw)
+        assert list(res.chain_health) == [1, 0, 0]
+        assert res.factor_draws.shape[0] == 2
+        assert res.loglik_path.shape == (3, 20)
+        np.testing.assert_array_equal(
+            np.asarray(res.factor_draws),
+            np.asarray(clean.factor_draws[1:]),
+        )
+        assert np.isfinite(res.rhat_loglik)
+        with faults.inject("nan_draw@5"):
+            with pytest.raises(RuntimeError, match="every Gibbs chain"):
+                estimate_dfm_bayes(*args, n_keep=10, n_burn=10,
+                                   n_chains=1, seed=0)
+
+    def test_nan_draw_grammar(self):
+        plan = faults.parse_spec("nan_draw@7")
+        assert plan.nan_draw == 7 and plan.any()
+        with pytest.raises(ValueError):
+            faults.parse_spec("nan_draw")  # explicit site required
+
+
+class TestDiagnostics:
+    def test_rhat_shape_dispatch(self):
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((4, 500))
+        assert isinstance(rhat(x), float) and rhat(x) < 1.05
+        # 1-D draws: one chain split in halves — still a float
+        assert isinstance(rhat(x[0]), float)
+        r3 = rhat(rng.standard_normal((4, 500, 3)))
+        assert np.asarray(r3).shape == (3,)
+        # a mean-shifted chain must blow split-Rhat up
+        y = x.copy()
+        y[0] += 10.0
+        assert rhat(y) > 1.5
+
+    def test_ess_sane(self):
+        rng = np.random.default_rng(1)
+        x = rng.standard_normal((4, 500))
+        e = ess(x)
+        assert 0 < e <= 2000.0
+        # heavy autocorrelation collapses the effective sample size
+        ar = np.zeros((2, 500))
+        eps = rng.standard_normal((2, 500))
+        for t in range(1, 500):
+            ar[:, t] = 0.98 * ar[:, t - 1] + eps[:, t]
+        assert ess(ar) < ess(x) / 4
+        assert np.asarray(ess(rng.standard_normal((2, 200, 3)))).shape \
+            == (3,)
+
+
+class TestFanout:
+    def test_conditional_fan_matches_looped_forecast(self):
+        """Every fan lane == conditional_forecast of that lane (1e-12)."""
+        params = _params()
+        x = _panel(params)
+        h, N = 6, params.lam.shape[0]
+        rng = np.random.default_rng(2)
+        cond = np.full((3, h, N), np.nan)
+        cond[1, 0, :2] = 1.5  # pin two series one step out
+        cond[2, :, 0] = rng.standard_normal(h)
+        mean, sd, f, Pf = conditional_fan(params, x, h, cond)
+        assert mean.shape == (3, h, N)
+        for s in range(3):
+            ref = conditional_forecast(params, x, h, cond[s])
+            np.testing.assert_allclose(
+                np.asarray(mean[s]), np.asarray(ref.mean), atol=1e-12
+            )
+            np.testing.assert_allclose(
+                np.asarray(sd[s]), np.asarray(ref.sd), atol=1e-12
+            )
+            np.testing.assert_allclose(
+                np.asarray(f[s]), np.asarray(ref.factor_mean),
+                atol=1e-12,
+            )
+
+    def test_draw_fan_shapes_and_reproducibility(self):
+        params = _params()
+        x = _panel(params)
+        f1, y1, ll1 = draw_fan(params, x, 4, 16, seed=7)
+        f2, y2, _ = draw_fan(params, x, 4, 16, seed=7)
+        assert y1.shape == (1, 16, 4, 8) and f1.shape == (1, 16, 4, 2)
+        np.testing.assert_array_equal(np.asarray(y1), np.asarray(y2))
+        assert np.isfinite(np.asarray(ll1)).all()
+        # draw spread brackets the smoothed mean
+        mean, *_ = conditional_fan(params, x, 4)
+        dm = np.asarray(y1).mean(axis=1)
+        assert np.abs(dm - np.asarray(mean)).max() < 2.0
+
+    def test_stress_fan_superposition(self):
+        """Zero shock == baseline; response is linear in the shock."""
+        params = _params()
+        x = _panel(params)
+        shocks = np.vstack([np.zeros(2), np.eye(2), 2 * np.eye(2)[:1]])
+        mean, sd, f = stress_fan(params, x, 5, shocks)
+        base, base_sd, base_f = (
+            np.asarray(mean[0]), np.asarray(sd[0]), np.asarray(f[0])
+        )
+        b0, *_ = conditional_fan(params, x, 5)
+        np.testing.assert_allclose(base, np.asarray(b0[0]), atol=1e-12)
+        np.testing.assert_allclose(
+            np.asarray(mean[3]) - base, 2 * (np.asarray(mean[1]) - base),
+            atol=1e-10,
+        )
+        np.testing.assert_array_equal(np.asarray(sd[1]), base_sd)
+
+    def test_news_batch_matches_scalar(self):
+        params = _params(N=6)
+        T, N = 40, 6
+        x_new = _panel(params, T=T, miss=0.0, seed=3)
+        x_new[-1, :2] = np.nan
+        x_old = x_new.copy()
+        x_old[-1, 2] = np.nan
+        x_old[-2, 3] = np.nan
+        targets = [(T - 1, 0), (T - 1, 1)]
+        nb = nowcast_news_batch(params, x_old, x_new, targets)
+        assert nb.news.shape == (2, 2)
+        for j, tgt in enumerate(targets):
+            sc = nowcast_news(params, x_old, x_new, tgt)
+            np.testing.assert_allclose(
+                np.asarray(sc.news), np.asarray(nb.news[:, j]),
+                atol=1e-12,
+            )
+            assert abs(sc.total_revision - nb.total_revision[j]) < 1e-12
+        # telescoping exactness per target
+        np.testing.assert_allclose(
+            np.asarray(nb.news).sum(0), nb.total_revision, atol=1e-10
+        )
+
+
+class TestScenarioAPI:
+    def test_run_scenario_dispatch(self):
+        params = _params()
+        x = _panel(params)
+        res = run_scenario(params, x, ScenarioRequest(
+            kind="conditional_fan", horizon=4, n_draws=5,
+        ))
+        assert res.mean.shape == (1, 4, 8)
+        assert res.draws.shape == (1, 5, 4, 8)
+        res = run_scenario(params, x, ScenarioRequest(
+            kind="stress", horizon=3, shocks=np.eye(2),
+        ))
+        assert res.mean.shape == (2, 3, 8) and res.draws is None
+        with pytest.raises(ValueError, match="unknown scenario kind"):
+            run_scenario(params, x, ScenarioRequest(kind="frobnicate"))
+        with pytest.raises(ValueError, match="shocks"):
+            run_scenario(params, x, ScenarioRequest(kind="stress"))
+        with pytest.raises(ValueError, match="n_draws"):
+            run_scenario(params, x, ScenarioRequest(kind="draw_fan"))
+
+    def test_engine_scenario_route(self):
+        from dynamic_factor_models_tpu.serving.engine import ServingEngine
+
+        rng = np.random.default_rng(5)
+        T, N = 48, 8
+        x = (rng.standard_normal((T, 4)).cumsum(0) * 0.1
+             @ rng.standard_normal((N, 4)).T
+             + 0.5 * rng.standard_normal((T, N)))
+        eng = ServingEngine()
+        eng.register("acme", x)
+        res = eng.handle({
+            "kind": "scenario", "tenant": "acme",
+            "scenario": {"kind": "stress", "horizon": 6,
+                         "shocks": np.eye(4)[:2].tolist()},
+        })
+        assert np.asarray(res.mean).shape == (2, 6, N)
+        res = eng.handle({
+            "kind": "scenario", "tenant": "acme",
+            "scenario": {"kind": "draw_fan", "horizon": 4, "n_draws": 6},
+        })
+        assert np.asarray(res.draws).shape == (1, 6, 4, N)
+        with pytest.raises(ValueError, match="unknown scenario kind"):
+            eng.handle({"kind": "scenario", "tenant": "acme",
+                        "scenario": {"kind": "nope"}})
+        with pytest.raises(TypeError):  # unknown field rejected loudly
+            eng.handle({"kind": "scenario", "tenant": "acme",
+                        "scenario": {"kind": "stress", "bogus": 1}})
+
+    def test_aot_registration_serves_fans(self):
+        """precompile(CompileSpec(scenario_draws=...)) registers the
+        three fan kernels; matching production calls dispatch to the
+        executables (aot_hits) instead of re-tracing."""
+        from dynamic_factor_models_tpu.scenarios.fanout import (
+            forecast_fan,
+        )
+        from dynamic_factor_models_tpu.utils.compile import (
+            CompileSpec,
+            counters,
+            precompile,
+        )
+
+        params = _params(N=6)
+        x = _panel(params, T=32, miss=0.0, seed=9)
+        rep = precompile(CompileSpec(
+            T=32, N=6, r=2, p=2, dtype="float64", kernels=(),
+            bucket=False, scenario_draws=8, scenario_paths=2,
+            scenario_horizon=5,
+        ))
+        assert {"scenario_fan", "scenario_cond_fan",
+                "scenario_draw_fan"} <= set(rep["kernels"])
+
+        def hits(name):
+            return counters()[name]["aot_hits"]
+
+        h0 = hits("scenario_cond_fan")
+        conditional_fan(params, x, 5, np.full((2, 5, 6), np.nan))
+        assert hits("scenario_cond_fan") == h0 + 1
+        h0 = hits("scenario_draw_fan")
+        draw_fan(params, x, 5, 8, np.full((2, 5, 6), np.nan))
+        assert hits("scenario_draw_fan") == h0 + 1
+        h0 = hits("scenario_fan")
+        D = 8
+        forecast_fan(
+            jnp.broadcast_to(params.lam, (D, 6, 2)),
+            jnp.broadcast_to(params.R, (D, 6)),
+            jnp.broadcast_to(params.A, (D, 2, 2, 2)),
+            jnp.broadcast_to(params.Q, (D, 2, 2)),
+            jnp.zeros((D, 4)),
+            jax.random.split(jax.random.PRNGKey(0), D),
+            5,
+        )
+        assert hits("scenario_fan") == h0 + 1

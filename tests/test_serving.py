@@ -439,5 +439,8 @@ def test_serve_cli_demo(capsys):
     assert rc == 0
     phases = [_json.loads(ln) for ln in
               capsys.readouterr().out.strip().splitlines()]
-    assert [p["phase"] for p in phases] == ["register", "ticks", "refit"]
+    assert [p["phase"] for p in phases] == [
+        "register", "ticks", "refit", "scenario"
+    ]
     assert set(phases[2]["results"]) == {"tenant0", "tenant1"}
+    assert phases[3]["fan_shape"] == [2, 6, 6]
