@@ -122,7 +122,6 @@ def _em_while_guarded_impl(
     args,
     tol,
     drop_tol,
-    resume_from,
     max_em_iter: int,
     stop_at,
     heartbeat_every: int = 0,
@@ -130,48 +129,72 @@ def _em_while_guarded_impl(
     inject_chol_at: int = 0,
 ):
     """Guarded on-device EM loop: `_em_while_impl` semantics plus the
-    utils.guards sentinel folded into the carry.
+    utils.guards sentinel AND the first two recovery-ladder rungs folded
+    into the carry.
 
-    Carry: (params, prev_params, ll_prev, ll, it, path, health).  Each
-    body call evaluates the step; when the new log-likelihood or any new
-    parameter leaf is non-finite, or the log-likelihood DROPS by more
-    than `drop_tol * (1 + |ll|)` (EM is monotone; the relative slack
-    covers f32 roundoff and the steady tail's approximate moments), the
-    carry is frozen with params rolled back to `prev_params`, `it` not
-    advanced, and `health` set (1 non-finite, 2 monotonicity) — the cond
-    then exits immediately and the host-side recovery ladder takes over.
+    Carry: (params, prev_params, ll_prev, ll, it, path, health, rung,
+    trips, resume_from).  Each body call evaluates the step; when the new
+    log-likelihood or any new parameter leaf is non-finite, or the
+    log-likelihood DROPS by more than `drop_tol * (1 + |ll|)` (EM is
+    monotone; the relative slack covers f32 roundoff and the steady
+    tail's approximate moments), the iterate is rolled back to
+    `prev_params` and one of two things happens ON DEVICE:
 
-    `resume_from` (traced) is the iteration count at the last ladder
-    resume: the two-loglik convergence bootstrap and the monotonicity
-    baseline both restart there, so a rung's first post-resume step is
-    never judged against the pre-trip trajectory (0 for a fresh run,
+    - `rung < guards.N_TRACED_RUNGS`: the jitter / jitter_grown repair
+      (`guards.ridge_jitter` with the traced rung) is applied to the
+      rolled-back params inside a `lax.cond` (the healthy path never
+      evaluates it), `rung`/`trips` advance, `resume_from` is reset to
+      the current iteration, and the loop CONTINUES — a jitter-recovered
+      run completes in one dispatch with zero device->host transfers per
+      iteration, exactly like a healthy run (pinned in
+      tests/test_perf_regression.py).
+    - otherwise the carry is frozen with `health` set (1 non-finite, 2
+      monotonicity), the cond exits, and the host ladder takes over for
+      the step/dtype-changing rungs (demote, promote_f64).
+
+    `resume_from` rides the carry (it used to be a traced argument): the
+    two-loglik convergence bootstrap and the monotonicity baseline both
+    restart at the last resume point, so a rung's first post-resume step
+    is never judged against the pre-trip trajectory (0 for a fresh run,
     reproducing `it <= 1` exactly).
 
     `inject_nan_at` / `inject_chol_at` (static, from utils.faults) bake
     a deterministic fault into THIS program: NaN the k-th iteration's
     log-likelihood, or poison the innovation covariance entering the
-    k-th step so its Cholesky genuinely fails.  At the default 0 the
+    k-th step so its Cholesky genuinely fails.  A POSITIVE k is a
+    transient fault — it fires only while `trips == 0`, i.e. in the
+    first attempt, matching the old host-ladder semantics where the
+    retry program carried no injection; a NEGATIVE k is a persistent
+    fault (`kind@k+`) firing on every in-trace attempt until the host
+    demotes/promotes to a different program.  At the default 0 the
     traced functions are identity and the program carries no fault code.
     """
     dtype = jnp.result_type(tol)
 
     def cond(c):
-        _, _, ll_prev, ll, it, _, health = c
+        _, _, ll_prev, ll, it, _, health, _, _, resume_from = c
         unconverged = (it <= resume_from + 1) | (
             jnp.abs(ll - ll_prev) >= tol * (1.0 + jnp.abs(ll_prev))
         )
         return (health == 0) & unconverged & (it < stop_at)
 
     def body(c):
-        params, prev_params, ll_prev, ll, it, path, health = c
+        (
+            params, prev_params, ll_prev, ll, it, path, health,
+            rung, trips, resume_from,
+        ) = c
         step_in = params
         if inject_chol_at:
-            step_in = _guards.poison_cov(step_in, it + 1 == inject_chol_at)
+            fire = it + 1 == abs(inject_chol_at)
+            if inject_chol_at > 0:
+                fire = fire & (trips == 0)
+            step_in = _guards.poison_cov(step_in, fire)
         new_params, ll_new = step(step_in, *args)
         if inject_nan_at:
-            ll_new = jnp.where(
-                it + 1 == inject_nan_at, jnp.full_like(ll_new, jnp.nan), ll_new
-            )
+            fire = it + 1 == abs(inject_nan_at)
+            if inject_nan_at > 0:
+                fire = fire & (trips == 0)
+            ll_new = jnp.where(fire, jnp.full_like(ll_new, jnp.nan), ll_new)
         ll_new = ll_new.astype(dtype)
         nonfinite = (~jnp.isfinite(ll_new)) | (~_guards.tree_finite(new_params))
         drop = (it >= resume_from + 1) & (
@@ -183,8 +206,20 @@ def _em_while_guarded_impl(
             jnp.where(drop, _guards.HEALTH_DECREASE, _guards.HEALTH_OK),
         ).astype(jnp.int32)
         bad = new_health != 0
-        sel = lambda on_bad, on_ok: jax.tree.map(
-            lambda x, y: jnp.where(bad, x, y), on_bad, on_ok
+        recover = bad & (rung < _guards.N_TRACED_RUNGS)
+        freeze = bad & ~recover
+        # device-resident jitter rungs: evaluated only on a tripped
+        # iteration (lax.cond — the healthy path skips the eigh entirely),
+        # applied to the ROLLED-BACK last-good params like the host ladder
+        repaired = jax.lax.cond(
+            recover,
+            lambda p: _guards.ridge_jitter(p, rung),
+            lambda p: p,
+            prev_params,
+        )
+        sel3 = lambda on_freeze, on_recover, on_ok: jax.tree.map(
+            lambda a, b, y: jnp.where(freeze, a, jnp.where(recover, b, y)),
+            on_freeze, on_recover, on_ok,
         )
         if heartbeat_every:
             jax.lax.cond(
@@ -195,13 +230,16 @@ def _em_while_guarded_impl(
                 ll_new,
             )
         return (
-            sel(prev_params, new_params),  # bad step: roll back to last-good
-            sel(prev_params, params),
+            sel3(prev_params, repaired, new_params),
+            sel3(prev_params, repaired, params),
             jnp.where(bad, ll_prev, ll),
             jnp.where(bad, ll, ll_new),
             jnp.where(bad, it, it + 1),
             path.at[it].set(jnp.where(bad, path[it], ll_new)),
-            new_health,
+            jnp.where(freeze, new_health, _guards.HEALTH_OK).astype(jnp.int32),
+            jnp.where(recover, rung + 1, rung),
+            jnp.where(bad, trips + 1, trips),
+            jnp.where(recover, it, resume_from),
         )
 
     return jax.lax.while_loop(cond, body, carry)
@@ -248,7 +286,10 @@ def _fresh_guarded_carry(params, tol, max_em_iter):
         jnp.asarray(jnp.nan, dtype),
         jnp.asarray(0, jnp.int32),
         jnp.full(max_em_iter, jnp.nan, dtype),
-        jnp.asarray(0, jnp.int32),
+        jnp.asarray(0, jnp.int32),  # health
+        jnp.asarray(0, jnp.int32),  # next ladder rung (traced rungs spent)
+        jnp.asarray(0, jnp.int32),  # cumulative sentinel trips
+        jnp.asarray(0, jnp.int32),  # resume_from
     )
 
 
@@ -888,6 +929,17 @@ def _has_f32_leaf(tree) -> bool:
     )
 
 
+def _signed_inj(k, persistent: bool) -> int:
+    """Injection static for the guarded program: 0 = none, +k transient
+    (fires only while the carry's trip counter is zero — the in-trace
+    equivalent of "only the first attempt's program is poisoned"), -k
+    persistent (`kind@k+`: fires on every in-trace attempt until the
+    host demotes/promotes to a different program)."""
+    if not k:
+        return 0
+    return -int(k) if persistent else int(k)
+
+
 def _run_device_guarded(
     step, params, args, tol, max_em_iter, checkpoint_path, checkpoint_every,
     stop_at, trace_name, rec, plan,
@@ -910,21 +962,23 @@ def _run_device_guarded(
         # in-loop injections are STATICS: with no fault plan the compiled
         # guarded program contains no injection code, and its dispatch key
         # (kernel "em_loop_guarded") matches the utils.compile plan
-        inj = (plan.nan_estep or 0, plan.chol_fail or 0)
+        inj = (
+            _signed_inj(plan.nan_estep, "nan_estep" in plan.persistent),
+            _signed_inj(plan.chol_fail, "chol_fail" in plan.persistent),
+        )
         cur_step, cur_args = step, args
 
-        def _run(carry, bound, resume_from, cur_step, cur_args, inj):
+        def _run(carry, bound, cur_step, cur_args, inj):
             statics = aot_statics(
                 cur_step, max_em_iter, donate, heartbeat, inj[0], inj[1]
             )
             return aot_call(
                 "em_loop_guarded",
-                lambda c, a, t, d, r, s: gloop(
-                    cur_step, c, a, t, d, r, max_em_iter, s, heartbeat,
+                lambda c, a, t, d, s: gloop(
+                    cur_step, c, a, t, d, max_em_iter, s, heartbeat,
                     inj[0], inj[1],
                 ),
                 carry, cur_args, tol_arr, drop_arr,
-                jnp.asarray(resume_from, jnp.int32),
                 jnp.asarray(bound, jnp.int32),
                 statics=statics,
             )
@@ -938,20 +992,20 @@ def _run_device_guarded(
             )
             carry = ckpt.resume(carry)
 
-        def _drive(carry, resume_from, cur_step, cur_args, inj):
+        def _drive(carry, cur_step, cur_args, inj):
             """Run to completion / trip, in checkpoint chunks when asked;
             a tripped chunk is NOT saved (the ladder resumes in-process
             and later healthy chunks persist)."""
             if ckpt is None:
                 bound = max_em_iter if stop_at is None else stop_at
-                return _run(carry, bound, resume_from, cur_step, cur_args, inj)
+                return _run(carry, bound, cur_step, cur_args, inj)
             while True:
                 it = int(carry[4])
                 if it >= max_em_iter:
                     return carry
                 carry = _run(
                     carry, min(it + checkpoint_every, max_em_iter),
-                    resume_from, cur_step, cur_args, inj,
+                    cur_step, cur_args, inj,
                 )
                 if int(carry[6]) != _guards.HEALTH_OK:
                     return carry
@@ -961,7 +1015,8 @@ def _run_device_guarded(
 
         faults_detected = 0
         rungs_used = []
-        resume_from = 0
+        traced_recorded = 0
+        trips_seen = 0
         final_health = _guards.HEALTH_OK
         rung_skips = []
         with span(trace_name):
@@ -972,15 +1027,31 @@ def _run_device_guarded(
                     _faults.fault_fired("nan_estep")
                 if inj[1]:
                     _faults.fault_fired("chol_fail")
-                carry = _drive(carry, resume_from, cur_step, cur_args, inj)
+                carry = _drive(carry, cur_step, cur_args, inj)
                 health = int(carry[6])
+                # reconcile the device-resident bookkeeping: sentinel
+                # trips and in-trace jitter rungs accumulated since the
+                # last dispatch (a healthy or jitter-recovered run makes
+                # exactly ONE dispatch — this readback happens after the
+                # loop exits, never per iteration)
+                trips = int(carry[8])
+                if trips > trips_seen:
+                    new_trips = trips - trips_seen
+                    faults_detected += new_trips
+                    inc("em_guard.faults_detected", new_trips)
+                    trips_seen = trips
+                n_traced = min(int(carry[7]), _guards.N_TRACED_RUNGS)
+                for i in range(traced_recorded, n_traced):
+                    rungs_used.append(_guards.LADDER_RUNGS[i])
+                    inc("em_guard.rung." + _guards.LADDER_RUNGS[i])
+                traced_recorded = n_traced
                 if health == _guards.HEALTH_OK:
                     final_health = health
                     break
-                faults_detected += 1
-                inc("em_guard.faults_detected")
                 inc("em_guard.trip." + _guards.HEALTH_NAMES[health])
-                # pick the next applicable rung (each tried exactly once)
+                # the device loop froze only after spending the traced
+                # rungs; pick the next applicable host rung (each tried
+                # exactly once)
                 next_i = (
                     _guards.LADDER_RUNGS.index(rungs_used[-1]) + 1
                     if rungs_used else 0
@@ -1004,43 +1075,44 @@ def _run_device_guarded(
                     break
                 # the device loop already rolled back: carry[0] is last-good
                 last_good, it = carry[0], int(carry[4])
-                if rung == "jitter":
-                    new_params = _guards.ridge_jitter(last_good, 0)
-                elif rung == "jitter_grown":
-                    new_params = _guards.ridge_jitter(last_good, 1)
-                elif rung == "demote":
+                if rung == "demote":
                     new_params = (
                         fallback_unwrap(last_good)
                         if fallback_unwrap is not None else last_good
                     )
                     cur_step = fallback_step
                     cur_args = args if fallback_args is None else fallback_args
-                else:  # promote_f64
+                elif rung == "promote_f64":
                     new_params = _guards.promote_f64(last_good)
                     cur_args = _promote_args_f64(cur_args)
-                # a transient injected fault fires only in the first
-                # attempt's program; a persistent one (`kind@k+`) re-fires
-                # on same-program retries until demote/promote changes the
-                # step or dtype — then it no longer applies by construction
+                else:  # jitter rungs are device-resident; unreachable here
+                    new_params = _guards.ridge_jitter(
+                        last_good, _guards.LADDER_RUNGS.index(rung)
+                    )
+                # a transient injected fault fires only while the trip
+                # counter is zero (baked into the program); a persistent
+                # one (`kind@k+`) re-fires on every attempt until demote/
+                # promote changes the step or dtype — then it no longer
+                # applies by construction
                 if rung in ("demote", "promote_f64"):
                     inj = (0, 0)
-                else:
-                    inj = (
-                        inj[0] if "nan_estep" in plan.persistent else 0,
-                        inj[1] if "chol_fail" in plan.persistent else 0,
-                    )
-                resume_from = it
                 rungs_used.append(rung)
                 inc("em_guard.rung." + rung)
                 carry = (
                     new_params,
                     jax.tree.map(jnp.copy, new_params),
                     carry[2], carry[3], carry[4], carry[5],
-                    jnp.asarray(0, jnp.int32),
+                    jnp.asarray(0, jnp.int32),  # health
+                    # traced rungs stay spent after a host rung: the
+                    # in-trace ladder never re-tries jitter
+                    jnp.asarray(_guards.N_TRACED_RUNGS, jnp.int32),
+                    carry[8],  # cumulative trips
+                    jnp.asarray(it, jnp.int32),  # resume_from
                 )
 
-        params, _, ll_prev, ll, n_iter, path, _ = carry
+        params, _, ll_prev, ll, n_iter, path = carry[:6]
         n_iter = int(n_iter)
+        resume_from = int(carry[9])
         converged = (
             final_health == _guards.HEALTH_OK
             and n_iter >= max(2, resume_from + 2)

@@ -344,6 +344,7 @@ def estimate_mixed_freq_dfm(
     accel: str | None = None,
     gram_dtype: str | None = None,
     bucket=None,
+    n_shards: int | None = None,
 ) -> MFResults:
     """Fit the mixed-frequency DFM on a MONTHLY-frequency (T, N) panel.
 
@@ -363,6 +364,9 @@ def estimate_mixed_freq_dfm(
     monthly-pattern aggregation rows (inert in every moment), padded
     periods are excluded from the factor-VAR moments via `PanelStats.tw`;
     one compiled MF executable then serves every panel in the bucket.
+
+    n_shards is accepted for API symmetry with `ssm.estimate_dfm_em` but
+    only n_shards in (None, 0, 1) is implemented here — see docs/sharding.md.
     """
     from ..utils.compile import (
         bucket_shape,
@@ -383,6 +387,18 @@ def estimate_mixed_freq_dfm(
         )
     if gram_dtype is not None and checkpoint_path is not None:
         raise ValueError("gram_dtype is not combinable with checkpoint_path")
+    if n_shards is not None and int(n_shards) > 1:
+        # the single-frequency collapse shards cleanly because every
+        # series contributes an independent rank-one term; the mixed-freq
+        # observation matrix couples a quarterly series to 5 state lags
+        # through the aggregation row, which still sums over series — but
+        # the padded-agg inertness contract has no sharded test pin yet,
+        # so refuse loudly rather than return silently-unverified numbers
+        raise NotImplementedError(
+            "n_shards > 1 covers the single-frequency EM path "
+            "(ssm.estimate_dfm_em); mixed-frequency sharding is tracked in "
+            "ROADMAP item 2"
+        )
     from ..utils.telemetry import run_record
 
     with on_backend(backend), run_record(

@@ -361,6 +361,72 @@ def _collapse_obs_stats(Hq, R, x, stats: PanelStats):
     return C, b, ld_R, xRx, stats.n_obs, ll_corr
 
 
+def _collapse_obs_stats_partial(Hq, R, x, stats: PanelStats):
+    """Per-shard half of `_collapse_obs_stats`: the two panel GEMMs on a
+    cross-section slice, returned as one packed (T, q(q+1)/2 + 1 + q)
+    payload — [Cu | b] with the fused log|R| column — plus the scalar
+    log-likelihood correction.  Every collapsed statistic is a sum over
+    series, so shard partials reduce EXACTLY: the caller all-reduces the
+    payload across the mesh (`ops.pallas_gram.ring_allreduce`), psums the
+    scalar, and unpacks with `_unpack_collapsed`.  n_obs is NOT part of
+    the payload — it is precomputed globally in PanelStats and rides the
+    replicated spec."""
+    q = Hq.shape[1]
+    iu, iv, _ = _sym_pack_idx(q)
+    pair_R = jnp.concatenate(
+        [(Hq[:, iu] * Hq[:, iv]) / R[:, None], jnp.log(R)[:, None]], axis=1
+    )
+    Cu = stats.m @ pair_R
+    b = x @ (Hq / R[:, None])
+    ll_corr = -0.5 * (stats.Sxx / R).sum()
+    return jnp.concatenate([Cu, b], axis=1), ll_corr
+
+
+def _unpack_collapsed(payload, q: int):
+    """Invert the `_collapse_obs_stats_partial` packing after reduction."""
+    npack = q * (q + 1) // 2
+    _, _, unpack = _sym_pack_idx(q)
+    C = payload[:, unpack].reshape(-1, q, q)
+    ld_R = payload[:, npack]
+    b = payload[:, npack + 1 :]
+    return C, b, ld_R
+
+
+def _filter_scan_collapsed_stats(params, C, b, ld_R, n_obs, ll_corr,
+                                 want_pinv=False):
+    """`_filter_scan`'s scan assembly on pre-reduced collapsed statistics.
+
+    The sharded EM step computes C/b/ld_R as per-shard partials and
+    all-reduces them across the mesh BEFORE the state recursion, which is
+    O(k^3) per step with no N-dependence and therefore runs replicated on
+    every device.  Kept as a separate function — not a refactor of
+    `_filter_scan` — so the single-device program stays byte-identical to
+    its HLO pins.  xRx is identically zero on the stats path (the
+    quadratic is the ll_corr scalar)."""
+    Tm, Qs = _companion(params)
+    k = Tm.shape[0]
+    r = params.r
+    s0, P0 = _init_state(params)
+    dtype = b.dtype
+    xRx = jnp.zeros(b.shape[0], dtype)
+
+    def obs_step(inp, sp):
+        Ct, bt, ld, xr, no = inp
+        f = sp[:r]
+        Cf = jnp.zeros((k, k), dtype).at[:r, :r].set(Ct)
+        rhs = jnp.zeros(k, dtype).at[:r].set(bt - Ct @ f)
+        quad0 = xr - 2.0 * (f @ bt) + f @ Ct @ f
+        return Cf, rhs, ld, quad0, no
+
+    outs = _info_filter_scan(
+        Tm, Qs, (C, b, ld_R, xRx, n_obs), obs_step, s0, P0,
+        want_pinv=want_pinv,
+    )
+    means, covs, pmeans, pcovs, lls = outs[:5]
+    res = KalmanResult(lls.sum() + ll_corr, means, covs, pmeans, pcovs)
+    return (res, outs[5]) if want_pinv else res
+
+
 def _pos_diag(Rf):
     # QR sign convention: flip rows so the triangular factor has a
     # positive diagonal (keeps log-det real and factors comparable)
@@ -1333,6 +1399,74 @@ def em_step_steady(state, x, mask, stats: PanelStats, t_star: int, block: int = 
     return _steady_step_for(int(t_star), int(block))(state, x, mask, stats)
 
 
+@lru_cache(maxsize=None)
+def _sharded_step_for(n_shards: int):
+    """The cross-section-sharded EM step over an ``("data",)`` N-axis mesh
+    of `n_shards` devices — same (params, x, mask, stats) -> (params,
+    loglik) contract as `em_step_stats`, N must be a shard multiple
+    (`estimate_dfm_em(n_shards=)` pads with inert series first).
+
+    Work split per iteration: the Jungbacker-Koopman collapse and the
+    M-step panel GEMMs — everything O(N) — run on local shards; the packed
+    collapse payload is all-reduced once per iteration by the ring kernel
+    (`ops.pallas_gram.ring_allreduce`: Pallas remote-DMA ring on TPU,
+    `lax.psum` on the CPU mesh); the O(k^3) filter/smoother scans and the
+    factor-VAR moments are N-free and run replicated; the loading/R solves
+    are per-series and stay shard-local.  With the guarded while-loop
+    outside, a whole sharded EM run executes with ONE cross-device
+    reduction and ZERO host syncs per iteration.
+
+    lru_cached and named per shard count so `run_em_loop`'s AOT-registry
+    statics key (utils.compile.aot_statics uses __module__ + __qualname__)
+    is stable across processes, like `_steady_step_for`."""
+    from jax.experimental.shard_map import shard_map
+
+    from ..ops.pallas_gram import ring_allreduce
+    from ..parallel.mesh import P, data_mesh
+
+    mesh = data_mesh(n_shards)
+
+    def step(params: SSMParams, x, mask, stats: PanelStats):
+        del mask  # collapse statistics already carry the mask
+        params = params._replace(Q=_psd_floor(params.Q))
+        payload, llc = _collapse_obs_stats_partial(params.lam, params.R, x, stats)
+        payload = ring_allreduce(payload, "data", n_shards)
+        llc = jax.lax.psum(llc, "data")
+        C, b, ld_R = _unpack_collapsed(payload, params.r)
+        filt, pinvs = _filter_scan_collapsed_stats(
+            params, C, b, ld_R, stats.n_obs, llc, want_pinv=True
+        )
+        s_sm, P_sm, lag1 = _smoother_scan(params, filt, pinvs=pinvs)
+        return (
+            _em_m_step(params, x, stats.m, s_sm, P_sm, lag1, stats=stats),
+            filt.loglik,
+        )
+
+    step.__name__ = step.__qualname__ = f"em_step_sharded_d{n_shards}"
+    step.__module__ = __name__
+
+    params_spec = SSMParams(lam=P("data", None), R=P("data"), A=P(), Q=P())
+    stats_spec = PanelStats(
+        m=P(None, "data"), xT=P("data", None), mT=P("data", None),
+        Sxx=P("data"), n_i=P("data"), n_obs=P(),
+        m16=None, x16=None, mT16=None, xT16=None, tw=P(),
+    )
+    return jax.jit(
+        shard_map(
+            step,
+            mesh=mesh,
+            in_specs=(params_spec, P(None, "data"), P(None, "data"), stats_spec),
+            out_specs=(params_spec, P()),
+            check_rep=False,
+        )
+    )
+
+
+def em_step_sharded(params: SSMParams, x, mask, stats: PanelStats, n_shards: int):
+    """One sharded EM iteration (see `_sharded_step_for`)."""
+    return _sharded_step_for(int(n_shards))(params, x, mask, stats)
+
+
 class EMResults(NamedTuple):
     params: SSMParams
     factors: jnp.ndarray  # (T, r) smoothed factors (standardized units)
@@ -1412,6 +1546,7 @@ def estimate_dfm_em(
     accel: str | None = None,
     gram_dtype: str | None = None,
     bucket=None,
+    n_shards: int | None = None,
 ) -> EMResults:
     """State-space DFM via EM on the standardized included panel
     (BASELINE.json config 2: `State-space DFM via EM + Kalman smoother`).
@@ -1452,6 +1587,14 @@ def estimate_dfm_em(
     observation statistic) and `PanelStats.tw` keeps padded periods out
     of the factor-VAR moments; results match the unbucketed run to
     numerical precision (pinned by tests/test_compile_cache.py).
+
+    n_shards > 1 (sequential method only) shards the cross-section over a
+    ``("data",)`` device mesh (`_sharded_step_for`): the panel is padded
+    with inert series up to a shard multiple (`parallel.mesh.series_pad`),
+    the O(N) collapse/M-step work runs shard-local with one ring
+    all-reduce per iteration, and the recovery ladder demotes a tripped
+    sharded run to the exact single-device sequential step.  Parity with
+    the unsharded run is pinned at 1e-10 in tests/test_sharding.py.
     """
     from ..utils.compile import (
         bucket_shape,
@@ -1487,6 +1630,22 @@ def estimate_dfm_em(
             "bucket requires method='sequential' (the PanelStats path "
             "carries the time-validity weight padding needs)"
         )
+    ns = int(n_shards) if n_shards is not None else 0
+    if ns > 1:
+        if method != "sequential":
+            raise ValueError(
+                "n_shards requires method='sequential' (the stats path)"
+            )
+        if gram_dtype is not None:
+            raise ValueError(
+                "n_shards is not combinable with gram_dtype: the bf16 "
+                "panel twins are not sharded"
+            )
+        if ns > jax.device_count():
+            raise ValueError(
+                f"n_shards={ns} exceeds the {jax.device_count()} visible "
+                "devices"
+            )
     from ..utils.telemetry import run_record
 
     with on_backend(backend), run_record(
@@ -1519,18 +1678,34 @@ def estimate_dfm_em(
         fallback_unwrap = None
         if method == "sequential":
             step = em_step_stats
-            if buckets is not None:
-                # pad up to the bucket; even at exact size the bucketed
-                # program carries tw, so every panel in the bucket shares
-                # ONE compiled executable (same avals, same pytree)
-                Tb, Nb = bucket_shape(T0, N0, *buckets)
-                rec.set(bucket=[Tb, Nb])
+            if buckets is not None or ns > 1:
+                # pad up to the bucket and/or a shard multiple; even at
+                # exact size the padded program carries tw, so every panel
+                # in the bucket shares ONE compiled executable (same
+                # avals, same pytree), and every sharded panel splits
+                # evenly over the data mesh
+                if buckets is not None:
+                    Tb, Nb = bucket_shape(T0, N0, *buckets)
+                else:
+                    Tb, Nb = T0, N0
+                if ns > 1:
+                    from ..parallel.mesh import series_pad
+
+                    Nb = series_pad(Nb, ns)
+                if buckets is not None:
+                    rec.set(bucket=[Tb, Nb])
                 xz_b, m_b, tw = pad_panel(xz, m_arr, Tb, Nb)
                 params = pad_ssm_params(params, Nb)
                 stats = compute_panel_stats(xz_b, m_b)._replace(tw=tw)
                 xz, m_arr = xz_b, m_b
             else:
                 stats = compute_panel_stats(xz, m_arr)
+            if ns > 1:
+                step = _sharded_step_for(ns)
+                # a tripped sharded run demotes to the exact single-device
+                # sequential step: same (xz, mask, stats) args
+                fallback_step = em_step_stats
+                rec.set(mesh_shape=[ns], sharded=True)
             args = (xz, m_arr, stats)
         elif method == "steady":
             stats = compute_panel_stats(xz, m_arr)
@@ -1638,7 +1813,7 @@ def estimate_dfm_em(
         # (padded cells are NaN -> missing; trailing all-missing periods
         # add no information at real times), then the readout slices back
         means, covs, _ = kalman_smoother(params, jnp.where(m_arr, xz, jnp.nan))
-        if buckets is not None:
+        if buckets is not None or ns > 1:
             params = unpad_ssm_params(params, N0)
         return EMResults(
             params=params,

@@ -16,7 +16,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = [
     "make_mesh",
+    "data_mesh",
     "rep_pad",
+    "series_pad",
     "shard_over",
     "replicate",
     "P",
@@ -45,6 +47,29 @@ def rep_pad(n_reps: int, n_dev: int, bucket: int | None = None) -> int:
         step = -(-bucket // n_dev) * n_dev  # lcm-ish: keep device multiple
         n = ((n + step - 1) // step) * step
     return n
+
+
+def series_pad(n_series: int, n_shards: int) -> int:
+    """Padded cross-section size: round N up to a shard multiple so the
+    series axis splits evenly over the ``data`` mesh.  Padding series are
+    inert by the `compile.pad_ssm_params` contract (zero loadings, unit
+    idiosyncratic variance, all-False mask): they contribute exactly zero
+    to every collapsed statistic that crosses the mesh (C, b, ld_R, xRx
+    are N-sums with zero terms; log R = log 1 = 0), so the reduced Gram —
+    and therefore the filter path and the loglik — match the unpadded
+    panel bit-for-bit on each shard (pinned in tests/test_sharding.py).
+    """
+    if n_shards <= 1:
+        return n_series
+    return ((n_series + n_shards - 1) // n_shards) * n_shards
+
+
+def data_mesh(n_shards: int | None = None) -> Mesh:
+    """1-D ``("data",)`` mesh over the first n_shards devices — the
+    cross-section (N axis) mesh used by the sharded EM step.  On TPU the
+    axis rides ICI; in CI the same program runs on the forced 8-device
+    CPU platform (tests/conftest.py)."""
+    return make_mesh(n_shards, axis_names=("data",))
 
 
 def make_mesh(n_devices: int | None = None, axis_names=("rep",), shape=None) -> Mesh:

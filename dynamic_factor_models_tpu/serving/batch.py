@@ -25,6 +25,7 @@ import numpy as np
 
 from ..models import ssm as _ssm
 from ..models.emloop import run_em_loop, run_em_loop_batched
+from ..parallel.mesh import series_pad as _series_pad
 from ..utils.compile import (
     bucket_shape,
     pad_panel,
@@ -127,14 +128,34 @@ def refit_sequential(
     tol: float = 1e-6,
     max_em_iter: int = 200,
     step=None,
+    n_shards: int | None = None,
 ) -> list[RefitResult]:
     """Per-tenant reference path: the SAME padded program per tenant, run
     one at a time through the scalar loop — the parity oracle for
-    `refit_batch` and the bench speedup baseline."""
+    `refit_batch` and the bench speedup baseline.
+
+    `n_shards > 1` runs each tenant's step sharded over the cross-section
+    (models/ssm._sharded_step_for): the bucket's N is further padded to a
+    shard multiple — inert under the same mask/tw contract as bucket
+    padding — and the per-iteration program is the zero-host-sync sharded
+    EM step.  Tenants too small to shard profitably still work; the knob
+    exists so a serving node with a mesh can refit its largest panels
+    without a separate code path."""
+    ns = int(n_shards) if n_shards else 0
+    if ns > 1:
+        if step is not None:
+            raise ValueError("pass either step= or n_shards=, not both")
+        if ns > jax.device_count():
+            raise ValueError(
+                f"n_shards={ns} exceeds device_count={jax.device_count()}"
+            )
+        step = _ssm._sharded_step_for(ns)
     step = step or _ssm.em_step_stats
     results = []
     for req in requests:
         t_pad, n_pad = bucket_shape(*req.x.shape)
+        if ns > 1:
+            n_pad = _series_pad(n_pad, ns)
         params_p, xz_p, mask_p, stats = _prepare(req, t_pad, n_pad)
         res = run_em_loop(
             step,

@@ -469,6 +469,13 @@ class CompileSpec:
     scenario_draws: int = 0
     scenario_paths: int = 8
     scenario_horizon: int = 12
+    # cross-section sharding (models/ssm._sharded_step_for): n_shards > 1
+    # additionally registers the sharded EM step ("em_step_sharded") and
+    # the guarded loop specialized to it, lowered at the shard-padded N
+    # (parallel.mesh.series_pad on top of the bucket) over a mesh with
+    # the given axis names.  0 (default) skips the sharded kernels.
+    n_shards: int = 0
+    mesh_axes: tuple = ("data",)
 
     def padded_shape(self) -> tuple:
         if not self.bucket:
@@ -636,7 +643,10 @@ def _kernel_plan(spec: CompileSpec):
                 _sds((), ld),
                 _sds((), jnp.int32),
                 _sds((spec.max_em_iter,), ld),
-                _sds((), jnp.int32),
+                _sds((), jnp.int32),  # health
+                _sds((), jnp.int32),  # rung
+                _sds((), jnp.int32),  # trips
+                _sds((), jnp.int32),  # resume_from
             )
 
             def steady_guarded_loop_inputs():
@@ -649,7 +659,6 @@ def _kernel_plan(spec: CompileSpec):
                     (x, mask, stats),
                     jnp.asarray(1e-6, ld),
                     jnp.asarray(1e-3, ld),
-                    jnp.asarray(0, jnp.int32),
                     jnp.asarray(2, jnp.int32),
                 )
 
@@ -657,8 +666,7 @@ def _kernel_plan(spec: CompileSpec):
             plans["em_loop_guarded@steady"] = (
                 emloop._em_while_guarded_jit(sgdonate),
                 (steady_step, sgcarry_s, (x_s, mask_s, stats_s), _sds((), ld),
-                 _sds((), ld), _sds((), jnp.int32), spec.max_em_iter,
-                 _sds((), jnp.int32)),
+                 _sds((), ld), spec.max_em_iter, _sds((), jnp.int32)),
                 {},
                 aot_statics(steady_step, spec.max_em_iter, sgdonate, 0, 0, 0),
                 steady_guarded_loop_inputs,
@@ -778,7 +786,8 @@ def _kernel_plan(spec: CompileSpec):
         from ..models import emloop
 
         ld = jnp.result_type(float)
-        # guarded carry: (params, prev_params, ll_prev, ll, it, path, health)
+        # guarded carry: (params, prev_params, ll_prev, ll, it, path,
+        # health, rung, trips, resume_from)
         gcarry_s = (
             params_s,
             params_s,
@@ -786,7 +795,10 @@ def _kernel_plan(spec: CompileSpec):
             _sds((), ld),
             _sds((), jnp.int32),
             _sds((spec.max_em_iter,), ld),
-            _sds((), jnp.int32),
+            _sds((), jnp.int32),  # health
+            _sds((), jnp.int32),  # rung
+            _sds((), jnp.int32),  # trips
+            _sds((), jnp.int32),  # resume_from
         )
         gargs_s = (x_s, mask_s, stats_s)
 
@@ -800,7 +812,6 @@ def _kernel_plan(spec: CompileSpec):
                 (x, mask, stats),
                 jnp.asarray(1e-6, ld),
                 jnp.asarray(1e-3, ld),
-                jnp.asarray(0, jnp.int32),
                 jnp.asarray(2, jnp.int32),
             )
 
@@ -808,7 +819,7 @@ def _kernel_plan(spec: CompileSpec):
         plans["em_loop_guarded"] = (
             emloop._em_while_guarded_jit(gdonate),
             (ssm.em_step_stats, gcarry_s, gargs_s, _sds((), ld), _sds((), ld),
-             _sds((), jnp.int32), spec.max_em_iter, _sds((), jnp.int32)),
+             spec.max_em_iter, _sds((), jnp.int32)),
             {},
             # mirrors the guarded dispatch key: (step, max_em_iter, donate,
             # heartbeat_every, inject_nan_at, inject_chol_at) — precompiled
@@ -817,6 +828,85 @@ def _kernel_plan(spec: CompileSpec):
             aot_statics(ssm.em_step_stats, spec.max_em_iter, gdonate, 0, 0, 0),
             guarded_loop_inputs,
         )
+
+    if spec.n_shards > 1 and (
+        "em_step_sharded" in spec.kernels
+        or "em_loop_guarded@sharded" in spec.kernels
+    ):
+        # cross-section-sharded EM: the shard_map'd step plus the guarded
+        # loop specialized to it, lowered at the shard-padded N so the
+        # executables match what estimate_dfm_em(n_shards=) dispatches
+        from ..models import emloop
+        from ..parallel.mesh import series_pad
+
+        Nsh = series_pad(Nb, spec.n_shards)
+        sh_step = ssm._sharded_step_for(spec.n_shards)
+        shparams_s = SSMParams(
+            _sds((Nsh, r), dt), _sds((Nsh,), dt), _sds((p, r, r), dt),
+            _sds((r, r), dt),
+        )
+        shx_s = _sds((Tb, Nsh), dt)
+        shmask_s = _sds((Tb, Nsh), jnp.bool_)
+        shstats_s = PanelStats(
+            m=_sds((Tb, Nsh), dt),
+            xT=_sds((Nsh, Tb), dt),
+            mT=_sds((Nsh, Tb), dt),
+            Sxx=_sds((Nsh,), dt),
+            n_i=_sds((Nsh,), dt),
+            n_obs=_sds((Tb,), dt),
+            tw=_sds((Tb,), dt),
+        )
+
+        def sharded_inputs():
+            return _benign_em_inputs(Tb, Nsh, r, p, dt)
+
+        if "em_step_sharded" in spec.kernels:
+            plans["em_step_sharded"] = (
+                sh_step,
+                (shparams_s, shx_s, shmask_s, shstats_s),
+                {},
+                (),
+                sharded_inputs,
+            )
+
+        ld = jnp.result_type(float)
+        shcarry_s = (
+            shparams_s,
+            shparams_s,
+            _sds((), ld),
+            _sds((), ld),
+            _sds((), jnp.int32),
+            _sds((spec.max_em_iter,), ld),
+            _sds((), jnp.int32),
+            _sds((), jnp.int32),
+            _sds((), jnp.int32),
+            _sds((), jnp.int32),
+        )
+
+        def sharded_guarded_loop_inputs():
+            pa, x, mask, stats = sharded_inputs()
+            carry = emloop._fresh_guarded_carry(
+                pa, jnp.asarray(1e-6, ld), spec.max_em_iter
+            )
+            return (
+                carry,
+                (x, mask, stats),
+                jnp.asarray(1e-6, ld),
+                jnp.asarray(1e-3, ld),
+                jnp.asarray(2, jnp.int32),
+            )
+
+        shdonate = donation_enabled()
+        if "em_loop_guarded@sharded" in spec.kernels:
+            plans["em_loop_guarded@sharded"] = (
+                emloop._em_while_guarded_jit(shdonate),
+                (sh_step, shcarry_s, (shx_s, shmask_s, shstats_s),
+                 _sds((), ld), _sds((), ld), spec.max_em_iter,
+                 _sds((), jnp.int32)),
+                {},
+                aot_statics(sh_step, spec.max_em_iter, shdonate, 0, 0, 0),
+                sharded_guarded_loop_inputs,
+            )
 
     if spec.serving_period > 0:
         # lazy import: serving.online imports this module for aot_call

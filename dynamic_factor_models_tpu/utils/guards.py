@@ -68,6 +68,12 @@ HEALTH_NAMES = {
 # rung names in escalation order; telemetry's `ladder_rung` reports the
 # 1-based index of the last rung attempted (0 = never tripped)
 LADDER_RUNGS = ("jitter", "jitter_grown", "demote", "promote_f64")
+# the leading rungs the guarded EM loop applies ON DEVICE (models/emloop.py):
+# jitter and jitter_grown are pure covariance repairs on the rolled-back
+# carry, so they run inside the traced while-loop body with no host
+# round-trip; demote/promote_f64 change the step function / dtypes and
+# must re-dispatch from the host
+N_TRACED_RUNGS = 2
 
 # rung epsilons for the two jitter attempts, scaled by mean diagonal
 _JITTER_EPS = (1e-8, 1e-4)
@@ -183,17 +189,25 @@ def _map_cov(params, fn_sq, fn_diag):
     return params._replace(**rep)
 
 
-def ridge_jitter(params, rung: int):
+def ridge_jitter(params, rung):
     """Rung-`rung` (0 or 1) covariance repair on rolled-back params:
     PSD-project Q with a growing eigenvalue floor, floor the diagonal
     observation variances, and scrub any non-finite leaf back to zero
     (the rollback params are last-good, so this is belt-and-braces).
     The repaired Q is verified factorizable with ops.linalg.chol_guarded;
     if even the projection cannot be factorized the covariance is
-    replaced by a trace-matched identity — maximally dull, always PD."""
+    replaced by a trace-matched identity — maximally dull, always PD.
+
+    `rung` may be a Python int (host recovery ladder) OR a traced int32
+    scalar (the device-resident jitter rungs inside the guarded EM loop):
+    the epsilon lookup is an array gather, every other op was already
+    trace-safe, and epsilons are cast to each leaf's dtype so a traced
+    rung never promotes an f32 covariance under x64."""
     from ..ops.linalg import chol_guarded
 
-    eps = _JITTER_EPS[min(rung, len(_JITTER_EPS) - 1)]
+    eps = jnp.asarray(_JITTER_EPS, jnp.result_type(float))[
+        jnp.minimum(jnp.asarray(rung, jnp.int32), len(_JITTER_EPS) - 1)
+    ]
     params = jax.tree_util.tree_map(
         lambda x: (
             jnp.where(jnp.isfinite(x), x, 0.0)
@@ -204,16 +218,17 @@ def ridge_jitter(params, rung: int):
     )
 
     def repair_sq(Q):
-        Qp = psd_project(Q, eps)
+        e = eps.astype(Q.dtype)
+        Qp = psd_project(Q, e)
         _, ok = chol_guarded(Qp)
-        scale = jnp.maximum(jnp.trace(Qp) / Qp.shape[0], eps)
+        scale = jnp.maximum(jnp.trace(Qp) / Qp.shape[0], e)
         return jnp.where(ok, Qp, scale * jnp.eye(Qp.shape[0], dtype=Qp.dtype))
 
-    return _map_cov(
-        params,
-        repair_sq,
-        lambda d: jnp.maximum(jnp.where(jnp.isfinite(d), d, eps), eps),
-    )
+    def repair_diag(d):
+        e = eps.astype(d.dtype)
+        return jnp.maximum(jnp.where(jnp.isfinite(d), d, e), e)
+
+    return _map_cov(params, repair_sq, repair_diag)
 
 
 def promote_f64(tree):

@@ -509,6 +509,11 @@ class RunRecord:
             d.setdefault("platform", "unknown")
             d.setdefault("device_kind", "unknown")
             d.setdefault("n_devices", 0)
+        # sharded runs set mesh_shape via rec.set(...) in the estimator;
+        # single-device records carry the explicit defaults so summarize
+        # can render "-" without guessing
+        d.setdefault("mesh_shape", None)
+        d.setdefault("sharded", False)
         d.setdefault("x64", bool(jax.config.jax_enable_x64))
         try:
             d.setdefault("donate", donation_enabled())
@@ -594,6 +599,19 @@ def _shape_str(rec: dict) -> str:
     return ",".join(f"{k}={v}" for k, v in s.items()) or "-"
 
 
+def _dev_str(rec: dict) -> str:
+    """Devices column: '-' for single-device records, 'NxM' for a sharded
+    mesh (its shape), else the raw device count when a record ran
+    multi-device without sharding (e.g. vmapped tenant batches)."""
+    mesh = rec.get("mesh_shape")
+    if rec.get("sharded") and mesh:
+        return "x".join(str(int(m)) for m in mesh)
+    n = rec.get("n_devices")
+    if isinstance(n, (int, float)) and n > 1 and rec.get("sharded"):
+        return str(int(n))
+    return "-"
+
+
 def _mem_mb(rec: dict) -> str:
     m = rec.get("memory") or {}
     b = m.get("peak_bytes_in_use", m.get("bytes_in_use"))
@@ -656,6 +674,7 @@ def summarize(path: str, entry: str | None = None) -> str:
             str(r.get("entry", "?")),
             str(r.get("kind") or "-"),
             str(r.get("platform", "?")),
+            _dev_str(r),
             _shape_str(r),
             str(it) if isinstance(it, (int, float, str)) else "-",
             {True: "y", False: "n"}.get(r.get("converged"), "-"),
@@ -667,7 +686,7 @@ def summarize(path: str, entry: str | None = None) -> str:
             "ERR" if r.get("error") else "",
         ])
     per_run = _fmt_table(
-        ["time", "entry", "kind", "plat", "shape", "iters", "conv",
+        ["time", "entry", "kind", "plat", "dev", "shape", "iters", "conv",
          "loglik", "wall_s", "peak_MB", "aot h/m", "faults", ""],
         rows,
     )

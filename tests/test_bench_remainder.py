@@ -181,3 +181,71 @@ def test_refscale_refuses_cpu_live_leg(bench, tmp_path, monkeypatch):
     assert out["refscale_live_leg_on_tpu"] is False
     assert "em_T_crossover" not in out
     assert not any(k.endswith("_tpu_over_cpu") for k in out)
+
+
+def test_parity_fill_from_precision_legs(bench):
+    """BENCH_r05 regression: a CPU-only fragment whose device-parity fields
+    are null must come back with parity_* filled from the precision legs,
+    parity_ok evaluated against the documented thresholds, and the
+    provenance tagged so nobody mistakes it for a two-backend check."""
+    fragment = {
+        "parity_factor": None,
+        "parity_smoother": None,
+        "parity_smoother_sqrt": None,
+        "parity_irf": None,
+        "parity_ok": None,
+        "parity_precision_factor": 2.0e-5,
+        "parity_precision_smoother": 3.0e-5,
+        "parity_precision_smoother_sqrt": 4.0e-5,
+        "parity_precision_irf": 5.0e-5,
+    }
+    out = bench._fill_parity_from_precision(fragment)
+    assert out is fragment  # filled in place, the orchestrator reuses it
+    assert out["parity_factor"] == 2.0e-5
+    assert out["parity_smoother"] == 3.0e-5
+    assert out["parity_smoother_sqrt"] == 4.0e-5
+    assert out["parity_irf"] == 5.0e-5
+    assert out["parity_source"] == "precision"
+    assert out["parity_ok"] is True
+    assert None not in {out[k] for k in bench.PARITY_THRESHOLDS}
+
+
+def test_parity_fill_respects_thresholds(bench):
+    """A filled value past its documented threshold must flip parity_ok to
+    False — the fill is evidence plumbing, not grade inflation."""
+    fragment = {
+        "parity_factor": None,
+        "parity_smoother": None,
+        "parity_smoother_sqrt": None,
+        "parity_irf": None,
+        "parity_ok": None,
+        "parity_precision_factor": 5.0e-2,  # >> 1e-3 threshold
+        "parity_precision_smoother": 1.0e-6,
+        "parity_precision_smoother_sqrt": 1.0e-6,
+        "parity_precision_irf": 1.0e-6,
+    }
+    out = bench._fill_parity_from_precision(fragment)
+    assert out["parity_source"] == "precision"
+    assert out["parity_ok"] is False
+
+
+def test_parity_fill_leaves_device_measurements_alone(bench):
+    """When the two-backend comparison DID run, its numbers win: nothing is
+    overwritten, parity_source stays 'device', and a pre-computed
+    parity_ok is not second-guessed."""
+    fragment = {
+        "parity_factor": 1.0e-6,
+        "parity_smoother": 2.0e-6,
+        "parity_smoother_sqrt": 3.0e-6,
+        "parity_irf": 4.0e-6,
+        "parity_ok": True,
+        "parity_precision_factor": 9.0e-1,  # would fail if it leaked in
+        "parity_precision_smoother": 9.0e-1,
+        "parity_precision_smoother_sqrt": 9.0e-1,
+        "parity_precision_irf": 9.0e-1,
+    }
+    out = bench._fill_parity_from_precision(dict(fragment))
+    assert out["parity_factor"] == 1.0e-6
+    assert out["parity_irf"] == 4.0e-6
+    assert out["parity_source"] == "device"
+    assert out["parity_ok"] is True

@@ -239,6 +239,82 @@ def test_sequential_program_unchanged_by_steady_path():
     assert before == after, "sequential EM program changed by steady path"
 
 
+def test_guarded_loop_has_zero_per_iteration_host_syncs():
+    """ISSUE-8 tentpole (b): the guarded EM while-loop — healthy OR
+    jitter-recovering — is ONE compiled program with no device->host
+    transfer anywhere in it.  Pinned on the program text: stableHLO and
+    the compiled module contain no infeed/outfeed/host callback (CPU
+    lapack custom-calls are fine — they run in-process on the device
+    buffers), and the same holds with a transient fault injection baked
+    in, whose jitter/jitter_grown recovery is now in-trace
+    (guards.N_TRACED_RUNGS)."""
+    from dynamic_factor_models_tpu.models.emloop import (
+        _em_while_guarded_jit,
+        _fresh_guarded_carry,
+    )
+    from dynamic_factor_models_tpu.models.ssm import (
+        compute_panel_stats,
+        em_step_stats,
+    )
+
+    xz, m = _panel(60, 12, 0.1, seed=4)
+    params = _ssm_params(12, 2, 1)
+    stats = compute_panel_stats(xz, m)
+    ld = jnp.result_type(float)
+    tol = jnp.asarray(1e-6, ld)
+    carry = _fresh_guarded_carry(params, tol, 16)
+    gloop = _em_while_guarded_jit(False)
+    for inj in (0, 3):  # clean program AND transient-nan\@3 program
+        lowered = gloop.lower(
+            em_step_stats, carry, (xz, m, stats), tol,
+            jnp.asarray(1e-3, ld), 16, jnp.asarray(16, jnp.int32),
+            0, inj, 0,
+        )
+        for text in (lowered.as_text(), lowered.compile().as_text().lower()):
+            for op in ("infeed", "outfeed", "callback", "host_transfer"):
+                assert op not in text, (
+                    f"host sync {op!r} in guarded loop (inject_nan_at={inj})"
+                )
+
+
+def test_jitter_recovered_run_completes_in_one_dispatch(monkeypatch):
+    """Behavioral twin of the HLO pin: a run whose only fault is cured by
+    the traced jitter rungs must dispatch the guarded while-loop exactly
+    once — the host ladder never re-enters."""
+    from dynamic_factor_models_tpu.models import emloop
+    from dynamic_factor_models_tpu.models.ssm import (
+        compute_panel_stats,
+        em_step_stats,
+    )
+    from dynamic_factor_models_tpu.utils import faults, guards
+
+    calls = []
+    orig = emloop._em_while_guarded_jit
+
+    def counting(donate):
+        g = orig(donate)
+
+        def wrapped(*a, **k):
+            calls.append(1)
+            return g(*a, **k)
+
+        return wrapped
+
+    monkeypatch.setattr(emloop, "_em_while_guarded_jit", counting)
+    xz, m = _panel(60, 12, 0.1, seed=4)
+    params = _ssm_params(12, 2, 1)
+    stats = compute_panel_stats(xz, m)
+    with faults.inject("nan_estep@3"):
+        res = emloop.run_em_loop(
+            em_step_stats, params, (xz, m, stats), 1e-8, 20, guard=True
+        )
+    assert res.health == guards.HEALTH_OK
+    assert res.recoveries == 1
+    assert len(calls) == 1, (
+        f"jitter-recovered run took {len(calls)} dispatches, expected 1"
+    )
+
+
 @pytest.mark.telemetry
 def test_disabled_telemetry_path_is_free(monkeypatch):
     """The observability layer must cost nothing when unconfigured: every

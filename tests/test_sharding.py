@@ -1,0 +1,328 @@
+"""Cross-section sharding (PR-8 tentpole): the N-axis shard_map'd EM step
+must match the single-device program at numerical precision, with padding
+provably inert at awkward shard counts.
+
+The exactness argument, pinned numerically here: the Jungbacker-Koopman
+collapse statistics (C, b) and the log-likelihood corrections (ld_R via
+the fused log-R column, the Sxx/R quadratic) are all SUMS over series, so
+a shard computes its partial on its N/n_dev slice and one all-reduce
+(`ops.pallas_gram.ring_allreduce`; `lax.psum` on this CPU mesh) restores
+the full-panel values bit-for-bit up to reduction-order roundoff.  The
+Kalman scan and factor-VAR moments are N-free and run replicated; the
+M-step's per-series solves are embarrassingly shard-local.  Padded series
+(zero loadings, unit R, all-False mask — `compile.pad_ssm_params` /
+`pad_panel`) contribute zero to every sum and land back on zero loadings
+after the M-step, so uneven N costs padding memory, never accuracy.
+
+Runs on the forced 8-device CPU platform (tests/conftest.py) — the
+`multidevice` marker documents the requirement and skips with a
+diagnostic if the devices did not materialize.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dynamic_factor_models_tpu.models.dfm import DFMConfig
+from dynamic_factor_models_tpu.models.ssm import (
+    compute_panel_stats,
+    em_step_sharded,
+    em_step_stats,
+    estimate_dfm_em,
+)
+from dynamic_factor_models_tpu.parallel.mesh import rep_pad, series_pad
+from dynamic_factor_models_tpu.utils.compile import (
+    pad_panel,
+    pad_ssm_params,
+)
+
+PARITY_ATOL = 1e-10  # the ISSUE-8 acceptance bar (x64 CPU mesh)
+
+
+def _panel(T, N, r=2, seed=0, missing=0.15):
+    rng = np.random.default_rng(seed)
+    f = rng.standard_normal((T, r))
+    lam = rng.standard_normal((N, r))
+    x = f @ lam.T + 0.5 * rng.standard_normal((T, N))
+    # ragged missingness off the first complete block (ALS init needs it)
+    x[rng.random((T, N)) < missing * (np.arange(N) >= r + 4)] = np.nan
+    return x
+
+
+def _prep_padded(T, N, n_shards, r=2, p=1, seed=0):
+    """Padded (params, xz, mask, stats) exactly as estimate_dfm_em's
+    sharded branch builds them (inert-series contract included)."""
+    x = _panel(T, N, r=r, seed=seed)
+    m = ~np.isnan(x)
+    xz = jnp.asarray(np.where(m, x, 0.0))
+    mask = jnp.asarray(m)
+    Np = series_pad(N, n_shards)
+    xz_p, mask_p, tw = pad_panel(xz, mask, T, Np)
+    rng = np.random.default_rng(seed + 1)
+    from dynamic_factor_models_tpu.models.ssm import SSMParams
+
+    params = SSMParams(
+        lam=jnp.asarray(0.3 * rng.standard_normal((N, r))),
+        R=jnp.ones(N, xz.dtype),
+        A=jnp.concatenate(
+            [0.5 * jnp.eye(r, dtype=xz.dtype)[None],
+             jnp.zeros((p - 1, r, r), xz.dtype)]
+        ),
+        Q=jnp.eye(r, dtype=xz.dtype),
+    )
+    params_p = pad_ssm_params(params, Np)
+    stats = compute_panel_stats(xz_p, mask_p)._replace(tw=tw)
+    return params_p, xz_p, mask_p, stats
+
+
+def _max_leaf_diff(a, b):
+    return max(
+        float(jnp.max(jnp.abs(x - y))) if x.size else 0.0
+        for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b))
+    )
+
+
+def test_series_pad_awkward_counts():
+    assert series_pad(37, 8) == 40
+    assert series_pad(40, 8) == 40
+    assert series_pad(1, 8) == 8
+    assert series_pad(16384, 8) == 16384
+    # n_shards <= 1: identity (no mesh, no padding)
+    assert series_pad(37, 1) == 37
+    assert series_pad(37, 0) == 37
+
+
+def test_rep_pad_awkward_counts():
+    # the replication-axis twin of series_pad, same awkward shapes
+    assert rep_pad(9, 8) == 16
+    assert rep_pad(8, 8) == 8
+    assert rep_pad(1, 8) == 8
+    assert rep_pad(17, 8, bucket=0) == 24
+    assert rep_pad(5, 1) == 5
+
+
+@pytest.mark.multidevice
+def test_sharded_step_matches_single_device_uneven_n():
+    """One sharded EM step over 8 devices == the single-device step at
+    <= 1e-10, at an N (37 -> padded 40) that does NOT divide evenly."""
+    params, xz, mask, stats = _prep_padded(60, 37, 8, r=3, p=2, seed=3)
+    p1, ll1 = em_step_stats(params, xz, mask, stats)
+    p8, ll8 = em_step_sharded(params, xz, mask, stats, 8)
+    assert abs(float(ll1) - float(ll8)) <= PARITY_ATOL
+    assert _max_leaf_diff(p1, p8) <= PARITY_ATOL
+
+
+@pytest.mark.multidevice
+def test_sharded_iteration_chain_stays_on_parity():
+    """Parity must hold ITERATIVELY, not just for one step — roundoff
+    from a reordered reduction would compound across EM iterations."""
+    params, xz, mask, stats = _prep_padded(50, 21, 8, seed=5)
+    p1 = p8 = params
+    for _ in range(5):
+        p1, ll1 = em_step_stats(p1, xz, mask, stats)
+        p8, ll8 = em_step_sharded(p8, xz, mask, stats, 8)
+    assert abs(float(ll1) - float(ll8)) <= PARITY_ATOL
+    assert _max_leaf_diff(p1, p8) <= PARITY_ATOL
+
+
+@pytest.mark.multidevice
+def test_sharded_padding_is_inert():
+    """Padded series must be exactly inert: zero loadings in, zero
+    loadings out (their Sxf rows are identically zero), and the REAL
+    series' parameters identical whether the padding exists or not."""
+    T, N, ns = 48, 11, 8  # pads 11 -> 16: five inert series
+    params, xz, mask, stats = _prep_padded(T, N, ns, seed=7)
+    Np = params.lam.shape[0]
+    assert Np == 16
+    p8 = params
+    for _ in range(3):
+        p8, _ = em_step_sharded(p8, xz, mask, stats, ns)
+        # padding stays exactly dark across iterations
+        np.testing.assert_array_equal(np.asarray(p8.lam[N:]), 0.0)
+    # real-series block: identical to the single-device step on the SAME
+    # padded inputs (transitively, to the unpadded run — the bucketing
+    # tests pin pad-vs-unpadded)
+    p1 = params
+    for _ in range(3):
+        p1, _ = em_step_stats(p1, xz, mask, stats)
+    assert _max_leaf_diff(p1, p8) <= PARITY_ATOL
+
+
+@pytest.mark.multidevice
+def test_single_shard_mesh_matches_unsharded():
+    """n_dev=1 degenerate mesh: shard_map over one device is the same
+    program (psum over a singleton axis is identity)."""
+    params, xz, mask, stats = _prep_padded(40, 9, 1, seed=11)
+    p1, ll1 = em_step_stats(params, xz, mask, stats)
+    ps, lls = em_step_sharded(params, xz, mask, stats, 1)
+    assert abs(float(ll1) - float(lls)) <= PARITY_ATOL
+    assert _max_leaf_diff(p1, ps) <= PARITY_ATOL
+
+
+@pytest.mark.multidevice
+def test_estimate_dfm_em_sharded_matches_unsharded_end_to_end():
+    """The acceptance pin: estimate_dfm_em(n_shards=8) == n_shards=None
+    at <= 1e-10 on params AND the loglik path, full guarded run."""
+    T, N = 70, 13
+    x = _panel(T, N, seed=2)
+    cfg = DFMConfig(nfac_u=2, n_factorlag=1)
+    base = estimate_dfm_em(x, np.ones(N), 0, T - 1, cfg, max_em_iter=12)
+    shrd = estimate_dfm_em(
+        x, np.ones(N), 0, T - 1, cfg, max_em_iter=12, n_shards=8
+    )
+    assert shrd.params.lam.shape == base.params.lam.shape  # unpadded back
+    assert shrd.n_iter == base.n_iter
+    assert shrd.converged == base.converged
+    assert _max_leaf_diff(base.params, shrd.params) <= PARITY_ATOL
+    n = base.n_iter
+    np.testing.assert_allclose(
+        np.asarray(shrd.loglik_path[:n]), np.asarray(base.loglik_path[:n]),
+        atol=PARITY_ATOL, rtol=0,
+    )
+    np.testing.assert_allclose(
+        np.asarray(shrd.factors), np.asarray(base.factors), atol=1e-8
+    )
+
+
+@pytest.mark.multidevice
+def test_estimate_n_shards_one_is_the_unsharded_path():
+    T, N = 50, 9
+    x = _panel(T, N, seed=4)
+    cfg = DFMConfig(nfac_u=2, n_factorlag=1)
+    base = estimate_dfm_em(x, np.ones(N), 0, T - 1, cfg, max_em_iter=8)
+    one = estimate_dfm_em(
+        x, np.ones(N), 0, T - 1, cfg, max_em_iter=8, n_shards=1
+    )
+    assert _max_leaf_diff(base.params, one.params) == 0.0
+
+
+def test_n_shards_validation():
+    x = _panel(40, 8, seed=6)
+    cfg = DFMConfig(nfac_u=2, n_factorlag=1)
+    with pytest.raises(ValueError, match="sequential"):
+        estimate_dfm_em(
+            x, np.ones(8), 0, 39, cfg, method="sqrt", n_shards=8
+        )
+    with pytest.raises(ValueError, match="gram_dtype"):
+        estimate_dfm_em(
+            x, np.ones(8), 0, 39, cfg, gram_dtype="bfloat16", n_shards=8
+        )
+    with pytest.raises(ValueError, match="devices|device"):
+        estimate_dfm_em(
+            x, np.ones(8), 0, 39, cfg, n_shards=jax.device_count() + 1
+        )
+
+
+def test_mixed_freq_n_shards_refuses_loudly():
+    from dynamic_factor_models_tpu.models.mixed_freq import (
+        estimate_mixed_freq_dfm,
+    )
+
+    x = _panel(36, 6, seed=8)
+    with pytest.raises(NotImplementedError, match="single-frequency"):
+        estimate_mixed_freq_dfm(x, np.zeros(6, bool), r=1, n_shards=8)
+
+
+@pytest.mark.multidevice
+@pytest.mark.serving
+def test_refit_sequential_sharded_matches_unsharded():
+    """serving/batch.py mesh pickup: a sharded per-tenant refit returns
+    the same params as the plain sequential reference."""
+    from dynamic_factor_models_tpu.serving.batch import (
+        RefitRequest,
+        refit_sequential,
+    )
+    from dynamic_factor_models_tpu.models.ssm import SSMParams
+
+    reqs = []
+    for i, (T, N) in enumerate([(40, 9), (40, 13)]):
+        x = _panel(T, N, seed=20 + i)
+        m = ~np.isnan(x)
+        r = 2
+        rng = np.random.default_rng(30 + i)
+        params = SSMParams(
+            lam=jnp.asarray(0.3 * rng.standard_normal((N, r))),
+            R=jnp.ones(N),
+            A=0.5 * jnp.eye(r)[None],
+            Q=jnp.eye(r),
+        )
+        reqs.append(
+            RefitRequest(f"t{i}", jnp.asarray(np.where(m, x, 0.0)),
+                         jnp.asarray(m), params)
+        )
+    base = refit_sequential(reqs, max_em_iter=6)
+    shrd = refit_sequential(reqs, max_em_iter=6, n_shards=8)
+    for b, s in zip(base, shrd):
+        assert s.params.lam.shape == b.params.lam.shape
+        assert s.n_iter == b.n_iter
+        assert _max_leaf_diff(b.params, s.params) <= PARITY_ATOL
+    with pytest.raises(ValueError, match="step"):
+        refit_sequential(reqs, step=em_step_stats, n_shards=8)
+
+
+@pytest.mark.multidevice
+def test_compile_spec_sharded_plans_warm_hit():
+    """CompileSpec(n_shards=8) AOT-registers the sharded step and the
+    guarded loop specialized to it; the second precompile of the same
+    spec is served entirely from the in-process registry."""
+    from dynamic_factor_models_tpu.utils import compile as cc
+
+    cc.reset_counters()
+    spec = cc.CompileSpec(
+        T=40, N=16, r=2, p=1, dtype=str(np.dtype(float)),
+        kernels=("em_step_sharded", "em_loop_guarded@sharded"),
+        max_em_iter=4, n_shards=8,
+    )
+    r1 = cc.precompile(spec)
+    assert not r1["kernels"]["em_step_sharded"]["aot_cached"]
+    assert not r1["kernels"]["em_loop_guarded@sharded"]["aot_cached"]
+    assert cc.counters()["em_step_sharded"]["compiles"] == 1
+    r2 = cc.precompile(spec)
+    assert r2["kernels"]["em_step_sharded"]["aot_cached"]
+    assert r2["kernels"]["em_loop_guarded@sharded"]["aot_cached"]
+    assert r2["compile_s_total"] == 0.0
+    assert cc.counters()["em_step_sharded"]["aot_hits"] == 1
+    assert cc.counters()["em_loop_guarded"]["aot_hits"] == 1
+
+
+@pytest.mark.multidevice
+@pytest.mark.telemetry
+def test_sharded_run_records_mesh_and_summarize_devices_column(
+    tmp_path, monkeypatch
+):
+    """RunRecord carries mesh_shape/n_devices/sharded; summarize renders
+    a devices column — '-' for single-device records, the mesh shape for
+    sharded ones."""
+    from dynamic_factor_models_tpu.utils import telemetry
+
+    path = str(tmp_path / "runs.jsonl")
+    # monkeypatch (not disable()) so the process-wide enablement state is
+    # RESTORED at teardown — disable() would pin telemetry off and break
+    # later DFM_TELEMETRY-driven tests in the same process
+    monkeypatch.setattr(telemetry, "_explicit_enabled", None)
+    monkeypatch.setattr(telemetry, "_explicit_sink", None)
+    telemetry.enable(path)
+    T, N = 50, 9
+    x = _panel(T, N, seed=9)
+    cfg = DFMConfig(nfac_u=2, n_factorlag=1)
+    estimate_dfm_em(x, np.ones(N), 0, T - 1, cfg, max_em_iter=5)
+    estimate_dfm_em(
+        x, np.ones(N), 0, T - 1, cfg, max_em_iter=5, n_shards=8
+    )
+    recs = [
+        r for r in telemetry._load_jsonl(path)
+        if r.get("entry") == "estimate_dfm_em"
+    ]
+    assert len(recs) == 2
+    plain, sharded = recs
+    assert plain["sharded"] is False and plain["mesh_shape"] is None
+    assert sharded["sharded"] is True and sharded["mesh_shape"] == [8]
+    assert sharded["n_devices"] == jax.device_count()
+    assert telemetry._dev_str(plain) == "-"
+    assert telemetry._dev_str(sharded) == "8"
+    table = telemetry.summarize(path)
+    header = next(
+        ln for ln in table.splitlines() if ln.startswith("time")
+    )
+    assert "dev" in header.split()
