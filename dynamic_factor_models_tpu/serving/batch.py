@@ -33,7 +33,18 @@ from ..utils.compile import (
     unpad_ssm_params,
 )
 
-__all__ = ["RefitRequest", "RefitResult", "refit_batch", "refit_sequential"]
+__all__ = [
+    "HEALTH_BUCKET_ERROR",
+    "RefitRequest",
+    "RefitResult",
+    "refit_batch",
+    "refit_sequential",
+]
+
+# Health code for "the bucket's device program itself raised" — distinct
+# from the in-loop utils.guards codes (0 ok, 1 nonfinite, 2 ll-decrease)
+# so telemetry can tell a numerics rollback from an engine-level failure.
+HEALTH_BUCKET_ERROR = 3
 
 
 class RefitRequest(NamedTuple):
@@ -84,6 +95,7 @@ def refit_batch(
     tol: float = 1e-6,
     max_em_iter: int = 200,
     step=None,
+    isolate_errors: bool = False,
 ) -> list[RefitResult]:
     """Refit every request, batching within each (T, N) compile bucket.
 
@@ -92,22 +104,47 @@ def refit_batch(
     EM loop.  Results come back in input order, params unpadded to each
     tenant's raw series count.  A tenant whose loop tripped the health
     sentinel gets its rolled-back last-good params and health != 0 —
-    callers (serving/engine.py) keep the old fit for that tenant."""
+    callers (serving/engine.py) keep the old fit for that tenant.
+
+    `isolate_errors=True` additionally contains a bucket whose program
+    RAISES (shape bug, compile failure, injected fault): its tenants
+    come back with ``health=HEALTH_BUCKET_ERROR`` and their warm-start
+    params untouched, and the other buckets still run — one poisoned
+    bucket must not kill a multi-tenant flush.  Simulated external
+    kills (preemption/crash injections) are never contained."""
+    from ..utils.faults import SimulatedCrash, SimulatedPreemption
+
     requests = list(requests)
     step = step or _ssm.em_step_stats
     out: dict[int, RefitResult] = {}
     order = {id(req): i for i, req in enumerate(requests)}
     for (t_pad, n_pad), group in _group_by_bucket(requests).items():
-        prepped = [_prepare(req, t_pad, n_pad) for req in group]
-        params_B = jax.tree.map(lambda *xs: jnp.stack(xs),
-                                *[p[0] for p in prepped])
-        x_B = jnp.stack([p[1] for p in prepped])
-        mask_B = jnp.stack([p[2] for p in prepped])
-        stats_B = jax.tree.map(lambda *xs: jnp.stack(xs),
-                               *[p[3] for p in prepped])
-        res = run_em_loop_batched(
-            step, params_B, (x_B, mask_B, stats_B), tol, max_em_iter
-        )
+        try:
+            prepped = [_prepare(req, t_pad, n_pad) for req in group]
+            params_B = jax.tree.map(lambda *xs: jnp.stack(xs),
+                                    *[p[0] for p in prepped])
+            x_B = jnp.stack([p[1] for p in prepped])
+            mask_B = jnp.stack([p[2] for p in prepped])
+            stats_B = jax.tree.map(lambda *xs: jnp.stack(xs),
+                                   *[p[3] for p in prepped])
+            res = run_em_loop_batched(
+                step, params_B, (x_B, mask_B, stats_B), tol, max_em_iter
+            )
+        except (SimulatedPreemption, SimulatedCrash, KeyboardInterrupt):
+            raise
+        except Exception:
+            if not isolate_errors:
+                raise
+            for req in group:
+                out[order[id(req)]] = RefitResult(
+                    tenant_id=req.tenant_id,
+                    params=req.params,
+                    n_iter=0,
+                    converged=False,
+                    health=HEALTH_BUCKET_ERROR,
+                    loglik=float("nan"),
+                )
+            continue
         for b, req in enumerate(group):
             params_b = jax.tree.map(lambda a: a[b], res.params)
             ll_path = res.llpath[b]

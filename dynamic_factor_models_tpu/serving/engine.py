@@ -14,12 +14,33 @@ EM runs.  Request dicts:
 no refactorization; `refit` only QUEUES the tenant, and `flush_refits()`
 executes the queue batched per (T, N) compile bucket (serving/batch.py).
 `scenario` hands the inner dict to scenarios.run_scenario against the
-tenant's current fit and panel — conditional/stress/draw fans and
-batched news, each one vmapped device program (see docs/scenarios.md).
-A tenant whose batched refit trips the health sentinel keeps its previous
-fit (the rollback already happened inside the loop; the engine just
-declines to install the frozen iterate) — its bucket-mates are installed
-normally.  State persists per tenant through serving/store.py.
+tenant's current fit and panel.  State persists per tenant through
+serving/store.py.
+
+Availability contract (docs/robustness.md): `handle()` ALWAYS returns a
+typed `Response` envelope — client error, tenant fault, or system
+fault, never an uncaught exception (injected external kills —
+SimulatedCrash / SimulatedPreemption — excepted: those model the
+process dying).  The hardening around the clean path:
+
+* requests are validated up front (client errors name the offending
+  field), carry an optional wall-clock deadline, and transient store
+  I/O faults are retried with bounded exponential backoff and
+  deterministic jitter (serving/resilience.py);
+* a failed tick lands its row in the tenant's REPLAY BUFFER and the
+  tenant serves DEGRADED nowcasts from last-good state (stamped
+  `degraded` / `ticks_behind`) until recovery reconciles the buffer via
+  one exact refilter — pinned against the never-faulted run;
+* k consecutive faults open a per-tenant CIRCUIT BREAKER: ticks
+  fast-fail into the buffer with no compute until a cooldown admits a
+  half-open probe, whose reconcile closes it;
+* every committed tick is WRITE-AHEAD journaled (serving/journal.py)
+  before the in-memory commit, so a kill/restart replays snapshot +
+  journal to a bit-identical FilterState with no caller-side panel.
+
+The device programs are untouched: all hardening is host-side wrapping
+around the same tick/nowcast executables (HLO pinned byte-identical by
+tests/test_serving.py).
 
 ``python -m dynamic_factor_models_tpu.serve`` runs the demo loop below.
 """
@@ -28,24 +49,42 @@ from __future__ import annotations
 
 import argparse
 import json
+import re as _re
 import sys
 
 import jax.numpy as jnp
 import numpy as np
 
 from ..models import ssm as _ssm
+from ..utils import faults as _faults
 from ..utils.compile import bucket_shape
-from ..utils.telemetry import run_record
+from ..utils.guards import host_finite
+from ..utils.telemetry import inc, run_record
 from .batch import RefitRequest, refit_batch
 from .online import (
     FilterState,
     derive_serving_model,
     nowcast,
     online_tick,
+    replay_ticks,
 )
-from .store import TenantState, TenantStore
+from .resilience import (
+    BREAKER_OPEN,
+    CLIENT_ERROR,
+    SYSTEM_FAULT,
+    TENANT_FAULT,
+    CircuitBreaker,
+    Deadline,
+    ErrorInfo,
+    Response,
+    RetryPolicy,
+    call_with_retries,
+)
+from .store import TenantState, TenantStore, template_state
 
 __all__ = ["ServingEngine", "default_params", "main"]
+
+_REQ_KINDS = ("tick", "nowcast", "refit", "scenario")
 
 
 def default_params(N: int, r: int = 4, p: int = 4, dtype=float) -> _ssm.SSMParams:
@@ -58,15 +97,60 @@ def default_params(N: int, r: int = 4, p: int = 4, dtype=float) -> _ssm.SSMParam
     return _ssm.SSMParams(lam, jnp.ones((N,), dt), A, jnp.eye(r, dtype=dt))
 
 
-class _Tenant:
-    __slots__ = ("x", "mask", "params", "model", "state")
+class _History:
+    """Amortized-append panel history.
 
-    def __init__(self, x, mask, params, model, state):
-        self.x = x          # (T, N) np array, zero-filled at missing
-        self.mask = mask    # (T, N) np bool
+    The old path re-built the panel with `np.vstack` on every tick — an
+    O(T) copy per O(1) update, O(T^2) total bytes moved over a tenant's
+    life.  This keeps (capacity, N) buffers, doubles capacity on
+    overflow, and exposes zero-copy views of the live prefix; appending
+    T rows is O(T) amortized.  `reallocs` counts doublings (bounded by
+    log2 of the growth factor), which the perf regression test pins
+    instead of flaky wall time."""
+
+    __slots__ = ("_x", "_mask", "n", "reallocs")
+
+    def __init__(self, x, mask):
+        self.n = int(x.shape[0])
+        self._x = np.array(x, float, copy=True)
+        self._mask = np.array(mask, bool, copy=True)
+        self.reallocs = 0
+
+    @property
+    def x(self) -> np.ndarray:
+        return self._x[: self.n]
+
+    @property
+    def mask(self) -> np.ndarray:
+        return self._mask[: self.n]
+
+    def append(self, x_row, mask_row) -> None:
+        if self.n == self._x.shape[0]:
+            cap = max(2 * self._x.shape[0], 8)
+            nx = np.zeros((cap,) + self._x.shape[1:], self._x.dtype)
+            nm = np.zeros((cap,) + self._mask.shape[1:], bool)
+            nx[: self.n] = self._x[: self.n]
+            nm[: self.n] = self._mask[: self.n]
+            self._x, self._mask = nx, nm
+            self.reallocs += 1
+        self._x[self.n] = x_row
+        self._mask[self.n] = mask_row
+        self.n += 1
+
+
+class _Tenant:
+    __slots__ = (
+        "hist", "params", "model", "state", "breaker", "replay", "suspect",
+    )
+
+    def __init__(self, hist, params, model, state, breaker):
+        self.hist = hist        # _History or None (panel-less resume)
         self.params = params
-        self.model = model  # ServingModel
-        self.state = state  # FilterState
+        self.model = model      # ServingModel
+        self.state = state      # FilterState (last-good, committed)
+        self.breaker = breaker  # CircuitBreaker
+        self.replay = []        # [(x_row, mask_row)] failed-tick rows
+        self.suspect = False    # force a deep finite check on next tick
 
 
 class ServingEngine:
@@ -77,12 +161,25 @@ class ServingEngine:
         store_dir: str | None = None,
         tol: float = 1e-6,
         max_em_iter: int = 200,
+        deadline_s: float | None = None,
+        retry_policy: RetryPolicy | None = None,
+        breaker_threshold: int = 3,
+        breaker_cooldown: int = 4,
+        max_refit_retries: int = 2,
     ):
         self.store = TenantStore(store_dir) if store_dir else None
         self.tol = tol
         self.max_em_iter = max_em_iter
+        self.deadline_s = deadline_s  # default per-request budget
+        self.retry_policy = retry_policy or RetryPolicy()
+        self.breaker_threshold = breaker_threshold
+        self.breaker_cooldown = breaker_cooldown
+        self.max_refit_retries = max_refit_retries
         self._tenants: dict[str, _Tenant] = {}
         self._refit_queue: list[str] = []
+        self._refit_retries: dict[str, int] = {}
+        self._requests = 0  # admission counter (slow_req/engine_crash sites)
+        self._ticks = 0     # computed-tick counter (tick_nan site)
 
     # -- registration ----------------------------------------------------
 
@@ -103,7 +200,10 @@ class ServingEngine:
 
     def _install(self, tenant_id, xz, mask, params) -> None:
         """(Re)derive a tenant's serving constants from `params` and its
-        exact filter state from a full refilter of the panel."""
+        exact filter state from a full refilter of the panel; persist
+        the snapshot and reset the tick journal, THEN commit in memory —
+        a persistence failure (OSError after retries) leaves the
+        previous tenant state untouched."""
         model = derive_serving_model(params)
         xnan = np.where(mask, xz, np.nan)
         filt = _ssm.kalman_filter(params, xnan)
@@ -111,75 +211,460 @@ class ServingEngine:
             s=jnp.asarray(filt.means[-1]),
             t=jnp.asarray(xz.shape[0], jnp.int32),
         )
-        self._tenants[tenant_id] = _Tenant(xz, mask, params, model, state)
-        if self.store is not None:
+        self._persist(tenant_id, params, state)
+        prev = self._tenants.get(tenant_id)
+        breaker = prev.breaker if prev is not None else CircuitBreaker(
+            self.breaker_threshold, self.breaker_cooldown
+        )
+        self._tenants[tenant_id] = _Tenant(
+            _History(xz, mask), params, model, state, breaker
+        )
+
+    def _persist(self, tenant_id, params, state) -> int:
+        """Snapshot + journal reset, retried on transient I/O faults.
+        Returns the retry count consumed (0 without a store)."""
+        if self.store is None:
+            return 0
+
+        def _save():
             self.store.save(
-                tenant_id, TenantState(params=params, s=state.s, t=state.t)
+                tenant_id,
+                TenantState(
+                    params=params,
+                    s=state.s,
+                    t=state.t,
+                    r=jnp.asarray(params.r, jnp.int32),
+                    p=jnp.asarray(params.p, jnp.int32),
+                ),
             )
+            self.store.journal(tenant_id).reset(int(state.t))
+
+        _, retries = call_with_retries(
+            _save, self.retry_policy, key=f"{tenant_id}:install"
+        )
+        return retries
 
     def tenant_ids(self) -> list[str]:
         return sorted(self._tenants)
 
     # -- request routing -------------------------------------------------
 
-    def handle(self, req: dict):
-        """Route one request dict; returns the request's result (the new
-        FilterState for tick, the (N,) nowcast vector, or the refit-queue
-        position).  Unknown kinds / tenants raise ValueError."""
-        kind = req.get("kind")
-        tenant_id = req.get("tenant")
-        if tenant_id not in self._tenants:
-            raise ValueError(f"unknown tenant {tenant_id!r}")
-        if kind == "tick":
-            return self._tick(tenant_id, req["x"], req.get("mask"))
-        if kind == "nowcast":
-            return self._nowcast(tenant_id, int(req.get("horizon", 0)))
-        if kind == "refit":
-            return self._queue_refit(tenant_id)
-        if kind == "scenario":
-            return self._scenario(tenant_id, req.get("scenario") or {})
-        raise ValueError(f"unknown request kind {kind!r}")
+    def handle(self, req) -> Response:
+        """Route one request dict; ALWAYS returns a typed `Response`.
 
-    def _tick(self, tenant_id: str, x_t, mask_t=None) -> FilterState:
-        ten = self._tenants[tenant_id]
-        x_t = np.asarray(x_t, float)
-        if mask_t is None:
-            mask_t = np.isfinite(x_t)
-        mask_t = np.asarray(mask_t, bool)
-        with run_record("serving", kind="tick", config={"tenant": tenant_id}):
-            ten.state = online_tick(ten.model, ten.state, x_t, mask_t)
-        ten.x = np.vstack([ten.x, np.where(mask_t, x_t, 0.0)[None]])
-        ten.mask = np.vstack([ten.mask, mask_t[None]])
-        return ten.state
-
-    def _nowcast(self, tenant_id: str, horizon: int):
-        ten = self._tenants[tenant_id]
+        Successful requests carry the result (new FilterState for tick,
+        the (N,) vector for nowcast, queue position for refit, the
+        ScenarioResult for scenario); failures carry an `ErrorInfo`
+        classifying the cause.  The only exceptions that escape are the
+        injected external kills (SimulatedCrash / SimulatedPreemption)
+        and KeyboardInterrupt — everything else is an envelope."""
+        self._requests += 1
+        reqno = self._requests
+        if _faults.site_hits("engine_crash", reqno):
+            _faults.fault_fired("engine_crash")
+            raise _faults.SimulatedCrash(
+                f"injected engine_crash at request {reqno}"
+            )
+        kind = req.get("kind") if isinstance(req, dict) else None
+        tenant_id = req.get("tenant") if isinstance(req, dict) else None
+        if not isinstance(tenant_id, str):
+            tenant_id = None
+        rkind = kind if kind in _REQ_KINDS else "invalid"
         with run_record(
-            "serving", kind="nowcast",
-            config={"tenant": tenant_id, "horizon": horizon},
-        ):
-            return nowcast(ten.model, ten.state, horizon)
+            "serving", kind=rkind, config={"tenant": tenant_id}
+        ) as rec:
+            try:
+                resp = self._dispatch(req, kind, tenant_id, reqno)
+            except (
+                _faults.SimulatedCrash,
+                _faults.SimulatedPreemption,
+                KeyboardInterrupt,
+            ):
+                raise
+            except Exception as e:  # last resort: nothing else escapes
+                inc("serving.internal_absorbed")
+                resp = Response(
+                    ok=False, kind=rkind, tenant=tenant_id,
+                    error=ErrorInfo(
+                        SYSTEM_FAULT, "internal",
+                        f"{type(e).__name__}: {e}",
+                    ),
+                )
+            rec.set(
+                outcome=(
+                    ("degraded" if resp.degraded else "ok")
+                    if resp.ok else resp.error.category
+                ),
+                error_kind=None if resp.error is None else resp.error.code,
+                retries=resp.retries,
+                breaker_state=resp.breaker_state,
+            )
+        return resp
 
-    def _scenario(self, tenant_id: str, spec: dict):
-        """Run a scenario fan against the tenant's current fit + panel.
-        `spec` supplies ScenarioRequest fields by name; unknown fields
-        raise (TypeError from the NamedTuple) rather than being dropped
-        silently."""
+    def _dispatch(self, req, kind, tenant_id, reqno) -> Response:
+        if not isinstance(req, dict):
+            return Response(
+                ok=False, kind="invalid", tenant=None,
+                error=ErrorInfo(
+                    CLIENT_ERROR, "bad_request",
+                    f"request must be a dict, got {type(req).__name__}",
+                ),
+            )
+        if kind is None:
+            return self._client_err(
+                "invalid", tenant_id, "missing_field",
+                "request is missing 'kind'", field="kind",
+            )
+        if kind not in _REQ_KINDS:
+            return self._client_err(
+                "invalid", tenant_id, "unknown_kind",
+                f"unknown request kind {kind!r} "
+                f"(valid: {', '.join(_REQ_KINDS)})", field="kind",
+            )
+        if tenant_id is None:
+            return self._client_err(
+                kind, None, "missing_field",
+                "request is missing 'tenant'", field="tenant",
+            )
+        if tenant_id not in self._tenants:
+            return self._client_err(
+                kind, tenant_id, "unknown_tenant",
+                f"unknown tenant {tenant_id!r}", field="tenant",
+            )
+        ten = self._tenants[tenant_id]
+        deadline = Deadline(req.get("deadline_s", self.deadline_s))
+        if _faults.site_hits("slow_req", reqno):
+            _faults.fault_fired("slow_req")
+            deadline.expire()
+        bstate = ten.breaker.on_request()
+        if kind == "tick":
+            return self._tick(tenant_id, ten, req, deadline, bstate)
+        if deadline.exceeded():  # nothing to buffer for read-only kinds
+            return self._fault_resp(
+                kind, tenant_id, ten,
+                ErrorInfo(
+                    SYSTEM_FAULT, "deadline_exceeded",
+                    f"deadline of {deadline.budget_s}s exceeded",
+                ),
+            )
+        if kind == "nowcast":
+            return self._nowcast(tenant_id, ten, req)
+        if kind == "refit":
+            pos = self._queue_refit(tenant_id)
+            return Response(
+                ok=True, kind="refit", tenant=tenant_id, result=pos,
+                breaker_state=ten.breaker.state,
+            )
+        return self._scenario(tenant_id, ten, req)
+
+    # -- envelope helpers ------------------------------------------------
+
+    def _client_err(self, kind, tenant_id, code, msg, field) -> Response:
+        ten = self._tenants.get(tenant_id) if tenant_id else None
+        inc("serving.client_errors")
+        return Response(
+            ok=False, kind=kind, tenant=tenant_id,
+            error=ErrorInfo(CLIENT_ERROR, code, msg, field),
+            degraded=bool(ten.replay) if ten else False,
+            ticks_behind=len(ten.replay) if ten else 0,
+            breaker_state=ten.breaker.state if ten else "closed",
+        )
+
+    def _fault_resp(
+        self, kind, tenant_id, ten, err, retries=0,
+        count_fault=True, recovered=False,
+    ) -> Response:
+        """A tenant/system fault envelope: stamps the degradation state
+        and (unless `count_fault=False`, e.g. a fast-fail against an
+        already-open breaker) counts one fault toward the breaker."""
+        if count_fault:
+            ten.breaker.record_fault()
+        inc("serving.faults." + err.code)
+        return Response(
+            ok=False, kind=kind, tenant=tenant_id, error=err,
+            degraded=bool(ten.replay), ticks_behind=len(ten.replay),
+            retries=retries, breaker_state=ten.breaker.state,
+            recovered=recovered,
+        )
+
+    # -- tick ------------------------------------------------------------
+
+    def _tick(self, tenant_id, ten, req, deadline, bstate) -> Response:
+        # validation: name the offending field, never a raw KeyError
+        if "x" not in req:
+            return self._client_err(
+                "tick", tenant_id, "missing_field",
+                "tick request is missing 'x'", field="x",
+            )
+        try:
+            x_t = np.asarray(req["x"], float)
+        except (TypeError, ValueError):
+            return self._client_err(
+                "tick", tenant_id, "bad_value",
+                "'x' is not convertible to a float array", field="x",
+            )
+        N = ten.model.Wb.shape[0]
+        if x_t.shape != (N,):
+            return self._client_err(
+                "tick", tenant_id, "bad_shape",
+                f"'x' must have shape ({N},), got {x_t.shape}", field="x",
+            )
+        if req.get("mask") is None:
+            mask_t = np.isfinite(x_t)
+        else:
+            try:
+                mask_t = np.asarray(req["mask"], bool)
+            except (TypeError, ValueError):
+                return self._client_err(
+                    "tick", tenant_id, "bad_value",
+                    "'mask' is not convertible to a bool array",
+                    field="mask",
+                )
+            if mask_t.shape != (N,):
+                return self._client_err(
+                    "tick", tenant_id, "bad_shape",
+                    f"'mask' must have shape ({N},), got {mask_t.shape}",
+                    field="mask",
+                )
+        row = (np.where(mask_t, x_t, 0.0), mask_t)
+
+        if bstate == BREAKER_OPEN:
+            ten.replay.append(row)
+            return self._fault_resp(
+                "tick", tenant_id, ten,
+                ErrorInfo(
+                    TENANT_FAULT, "breaker_open",
+                    "circuit breaker open; tick buffered for replay",
+                ),
+                count_fault=False,
+            )
+
+        # recovery: reconcile any buffered rows before applying this one
+        recovered = False
+        if ten.replay:
+            try:
+                self._reconcile(tenant_id, ten)
+                ten = self._tenants[tenant_id]  # reconcile reinstalls
+                recovered = True
+            except OSError as e:
+                ten.replay.append(row)
+                return self._fault_resp(
+                    "tick", tenant_id, ten,
+                    ErrorInfo(
+                        SYSTEM_FAULT, "store_io",
+                        f"reconcile persistence failed: {e}",
+                    ),
+                )
+
+        if deadline.exceeded():
+            ten.replay.append(row)
+            return self._fault_resp(
+                "tick", tenant_id, ten,
+                ErrorInfo(
+                    SYSTEM_FAULT, "deadline_exceeded",
+                    f"deadline of {deadline.budget_s}s exceeded",
+                ),
+                recovered=recovered,
+            )
+
+        self._ticks += 1
+        new_state = online_tick(ten.model, ten.state, row[0], row[1])
+        if _faults.site_hits("tick_nan", self._ticks):
+            _faults.fault_fired("tick_nan")
+            new_state = FilterState(s=new_state.s * np.nan, t=new_state.t)
+        # The deep check materializes the state on host — a forced device
+        # sync that breaks dispatch pipelining, ~the whole envelope
+        # budget on its own.  The committed state is provably finite when
+        # the previous state and this row were (the update is linear in
+        # both with finite install-time constants), so the clean fast
+        # path samples the sync every 8th tick and goes deep only when a
+        # cheap host signal says it must: an observed non-finite input,
+        # an active fault plan (injection bypasses the invariant by
+        # poisoning the output directly), a panel-less tenant (its
+        # reconcile path cannot refilter from scratch), or a suspect
+        # flag raised by a non-finite materialized nowcast.
+        deep = (
+            ten.suspect
+            or ten.hist is None
+            or not np.isfinite(row[0]).all()
+            or _faults.active_plan().any()
+            or (self._ticks & 7) == 0
+        )
+        if deep and not host_finite(new_state.s):
+            ten.replay.append(row)
+            return self._fault_resp(
+                "tick", tenant_id, ten,
+                ErrorInfo(
+                    TENANT_FAULT, "nonfinite_state",
+                    "tick produced a non-finite filter state; "
+                    "row buffered for replay",
+                ),
+                recovered=recovered,
+            )
+        if deadline.exceeded():  # final probe before the commit point
+            ten.replay.append(row)
+            return self._fault_resp(
+                "tick", tenant_id, ten,
+                ErrorInfo(
+                    SYSTEM_FAULT, "deadline_exceeded",
+                    f"deadline of {deadline.budget_s}s exceeded",
+                ),
+                recovered=recovered,
+            )
+
+        # write-ahead: the journal append is the commit point
+        retries = 0
+        if self.store is not None:
+            journal = self.store.journal(tenant_id)
+            t_idx = int(ten.state.t)
+            try:
+                _, retries = call_with_retries(
+                    lambda: journal.append(t_idx, row[0], row[1]),
+                    self.retry_policy,
+                    key=f"{tenant_id}:tick:{t_idx}",
+                    deadline=deadline,
+                )
+            except OSError as e:
+                ten.replay.append(row)
+                return self._fault_resp(
+                    "tick", tenant_id, ten,
+                    ErrorInfo(
+                        SYSTEM_FAULT, "store_io",
+                        f"tick journal append failed: {e}",
+                    ),
+                    retries=self.retry_policy.max_retries,
+                    recovered=recovered,
+                )
+
+        ten.state = new_state
+        if deep:
+            ten.suspect = False  # committed state re-verified on host
+        if ten.hist is not None:
+            ten.hist.append(row[0], row[1])
+        ten.breaker.record_success()
+        return Response(
+            ok=True, kind="tick", tenant=tenant_id, result=new_state,
+            retries=retries, breaker_state=ten.breaker.state,
+            recovered=recovered,
+        )
+
+    def _reconcile(self, tenant_id, ten) -> None:
+        """Fold the replay buffer back into committed state.
+
+        Panel tenants get ONE exact refilter over history + buffered
+        rows (`_install`), the recovery the chaos tests pin ≤ 1e-10
+        against the never-faulted run; panel-less resumed tenants
+        replay the buffered rows through the same tick executable.
+        Raises OSError when persistence keeps failing — the caller
+        leaves the buffer intact and reports a system fault."""
+        rows, ten.replay = ten.replay, []
+        try:
+            if ten.hist is not None:
+                xs = np.vstack([ten.hist.x] + [r[0][None] for r in rows])
+                ms = np.vstack([ten.hist.mask] + [r[1][None] for r in rows])
+                self._install(tenant_id, xs, ms, ten.params)
+            else:
+                state = ten.state
+                for x_row, m_row in rows:
+                    if self.store is not None:
+                        journal = self.store.journal(tenant_id)
+                        t_idx = int(state.t)
+                        call_with_retries(
+                            lambda: journal.append(t_idx, x_row, m_row),
+                            self.retry_policy,
+                            key=f"{tenant_id}:reconcile:{t_idx}",
+                        )
+                    state = online_tick(ten.model, state, x_row, m_row)
+                ten.state = state
+        except OSError:
+            ten.replay = rows + ten.replay  # keep the rows for next try
+            raise
+        inc("serving.reconciles")
+
+    # -- nowcast / refit / scenario --------------------------------------
+
+    def _nowcast(self, tenant_id, ten, req) -> Response:
+        try:
+            horizon = int(req.get("horizon", 0))
+        except (TypeError, ValueError):
+            return self._client_err(
+                "nowcast", tenant_id, "bad_value",
+                "'horizon' must be a non-negative integer", field="horizon",
+            )
+        if horizon < 0:
+            return self._client_err(
+                "nowcast", tenant_id, "bad_value",
+                f"'horizon' must be >= 0, got {horizon}", field="horizon",
+            )
+        # degraded mode: last-good state still answers, with an explicit
+        # staleness stamp, while the tenant's ticks are buffered
+        vec = np.asarray(nowcast(ten.model, ten.state, horizon))
+        # the result just materialized on host, so this check is free —
+        # it is the backstop for the sampled deep check in _tick: a
+        # non-finite state can never reach a caller unflagged
+        if not np.isfinite(vec).all():
+            ten.suspect = True
+            return self._fault_resp(
+                "nowcast", tenant_id, ten,
+                ErrorInfo(
+                    TENANT_FAULT, "nonfinite_state",
+                    "nowcast drew on a non-finite filter state; "
+                    "tenant flagged for deep check",
+                ),
+            )
+        return Response(
+            ok=True, kind="nowcast", tenant=tenant_id, result=vec,
+            degraded=bool(ten.replay), ticks_behind=len(ten.replay),
+            breaker_state=ten.breaker.state,
+        )
+
+    def _scenario(self, tenant_id, ten, req) -> Response:
         from ..scenarios import ScenarioRequest, run_scenario
 
-        ten = self._tenants[tenant_id]
-        req = ScenarioRequest(**spec)
-        with run_record(
-            "serving", kind="scenario",
-            config={
-                "tenant": tenant_id,
-                "scenario": req.kind,
-                "horizon": int(req.horizon),
-                "n_draws": int(req.n_draws or 0),
-            },
-        ):
-            x = np.where(ten.mask, ten.x, np.nan)
-            return run_scenario(ten.params, x, req)
+        spec = req.get("scenario")
+        if spec is None:
+            return self._client_err(
+                "scenario", tenant_id, "missing_field",
+                "scenario request is missing 'scenario'", field="scenario",
+            )
+        if not isinstance(spec, dict):
+            return self._client_err(
+                "scenario", tenant_id, "bad_value",
+                f"'scenario' must be a dict, got {type(spec).__name__}",
+                field="scenario",
+            )
+        if ten.hist is None:
+            return self._fault_resp(
+                "scenario", tenant_id, ten,
+                ErrorInfo(
+                    TENANT_FAULT, "no_history",
+                    "tenant was resumed without a panel; re-register "
+                    "with history to run scenarios",
+                ),
+                count_fault=False,
+            )
+        try:
+            sreq = ScenarioRequest(**spec)
+        except TypeError as e:
+            m = _re.search(r"'(\w+)'", str(e))
+            field = f"scenario.{m.group(1)}" if m else "scenario"
+            return self._client_err(
+                "scenario", tenant_id, "unknown_scenario_field",
+                str(e), field=field,
+            )
+        x = np.where(ten.hist.mask, ten.hist.x, np.nan)
+        try:
+            result = run_scenario(ten.params, x, sreq)
+        except ValueError as e:  # unknown scenario kind / bad spec values
+            return self._client_err(
+                "scenario", tenant_id, "bad_scenario",
+                str(e), field="scenario",
+            )
+        return Response(
+            ok=True, kind="scenario", tenant=tenant_id, result=result,
+            degraded=bool(ten.replay), ticks_behind=len(ten.replay),
+            breaker_state=ten.breaker.state,
+        )
 
     def _queue_refit(self, tenant_id: str) -> int:
         if tenant_id not in self._refit_queue:
@@ -188,62 +673,134 @@ class ServingEngine:
 
     # -- batched refits --------------------------------------------------
 
-    def flush_refits(self) -> dict:
+    def flush_refits(self) -> Response:
         """Execute the refit queue, batched per (T, N) compile bucket.
 
         Healthy tenants get new params + re-derived serving constants +
         an exact refiltered state; a tenant whose loop tripped keeps its
-        previous fit untouched.  Returns {tenant_id: RefitResult}."""
+        previous fit and is RE-QUEUED, up to `max_refit_retries` flushes,
+        after which it is surfaced as a permanent failure (and counted in
+        telemetry) instead of silently dropped.  Returns a Response whose
+        `result` maps tenant_id -> RefitResult and whose `info` carries
+        ``installed`` / ``requeued`` / ``permanent_failures``."""
         queue, self._refit_queue = self._refit_queue, []
         if not queue:
-            return {}
-        reqs = [
-            RefitRequest(
-                tenant_id=tid,
-                x=jnp.asarray(self._tenants[tid].x),
-                mask=jnp.asarray(self._tenants[tid].mask),
-                params=self._tenants[tid].params,
+            return Response(
+                ok=True, kind="refit_flush", tenant=None, result={},
+                info={"installed": 0, "requeued": [],
+                      "permanent_failures": []},
             )
-            for tid in queue
-        ]
+        reqs = []
+        for tid in queue:
+            ten = self._tenants[tid]
+            if ten.hist is None:  # panel-less: nothing to refit against
+                self._refit_retries.pop(tid, None)
+                continue
+            reqs.append(RefitRequest(
+                tenant_id=tid,
+                x=jnp.asarray(ten.hist.x),
+                mask=jnp.asarray(ten.hist.mask),
+                params=ten.params,
+            ))
         with run_record(
             "serving", kind="refit_flush", config={"n_tenants": len(reqs)},
         ) as rec:
             results = refit_batch(
-                reqs, tol=self.tol, max_em_iter=self.max_em_iter
+                reqs, tol=self.tol, max_em_iter=self.max_em_iter,
+                isolate_errors=True,
             )
-            installed = 0
+            installed, requeued, permanent = 0, [], []
             for res in results:
                 ten = self._tenants[res.tenant_id]
-                if res.health == 0:
-                    self._install(res.tenant_id, ten.x, ten.mask, res.params)
+                ok = res.health == 0
+                if ok:
+                    try:
+                        self._install(
+                            res.tenant_id, ten.hist.x, ten.hist.mask,
+                            res.params,
+                        )
+                    except OSError:
+                        ok = False  # persistence failed: retry the refit
+                if ok:
                     installed += 1
-            rec.set(n_installed=installed)
-        return {res.tenant_id: res for res in results}
+                    self._refit_retries.pop(res.tenant_id, None)
+                    continue
+                n = self._refit_retries.get(res.tenant_id, 0) + 1
+                self._refit_retries[res.tenant_id] = n
+                if n <= self.max_refit_retries:
+                    requeued.append(res.tenant_id)
+                    if res.tenant_id not in self._refit_queue:
+                        self._refit_queue.append(res.tenant_id)
+                else:
+                    permanent.append(res.tenant_id)
+                    self._refit_retries.pop(res.tenant_id, None)
+                    inc("serving.refit.permanent_failures")
+            rec.set(
+                n_installed=installed,
+                outcome="ok" if not permanent else "tenant_fault",
+                error_kind=None if not permanent else "refit_permanent",
+                retries=max(
+                    (self._refit_retries.get(t, 0) for t in requeued),
+                    default=0,
+                ),
+                breaker_state="closed",
+            )
+        return Response(
+            ok=True, kind="refit_flush", tenant=None,
+            result={res.tenant_id: res for res in results},
+            info={"installed": installed, "requeued": requeued,
+                  "permanent_failures": permanent},
+        )
 
     # -- persistence -----------------------------------------------------
 
-    def resume(self, tenant_id: str, x, mask=None) -> bool:
-        """Re-admit a tenant from the store (params + filter clock); the
-        caller supplies the history panel (panels are not persisted —
-        they live in the tenant's data plane).  Returns False when the
-        store has no intact state for the id (never saved, or its archive
-        was quarantined as corrupt) — register() it afresh instead."""
+    def resume(self, tenant_id: str, x=None, mask=None) -> bool:
+        """Re-admit a tenant from the store.  Returns False when the
+        store has no intact state for the id (never saved, or its
+        archive was quarantined as corrupt) — register() it afresh.
+
+        With a panel `x` supplied, the snapshot's params are re-derived
+        against the caller's history (the classic path).  WITHOUT a
+        panel — the crash-restart path — the snapshot's FilterState is
+        restored and the write-ahead tick journal replayed through the
+        same tick executable, landing bit-identically on the killed
+        process's committed state; the tenant then serves ticks and
+        nowcasts normally but answers `no_history` to refit/scenario
+        until re-registered with history."""
         if self.store is None:
             return False
-        x = np.asarray(x, float)
-        if mask is None:
-            mask = np.isfinite(x)
-        mask = np.asarray(mask, bool)
-        N = x.shape[1]
-        from .store import template_state
-
-        like = template_state(N, 4, 4)
-        stored = self.store.load(tenant_id, like)
+        # the template is structure-only (leaf shapes come from the
+        # archive), so one (1, 1, 1) template loads any (N, r, p) tenant
+        stored = self.store.load(tenant_id, template_state(1, 1, 1))
         if stored is None:
             return False
-        self._install(
-            tenant_id, np.where(mask, x, 0.0), mask, stored.params
+        params = stored.params
+        r, p = int(stored.r), int(stored.p)
+        if params.lam.shape[1] != r or params.A.shape[0] != p:
+            inc("serving.store.inconsistent")
+            return False
+        if x is not None:
+            x = np.asarray(x, float)
+            if mask is None:
+                mask = np.isfinite(x)
+            mask = np.asarray(mask, bool)
+            self._install(tenant_id, np.where(mask, x, 0.0), mask, params)
+            return True
+        model = derive_serving_model(params)
+        state = FilterState(
+            s=jnp.asarray(stored.s), t=jnp.asarray(stored.t, jnp.int32)
+        )
+        rep = self.store.journal(tenant_id).replay()
+        if rep is not None:
+            base_t, rows = rep
+            if base_t == int(stored.t) and rows:
+                state = replay_ticks(model, state, rows)
+                inc("serving.journal.replayed", len(rows))
+            # a journal anchored at a different t predates this snapshot
+            # (crash between save and journal reset): already folded in
+        self._tenants[tenant_id] = _Tenant(
+            None, params, model, state,
+            CircuitBreaker(self.breaker_threshold, self.breaker_cooldown),
         )
         return True
 
@@ -287,15 +844,18 @@ def main(argv=None) -> int:
         for tid in eng.tenant_ids():
             row = rng.standard_normal(args.N)
             eng.handle({"kind": "tick", "tenant": tid, "x": row})
-    nc = eng.handle({"kind": "nowcast", "tenant": "tenant0", "horizon": 0})
+    resp = eng.handle({"kind": "nowcast", "tenant": "tenant0", "horizon": 0})
     print(json.dumps({
         "phase": "ticks", "n_ticks": args.ticks * args.tenants,
-        "nowcast0_head": [round(float(v), 4) for v in np.asarray(nc)[:4]],
+        "degraded": resp.degraded,
+        "nowcast0_head": [
+            round(float(v), 4) for v in np.asarray(resp.result)[:4]
+        ],
     }))
 
     for tid in eng.tenant_ids():
         eng.handle({"kind": "refit", "tenant": tid})
-    results = eng.flush_refits()
+    flush = eng.flush_refits()
     print(json.dumps({
         "phase": "refit",
         "results": {
@@ -304,8 +864,9 @@ def main(argv=None) -> int:
                 "converged": r.converged,
                 "health": r.health,
             }
-            for tid, r in sorted(results.items())
+            for tid, r in sorted(flush.result.items())
         },
+        "permanent_failures": flush.info["permanent_failures"],
     }))
 
     sc = eng.handle({
@@ -317,7 +878,7 @@ def main(argv=None) -> int:
     })
     print(json.dumps({
         "phase": "scenario", "scenario": "stress",
-        "fan_shape": list(np.asarray(sc.mean).shape),
+        "fan_shape": list(np.asarray(sc.result.mean).shape),
     }))
     return 0
 

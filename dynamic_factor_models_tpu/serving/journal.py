@@ -1,0 +1,209 @@
+"""Crash-safe write-ahead tick journal: replayable O(1) updates.
+
+A tenant's snapshot (`TenantStore.save`) lands only at `_install` time —
+register, resume, or a successful refit.  Every online tick between two
+snapshots would die with the process, forcing the caller to re-supply
+the panel on restart.  The journal closes that gap with write-ahead
+logging: the engine appends the tick's `(t, x, mask)` row BEFORE
+committing the new `FilterState`, so after a kill the next process
+replays `snapshot + journal` through the SAME `online_tick` executable
+and lands on a bit-identical state — same program, same inputs, same
+floats.
+
+Format: one JSONL file per tenant next to its snapshot.
+
+    line 0:  {"magic", "version", "base_t", "sha"}          header
+    line k:  {"t", "dtype", "x", "mask", "sha"}             one tick
+
+`x` is the base64 of the zero-filled row's raw bytes, `mask` the base64
+of the uint8 mask bytes; `sha` is a sha256 over the record's payload
+fields, so torn writes and silent corruption are both detected per
+SEGMENT, like PR 4's checkpoints.  Appends are a single `write()` of
+one line (O_APPEND semantics: a crash can tear at most the final line)
+followed by flush+fsync — the journal is the commit point.
+
+Recovery policy on damage: the intact prefix is TRUSTED, everything
+from the first bad record on is dropped; the damaged file is preserved
+whole at ``<path>.corrupt`` for forensics and the live file rewritten
+to the intact prefix (counter ``serving.journal.quarantined``).  A bad
+HEADER poisons the whole journal: quarantine and report empty.
+
+I/O faults: every disk touch first calls the owning store's `io_probe`
+(see `TenantStore.io_probe`), the shared site counter behind the
+``store_io@n`` chaos grammar — so snapshot saves and journal appends
+draw from one deterministic fault sequence.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import json
+import os
+
+import numpy as np
+
+from ..utils.telemetry import inc
+
+__all__ = ["TickJournal", "JOURNAL_MAGIC"]
+
+JOURNAL_MAGIC = "dfm-tick-journal"
+_VERSION = 1
+
+
+def _header_sha(base_t: int) -> str:
+    payload = f"{JOURNAL_MAGIC}|{_VERSION}|{int(base_t)}".encode()
+    return hashlib.sha256(payload).hexdigest()
+
+
+def _record_sha(t: int, dtype: str, x_b64: str, mask_b64: str) -> str:
+    payload = f"{int(t)}|{dtype}|{x_b64}|{mask_b64}".encode()
+    return hashlib.sha256(payload).hexdigest()
+
+
+class TickJournal:
+    """One tenant's append-only tick log.  Constructed by the store
+    (`TenantStore.journal`), which supplies the fault-counted
+    `io_probe`; safe to construct standalone with `io_probe=None`."""
+
+    def __init__(self, path: str, io_probe=None):
+        self.path = path
+        self._probe = io_probe or (lambda: None)
+
+    # -- writes ----------------------------------------------------------
+
+    def reset(self, base_t: int) -> None:
+        """Start a fresh journal anchored at snapshot time `base_t`
+        (atomic: temp file + rename, like the snapshot itself)."""
+        self._probe()
+        hdr = {
+            "magic": JOURNAL_MAGIC,
+            "version": _VERSION,
+            "base_t": int(base_t),
+            "sha": _header_sha(base_t),
+        }
+        tmp = f"{self.path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            f.write(json.dumps(hdr) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+
+    def append(self, t: int, x: np.ndarray, mask: np.ndarray) -> None:
+        """Write-ahead one tick: a single one-line append + fsync.  The
+        caller commits its in-memory state only after this returns — an
+        OSError here (real or ``store_io@n``-injected) means the tick
+        never happened."""
+        x = np.ascontiguousarray(x)
+        mask = np.ascontiguousarray(mask, dtype=np.uint8)
+        x_b64 = base64.b64encode(x.tobytes()).decode()
+        mask_b64 = base64.b64encode(mask.tobytes()).decode()
+        rec = {
+            "t": int(t),
+            "dtype": x.dtype.str,
+            "x": x_b64,
+            "mask": mask_b64,
+            "sha": _record_sha(t, x.dtype.str, x_b64, mask_b64),
+        }
+        self._probe()
+        with open(self.path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        inc("serving.journal.appends")
+
+    # -- reads -----------------------------------------------------------
+
+    def replay(self):
+        """Read the journal back: ``(base_t, rows)`` with `rows` a list
+        of ``(t, x, mask)`` in append order, or None when the file is
+        absent or its header is damaged.  A damaged record quarantines
+        the file (kept whole at ``.corrupt``) and truncates the live
+        journal to the intact prefix, which is returned."""
+        if not os.path.exists(self.path):
+            return None
+        with open(self.path, "rb") as f:
+            raw = f.read()
+        lines = raw.split(b"\n")
+        hdr = self._parse_header(lines[0] if lines else b"")
+        if hdr is None:
+            self._quarantine(raw, base_t=None, good=[])
+            return None
+        rows, good = [], []
+        for line in lines[1:]:
+            if not line.strip():
+                continue
+            rec = self._parse_record(line)
+            if rec is None:  # torn append or flipped bytes: drop the tail
+                self._quarantine(raw, base_t=hdr, good=good)
+                break
+            rows.append(rec)
+            good.append(line)
+        return hdr, rows
+
+    def _parse_header(self, line: bytes):
+        try:
+            hdr = json.loads(line)
+            if (
+                hdr.get("magic") != JOURNAL_MAGIC
+                or hdr.get("version") != _VERSION
+                or hdr.get("sha") != _header_sha(hdr["base_t"])
+            ):
+                return None
+            return int(hdr["base_t"])
+        except (ValueError, KeyError, TypeError):
+            return None
+
+    def _parse_record(self, line: bytes):
+        try:
+            rec = json.loads(line)
+            if rec["sha"] != _record_sha(
+                rec["t"], rec["dtype"], rec["x"], rec["mask"]
+            ):
+                return None
+            x = np.frombuffer(
+                base64.b64decode(rec["x"]), dtype=np.dtype(rec["dtype"])
+            )
+            mask = np.frombuffer(
+                base64.b64decode(rec["mask"]), dtype=np.uint8
+            ).astype(bool)
+            if mask.shape != x.shape:
+                return None
+            return int(rec["t"]), x, mask
+        except (ValueError, KeyError, TypeError):
+            return None
+
+    def _quarantine(self, raw: bytes, base_t, good: list) -> None:
+        """Preserve the damaged file, rewrite the live one to the intact
+        prefix (or remove it entirely on a bad header)."""
+        with open(self.path + ".corrupt", "wb") as f:
+            f.write(raw)
+        if base_t is None:
+            os.remove(self.path)
+        else:
+            tmp = f"{self.path}.tmp.{os.getpid()}"
+            hdr = {
+                "magic": JOURNAL_MAGIC,
+                "version": _VERSION,
+                "base_t": int(base_t),
+                "sha": _header_sha(base_t),
+            }
+            with open(tmp, "wb") as f:
+                f.write((json.dumps(hdr) + "\n").encode())
+                for line in good:
+                    f.write(line + b"\n")
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.path)
+        inc("serving.journal.quarantined")
+
+    # -- lifecycle -------------------------------------------------------
+
+    def exists(self) -> bool:
+        return os.path.exists(self.path)
+
+    def delete(self) -> None:
+        try:
+            os.remove(self.path)
+        except FileNotFoundError:
+            pass

@@ -41,6 +41,7 @@ __all__ = [
     "derive_serving_model",
     "derive_serving_model_mf",
     "online_tick",
+    "replay_ticks",
     "nowcast",
 ]
 
@@ -167,6 +168,18 @@ def online_tick(
     x_t = jnp.asarray(x_t, model.Wb.dtype)
     mask_t = jnp.asarray(mask_t, bool)
     return aot_call("serving_tick", _tick, model, state, x_t, mask_t)
+
+
+def replay_ticks(model: ServingModel, state: FilterState, rows) -> FilterState:
+    """Re-apply journaled ticks: `rows` iterates ``(t, x, mask)`` in
+    append order (serving/journal.py).  Each row goes through the SAME
+    `online_tick` executable the live path used, so a restart that
+    replays snapshot + journal lands on a bit-identical FilterState —
+    same program, same inputs, same floats.  Host loop: journals are
+    short (ticks since the last snapshot), replay is a restart path."""
+    for _t, x_t, mask_t in rows:
+        state = online_tick(model, state, x_t, mask_t)
+    return state
 
 
 @jax.jit

@@ -1,0 +1,228 @@
+"""Request-hardening primitives for the serving engine.
+
+The serving loop's availability contract (docs/serving.md): every
+request gets a TYPED response — never an uncaught exception — and a
+faulted tenant degrades instead of taking the engine down.  This module
+supplies the host-side pieces the engine composes; nothing here touches
+a device program, so the tick/nowcast HLO stays byte-identical to the
+pre-hardening build (pinned by tests/test_serving.py).
+
+* **Error taxonomy** — every failure is classified into one of three
+  CATEGORIES, each with a machine-readable CODE:
+
+  - ``client_error``: the request itself is wrong (missing field, bad
+    shape, unknown tenant/kind).  Never retried, never counts against a
+    tenant's circuit breaker.
+  - ``tenant_fault``: this tenant's serving state is unhealthy (a
+    non-finite tick result, an open breaker).  The tick lands in the
+    tenant's replay buffer; nowcasts degrade to last-good state; other
+    tenants are unaffected.
+  - ``system_fault``: the engine's own machinery failed (store I/O,
+    deadline blown, unexpected exception).  Transient system faults are
+    retried with bounded exponential backoff before surfacing.
+
+* **Response envelope** — a NamedTuple carrying the result OR an
+  `ErrorInfo`, plus the staleness stamp (`degraded`, `ticks_behind`),
+  the retry count, and the tenant's breaker state, so a caller — or the
+  chaos harness — can compute availability from responses alone.
+
+* **CircuitBreaker** — per-tenant, classic three-state: `closed` →
+  (k consecutive tenant faults) → `open` (requests fast-fail into the
+  replay buffer, no compute) → (cooldown requests) → `half_open` (one
+  probe allowed; success closes via the recovery reconcile, failure
+  re-opens).
+
+* **RetryPolicy** — exponential backoff with DETERMINISTIC jitter: the
+  jitter fraction is sha256(key:attempt), so a chaos run's retry timing
+  is reproducible bit-for-bit while distinct tenants still decorrelate.
+
+* **Deadline** — a started wall-clock budget; `exceeded()` probes are
+  placed at admission and immediately before any state commit, so a
+  blown deadline can never half-apply a tick.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import time
+from typing import Any, NamedTuple
+
+__all__ = [
+    "CLIENT_ERROR",
+    "TENANT_FAULT",
+    "SYSTEM_FAULT",
+    "BREAKER_CLOSED",
+    "BREAKER_OPEN",
+    "BREAKER_HALF_OPEN",
+    "ErrorInfo",
+    "Response",
+    "CircuitBreaker",
+    "RetryPolicy",
+    "Deadline",
+    "call_with_retries",
+]
+
+CLIENT_ERROR = "client_error"
+TENANT_FAULT = "tenant_fault"
+SYSTEM_FAULT = "system_fault"
+
+BREAKER_CLOSED = "closed"
+BREAKER_OPEN = "open"
+BREAKER_HALF_OPEN = "half_open"
+
+
+class ErrorInfo(NamedTuple):
+    """One classified failure.  `category` is the taxonomy bucket above;
+    `code` the machine-readable cause (e.g. ``missing_field``,
+    ``nonfinite_state``, ``deadline_exceeded``, ``store_io``);
+    `field` names the offending request field for client errors."""
+
+    category: str
+    code: str
+    message: str
+    field: str | None = None
+
+
+class Response(NamedTuple):
+    """Typed envelope for one serving request (or one refit flush).
+
+    `ok` is True when `result` holds the requested answer; False means
+    `error` explains why (and for a degraded nowcast, `result` may
+    STILL carry the stale answer — check `degraded`).  `ticks_behind`
+    counts the tenant's buffered-but-unapplied ticks at response time;
+    `retries` how many transient-fault retries the request consumed;
+    `breaker_state` the tenant's breaker after the request; `recovered`
+    flags a response whose handling completed a recovery reconcile.
+    `info` carries per-kind extras (flush retry/permanent lists)."""
+
+    ok: bool
+    kind: str
+    tenant: str | None
+    result: Any = None
+    error: ErrorInfo | None = None
+    degraded: bool = False
+    ticks_behind: int = 0
+    retries: int = 0
+    breaker_state: str = BREAKER_CLOSED
+    recovered: bool = False
+    info: dict | None = None
+
+
+class CircuitBreaker:
+    """Per-tenant three-state breaker over CONSECUTIVE tenant faults.
+
+    `threshold` consecutive faults open the breaker; while open, each
+    observed request decrements a cooldown of `cooldown` requests, after
+    which the breaker half-opens and admits exactly one probe.  A
+    successful probe (the engine's recovery reconcile) closes it; a
+    failed probe re-opens with a fresh cooldown.  Client errors must not
+    be recorded here — only genuine tenant faults."""
+
+    __slots__ = ("threshold", "cooldown", "state", "consecutive",
+                 "_cooldown_left", "opens")
+
+    def __init__(self, threshold: int = 3, cooldown: int = 4):
+        if threshold < 1 or cooldown < 1:
+            raise ValueError("breaker threshold and cooldown must be >= 1")
+        self.threshold = threshold
+        self.cooldown = cooldown
+        self.state = BREAKER_CLOSED
+        self.consecutive = 0
+        self._cooldown_left = 0
+        self.opens = 0  # lifetime open transitions (telemetry)
+
+    def on_request(self) -> str:
+        """Observe one request against this tenant; while open, burn one
+        cooldown slot and half-open when it reaches zero.  Returns the
+        state the request should be admitted under."""
+        if self.state == BREAKER_OPEN:
+            self._cooldown_left -= 1
+            if self._cooldown_left <= 0:
+                self.state = BREAKER_HALF_OPEN
+        return self.state
+
+    def record_success(self) -> None:
+        self.consecutive = 0
+        if self.state != BREAKER_CLOSED:
+            self.state = BREAKER_CLOSED
+
+    def record_fault(self) -> None:
+        self.consecutive += 1
+        if self.state == BREAKER_HALF_OPEN or (
+            self.state == BREAKER_CLOSED
+            and self.consecutive >= self.threshold
+        ):
+            self.state = BREAKER_OPEN
+            self._cooldown_left = self.cooldown
+            self.opens += 1
+
+
+class RetryPolicy(NamedTuple):
+    """Bounded exponential backoff with deterministic jitter.
+
+    Attempt a's delay is ``min(cap, base * 2**a) * (0.5 + 0.5 * u)``
+    with ``u = sha256(key:a) / 2**64`` — reproducible for a given
+    (key, attempt), decorrelated across tenants.  ``base=0`` (the test
+    configuration) makes every delay exactly zero."""
+
+    max_retries: int = 2
+    backoff_base_s: float = 0.02
+    backoff_cap_s: float = 0.25
+
+    def delay_s(self, key: str, attempt: int) -> float:
+        base = min(self.backoff_cap_s, self.backoff_base_s * (2.0 ** attempt))
+        if base <= 0.0:
+            return 0.0
+        h = hashlib.sha256(f"{key}:{attempt}".encode()).digest()
+        u = int.from_bytes(h[:8], "big") / float(1 << 64)
+        return base * (0.5 + 0.5 * u)
+
+
+class Deadline:
+    """A started wall-clock budget.  `budget_s=None` never expires."""
+
+    __slots__ = ("budget_s", "_t0")
+
+    def __init__(self, budget_s: float | None):
+        self.budget_s = None if budget_s is None else float(budget_s)
+        self._t0 = time.perf_counter()
+
+    def elapsed_s(self) -> float:
+        return time.perf_counter() - self._t0
+
+    def exceeded(self) -> bool:
+        return self.budget_s is not None and self.elapsed_s() > self.budget_s
+
+    def expire(self) -> None:
+        """Force the budget spent — the ``slow_req@n`` injection models a
+        stall past the deadline without actually sleeping the budget (a
+        None budget stays un-expirable: no deadline means no stall)."""
+        self._t0 = float("-inf")
+
+
+def call_with_retries(
+    fn,
+    policy: RetryPolicy,
+    key: str,
+    retryable: tuple = (OSError,),
+    deadline: Deadline | None = None,
+    sleep=time.sleep,
+):
+    """Run `fn()` with up to `policy.max_retries` retries on `retryable`
+    exceptions, backing off per `policy.delay_s(key, attempt)`.
+
+    Returns ``(result, retries_used)``.  A deadline cuts retrying short:
+    once exceeded, the last exception propagates to the caller (which
+    classifies it) rather than burning further attempts.  Non-retryable
+    exceptions propagate immediately with zero extra attempts."""
+    attempt = 0
+    while True:
+        try:
+            return fn(), attempt
+        except retryable:
+            if attempt >= policy.max_retries or (
+                deadline is not None and deadline.exceeded()
+            ):
+                raise
+            sleep(policy.delay_s(key, attempt))
+            attempt += 1

@@ -31,6 +31,7 @@ from ..utils.checkpoint import (
     save_pytree,
 )
 from ..utils.telemetry import inc
+from .journal import TickJournal
 
 __all__ = ["TenantState", "TenantStore", "template_state"]
 
@@ -39,18 +40,27 @@ _ID_RE = re.compile(r"^[A-Za-z0-9_\-]+$")
 
 class TenantState(NamedTuple):
     """Everything a tenant needs to serve after a process restart: the
-    fitted `params`, the current filtered mean `s` (k,), and the absolute
-    time index `t` of the next tick (the observation phase is t mod d).
-    The ServingModel itself is NOT stored — it is a pure function of
-    `params` (one DARE solve) and is re-derived on load."""
+    fitted `params`, the current filtered mean `s` (k,), the absolute
+    time index `t` of the next tick (the observation phase is t mod d),
+    and the factor count `r` / VAR order `p` as stored leaves — so a
+    tenant fitted with non-default (r, p) round-trips without the loader
+    guessing shapes.  The ServingModel itself is NOT stored — it is a
+    pure function of `params` (one DARE solve) and is re-derived on
+    load."""
 
     params: SSMParams
     s: jnp.ndarray
     t: jnp.ndarray
+    r: jnp.ndarray
+    p: jnp.ndarray
 
 
 def template_state(N: int, r: int, p: int, dtype=float) -> TenantState:
-    """Structure-only template for `load_pytree` (dummy leaves)."""
+    """Structure-only template for `load_pytree` (dummy leaves).
+
+    Leaf SHAPES here are placeholders — `load_pytree` verifies leaf
+    count and treedef, then takes shapes from the archive — so one
+    template covers tenants of any (r, p)."""
     dt = jnp.result_type(dtype)  # respects the x64 switch
     k = r * p
     return TenantState(
@@ -62,6 +72,8 @@ def template_state(N: int, r: int, p: int, dtype=float) -> TenantState:
         ),
         s=jnp.zeros((k,), dt),
         t=jnp.zeros((), jnp.int32),
+        r=jnp.asarray(r, jnp.int32),
+        p=jnp.asarray(p, jnp.int32),
     )
 
 
@@ -79,6 +91,7 @@ class TenantStore:
         self.directory = directory
         os.makedirs(directory, exist_ok=True)
         self._saves = 0
+        self._io_ops = 0
 
     def _path(self, tenant_id: str) -> str:
         if not _ID_RE.match(tenant_id):
@@ -87,6 +100,24 @@ class TenantStore:
             )
         return os.path.join(self.directory, tenant_id + ".npz")
 
+    def io_probe(self) -> None:
+        """Count one store I/O operation against the ``store_io@n``
+        fault site.  Snapshot saves and journal writes share THIS
+        counter, so one spec drives a deterministic fault sequence
+        across both paths.  Raises OSError when the site fires."""
+        self._io_ops += 1
+        if _faults.site_hits("store_io", self._io_ops):
+            _faults.fault_fired("store_io")
+            raise OSError(
+                f"injected store_io fault (op {self._io_ops})"
+            )
+
+    def journal(self, tenant_id: str) -> TickJournal:
+        """This tenant's write-ahead tick journal, wired to the store's
+        fault-counted `io_probe` (file lives next to the snapshot)."""
+        path = self._path(tenant_id)[: -len(".npz")] + ".journal"
+        return TickJournal(path, io_probe=self.io_probe)
+
     def save(self, tenant_id: str, state: TenantState) -> None:
         """Atomically persist one tenant (temp file + rename; a crash
         mid-save leaves the previous archive intact).  Honors the
@@ -94,6 +125,7 @@ class TenantStore:
         store instance is damaged after landing — the chaos drill the
         quarantine path is pinned against."""
         path = self._path(tenant_id)
+        self.io_probe()
         tmp = f"{path}.tmp.{os.getpid()}.{uuid.uuid4().hex[:8]}.npz"
         try:
             save_pytree(tmp, state)
@@ -130,6 +162,7 @@ class TenantStore:
 
     def delete(self, tenant_id: str) -> bool:
         path = self._path(tenant_id)
+        self.journal(tenant_id).delete()
         try:
             os.remove(path)
             return True

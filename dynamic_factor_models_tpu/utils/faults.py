@@ -27,8 +27,26 @@ Spec grammar (``DFM_FAULTS``, also `inject()` below)::
                     n-th checkpoint chunk save — a mid-run kill whose
                     resume must be bit-identical to an unkilled run
 
-Unsuffixed ``ckpt_corrupt`` / ``preempt`` default to n=1; ``nan_estep`` /
-``chol_fail`` / ``nan_draw`` require an explicit iteration.
+Serving-path kinds (counted by serving/engine + serving/store, the
+chaos-serving drills in tests/test_chaos_serving.py and
+``bench.py --chaos-serving``)::
+
+    tick_nan@n      poison the RESULT of the n-th online tick through a
+                    ServingEngine (a transient compute fault: the input
+                    row stays clean in the replay buffer, so recovery
+                    must reconcile to the fault-free run)
+    store_io@n      the n-th tenant-store I/O operation (snapshot save
+                    or journal append) raises OSError — the transient
+                    fault the engine's bounded retry must absorb
+    slow_req@n      stall the n-th engine request past its deadline
+                    (the request must come back deadline_exceeded, not
+                    hang or corrupt state)
+    engine_crash@n  raise SimulatedCrash at admission of the n-th
+                    engine request — a process kill whose restart must
+                    replay the tick journal bit-identically
+
+Unsuffixed ``ckpt_corrupt`` / ``preempt`` / ``engine_crash`` default to
+n=1; every other kind requires an explicit site.
 
 By default an in-loop fault (`nan_estep`, `chol_fail`) is TRANSIENT: it
 is baked only into the FIRST guarded-loop attempt's program, so the
@@ -40,6 +58,13 @@ rungs) and only stops applying when a rung changes the step or its dtype
 program, used to exercise the deeper rungs deterministically.  The
 checkpoint faults fire once per `run_em_loop` call when the chunk
 counter hits n and ignore ``+``.
+
+For the serving kinds ``+`` means a fault STORM rather than a one-shot:
+``tick_nan@1+`` poisons EVERY tick from site 1 onward while the plan is
+active (the circuit-breaker open drill), ``store_io@2+`` fails every
+store op from the 2nd on (retry exhaustion), ``slow_req@1+`` stalls
+every request.  ``engine_crash`` is a kill — it fires once and cannot
+be persistent.
 
 Everything here is host-side and import-cheap; with no spec active every
 probe returns the empty plan and the guarded program is unchanged.
@@ -56,19 +81,28 @@ __all__ = [
     "FaultPlan",
     "EMPTY_PLAN",
     "SimulatedPreemption",
+    "SimulatedCrash",
     "parse_spec",
     "active_plan",
     "inject",
     "fault_fired",
+    "site_hits",
     "corrupt_file",
 ]
 
 _lock = threading.RLock()
 _override: "FaultPlan | None" = None
 
-_KINDS = ("nan_estep", "chol_fail", "nan_draw", "ckpt_corrupt", "preempt")
+_KINDS = (
+    "nan_estep", "chol_fail", "nan_draw", "ckpt_corrupt", "preempt",
+    "tick_nan", "store_io", "slow_req", "engine_crash",
+)
 # kinds where a bare clause means "at the first site"
-_DEFAULT_SITE = {"ckpt_corrupt": 1, "preempt": 1}
+_DEFAULT_SITE = {"ckpt_corrupt": 1, "preempt": 1, "engine_crash": 1}
+# kinds a trailing '+' may mark persistent (in-loop retries / serving storms)
+_PERSISTABLE = frozenset(
+    {"nan_estep", "chol_fail", "nan_draw", "tick_nan", "store_io", "slow_req"}
+)
 
 
 class SimulatedPreemption(RuntimeError):
@@ -80,6 +114,13 @@ class SimulatedPreemption(RuntimeError):
     """
 
 
+class SimulatedCrash(RuntimeError):
+    """Raised at an injected `engine_crash@n` site: a process kill at
+    request admission.  Like SimulatedPreemption it models an EXTERNAL
+    death — the serving engine's error envelope must NOT absorb it;
+    recovery happens in the next process via tick-journal replay."""
+
+
 class FaultPlan(NamedTuple):
     """Parsed DFM_FAULTS spec: 1-based site index per kind (None = off)
     plus the set of kinds flagged persistent with a trailing ``+``."""
@@ -89,10 +130,26 @@ class FaultPlan(NamedTuple):
     ckpt_corrupt: int | None = None
     preempt: int | None = None
     nan_draw: int | None = None
+    tick_nan: int | None = None
+    store_io: int | None = None
+    slow_req: int | None = None
+    engine_crash: int | None = None
     persistent: frozenset = frozenset()
 
     def any(self) -> bool:
-        return any(v is not None for v in self[:5])
+        return any(v is not None for v in self[:-1])
+
+    def hits(self, kind: str, count: int) -> bool:
+        """Does the `count`-th pass through a site-counted probe fire
+        the `kind` fault?  One-shot at the exact site by default; a
+        persistent kind fires at every count >= its site (the serving
+        fault-storm semantics)."""
+        site = getattr(self, kind)
+        if site is None:
+            return False
+        if kind in self.persistent:
+            return count >= site
+        return count == site
 
 
 EMPTY_PLAN = FaultPlan()
@@ -146,13 +203,21 @@ def parse_spec(spec: str | None) -> FaultPlan:
             raise ValueError(f"DFM_FAULTS: duplicate clause for {kind!r}")
         plan[kind] = n
         if persist:
-            if kind in _DEFAULT_SITE:
+            if kind not in _PERSISTABLE:
                 raise ValueError(
-                    f"DFM_FAULTS: '+' (persistent) only applies to in-loop "
-                    f"faults, not {kind!r}"
+                    f"DFM_FAULTS: '+' (persistent) does not apply to "
+                    f"{kind!r} (valid for: {', '.join(sorted(_PERSISTABLE))})"
                 )
             persistent.add(kind)
     return FaultPlan(persistent=frozenset(persistent), **plan)
+
+
+def site_hits(kind: str, count: int) -> bool:
+    """Probe the active plan at a site-counted fault point: True when the
+    `count`-th pass through the `kind` site should fault (see
+    FaultPlan.hits).  The caller acts on the fault and reports it via
+    `fault_fired(kind)`."""
+    return active_plan().hits(kind, count)
 
 
 def active_plan() -> FaultPlan:

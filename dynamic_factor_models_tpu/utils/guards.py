@@ -48,6 +48,7 @@ __all__ = [
     "guards_enabled",
     "drop_tol",
     "tree_finite",
+    "host_finite",
     "batched_tree_finite",
     "batched_where",
     "psd_project",
@@ -122,6 +123,22 @@ def tree_finite(tree) -> jnp.ndarray:
     for v in leaves[1:]:
         out = out & v
     return out
+
+
+def host_finite(tree) -> bool:
+    """Host-side finiteness probe: a concrete python bool, for guard
+    points OUTSIDE any trace — the serving engine checks each committed
+    tick result with this before journaling it, so a poisoned state
+    (``tick_nan@n``) is caught at the request boundary instead of
+    corrupting the tenant's committed filter.  Pulls the leaves to host
+    (they are O(k) serving-state sized, not panel sized)."""
+    import numpy as np
+
+    for x in jax.tree_util.tree_leaves(tree):
+        arr = np.asarray(x)
+        if np.issubdtype(arr.dtype, np.inexact) and not np.isfinite(arr).all():
+            return False
+    return True
 
 
 def batched_tree_finite(tree) -> jnp.ndarray:

@@ -697,9 +697,17 @@ def summarize(path: str, entry: str | None = None) -> str:
             "runs": 0, "errors": 0, "wall": 0.0, "iters": 0, "iter_runs": 0,
             "conv": 0, "compile_s": 0.0, "hits": 0, "misses": 0,
             "faults": 0, "recovered": 0, "unhealthy": 0,
+            "outcomes": 0, "answered": 0,
         })
         a["runs"] += 1
         a["errors"] += 1 if r.get("error") else 0
+        # availability: serving envelopes stamp `outcome` per request —
+        # "ok" and "degraded" both ANSWERED (degraded mode is the point),
+        # error categories did not.  Entries without outcomes show "-".
+        oc = r.get("outcome")
+        if oc is not None:
+            a["outcomes"] += 1
+            a["answered"] += 1 if oc in ("ok", "degraded") else 0
         a["wall"] += r.get("wall_s", 0.0) or 0.0
         # mean_iters averages over EM-style records only: a stream of
         # online ticks must not drag an entry's mean toward zero
@@ -732,12 +740,14 @@ def summarize(path: str, entry: str | None = None) -> str:
             (f"{a['faults']}/{a['recovered']}"
              + (f" ({a['unhealthy']} bad)" if a["unhealthy"] else "")
              if a["faults"] else "-"),
+            (f"{100.0 * a['answered'] / a['outcomes']:.1f}%"
+             if a["outcomes"] else "-"),
         ]
         for e, a in sorted(agg.items())
     ]
     aggregate = _fmt_table(
         ["entry", "runs", "err", "wall_s", "mean_s", "mean_iters",
-         "conv%", "compile_s", "aot h/m", "faults"],
+         "conv%", "compile_s", "aot h/m", "faults", "avail"],
         arows,
     )
     return (

@@ -1,0 +1,452 @@
+"""Serving-layer chaos drills (engine resilience, PR: serving hardening).
+
+Pinned claims:
+
+1. the DFM_FAULTS grammar covers the serving path (`tick_nan@n`,
+   `store_io@n`, `slow_req@n`, `engine_crash@n`, with ``+`` = storm);
+2. under a fault storm mixing tick_nan / store_io / slow_req across a
+   multi-tenant tick/nowcast/refit/scenario workload, 100% of requests
+   get a TYPED Response (zero uncaught exceptions), degraded responses
+   carry staleness stamps, and post-recovery state matches the
+   never-faulted run to <= 1e-10;
+3. a single transient fault degrades exactly one tenant (replay buffer
+   + degraded nowcasts) and the next clean tick reconciles it; a
+   persistent storm opens the per-tenant circuit breaker, which
+   half-opens after its cooldown and closes on a successful probe;
+4. `store_io` transients are absorbed by bounded retry with
+   deterministic backoff; retry exhaustion surfaces a typed
+   system-fault envelope with the tick row preserved for replay;
+5. `engine_crash@n` kill + restart replays the write-ahead tick journal
+   to a BIT-identical FilterState with no caller-side panel; journal
+   corruption quarantines the damaged tail and trusts the intact
+   prefix;
+6. `flush_refits` re-queues failing tenants with a bounded retry count
+   and surfaces permanent failures instead of silently dropping them.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from dynamic_factor_models_tpu.serving.engine import ServingEngine
+from dynamic_factor_models_tpu.serving.journal import TickJournal
+from dynamic_factor_models_tpu.serving.resilience import (
+    CircuitBreaker,
+    Response,
+    RetryPolicy,
+)
+from dynamic_factor_models_tpu.serving.store import TenantStore
+from dynamic_factor_models_tpu.utils import faults, telemetry
+
+pytestmark = [pytest.mark.serving, pytest.mark.chaos_serving]
+
+# zero backoff keeps the retry drills instant; jitter is deterministic
+# anyway (sha256 of key:attempt), so timing never enters the assertions
+_POLICY = RetryPolicy(max_retries=2, backoff_base_s=0.0)
+
+T, N = 48, 6
+
+
+def _panel(seed=0):
+    rng = np.random.default_rng(seed)
+    f = rng.standard_normal((T, 4)).cumsum(0) * 0.1
+    lam = rng.standard_normal((N, 4))
+    return f @ lam.T + 0.5 * rng.standard_normal((T, N))
+
+
+def _engine(store_dir=None, **kw):
+    kw.setdefault("retry_policy", _POLICY)
+    kw.setdefault("max_em_iter", 5)
+    return ServingEngine(store_dir=store_dir, **kw)
+
+
+# ---------------------------------------------------------------------------
+# 1. grammar
+# ---------------------------------------------------------------------------
+
+
+def test_fault_grammar_serving_kinds():
+    plan = faults.parse_spec("tick_nan@2;store_io@1+;slow_req@3")
+    assert plan.tick_nan == 2 and plan.store_io == 1 and plan.slow_req == 3
+    assert plan.persistent == frozenset({"store_io"})
+    # one-shot fires at the exact site; a storm fires from the site on
+    assert plan.hits("tick_nan", 2) and not plan.hits("tick_nan", 3)
+    assert plan.hits("store_io", 1) and plan.hits("store_io", 7)
+    # engine_crash defaults to the first request and is never persistent
+    assert faults.parse_spec("engine_crash").engine_crash == 1
+    with pytest.raises(ValueError, match="persistent"):
+        faults.parse_spec("engine_crash@2+")
+    with pytest.raises(ValueError, match="needs an iteration"):
+        faults.parse_spec("tick_nan")
+
+
+def test_circuit_breaker_lifecycle():
+    br = CircuitBreaker(threshold=3, cooldown=2)
+    for _ in range(2):
+        br.record_fault()
+    assert br.state == "closed"
+    br.record_fault()  # third consecutive fault opens
+    assert br.state == "open" and br.opens == 1
+    assert br.on_request() == "open"        # cooldown 2 -> 1
+    assert br.on_request() == "half_open"   # cooldown exhausted: probe
+    br.record_fault()                       # failed probe re-opens
+    assert br.state == "open" and br.opens == 2
+    br.on_request(), br.on_request()
+    assert br.state == "half_open"
+    br.record_success()
+    assert br.state == "closed" and br.consecutive == 0
+
+
+def test_retry_jitter_deterministic():
+    p = RetryPolicy(max_retries=3, backoff_base_s=0.01, backoff_cap_s=0.1)
+    assert p.delay_s("k", 1) == p.delay_s("k", 1)
+    assert p.delay_s("k", 1) != p.delay_s("other", 1)
+    assert 0.005 <= p.delay_s("k", 0) <= 0.01  # half-to-full jitter band
+    assert RetryPolicy(backoff_base_s=0.0).delay_s("k", 5) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# 2. the storm: 100% typed responses, staleness stamps, exact recovery
+# ---------------------------------------------------------------------------
+
+
+def test_fault_storm_all_responses_typed(tmp_path):
+    rng = np.random.default_rng(1)
+    eng = _engine(str(tmp_path / "store"), deadline_s=30.0)
+    ref = _engine()  # never-faulted reference
+    for i in range(3):
+        p = _panel(seed=10 + i)
+        eng.register(f"t{i}", p)
+        ref.register(f"t{i}", p)
+
+    ticks = {f"t{i}": [rng.standard_normal(N) for _ in range(12)]
+             for i in range(3)}
+    responses = []
+    # storm: every tick poisoned from site 4 on, one store fault, one
+    # stalled request — while ALSO serving nowcasts and queueing refits
+    with faults.inject("tick_nan@4+;store_io@9;slow_req@11"):
+        for k in range(8):
+            for tid in ("t0", "t1", "t2"):
+                responses.append(eng.handle(
+                    {"kind": "tick", "tenant": tid, "x": ticks[tid][k]}
+                ))
+                responses.append(eng.handle(
+                    {"kind": "nowcast", "tenant": tid}
+                ))
+        responses.append(eng.handle({"kind": "refit", "tenant": "t0"}))
+        responses.append(eng.handle(
+            {"kind": "nowcast", "tenant": "t0", "horizon": 2}
+        ))
+    # every single response is a typed envelope; degraded ones stamped
+    assert all(isinstance(r, Response) for r in responses)
+    failed = [r for r in responses if not r.ok]
+    assert failed, "the storm must actually have faulted something"
+    assert all(r.error is not None for r in failed)
+    assert all(
+        r.error.category in ("client_error", "tenant_fault", "system_fault")
+        for r in failed
+    )
+    degraded = [r for r in responses if r.degraded]
+    assert degraded and all(r.ticks_behind >= 1 for r in degraded)
+    # degraded nowcasts still ANSWER (ok) from last-good state
+    assert any(r.ok and r.kind == "nowcast" for r in degraded)
+
+    # storm over: burn any still-open breakers down to their half-open
+    # probe with read-only requests (typed fast-fails, rows all safely
+    # buffered), then drain the replay buffers with the remaining clean
+    # ticks and compare against the never-faulted reference
+    for tid in ("t0", "t1", "t2"):
+        for _ in range(8):
+            if eng._tenants[tid].breaker.state != "open":
+                break
+            assert eng.handle({"kind": "nowcast", "tenant": tid}).ok
+        assert eng._tenants[tid].breaker.state != "open"
+    for k in range(8, 12):
+        for tid in ("t0", "t1", "t2"):
+            r = eng.handle({"kind": "tick", "tenant": tid, "x": ticks[tid][k]})
+            assert isinstance(r, Response) and r.ok
+    for k in range(12):
+        for tid in ("t0", "t1", "t2"):
+            assert ref.handle(
+                {"kind": "tick", "tenant": tid, "x": ticks[tid][k]}
+            ).ok
+    for tid in ("t0", "t1", "t2"):
+        a, b = eng._tenants[tid], ref._tenants[tid]
+        assert not a.replay
+        assert int(a.state.t) == int(b.state.t)
+        np.testing.assert_allclose(
+            np.asarray(a.state.s), np.asarray(b.state.s),
+            atol=1e-10, rtol=0,
+        )
+        nca = eng.handle({"kind": "nowcast", "tenant": tid})
+        ncb = ref.handle({"kind": "nowcast", "tenant": tid})
+        assert nca.ok and not nca.degraded
+        np.testing.assert_allclose(
+            np.asarray(nca.result), np.asarray(ncb.result),
+            atol=1e-10, rtol=0,
+        )
+
+
+def test_degraded_nowcast_then_lazy_reconcile():
+    rng = np.random.default_rng(2)
+    eng = _engine()
+    ref = _engine()
+    p = _panel(seed=3)
+    eng.register("a", p)
+    ref.register("a", p)
+    rows = [rng.standard_normal(N) for _ in range(4)]
+
+    assert eng.handle({"kind": "tick", "tenant": "a", "x": rows[0]}).ok
+    with faults.inject("tick_nan@2"):
+        bad = eng.handle({"kind": "tick", "tenant": "a", "x": rows[1]})
+    assert not bad.ok and bad.error.category == "tenant_fault"
+    assert bad.error.code == "nonfinite_state"
+    # committed state untouched; nowcast degrades with a staleness stamp
+    nc = eng.handle({"kind": "nowcast", "tenant": "a"})
+    assert nc.ok and nc.degraded and nc.ticks_behind == 1
+    assert int(eng._tenants["a"].state.t) == T + 1
+    # next clean tick reconciles the buffered row first
+    rec = eng.handle({"kind": "tick", "tenant": "a", "x": rows[2]})
+    assert rec.ok and rec.recovered
+    for row in rows[:3]:
+        assert ref.handle({"kind": "tick", "tenant": "a", "x": row}).ok
+    np.testing.assert_allclose(
+        np.asarray(eng._tenants["a"].state.s),
+        np.asarray(ref._tenants["a"].state.s),
+        atol=1e-10, rtol=0,
+    )
+    assert not eng.handle({"kind": "nowcast", "tenant": "a"}).degraded
+
+
+def test_breaker_opens_fast_fails_and_recovers():
+    rng = np.random.default_rng(4)
+    eng = _engine(breaker_threshold=2, breaker_cooldown=2)
+    eng.register("a", _panel(seed=5))
+    rows = [rng.standard_normal(N) for _ in range(10)]
+    with faults.inject("tick_nan@1+"):
+        r0 = eng.handle({"kind": "tick", "tenant": "a", "x": rows[0]})
+        r1 = eng.handle({"kind": "tick", "tenant": "a", "x": rows[1]})
+        r2 = eng.handle({"kind": "tick", "tenant": "a", "x": rows[2]})
+    assert r0.error.code == "nonfinite_state" and r0.breaker_state == "closed"
+    assert r1.error.code == "nonfinite_state" and r1.breaker_state == "open"
+    # r1 reconciled r0's buffered row first (the exact refilter has no
+    # tick_nan site), then its own tick was poisoned: 2 rows pending.
+    # breaker now open: r2 fast-fails, row buffered, NO compute.
+    assert r2.error.code == "breaker_open" and r2.ticks_behind == 2
+    # storm over: cooldown burns down to a half-open probe that succeeds
+    out = [eng.handle({"kind": "tick", "tenant": "a", "x": rows[3 + i]})
+           for i in range(3)]
+    probe = next(r for r in out if r.ok)
+    assert probe.recovered and probe.breaker_state == "closed"
+    assert not eng._tenants["a"].replay
+    # every row was folded in: 3 buffered during the storm + 3 after
+    assert int(eng._tenants["a"].state.t) - T == 6
+
+
+# ---------------------------------------------------------------------------
+# 3. store_io retries + deadlines
+# ---------------------------------------------------------------------------
+
+
+def test_store_io_transient_absorbed_by_retry(tmp_path):
+    rng = np.random.default_rng(6)
+    eng = _engine(str(tmp_path / "store"))
+    eng.register("a", _panel(seed=7))
+    with faults.inject(f"store_io@{eng.store._io_ops + 1}"):
+        r = eng.handle({"kind": "tick", "tenant": "a",
+                        "x": rng.standard_normal(N)})
+    assert r.ok and r.retries == 1  # one injected failure, one retry
+
+
+def test_store_io_exhaustion_is_typed_and_recoverable(tmp_path):
+    rng = np.random.default_rng(8)
+    eng = _engine(str(tmp_path / "store"))
+    eng.register("a", _panel(seed=9))
+    rows = [rng.standard_normal(N) for _ in range(2)]
+    with faults.inject(f"store_io@{eng.store._io_ops + 1}+"):
+        r = eng.handle({"kind": "tick", "tenant": "a", "x": rows[0]})
+    assert not r.ok and r.error.category == "system_fault"
+    assert r.error.code == "store_io" and r.ticks_behind == 1
+    # storm over: the buffered row reconciles and the journal catches up
+    r2 = eng.handle({"kind": "tick", "tenant": "a", "x": rows[1]})
+    assert r2.ok and r2.recovered
+    assert int(eng._tenants["a"].state.t) == T + 2
+
+
+def test_slow_req_comes_back_deadline_exceeded():
+    rng = np.random.default_rng(10)
+    eng = _engine(deadline_s=10.0)
+    eng.register("a", _panel(seed=11))
+    with faults.inject("slow_req@2"):  # register() is not a request
+        ok = eng.handle({"kind": "nowcast", "tenant": "a"})
+        stalled = eng.handle({"kind": "nowcast", "tenant": "a"})
+    assert ok.ok
+    assert not stalled.ok and stalled.error.code == "deadline_exceeded"
+    assert stalled.error.category == "system_fault"
+    # a stalled TICK preserves its row for replay
+    with faults.inject("slow_req@3"):
+        r = eng.handle({"kind": "tick", "tenant": "a",
+                        "x": rng.standard_normal(N)})
+    assert not r.ok and r.error.code == "deadline_exceeded"
+    assert r.ticks_behind == 1
+    r2 = eng.handle({"kind": "tick", "tenant": "a",
+                     "x": rng.standard_normal(N)})
+    assert r2.ok and r2.recovered and int(eng._tenants["a"].state.t) == T + 2
+
+
+# ---------------------------------------------------------------------------
+# 4. crash + journal
+# ---------------------------------------------------------------------------
+
+
+def test_engine_crash_restart_replays_journal_bit_identical(tmp_path):
+    rng = np.random.default_rng(12)
+    d = str(tmp_path / "store")
+    eng = _engine(d)
+    eng.register("a", _panel(seed=13))
+    rows = [rng.standard_normal(N) for _ in range(6)]
+    with faults.inject("engine_crash@5"), pytest.raises(faults.SimulatedCrash):
+        for row in rows:
+            eng.handle({"kind": "tick", "tenant": "a", "x": row})
+    # 4 ticks committed before the kill (crash fires at admission of #5)
+    s_dead = np.asarray(eng._tenants["a"].state.s).copy()
+    assert int(eng._tenants["a"].state.t) == T + 4
+
+    # restart: NO panel re-supplied — snapshot + journal replay only
+    eng2 = _engine(d)
+    assert eng2.resume("a")
+    ten = eng2._tenants["a"]
+    assert ten.hist is None
+    assert int(ten.state.t) == T + 4
+    np.testing.assert_array_equal(np.asarray(ten.state.s), s_dead)
+
+    # the resumed tenant keeps serving AND journaling: tick again, kill
+    # again (by just restarting), and the replay still lands exactly
+    assert eng2.handle({"kind": "tick", "tenant": "a", "x": rows[4]}).ok
+    eng3 = _engine(d)
+    assert eng3.resume("a")
+    assert int(eng3._tenants["a"].state.t) == T + 5
+    np.testing.assert_array_equal(
+        np.asarray(eng3._tenants["a"].state.s),
+        np.asarray(eng2._tenants["a"].state.s),
+    )
+    # panel-less tenants answer refit/scenario with a typed envelope
+    r = eng3.handle({"kind": "scenario", "tenant": "a",
+                     "scenario": {"kind": "stress"}})
+    assert not r.ok and r.error.code == "no_history"
+
+
+def test_journal_corruption_quarantines_damaged_tail(tmp_path):
+    rng = np.random.default_rng(14)
+    store = TenantStore(str(tmp_path / "store"))
+    j = store.journal("a")
+    j.reset(5)
+    rows = [(5 + i, rng.standard_normal(3), np.ones(3, bool))
+            for i in range(3)]
+    for t, x, m in rows:
+        j.append(t, x, m)
+    base, back = j.replay()
+    assert base == 5 and len(back) == 3
+    np.testing.assert_array_equal(back[1][1], rows[1][1])
+
+    # flip a byte inside the LAST record: sha mismatch drops the tail,
+    # trusts the prefix, and preserves the damaged file for forensics
+    with open(j.path, "rb") as f:
+        lines = f.read().splitlines(keepends=True)
+    last = bytearray(lines[-1])
+    last[len(last) // 2] ^= 0xFF
+    with open(j.path, "wb") as f:
+        f.write(b"".join(lines[:-1]) + bytes(last))
+    base2, back2 = j.replay()
+    assert base2 == 5 and len(back2) == 2
+    assert os.path.exists(j.path + ".corrupt")
+    # the live journal was rewritten to the intact prefix: stable reads
+    base3, back3 = j.replay()
+    assert base3 == 5 and len(back3) == 2
+    # a torn final append (half a line) is likewise dropped
+    with open(j.path, "ab") as f:
+        f.write(b'{"t": 99, "dtype": "<f8"')
+    _, back4 = j.replay()
+    assert len(back4) == 2
+
+
+def test_tick_journal_is_write_ahead(tmp_path):
+    # the journal append happens BEFORE the in-memory commit: a tick
+    # whose journal write fails leaves committed state untouched
+    rng = np.random.default_rng(15)
+    eng = _engine(str(tmp_path / "store"))
+    eng.register("a", _panel(seed=16))
+    t_before = int(eng._tenants["a"].state.t)
+    with faults.inject(f"store_io@{eng.store._io_ops + 1}+"):
+        r = eng.handle({"kind": "tick", "tenant": "a",
+                        "x": rng.standard_normal(N)})
+    assert not r.ok
+    assert int(eng._tenants["a"].state.t) == t_before
+    base, rows = eng.store.journal("a").replay()
+    assert rows == []  # nothing journaled, nothing committed
+
+
+# ---------------------------------------------------------------------------
+# 5. refit retry / permanent failure surfacing
+# ---------------------------------------------------------------------------
+
+
+def test_flush_refits_requeues_then_surfaces_permanent_failures():
+    eng = _engine(max_refit_retries=1, max_em_iter=6)
+    eng.register("sick", _panel(seed=17))
+    with faults.inject("nan_estep@1+"):
+        assert eng.handle({"kind": "refit", "tenant": "sick"}).ok
+        f1 = eng.flush_refits()
+        assert f1.ok and f1.result["sick"].health != 0
+        assert f1.info["requeued"] == ["sick"]
+        assert f1.info["permanent_failures"] == []
+        assert "sick" in eng._refit_queue  # bounded retry: re-queued
+        f2 = eng.flush_refits()
+        assert f2.info["requeued"] == []
+        assert f2.info["permanent_failures"] == ["sick"]
+    assert "sick" not in eng._refit_queue  # not silently dropped: SURFACED
+    assert telemetry.snapshot()["counters"].get(
+        "serving.refit.permanent_failures", 0) >= 1
+    # the tenant keeps its previous (finite) fit throughout
+    assert np.isfinite(np.asarray(eng._tenants["sick"].params.lam)).all()
+
+
+# ---------------------------------------------------------------------------
+# 6. telemetry: outcome stamps + availability column
+# ---------------------------------------------------------------------------
+
+
+def test_serving_telemetry_outcomes_and_availability(tmp_path):
+    sink = str(tmp_path / "run.jsonl")
+    rng = np.random.default_rng(18)
+    telemetry.enable(sink=sink)
+    try:
+        eng = _engine()
+        eng.register("a", _panel(seed=19))
+        assert eng.handle({"kind": "tick", "tenant": "a",
+                           "x": rng.standard_normal(N)}).ok
+        with faults.inject("tick_nan@2"):
+            eng.handle({"kind": "tick", "tenant": "a",
+                        "x": rng.standard_normal(N)})
+        eng.handle({"kind": "nowcast", "tenant": "a"})   # degraded
+        eng.handle({"kind": "tick", "tenant": "a"})       # client error
+    finally:
+        telemetry.disable()
+        # disable() pins the explicit override to False, which would mask
+        # DFM_TELEMETRY for every later test in the process; restore the
+        # env-driven tri-state.
+        telemetry._explicit_enabled = None
+    recs = [r for r in telemetry._load_jsonl(sink)
+            if r.get("entry") == "serving"]
+    outcomes = [r.get("outcome") for r in recs]
+    assert "ok" in outcomes and "degraded" in outcomes
+    assert "tenant_fault" in outcomes and "client_error" in outcomes
+    assert any(r.get("error_kind") == "nonfinite_state" for r in recs)
+    assert all("breaker_state" in r and "retries" in r for r in recs)
+    table = telemetry.summarize(sink, entry="serving")
+    assert "avail" in table
+    # 4 requests, 2 answered (ok tick + degraded nowcast) -> 50.0%
+    assert "50.0%" in table

@@ -299,18 +299,24 @@ class TestScenarioAPI:
             "scenario": {"kind": "stress", "horizon": 6,
                          "shocks": np.eye(4)[:2].tolist()},
         })
-        assert np.asarray(res.mean).shape == (2, 6, N)
+        assert res.ok and np.asarray(res.result.mean).shape == (2, 6, N)
         res = eng.handle({
             "kind": "scenario", "tenant": "acme",
             "scenario": {"kind": "draw_fan", "horizon": 4, "n_draws": 6},
         })
-        assert np.asarray(res.draws).shape == (1, 6, 4, N)
-        with pytest.raises(ValueError, match="unknown scenario kind"):
-            eng.handle({"kind": "scenario", "tenant": "acme",
-                        "scenario": {"kind": "nope"}})
-        with pytest.raises(TypeError):  # unknown field rejected loudly
-            eng.handle({"kind": "scenario", "tenant": "acme",
-                        "scenario": {"kind": "stress", "bogus": 1}})
+        assert res.ok and np.asarray(res.result.draws).shape == (1, 6, 4, N)
+        # spec errors come back as typed client-error envelopes, never
+        # raw ValueError/TypeError out of the request loop
+        res = eng.handle({"kind": "scenario", "tenant": "acme",
+                          "scenario": {"kind": "nope"}})
+        assert not res.ok and res.error.category == "client_error"
+        assert res.error.code == "bad_scenario"
+        assert "unknown scenario kind" in res.error.message
+        res = eng.handle({"kind": "scenario", "tenant": "acme",
+                          "scenario": {"kind": "stress", "bogus": 1}})
+        assert not res.ok and res.error.category == "client_error"
+        assert res.error.code == "unknown_scenario_field"
+        assert res.error.field == "scenario.bogus"
 
     def test_aot_registration_serves_fans(self):
         """precompile(CompileSpec(scenario_draws=...)) registers the

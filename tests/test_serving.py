@@ -322,6 +322,8 @@ def _tenant_state(rng, N=6, r=2, p=2):
         params=_params(rng, N, r, p),
         s=jnp.asarray(rng.standard_normal(r * p)),
         t=jnp.asarray(40, jnp.int32),
+        r=jnp.asarray(r, jnp.int32),
+        p=jnp.asarray(p, jnp.int32),
     )
 
 
@@ -408,25 +410,82 @@ def test_engine_requests(tmp_path):
 
     st0 = eng.handle({"kind": "tick", "tenant": "acme",
                       "x": rng.standard_normal(N)})
-    assert int(st0.t) == T + 1
+    assert st0.ok and not st0.degraded
+    assert int(st0.result.t) == T + 1
     nc = eng.handle({"kind": "nowcast", "tenant": "acme"})
-    assert np.asarray(nc).shape == (N,)
-    eng.handle({"kind": "refit", "tenant": "acme"})
-    results = eng.flush_refits()
-    assert results["acme"].health == 0 and results["acme"].n_iter == 8
-    assert eng.flush_refits() == {}  # queue drained
+    assert nc.ok and np.asarray(nc.result).shape == (N,)
+    qr = eng.handle({"kind": "refit", "tenant": "acme"})
+    assert qr.ok and qr.result == 0
+    flush = eng.flush_refits()
+    assert flush.ok
+    assert flush.result["acme"].health == 0
+    assert flush.result["acme"].n_iter == 8
+    assert flush.info["installed"] == 1
+    assert not flush.info["permanent_failures"]
+    assert eng.flush_refits().result == {}  # queue drained
 
-    with pytest.raises(ValueError, match="unknown tenant"):
-        eng.handle({"kind": "tick", "tenant": "nope", "x": np.zeros(N)})
-    with pytest.raises(ValueError, match="unknown request kind"):
-        eng.handle({"kind": "frobnicate", "tenant": "acme"})
+    # errors come back as TYPED envelopes naming the offending field,
+    # never raw exceptions out of the request loop
+    resp = eng.handle({"kind": "tick", "tenant": "nope", "x": np.zeros(N)})
+    assert not resp.ok and resp.error.category == "client_error"
+    assert resp.error.code == "unknown_tenant"
+    assert resp.error.field == "tenant"
+    resp = eng.handle({"kind": "frobnicate", "tenant": "acme"})
+    assert not resp.ok and resp.error.code == "unknown_kind"
+    resp = eng.handle({"kind": "tick", "tenant": "acme"})
+    assert not resp.ok and resp.error.code == "missing_field"
+    assert resp.error.field == "x"
+    resp = eng.handle({"kind": "tick", "tenant": "acme", "x": [1.0, 2.0]})
+    assert not resp.ok and resp.error.code == "bad_shape"
+    assert resp.error.field == "x"
 
     # store-backed resume re-derives serving state from persisted params
     eng2 = ServingEngine(store_dir=str(tmp_path / "store"))
     assert eng2.resume("acme", x)
     assert not eng2.resume("ghost", x)
     nc2 = eng2.handle({"kind": "nowcast", "tenant": "acme"})
-    assert np.asarray(nc2).shape == (N,)
+    assert nc2.ok and np.asarray(nc2.result).shape == (N,)
+
+
+def test_resume_non_default_factor_counts(tmp_path):
+    # regression: resume() used to guess template_state(N, 4, 4) — (r, p)
+    # now persist in TenantState, so an r=2 tenant round-trips exactly
+    rng = np.random.default_rng(31)
+    T, N, r, p = 48, 6, 2, 3
+    params = _params(rng, N, r, p)
+    x = _panel(rng, params, T, N)
+    eng = ServingEngine(store_dir=str(tmp_path / "store"))
+    eng.register("acme", x, params=params)
+    s0 = np.asarray(eng._tenants["acme"].state.s)
+    assert s0.shape == (r * p,)
+
+    eng2 = ServingEngine(store_dir=str(tmp_path / "store"))
+    assert eng2.resume("acme", x)
+    ten = eng2._tenants["acme"]
+    assert ten.params.lam.shape == (N, r) and ten.params.A.shape == (p, r, r)
+    np.testing.assert_array_equal(np.asarray(ten.state.s), s0)
+    # panel-less (crash-restart) path restores the same state from the
+    # snapshot alone, for the same non-default (r, p)
+    eng3 = ServingEngine(store_dir=str(tmp_path / "store"))
+    assert eng3.resume("acme")
+    np.testing.assert_array_equal(
+        np.asarray(eng3._tenants["acme"].state.s), s0
+    )
+
+
+def test_tick_history_amortized_append():
+    # perf regression: the tick path used np.vstack (O(T) copy per tick);
+    # the append buffer must realloc only logarithmically often and hand
+    # out zero-copy views of the live prefix
+    from dynamic_factor_models_tpu.serving.engine import _History
+
+    rng = np.random.default_rng(32)
+    h = _History(rng.standard_normal((40, 6)), np.ones((40, 6), bool))
+    for _ in range(1000):
+        h.append(np.zeros(6), np.ones(6, bool))
+    assert h.n == 1040 and h.x.shape == (1040, 6)
+    assert h.reallocs <= int(np.ceil(np.log2(1040 / 40))) + 1
+    assert h.x.base is h._x and h.mask.base is h._mask
 
 
 def test_serve_cli_demo(capsys):
