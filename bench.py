@@ -1658,6 +1658,170 @@ def multichip_orchestrate(force_cpu: bool):
     sys.exit(pr.returncode)
 
 
+def _synthetic_ragged_panel(T, N, r, dtype):
+    """Factor + AR(1)-idio DGP with CONTIGUOUS per-series observation runs
+    (ragged heads/tails, no interior gaps) — the mask class the
+    quasi-differenced collapsed-AR path is exact for."""
+    import numpy as np
+
+    rng = np.random.default_rng(11)
+    f = np.zeros((T, r), np.float64)
+    for t in range(1, T):
+        f[t] = 0.7 * f[t - 1] + rng.standard_normal(r)
+    lam = rng.standard_normal((N, r)) * 0.5
+    phi = rng.uniform(-0.5, 0.7, N)
+    e = np.zeros((T, N))
+    for t in range(1, T):
+        e[t] = phi * e[t - 1] + rng.standard_normal(N) * 0.5
+    x = f @ lam.T + e
+    heads = rng.integers(0, max(2, T // 8), N)
+    tails = rng.integers(0, max(2, T // 8), N)
+    for i in range(N):
+        x[: heads[i], i] = np.nan
+        if tails[i]:
+            x[T - tails[i]:, i] = np.nan
+    return x.astype(dtype)
+
+
+def large_n_section(force_cpu: bool = False):
+    """--large-n: is the collapsed-AR EM step's cost really N-free?
+
+    Measured, per N in {1k, 10k, 100k}: em_iters_per_sec of
+    `em_step_ar_qd` and the compiled executable's peak memory (XLA's
+    memory_analysis: temp + argument space, the number an accelerator
+    allocator actually reserves).  The 100k leg is memory-gated against
+    DFM_MEM_BUDGET — recorded null (never skipped silently) when the
+    QDStats panels alone would blow the budget.  Plus the two acceptance
+    numbers: collapsed-vs-dense speedup at N = 512 on the SAME panel
+    (target >= 10x) and a 64-lane scenario fan at N = 10k through the
+    collapsed smoother, with the byte count the uncollapsed per-lane
+    panel stacks would have needed.  Prints one JSON line and persists
+    docs/BENCH_large_n.json."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    if force_cpu:
+        from dynamic_factor_models_tpu.utils.backend import fall_back_to_cpu
+
+        fall_back_to_cpu("large-n forced CPU", caller="bench")
+
+    from dynamic_factor_models_tpu.models import ssm_ar
+    from dynamic_factor_models_tpu.ops.masking import fillz, mask_of
+    from dynamic_factor_models_tpu.scenarios import fanout
+
+    dev = jax.devices()[0]
+    T, r, p = 128, 4, 1
+    budget = float(os.environ.get("DFM_MEM_BUDGET", 8e9))
+    out = {
+        "device": str(dev),
+        "large_n": True,
+        "T": T, "r": r, "p": p,
+        "mem_budget_bytes": budget,
+    }
+
+    def _prep(N, dtype=np.float32):
+        x = _synthetic_ragged_panel(T, N, r, dtype)
+        xj = jnp.asarray(x)
+        xz, m = fillz(xj), mask_of(xj)
+        assert ssm_ar.qd_mask_supported(np.asarray(m))
+        qd = ssm_ar.compute_qd_stats(xz, m)
+        rng = np.random.default_rng(0)
+        params = ssm_ar.SSMARParams(
+            lam=jnp.asarray(0.3 * rng.standard_normal((N, r)), xz.dtype),
+            phi=jnp.zeros(N, xz.dtype),
+            sigv2=jnp.ones(N, xz.dtype),
+            A=0.5 * jnp.eye(r, dtype=xz.dtype)[None],
+            Q=jnp.eye(r, dtype=xz.dtype),
+        )
+        return params, xz, m, qd
+
+    for N in (1000, 10_000, 100_000):
+        key = f"n{N // 1000}k"
+        # the collapsed step's footprint is the QDStats panels (9 (T, N)
+        # + 2 vectors) + the panel itself; gate the attempt, never the key
+        est = 10 * T * N * 4
+        if est > budget:
+            out[f"em_ar_qd_iters_per_sec_{key}"] = None
+            out[f"em_ar_qd_peak_bytes_{key}"] = None
+            out[f"em_ar_qd_gated_{key}"] = (
+                f"estimated {est:.2e} B QD panels > DFM_MEM_BUDGET "
+                f"{budget:.2e} B"
+            )
+            continue
+        params, xz, m, qd = _prep(N)
+        ex = jax.jit(ssm_ar.em_step_ar_qd).lower(params, xz, qd).compile()
+        ma = ex.memory_analysis()
+        peak = None
+        if ma is not None:
+            peak = int(
+                getattr(ma, "temp_size_in_bytes", 0)
+                + getattr(ma, "argument_size_in_bytes", 0)
+                + getattr(ma, "output_size_in_bytes", 0)
+            )
+        jax.block_until_ready(ex(params, xz, qd))
+        t = _time_fixed_iters(
+            lambda: jax.block_until_ready(ex(params, xz, qd))
+        )
+        out[f"em_ar_qd_iters_per_sec_{key}"] = round(1.0 / t, 2)
+        out[f"em_ar_qd_peak_bytes_{key}"] = peak
+        print(json.dumps({key: round(1.0 / t, 2)}), file=sys.stderr, flush=True)
+
+    # acceptance: collapsed >= 10x dense at N = 512 on the same panel
+    N = 512
+    params, xz, m, qd = _prep(N)
+    exq = jax.jit(ssm_ar.em_step_ar_qd).lower(params, xz, qd).compile()
+    jax.block_until_ready(exq(params, xz, qd))
+    tq = _time_fixed_iters(lambda: jax.block_until_ready(exq(params, xz, qd)))
+    exd = jax.jit(ssm_ar.em_step_ar).lower(params, xz, m).compile()
+    jax.block_until_ready(exd(params, xz, m))
+    td = _time_fixed_iters(
+        lambda: jax.block_until_ready(exd(params, xz, m)), n_timing_runs=2
+    )
+    out["em_ar_qd_iters_per_sec_n512"] = round(1.0 / tq, 2)
+    out["em_ar_dense_iters_per_sec_n512"] = round(1.0 / td, 2)
+    out["em_ar_collapse_speedup_n512"] = round(td / tq, 1)
+
+    # scenario fan at N = 10k: the ISSUE's 1k-lane fan through the
+    # collapsed smoother (per-lane scan state is r-sized, so 1024 lanes
+    # fit easily); the uncollapsed fan would carry S stacked (T+h, N)
+    # panels (plus the per-lane N-row collapse intermediates) — report
+    # the stack bytes it would have needed next to the measured run
+    S, h = 1024, 8
+    Nf = 10_000
+    xf = _synthetic_ragged_panel(T, Nf, r, np.float32)
+    cond = np.full((S, h, Nf), np.nan, np.float32)
+    cond[:, 0, 0] = np.linspace(-2, 2, S)
+    from dynamic_factor_models_tpu.models.ssm import SSMParams
+
+    rng = np.random.default_rng(3)
+    pfan = SSMParams(
+        lam=jnp.asarray(0.3 * rng.standard_normal((Nf, r)), jnp.float32),
+        R=jnp.ones(Nf, jnp.float32),
+        A=0.5 * jnp.eye(r, dtype=jnp.float32)[None],
+        Q=jnp.eye(r, dtype=jnp.float32),
+    )
+    t0 = time.perf_counter()
+    fmean, fcov = fanout.conditional_fan(
+        pfan, xf, h, cond, collapsed=True, observables=False
+    )
+    jax.block_until_ready((fmean, fcov))
+    out["fan_collapsed_wall_s_n10k_s1024"] = round(time.perf_counter() - t0, 3)
+    out["fan_collapsed_ok_n10k_s1024"] = bool(
+        np.isfinite(np.asarray(fmean)).all()
+    )
+    dense_stack = 2 * S * (T + h) * Nf * 4  # xz + mask stacks alone
+    out["fan_dense_stack_bytes_n10k_s1024"] = dense_stack
+    out["fan_dense_exceeds_budget_n10k_s1024"] = dense_stack > budget
+
+    path = os.path.join(REPO, "docs", "BENCH_large_n.json")
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(out, fh, indent=1, sort_keys=True)
+    os.replace(tmp, path)
+    print(json.dumps(out), flush=True)
+
+
 def crossover_table():
     """Manual mode: Pallas-vs-XLA crossover sweep on the live chip; prints a
     markdown table for ops/pallas_gram.py and docs/PARITY.md."""
@@ -2080,6 +2244,27 @@ def run_tpu_remainder(force_cpu: bool = False):
     # the markdown sweep is documentation — a short window should capture
     # the former first
     partial.update(refscale_section())
+    _persist_partial(partial)
+    print(json.dumps(partial), file=sys.stderr, flush=True)
+
+    # sharded/MFU leg: a child process because the forced-8-device XLA
+    # flag must precede jax init (same reason --multichip is a child of
+    # the orchestrator).  A failed leg records the error and moves on —
+    # the remainder's later sections are independent of it.
+    mc_flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in mc_flags:
+        mc_flags = (
+            mc_flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    mc_args = ["--run-multichip"]
+    if force_cpu:
+        mc_args.append("--force-cpu")
+    mc_pr = _run_child(mc_args, env_extra={"XLA_FLAGS": mc_flags})
+    mc = _parse_fragment(mc_pr)
+    if mc is None:
+        partial["multichip"] = {"error": "multichip child produced no JSON"}
+    else:
+        partial["multichip"] = mc
     _persist_partial(partial)
     print(json.dumps(partial), file=sys.stderr, flush=True)
 
@@ -2734,6 +2919,14 @@ def main():
                     help="sharded-EM scaling + measured-FLOPs MFU + Pallas "
                          "Gram + parity fill, CPU-testable on the forced "
                          "8-device host platform; prints one JSON line")
+    ap.add_argument("--large-n", action="store_true",
+                    help="large-N collapse scaling: collapsed-AR EM "
+                         "iters/sec + compiled peak memory at N in "
+                         "{1k, 10k, 100k} (100k memory-gated to null), "
+                         "collapsed-vs-dense speedup at N=512, and a "
+                         "1024-lane scenario fan at N=10k "
+                         "(large_n_section); prints one JSON line and "
+                         "persists docs/BENCH_large_n.json")
     ap.add_argument("--run-multichip", action="store_true")
     ap.add_argument("--run-compile-split", action="store_true")
     ap.add_argument("--cache-dir")
@@ -2761,6 +2954,9 @@ def main():
         return
     if args.chaos_preempt_drill:
         chaos_preempt_drill()
+        return
+    if args.large_n:
+        large_n_section(force_cpu=args.force_cpu)
         return
     if args.run_multichip:
         run_multichip(force_cpu=args.force_cpu)

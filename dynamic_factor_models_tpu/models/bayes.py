@@ -38,12 +38,16 @@ from ..parallel.mesh import NamedSharding, P
 from ..utils.backend import on_backend
 from .dfm import DFMConfig
 from .ssm import (
+    LARGE_N_THRESHOLD,
     SSMParams,
+    _collapse_obs,
     _companion,
     _filter_scan,
+    _filter_scan_collapsed_stats,
     _init_params_from_als,
     _init_state,
     _psd_floor,
+    _psd_sqrt,
     _smoother_scan,
 )
 
@@ -161,17 +165,105 @@ def _simulation_smoother_core(params: SSMParams, x, mask, key, qdiag=None):
     return f, filt.loglik
 
 
+def _sim_plus_path(params: SSMParams, key, T: int, dtype):
+    """Unconditional forward simulation of the DK smoother's f+ path: draw
+    s_0 ~ N(s0, P0) and iterate the factor VAR with fresh innovations.
+    Splits `key` three ways exactly like `_simulation_smoother_core`
+    (k0 init, ku innovations) and returns (f_plus (T, r), unused third
+    subkey) — the caller spends the third key on its measurement-noise
+    draw (dense: eps panel; collapsed: the r-dim zeta)."""
+    r = params.r
+    Tm, _ = _companion(params)
+    k = Tm.shape[0]
+    k0, ku, ke = jax.random.split(key, 3)
+    s0_mean, P0 = _init_state(params)
+    s0 = s0_mean + jnp.linalg.cholesky(P0) @ jax.random.normal(k0, (k,), dtype)
+    Lq = jnp.linalg.cholesky(_psd_floor(params.Q))
+    u = jax.random.normal(ku, (T, r), dtype) @ Lq.T
+
+    def sim_step(s_prev, u_t):
+        s_t = (Tm @ s_prev).at[:r].add(u_t)
+        return s_t, s_t[:r]
+
+    _, f_plus = jax.lax.scan(sim_step, s0, u)
+    return f_plus, ke
+
+
+def _simulation_smoother_core_collapsed(
+    params: SSMParams, C, b, ld_R, n_obs, ll_corr, sqrtC, key
+):
+    """Durbin-Koopman draw on the COLLAPSED observation statistics: the
+    large-N form of `_simulation_smoother_core`, with no (T, N) operand
+    anywhere past the one-time collapse.
+
+    The simulated panel never needs materializing: collapsing
+    x+ = M_t(Lam f+ + eps) gives b+_t = C_t f+_t + Lam'R^-1 M_t eps_t,
+    and the noise term is exactly N(0, C_t) — so the r-dim pseudo-
+    observation b+_t = C_t f+_t + C_t^{1/2} zeta_t (zeta ~ N(0, I_r)) has
+    the same joint law with f+ as a collapsed simulated panel.  Smoothed
+    means are LINEAR in b for a fixed C stack (zero prior mean), so the
+    mean-correction smooth(b) - smooth(b+) collapses to ONE filter+RTS
+    pass on the difference db = b - b+ — a draw costs one r*p-state
+    filter+smoother scan, not two N-collapses plus two scans.
+
+    The real-data loglik is draw-independent; callers needing it run one
+    `_filter_scan_collapsed_stats(params, C, b, ld_R, n_obs, ll_corr)`
+    per panel, not per draw.  Returns f_draw (T, r)."""
+    r = params.r
+    f_plus, kz = _sim_plus_path(params, key, C.shape[0], b.dtype)
+    zeta = jax.random.normal(kz, (C.shape[0], r), b.dtype)
+    b_plus = jnp.einsum("trs,ts->tr", C, f_plus) + jnp.einsum(
+        "trs,ts->tr", sqrtC, zeta
+    )
+    filt_d = _filter_scan_collapsed_stats(
+        params, C, b - b_plus, ld_R, n_obs, jnp.zeros((), b.dtype)
+    )
+    sm_d, _, _ = _smoother_scan(params, filt_d)
+    return f_plus + sm_d[:, :r]
+
+
+@jax.jit
+def _simulation_smoother_collapsed_entry(params: SSMParams, xz, m, key):
+    C, b, ld_R, xRx, n_obs = _collapse_obs(params.lam, params.R, xz, m)
+    ll_corr = -0.5 * xRx.sum()
+    filt = _filter_scan_collapsed_stats(
+        params, C, b, ld_R, n_obs, ll_corr
+    )
+    f = _simulation_smoother_core_collapsed(
+        params, C, b, ld_R, n_obs, ll_corr, _psd_sqrt(C), key
+    )
+    return f, filt.loglik
+
+
 def simulation_smoother(
-    params: SSMParams, x, seed: int = 0, backend: str | None = None
+    params: SSMParams,
+    x,
+    seed: int = 0,
+    backend: str | None = None,
+    collapsed: bool | None = None,
 ):
     """Public entry: one posterior factor-path draw f | x, params.
 
     x: (T, N) panel with NaN missing.  Returns ((T, r) draw, loglik).
     vmap over seeds (via jax.random.split outside) for multiple draws.
-    """
+
+    `collapsed` selects the large-N variant that shares one observation
+    collapse and runs one r*p-state scan pass per draw instead of two
+    N-dim smoother passes; default None auto-enables it for
+    N > ssm.LARGE_N_THRESHOLD.  Both variants draw from the identical
+    posterior (the collapse is exact); the draws differ only in their
+    PRNG stream."""
     with on_backend(backend):
         params = params._replace(Q=_psd_floor(params.Q))
         x = jnp.asarray(x)
+        if collapsed is None:
+            collapsed = x.shape[1] > LARGE_N_THRESHOLD
+        if collapsed:
+            xz = fillz(x)
+            return _simulation_smoother_collapsed_entry(
+                params, xz, mask_of(x).astype(xz.dtype),
+                jax.random.PRNGKey(seed),
+            )
         return _simulation_smoother_core(
             params, fillz(x), mask_of(x), jax.random.PRNGKey(seed)
         )

@@ -30,7 +30,15 @@ from functools import partial
 
 from ..ops.masking import fillz, mask_of
 from ..utils.backend import on_backend
-from .ssm import SSMParams, _filter_scan, _psd_floor, _smoother_scan
+from .ssm import (
+    LARGE_N_THRESHOLD,
+    SSMParams,
+    _collapse_obs,
+    _filter_scan,
+    _filter_scan_collapsed_stats,
+    _psd_floor,
+    _smoother_scan,
+)
 
 __all__ = [
     "NowcastNews",
@@ -71,6 +79,57 @@ def _nowcast_paths_multi(params: SSMParams, xz, masks, tgt_rows, tgt_cols):
         return jnp.einsum("kr,kr->k", params.lam[tgt_cols], f_t)
 
     return jax.vmap(nowcast_under)(masks)
+
+
+@jax.jit
+def _nowcast_paths_multi_collapsed(
+    params: SSMParams, xz, m_old_f, rel_t, rel_i, tgt_rows, tgt_cols
+):
+    """Collapsed news stack: the O(N) work is ONE base-vintage collapse —
+    each of the K releases is a rank-1 increment to the collapsed
+    statistics at its release time (dC = lam_i lam_i' / R_i, db =
+    lam_i x_ti / R_i), so the K+1 information sets become a cumulative
+    sum of r-sized stacks and the vmapped smoother never touches an
+    N-sized operand.  Exact: adding one observed cell to a diagonal-R
+    panel changes (C_t, b_t) by exactly that rank-1 term.
+
+    The loglik-constant pieces (x'R^-1 x correction) are dropped
+    (ll_corr = 0): nowcast means are independent of additive loglik
+    constants.  Returns (K+1, n_tgt) nowcast paths."""
+    r = params.r
+    T = xz.shape[0]
+    K = rel_t.shape[0]
+    dt = xz.dtype
+    C0, b0, ld0, _, no0 = _collapse_obs(params.lam, params.R, xz * m_old_f, m_old_f)
+
+    lam_r = params.lam[rel_i]  # (K, r)
+    rinv = 1.0 / params.R[rel_i]  # (K,)
+    xv = xz[rel_t, rel_i]  # (K,)
+    kk = jnp.arange(K)
+    dC = jnp.zeros((K, T, r, r), dt).at[kk, rel_t].add(
+        lam_r[:, :, None] * lam_r[:, None, :] * rinv[:, None, None]
+    )
+    db = jnp.zeros((K, T, r), dt).at[kk, rel_t].add(
+        lam_r * (xv * rinv)[:, None]
+    )
+    dld = jnp.zeros((K, T), dt).at[kk, rel_t].add(jnp.log(params.R[rel_i]))
+    dno = jnp.zeros((K, T), dt).at[kk, rel_t].add(1.0)
+
+    def stack(base, d):
+        z = jnp.zeros((1,) + d.shape[1:], dt)
+        return base[None] + jnp.concatenate([z, jnp.cumsum(d, axis=0)], 0)
+
+    Cs, bs, lds, nos = stack(C0, dC), stack(b0, db), stack(ld0, dld), stack(no0, dno)
+
+    def nowcast_under(Ck, bk, ldk, nok):
+        filt = _filter_scan_collapsed_stats(
+            params, Ck, bk, ldk, nok, jnp.zeros((), dt)
+        )
+        sm, _, _ = _smoother_scan(params, filt)
+        f_t = sm[tgt_rows, :r]  # (n_tgt, r)
+        return jnp.einsum("kr,kr->k", params.lam[tgt_cols], f_t)
+
+    return jax.vmap(nowcast_under)(Cs, bs, lds, nos)
 
 
 def _validate_vintages(x_old, x_new):
@@ -126,6 +185,7 @@ def nowcast_news(
     target: tuple[int, int],
     order=None,
     backend: str | None = None,
+    collapsed: bool | None = None,
 ) -> NowcastNews:
     """Decompose the revision of the target nowcast between two vintages
     into per-release news contributions.
@@ -135,6 +195,11 @@ def nowcast_news(
     the (row, series) entry being nowcast — typically (T-1, gdp_idx) with
     that entry missing in both vintages.  `order` optionally reorders the
     release sequence (default: row-major order of the new observations).
+
+    `collapsed` (default None = auto for N > ssm.LARGE_N_THRESHOLD)
+    replaces the K+1 masked-panel smoother runs with one base-vintage
+    collapse plus rank-1 release increments — exact, and the device stack
+    is r-sized instead of N-sized.
 
     The smoother conditional mean of the target entry is lam_i' E[f_t | Omega];
     contributions telescope exactly to `total_revision`.
@@ -158,9 +223,18 @@ def nowcast_news(
                 raise ValueError("order must be a permutation of the releases")
             rel = rel[order]
 
-        masks_j = _cumulative_masks(m_old, rel)
         xz = fillz(x_new)
-        path = _nowcast_paths(params, xz, masks_j, int(t_tgt), int(i_tgt))
+        if collapsed is None:
+            collapsed = x_new.shape[1] > LARGE_N_THRESHOLD
+        if collapsed:
+            path = _nowcast_paths_multi_collapsed(
+                params, xz, jnp.asarray(m_old, xz.dtype),
+                jnp.asarray(rel[:, 0]), jnp.asarray(rel[:, 1]),
+                jnp.asarray([t_tgt]), jnp.asarray([i_tgt]),
+            )[:, 0]
+        else:
+            masks_j = _cumulative_masks(m_old, rel)
+            path = _nowcast_paths(params, xz, masks_j, int(t_tgt), int(i_tgt))
         news = jnp.diff(path)
         return NowcastNews(
             total_revision=float(path[-1] - path[0]),
@@ -196,6 +270,7 @@ def nowcast_news_batch(
     targets,
     order=None,
     backend: str | None = None,
+    collapsed: bool | None = None,
 ) -> NowcastNewsBatch:
     """`nowcast_news` for MANY target entries at once (the scenario
     engine's batched decomposition): the K+1 masked-smoother runs are
@@ -204,7 +279,8 @@ def nowcast_news_batch(
 
     `targets`: (n_tgt, 2) [row, series] entries, each missing in the new
     vintage.  Release sequencing (and its ordering caveat) is identical
-    to the scalar entry point."""
+    to the scalar entry point, as is the `collapsed` large-N routing
+    (one base collapse + rank-1 release increments)."""
     with on_backend(backend):
         params = params._replace(Q=_psd_floor(params.Q))
         x_old = jnp.asarray(x_old)
@@ -230,11 +306,21 @@ def nowcast_news_batch(
                 raise ValueError("order must be a permutation of the releases")
             rel = rel[order]
 
-        masks_j = _cumulative_masks(m_old, rel)
-        paths = _nowcast_paths_multi(
-            params, fillz(x_new), masks_j,
-            jnp.asarray(tgt[:, 0]), jnp.asarray(tgt[:, 1]),
-        )  # (K+1, n_tgt)
+        xz = fillz(x_new)
+        if collapsed is None:
+            collapsed = x_new.shape[1] > LARGE_N_THRESHOLD
+        if collapsed:
+            paths = _nowcast_paths_multi_collapsed(
+                params, xz, jnp.asarray(m_old, xz.dtype),
+                jnp.asarray(rel[:, 0]), jnp.asarray(rel[:, 1]),
+                jnp.asarray(tgt[:, 0]), jnp.asarray(tgt[:, 1]),
+            )  # (K+1, n_tgt)
+        else:
+            masks_j = _cumulative_masks(m_old, rel)
+            paths = _nowcast_paths_multi(
+                params, xz, masks_j,
+                jnp.asarray(tgt[:, 0]), jnp.asarray(tgt[:, 1]),
+            )  # (K+1, n_tgt)
         news = jnp.diff(paths, axis=0)
         p_np = np.asarray(paths)
         return NowcastNewsBatch(

@@ -118,6 +118,34 @@ def _psd_floor(Q: jnp.ndarray) -> jnp.ndarray:
     return (v * jnp.maximum(e, floor)) @ v.T
 
 
+# Auto-dispatch threshold for the N-free collapsed variants of the fan /
+# news / simulation-smoother entry points (scenarios/fanout.py, models/
+# news.py, models/bayes.py): above this panel width the per-lane masked
+# filters would each drag (T, N) operands through their scans, so the
+# entry points switch to sharing ONE (T, N) collapse projection across
+# every lane/draw.  Parity between the two forms is exact (pinned), so
+# the threshold is purely a performance crossover, overridable per call
+# via each entry point's `collapsed=` flag.
+LARGE_N_THRESHOLD = 512
+
+
+def _psd_sqrt(C: jnp.ndarray) -> jnp.ndarray:
+    """Symmetric PSD square root, batched over leading axes.
+
+    Used by the collapsed Durbin-Koopman simulation smoothers: the
+    collapse of simulated measurement noise is Lam'R^-1 M_t eps_t ~
+    N(0, C_t), so drawing the r-dim pseudo-observation noise needs
+    C_t^{1/2}.  C_t is singular when fewer than r series are observed at
+    t (rank = min(n_obs_t, r)); the eigenvalue clamp keeps the root exact
+    on the range and zero on the null space — an all-missing step yields
+    C = 0 and a zero root, which is the correct degenerate draw."""
+    C = 0.5 * (C + jnp.swapaxes(C, -1, -2))
+    e, v = jnp.linalg.eigh(C)
+    return (v * jnp.sqrt(jnp.maximum(e, 0.0))[..., None, :]) @ jnp.swapaxes(
+        v, -1, -2
+    )
+
+
 def _companion(params: SSMParams):
     r, p = params.r, params.p
     k = r * p

@@ -19,21 +19,37 @@ an EM iteration is a single jitted function, as in ssm.py.
 
 from __future__ import annotations
 
+import os
+import warnings
 from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
+import jax.scipy.linalg as jsl
 import numpy as np
 
 from ..ops.linalg import solve_normal
 from ..ops.masking import fillz, mask_of
 from ..utils.backend import on_backend
 from .dfm import DFMConfig
-from .ssm import _info_filter_scan, _psd_floor, _rts_scan, estimate_dfm_em
+from .ssm import (
+    _info_filter_scan,
+    _psd_floor,
+    _rts_scan,
+    _sym_pack_idx,
+    _var_moments,
+    estimate_dfm_em,
+)
 
 __all__ = [
     "SSMARParams",
+    "QDStats",
+    "compute_qd_stats",
+    "qd_mask_supported",
     "em_step_ar",
+    "em_step_ar_qd",
+    "em_step_ar_dense0",
+    "check_dense_ar_budget",
     "estimate_dfm_em_ar",
     "EMARResults",
     "nowcast_em_ar",
@@ -201,6 +217,440 @@ def em_step_ar(params: SSMARParams, x, mask):
     return SSMARParams(lam, phi, sigv2, A, Q), ll
 
 
+# ===================== Large-N collapsed path (quasi-differencing) ==========
+#
+# The dense state s = [f-lags, e] caps this model near N ~ 300: the info
+# filter's per-step Cholesky is O(k^3) and the E-step stores six (T, k, k)
+# covariance stacks, k = r*p + N.  For the EXACT model (kappa = 0) the state
+# does not need the idio block at all: quasi-differencing each series by its
+# own AR root,
+#
+#     z_it = x_it - phi_i x_{i,t-1}          (previous period observed)
+#     z_it = x_it                            (series' first observation)
+#
+# is a unit-Jacobian linear transform of the observed data whose measurement
+# noise is INDEPENDENT across time — v_it = e_it - phi_i e_{i,t-1} ~
+# N(0, sigv_i^2) at interior cells, e_it ~ N(0, sigv_i^2/(1-phi_i^2))
+# (stationary) at each series' first cell — so the transformed model
+#
+#     z_it = lam_i' f_t - beta_it lam_i' f_{t-1} + v_it,  beta_it in {0, phi_i}
+#
+# is a time-varying-loading DFM over the FACTOR LAGS ONLY (state dim
+# r * max(p, 2)), and the Jungbacker-Koopman collapse applies verbatim: the
+# per-step information matrix over [f_t, f_{t-1}] is assembled from (T, N)
+# panel GEMMs outside the scan and nothing N-shaped enters the scan body.
+# Exact for the contiguous per-series observation class (ragged heads/tails,
+# the nowcasting case); `qd_mask_supported` gates it, interior gaps fall
+# back to the dense path (an interior gap would need e to re-enter through
+# a phi^gap cross-covariance that the one-lag difference cannot express).
+#
+# kappa = 0 is the EXACT Banbura-Modugno model; the kappa = 1e-3 dense path
+# above is the legacy regularized variant and is kept untouched.  Parity is
+# pinned against `em_step_ar_dense0` — a dense covariance-form filter of the
+# same kappa = 0 model sharing this module's M-step — at 1e-8.
+
+
+class QDStats(NamedTuple):
+    """Loop-invariant quasi-differencing statistics (the AR-model analogue
+    of ssm.PanelStats), computed once per panel and threaded through the EM
+    loop.  Both orientations of the indicator panels are stored because the
+    E-step collapse contracts (T, N) @ (N, cols) while the M-step's
+    series-side Grams contract (N, T) @ (T, cols), and XLA does not hoist a
+    transpose of a loop constant out of ``lax.while_loop``."""
+
+    m: jnp.ndarray  # (T, N) float mask
+    first: jnp.ndarray  # (T, N) 1 at each series' first observed period
+    interior: jnp.ndarray  # (T, N) 1 at observations whose previous period is observed
+    x_prev: jnp.ndarray  # (T, N) panel shifted one period (zero row at t=0)
+    mT: jnp.ndarray  # (N, T)
+    firstT: jnp.ndarray  # (N, T)
+    interiorT: jnp.ndarray  # (N, T)
+    xT: jnp.ndarray  # (N, T) zero-filled panel, transposed
+    x_prevT: jnp.ndarray  # (N, T)
+    n_int: jnp.ndarray  # (N,) per-series interior-transition counts
+    n_obs: jnp.ndarray  # (T,) per-period observation counts
+
+
+def compute_qd_stats(x, mask) -> QDStats:
+    """Materialize the quasi-differencing indicators for (x zero-filled,
+    mask).  `first` marks cells observed with the previous period missing —
+    for the supported contiguous mask class that is exactly each series'
+    first observation."""
+    m = mask.astype(x.dtype)
+    m_prev = jnp.concatenate([jnp.zeros_like(m[:1]), m[:-1]], axis=0)
+    x_prev = jnp.concatenate([jnp.zeros_like(x[:1]), x[:-1]], axis=0)
+    first = m * (1.0 - m_prev)
+    interior = m * m_prev
+    return QDStats(
+        m=m,
+        first=first,
+        interior=interior,
+        x_prev=x_prev,
+        mT=jnp.asarray(m.T),
+        firstT=jnp.asarray(first.T),
+        interiorT=jnp.asarray(interior.T),
+        xT=jnp.asarray(x.T),
+        x_prevT=jnp.asarray(x_prev.T),
+        n_int=interior.sum(axis=0),
+        n_obs=m.sum(axis=1),
+    )
+
+
+def qd_mask_supported(mask) -> bool:
+    """Host-side gate for the collapsed path's mask class: every series'
+    observations must form at most one contiguous run (ragged-edge heads
+    and tails).  An interior gap makes the one-lag quasi-difference
+    inexact — those panels fall back to the dense path."""
+    m = np.asarray(mask, bool)
+    starts = (np.diff(m.astype(np.int8), axis=0) == 1).sum(axis=0) + m[0]
+    return bool((starts <= 1).all())
+
+
+def _qd_companion(params: SSMARParams):
+    """Factor-lag companion at pt = max(p, 2) lags: the quasi-differenced
+    observation loads [f_t, f_{t-1}], so even a p = 1 VAR carries one extra
+    (dynamically inert) lag slot in the state."""
+    r, p = params.r, params.p
+    pt = max(p, 2)
+    k = r * pt
+    dtype = params.lam.dtype
+    Tm = jnp.zeros((k, k), dtype)
+    Tm = Tm.at[:r, : r * p].set(
+        jnp.concatenate([params.A[i] for i in range(p)], 1)
+    )
+    Tm = Tm.at[r:, : r * (pt - 1)].set(jnp.eye(r * (pt - 1), dtype=dtype))
+    Qs = jnp.zeros((k, k), dtype).at[:r, :r].set(params.Q)
+    return Tm, Qs
+
+
+def _qd_weight_panels(params: SSMARParams, qd: QDStats, transposed: bool):
+    """The per-iteration quasi-differencing weights, in either panel
+    orientation: Vinv = m_it / Var(v_it) (so (1-phi^2)/sigv^2 at first
+    cells, 1/sigv^2 interior, 0 missing) and beta = phi at interior cells,
+    0 elsewhere."""
+    phi2 = params.phi * params.phi
+    if transposed:
+        Vinv = (qd.mT - qd.firstT * phi2[:, None]) / params.sigv2[:, None]
+        beta = params.phi[:, None] * qd.interiorT
+    else:
+        Vinv = (qd.m - qd.first * phi2[None, :]) / params.sigv2[None, :]
+        beta = params.phi[None, :] * qd.interior
+    return Vinv, beta
+
+
+def _collapse_obs_qd(params: SSMARParams, x, qd: QDStats):
+    """Collapsed observation statistics of the quasi-differenced model:
+    the per-step information matrix over [f_t, f_{t-1}],
+
+        C[t] = Lam2_t' V_t^-1 Lam2_t,   Lam2_t row i = [lam_i, -beta_it lam_i]
+        b[t] = Lam2_t' V_t^-1 z_t,      z_t = x_t - beta_t * x_{t-1}
+
+    plus log|V_t| over observed rows, the data quadratic z'V^-1z, and the
+    per-step counts — five (T, N)-panel GEMMs/GEMVs total, nothing inside
+    any scan.  Each C block is a weighted sum of the same lam_i lam_i'
+    outer products, so the three blocks ride one packed-symmetric loading
+    matrix (`_sym_pack_idx`)."""
+    r = params.r
+    iu, iv, unpack = _sym_pack_idx(r)
+    Vinv, beta = _qd_weight_panels(params, qd, transposed=False)
+    z = x - beta * qd.x_prev
+    u = Vinv * z
+    w1 = -Vinv * beta
+    pair = params.lam[:, iu] * params.lam[:, iv]  # (N, r(r+1)/2)
+    C00 = (Vinv @ pair)[:, unpack].reshape(-1, r, r)
+    C01 = (w1 @ pair)[:, unpack].reshape(-1, r, r)  # symmetric itself
+    C11 = ((-w1 * beta) @ pair)[:, unpack].reshape(-1, r, r)
+    C = jnp.concatenate(
+        [
+            jnp.concatenate([C00, C01], axis=2),
+            jnp.concatenate([C01, C11], axis=2),
+        ],
+        axis=1,
+    )
+    b = jnp.concatenate([u @ params.lam, (w1 * z) @ params.lam], axis=1)
+    ld_V = qd.m @ jnp.log(params.sigv2) - qd.first @ jnp.log1p(
+        -params.phi * params.phi
+    )
+    xRx = (u * z).sum(axis=1)
+    return C, b, ld_V, xRx, qd.n_obs
+
+
+def _filter_ar_qd(params: SSMARParams, x, qd: QDStats, want_pinv=False):
+    """Masked filter of the quasi-differenced model: state = factor lags
+    only (k = r * max(p, 2)), scan body O(k^3) with no N-sized operand
+    (pinned in tests/test_perf_regression.py).  Likelihood is the exact
+    kappa = 0 model likelihood (unit-Jacobian transform)."""
+    r = params.r
+    Tm, Qs = _qd_companion(params)
+    k = Tm.shape[0]
+    dtype = x.dtype
+    s0 = jnp.zeros(k, dtype)
+    P0 = 1e2 * jnp.eye(k, dtype=dtype)
+    C, b, ld_V, xRx, n_obs = _collapse_obs_qd(params, x, qd)
+    q2 = 2 * r
+
+    def obs_step(inp, sp):
+        Ct, bt, ld, xr, no = inp
+        f2 = sp[:q2]
+        Cf = jnp.zeros((k, k), dtype).at[:q2, :q2].set(Ct)
+        rhs = jnp.zeros(k, dtype).at[:q2].set(bt - Ct @ f2)
+        quad0 = xr - 2.0 * (f2 @ bt) + f2 @ Ct @ f2
+        return Cf, rhs, ld, quad0, no
+
+    return _info_filter_scan(
+        Tm, Qs, (C, b, ld_V, xRx, n_obs), obs_step, s0, P0,
+        want_pinv=want_pinv,
+    )
+
+
+def _m_step_ar_qd(params: SSMARParams, x, qd: QDStats, s_sm, P_sm, lag1):
+    """ECM M-step of the kappa = 0 model from FACTOR-LAG moments only —
+    shared verbatim by the collapsed path and the dense parity oracle, so
+    parameter parity reduces to E-step exactness.
+
+    s_sm (T, r*pt), P_sm (T, r*pt, r*pt), lag1 (T-1, r*pt, r*pt) with
+    pt = max(p, 2).
+
+    With kappa = 0 the loadings cannot come from the iid-model regression
+    (e_it = x_it - lam_i'f_t is deterministic given f at observed cells, so
+    that update is a fixed point); the information lives in the idio
+    TRANSITION likelihood of v_it = z_it - lam_i' xi_it,
+    xi_it = f_t - beta_it f_{t-1}:
+
+      * lam_i: per-series WLS against E[xi xi'] — three (N, T)-side Grams
+        over the packed factor second moments, one batched r x r solve;
+      * phi_i | lam: smoothed autocovariance ratio of e_i = x_i - lam_i'f
+        over interior transitions (lam'P lam corrections via the packed
+        pair trick);
+      * sigv_i^2 | lam, phi: interior innovation variance.  The first-obs
+        stationary term is excluded from the phi/sigv update (conditional-
+        likelihood ECM choice; it still enters lam's weights) — identical
+        choice on both paths, so parity is unaffected;
+      * A, Q: `ssm._var_moments` on the leading r*p lag moments.
+    """
+    r, p = params.r, params.p
+    rp = r * p
+    iu, iv, unpack = _sym_pack_idx(r)
+    f0 = s_sm[:, :r]
+    f1 = s_sm[:, r : 2 * r]
+    P00 = P_sm[:, :r, :r]
+    P01 = P_sm[:, :r, r : 2 * r]
+    P11 = P_sm[:, r : 2 * r, r : 2 * r]
+    F00u = f0[:, iu] * f0[:, iv] + P00[:, iu, iv]  # (T, r(r+1)/2)
+    F11u = f1[:, iu] * f1[:, iv] + P11[:, iu, iv]
+    F01 = f0[:, :, None] * f1[:, None, :] + P01
+    F01su = (F01 + jnp.swapaxes(F01, 1, 2))[:, iu, iv]
+
+    VinvT, betaT = _qd_weight_panels(params, qd, transposed=True)
+    w1T = -VinvT * betaT
+    w2T = -w1T * betaT
+    G = VinvT @ F00u + w1T @ F01su + w2T @ F11u  # (N, r(r+1)/2)
+    Gram = G[:, unpack].reshape(-1, r, r)
+    zT = qd.xT - betaT * qd.x_prevT
+    uT = VinvT * zT
+    rhs = uT @ f0 + (w1T * zT) @ f1  # (N, r)
+    lam = jax.vmap(solve_normal)(Gram, rhs)
+
+    # --- phi / sigv2 given the new loadings ---
+    ehat = x - f0 @ lam.T  # E[e_t | data] at observed cells
+    ehat_p = qd.x_prev - f1 @ lam.T
+    dupe = jnp.where(iu == iv, 1.0, 2.0).astype(x.dtype)
+    pair2 = (lam[:, iu] * lam[:, iv]) * dupe[None, :]  # (N, npack)
+    q00 = P00[:, iu, iv] @ pair2.T  # (T, N) lam_i' P00 lam_i
+    q11 = P11[:, iu, iv] @ pair2.T
+    P01s = 0.5 * (P01 + jnp.swapaxes(P01, 1, 2))
+    q01 = P01s[:, iu, iv] @ pair2.T
+    num = jnp.einsum("tn,tn->n", qd.interior, ehat * ehat_p + q01)
+    den = jnp.einsum("tn,tn->n", qd.interior, ehat_p * ehat_p + q11)
+    S2 = jnp.einsum("tn,tn->n", qd.interior, ehat * ehat + q00)
+    phi = jnp.clip(num / jnp.maximum(den, 1e-12), -0.99, 0.99)
+    sigv2 = (S2 - 2.0 * phi * num + phi * phi * den) / jnp.maximum(
+        qd.n_int, 1.0
+    )
+    sigv2 = jnp.maximum(sigv2, 1e-8)
+    # series without interior transitions carry no phi/sigv information
+    has = qd.n_int > 0
+    phi = jnp.where(has, phi, params.phi)
+    sigv2 = jnp.where(has, sigv2, params.sigv2)
+
+    # --- factor VAR blocks + Q from the leading r*p lag moments ---
+    Tn = x.shape[0]
+    S11, S00, S10, Tn_eff = _var_moments(
+        s_sm[:, :rp], P_sm[:, :rp, :rp], lag1[:, :rp, :rp], r, Tn
+    )
+    Ak = S10 @ jnp.linalg.pinv(S00, hermitian=True)
+    Q = _psd_floor((S11 - Ak @ S10.T) / (Tn_eff - 1))
+    A = jnp.stack([Ak[:, i * r : (i + 1) * r] for i in range(p)])
+    return SSMARParams(lam, phi, sigv2, A, Q)
+
+
+def _guard_params_qd(params: SSMARParams) -> SSMARParams:
+    return params._replace(
+        Q=_psd_floor(params.Q),
+        sigv2=jnp.maximum(params.sigv2, 1e-8),
+        phi=jnp.clip(params.phi, -0.99, 0.99),
+    )
+
+
+@jax.jit
+def em_step_ar_qd(params: SSMARParams, x, qd: QDStats):
+    """One collapsed-AR EM iteration (exact kappa = 0 model); returns
+    (new_params, loglik of current params).  Per-iteration cost: a fixed
+    set of (T, N) panel GEMMs plus an N-free O(T k^3) scan, k = r*max(p,2)."""
+    params = _guard_params_qd(params)
+    means, covs, pmeans, pcovs, lls, pinvs = _filter_ar_qd(
+        params, x, qd, want_pinv=True
+    )
+    Tm, _ = _qd_companion(params)
+    s_sm, P_sm, lag1 = _rts_scan(Tm, means, covs, pmeans, pcovs, pinvs=pinvs)
+    return _m_step_ar_qd(params, x, qd, s_sm, P_sm, lag1), lls.sum()
+
+
+def _idio_fill(phi, e_obs, m):
+    """O(T N) recovery of the smoothed idio means at unobserved cells from
+    the observed-cell values e_it = x_it - lam_i'E[f_t | data] (exact at
+    kappa = 0): tail cells decay forward from the last observation
+    (E[e_{t+j}] = phi^j e_last), head cells decay backward (stationary
+    AR(1) time-reversibility).  Exact for the contiguous mask class."""
+
+    def fill(carry, inp):
+        e_t, m_t = inp
+        c = jnp.where(m_t > 0, e_t, phi * carry)
+        return c, c
+
+    zeros = jnp.zeros((e_obs.shape[1],), e_obs.dtype)
+    _, fwd = jax.lax.scan(fill, zeros, (e_obs, m))
+    _, bwd = jax.lax.scan(fill, zeros, (e_obs, m), reverse=True)
+    seen = jnp.cumsum(m, axis=0) > 0  # an observation at or before t
+    return jnp.where(m > 0, e_obs, jnp.where(seen, fwd, bwd))
+
+
+def idio_moments_qd(params: SSMARParams, x, qd: QDStats, s_sm):
+    """Smoothed idiosyncratic means in O(N r) per step from the collapsed
+    smoother output (the dense path reads them off s_sm[:, rp:])."""
+    e_obs = qd.m * (x - s_sm[:, : params.r] @ params.lam.T)
+    return _idio_fill(params.phi, e_obs, qd.m)
+
+
+# --------------------- dense kappa = 0 parity oracle ------------------------
+
+
+def _dense0_system(params: SSMARParams):
+    r, p, N = params.r, params.p, params.N
+    pt = max(p, 2)
+    rpt = r * pt
+    k = rpt + N
+    dtype = params.lam.dtype
+    Tf, Qf = _qd_companion(params)
+    idio = jnp.arange(rpt, k)
+    Tm = jnp.zeros((k, k), dtype).at[:rpt, :rpt].set(Tf)
+    Tm = Tm.at[idio, idio].set(params.phi)
+    Qs = jnp.zeros((k, k), dtype).at[:rpt, :rpt].set(Qf)
+    Qs = Qs.at[idio, idio].set(params.sigv2)
+    P0 = jnp.zeros((k, k), dtype)
+    P0 = P0.at[:rpt, :rpt].set(1e2 * jnp.eye(rpt, dtype=dtype))
+    # stationary idio prior — the marginalization the quasi-difference's
+    # first-observation variance encodes; required for likelihood parity
+    P0 = P0.at[idio, idio].set(
+        params.sigv2 / (1.0 - params.phi * params.phi)
+    )
+    return Tm, Qs, jnp.zeros(k, dtype), P0
+
+
+@jax.jit
+def _filter_ar_dense0(params: SSMARParams, x, mask):
+    """Dense covariance-form masked filter of the EXACT (kappa = 0) BM-AR
+    model: state [f-lags at max(p,2), e (N)], R = 0.  The information form
+    cannot express exact-observation rows (d = m/R diverges), so this
+    oracle runs the covariance recursion with unit dummy rows on missing
+    entries — their innovations are zeroed, contribute log|1| = 0, and
+    their gain columns vanish, so the likelihood and posteriors are those
+    of the observed subvector exactly.  O(T (N + k)^3): a parity oracle,
+    not a production path (see `check_dense_ar_budget`)."""
+    r, p = params.r, params.p
+    rpt = r * max(p, 2)
+    Tm, Qs, s0, P0 = _dense0_system(params)
+    lam = params.lam
+    dtype = x.dtype
+    m_f = mask.astype(dtype)
+    log2pi = jnp.asarray(np.log(2.0 * np.pi), dtype)
+
+    def step(carry, inp):
+        s, P = carry
+        xt, mt = inp
+        sp = Tm @ s
+        Pp = Tm @ P @ Tm.T + Qs
+        Pp = 0.5 * (Pp + Pp.T)
+        PHt = Pp[:, :r] @ lam.T + Pp[:, rpt:]  # (k, N) Pp H'
+        HPH = lam @ PHt[:r] + PHt[rpt:]  # (N, N)
+        S = mt[:, None] * HPH * mt[None, :] + jnp.diag(1.0 - mt)
+        v = mt * (xt - lam @ sp[:r] - sp[rpt:])
+        Ls = jnp.linalg.cholesky(0.5 * (S + S.T))
+        PHm = PHt * mt[None, :]
+        K = jsl.cho_solve((Ls, True), PHm.T).T  # (k, N)
+        su = sp + K @ v
+        Pu = Pp - K @ PHm.T
+        Pu = 0.5 * (Pu + Pu.T)
+        ll = -0.5 * (
+            mt.sum() * log2pi
+            + 2.0 * jnp.log(jnp.diagonal(Ls)).sum()
+            + v @ jsl.cho_solve((Ls, True), v)
+        )
+        return (su, Pu), (su, Pu, sp, Pp, ll)
+
+    (_, _), outs = jax.lax.scan(step, (s0, P0), (x, m_f))
+    return outs
+
+
+@jax.jit
+def em_step_ar_dense0(params: SSMARParams, x, mask, qd: QDStats):
+    """Dense parity oracle of `em_step_ar_qd`: identical kappa = 0 model,
+    IDENTICAL M-step function, E-step through the full r*max(p,2) + N
+    state.  tests/test_ar_collapsed.py pins <= 1e-8 agreement."""
+    params = _guard_params_qd(params)
+    means, covs, pmeans, pcovs, lls = _filter_ar_dense0(params, x, mask)
+    Tm, _, _, _ = _dense0_system(params)
+    s_sm, P_sm, lag1 = _rts_scan(Tm, means, covs, pmeans, pcovs)
+    rpt = params.r * max(params.p, 2)
+    new = _m_step_ar_qd(
+        params, x, qd,
+        s_sm[:, :rpt], P_sm[:, :rpt, :rpt], lag1[:, :rpt, :rpt],
+    )
+    return new, lls.sum()
+
+
+# --------------------- dense-path memory budget guard -----------------------
+
+# Default ceiling for the dense AR E-step's covariance stacks (bytes).
+# Override with DFM_MEM_BUDGET (plain bytes, float syntax accepted).
+_DEFAULT_MEM_BUDGET = 8e9
+
+
+def _dense_ar_mem_bytes(T: int, N: int, r: int, p: int, itemsize: int = 8):
+    # filtered + predicted covariances (+ their inverses when want_pinv),
+    # smoothed covariances, lag-one covariances: six (T, k, k) stacks
+    k = r * p + N
+    return 6 * T * k * k * itemsize
+
+
+def check_dense_ar_budget(T: int, N: int, r: int, p: int, itemsize: int = 8):
+    """Fail loudly BEFORE the dense AR path's (T, k, k) allocations when
+    they would exceed the DFM_MEM_BUDGET ceiling, instead of OOM-ing
+    mid-scan, and point at the collapsed path."""
+    need = _dense_ar_mem_bytes(T, N, r, p, itemsize)
+    budget = int(float(os.environ.get("DFM_MEM_BUDGET", _DEFAULT_MEM_BUDGET)))
+    if need > budget:
+        raise MemoryError(
+            f"dense AR state is k = r*p + N = {r * p + N}; the E-step "
+            f"stores ~6 (T={T}, k, k) covariance stacks "
+            f"~= {need / 1e9:.2f} GB > DFM_MEM_BUDGET="
+            f"{budget / 1e9:.2f} GB. Use estimate_dfm_em_ar("
+            "method='collapsed') — the N-free quasi-differenced path, "
+            "exact for contiguous per-series observation runs — or raise "
+            "DFM_MEM_BUDGET."
+        )
+
+
 class EMARResults(NamedTuple):
     params: SSMARParams
     factors: jnp.ndarray  # (T, r) smoothed factors
@@ -239,6 +689,7 @@ def estimate_dfm_em_ar(
     checkpoint_path: str | None = None,
     checkpoint_every: int = 25,
     accel: str | None = None,
+    method: str = "dense",
 ) -> EMARResults:
     """Full Banbura-Modugno EM: factors + AR(1) idiosyncratic states.
 
@@ -248,19 +699,31 @@ def estimate_dfm_em_ar(
     accel="squarem" wraps the EM step in one SQUAREM extrapolation cycle
     per loop iteration (`emaccel.squarem`; n_iter then counts cycles of
     three EM-map evaluations each).
+
+    method="dense" is the legacy kappa-regularized path (state
+    k = r*p + N; O(k^3) per step, subject to `check_dense_ar_budget`);
+    method="collapsed" is the N-free quasi-differenced path
+    (`em_step_ar_qd`; exact kappa = 0 model) — the large-N production
+    path.  Panels whose series have interior observation gaps are outside
+    the collapsed path's exact mask class and fall back to dense with a
+    warning.
     """
     from ..utils.compile import configure_compilation_cache
 
     configure_compilation_cache()
     if accel not in (None, "squarem"):
         raise ValueError(f"accel must be None or 'squarem', got {accel!r}")
+    if method not in ("dense", "collapsed"):
+        raise ValueError(
+            f"method must be 'dense' or 'collapsed', got {method!r}"
+        )
     from ..utils.telemetry import run_record
 
     with on_backend(backend), run_record(
         "estimate_dfm_em_ar",
         config={
             "accel": accel, "tol": tol, "max_em_iter": max_em_iter,
-            "checkpointed": checkpoint_path is not None,
+            "checkpointed": checkpoint_path is not None, "method": method,
         },
     ) as rec:
         data = jnp.asarray(data)
@@ -285,25 +748,51 @@ def estimate_dfm_em_ar(
 
         from .emloop import run_em_loop
 
-        rec.set(shapes={
-            "T": int(xz.shape[0]), "N": int(xz.shape[1]),
-            "r": config.nfac_u, "p": config.n_factorlag,
-        })
-        step = em_step_ar
+        use_collapsed = method == "collapsed"
+        if use_collapsed and not qd_mask_supported(np.asarray(m_arr)):
+            warnings.warn(
+                "estimate_dfm_em_ar(method='collapsed'): panel has interior "
+                "observation gaps (non-contiguous per-series runs) outside "
+                "the quasi-differenced path's exact mask class; falling "
+                "back to method='dense'",
+                stacklevel=2,
+            )
+            use_collapsed = False
+        T_n, N_n = int(xz.shape[0]), int(xz.shape[1])
+        r_n, p_n = config.nfac_u, config.n_factorlag
+        if not use_collapsed:
+            check_dense_ar_budget(
+                T_n, N_n, r_n, p_n, itemsize=xz.dtype.itemsize
+            )
+        state_dim = (
+            r_n * max(p_n, 2) if use_collapsed else r_n * p_n + N_n
+        )
+        rec.set(
+            shapes={"T": T_n, "N": N_n, "r": r_n, "p": p_n},
+            n_series=N_n, state_dim=state_dim,
+        )
+        base_step = em_step_ar_qd if use_collapsed else em_step_ar
+        if use_collapsed:
+            qd = compute_qd_stats(xz, m_arr)
+            em_args = (xz, qd)
+        else:
+            em_args = (xz, m_arr)
+        step = base_step
         fallback_step = None
         fallback_unwrap = None
         if accel == "squarem":
             from .emaccel import squarem, squarem_state, unwrap_state
 
-            step = squarem(em_step_ar, _project_params_ar)
+            step = squarem(base_step, _project_params_ar)
             params = squarem_state(params)
             # recovery-ladder demotion: drop the SQUAREM cycle back to the
             # plain AR EM map on the same args
-            fallback_step = em_step_ar
+            fallback_step = base_step
             fallback_unwrap = unwrap_state
         res = run_em_loop(
-            step, params, (xz, m_arr), tol, max_em_iter,
-            collect_path=collect_path, trace_name="em_dfm_ar",
+            step, params, em_args, tol, max_em_iter,
+            collect_path=collect_path,
+            trace_name="em_dfm_ar_qd" if use_collapsed else "em_dfm_ar",
             checkpoint_path=checkpoint_path, checkpoint_every=checkpoint_every,
             fallback_step=fallback_step, fallback_unwrap=fallback_unwrap,
         )
@@ -327,13 +816,23 @@ def estimate_dfm_em_ar(
                 final_health=HEALTH_NAMES[res.health],
             )
 
-        means, covs, pmeans, pcovs, _ = _filter_ar(params, xz, m_arr)
-        s_sm, _, _ = _smoother_ar(params, means, covs, pmeans, pcovs)
         r, rp = config.nfac_u, config.nfac_u * config.n_factorlag
+        if use_collapsed:
+            params = _guard_params_qd(params)
+            means, covs, pmeans, pcovs, _ = _filter_ar_qd(params, xz, qd)
+            Tmq, _ = _qd_companion(params)
+            s_sm, _, _ = _rts_scan(Tmq, means, covs, pmeans, pcovs)
+            factors = s_sm[:, :r]
+            idio = idio_moments_qd(params, xz, qd, s_sm)
+        else:
+            means, covs, pmeans, pcovs, _ = _filter_ar(params, xz, m_arr)
+            s_sm, _, _ = _smoother_ar(params, means, covs, pmeans, pcovs)
+            factors = s_sm[:, :r]
+            idio = s_sm[:, rp:]
         return EMARResults(
             params=params,
-            factors=s_sm[:, :r],
-            idio=s_sm[:, rp:],
+            factors=factors,
+            idio=idio,
             loglik_path=llpath,
             n_iter=it,
             stds=stds,
@@ -352,6 +851,7 @@ def nowcast_em_ar(
     lastperiod: int,
     h: int = 0,
     backend: str | None = None,
+    method: str = "dense",
 ):
     """Ragged-edge nowcast in ORIGINAL units from the BM-AR fit.
 
@@ -359,9 +859,19 @@ def nowcast_em_ar(
     idiosyncratic state carries each series' persistent deviation into its
     unreleased periods: x_hat = Lam f + e with e evolved by phi.  Returns a
     forecast.Nowcast (x_hat (T+h, N_incl), factor, filled).
-    """
-    from .forecast import _check_included_columns, _predict_and_fill
 
+    method="collapsed" runs the N-free quasi-differenced filter (the path
+    for fits produced by `estimate_dfm_em_ar(method="collapsed")` at large
+    N): the idio contribution is recovered in O(T N) from the filtered
+    factors (e = x - Lam f at observed cells, phi-decay into the ragged
+    tail) instead of carrying N idio states through a (T, k, k) scan.
+    """
+    from .forecast import Nowcast, _check_included_columns, _predict_and_fill
+
+    if method not in ("dense", "collapsed"):
+        raise ValueError(
+            f"method must be 'dense' or 'collapsed', got {method!r}"
+        )
     with on_backend(backend):
         data = jnp.asarray(data)
         inclcode = np.asarray(inclcode)
@@ -374,6 +884,37 @@ def nowcast_em_ar(
         # gracefully, not NaN the whole nowcast
         params = em.params._replace(
             Q=_psd_floor(em.params.Q), sigv2=jnp.maximum(em.params.sigv2, 1e-8)
+        )
+        if method == "collapsed":
+            params = _guard_params_qd(params)
+            r = params.r
+            xzf = fillz(xz)
+            qd = compute_qd_stats(xzf, m)
+            f_means = _filter_ar_qd(params, xzf, qd)[0]  # (T, r*pt) filtered
+            e = _idio_fill(
+                params.phi, qd.m * (xzf - f_means[:, :r] @ params.lam.T), qd.m
+            )
+            Tmq, _ = _qd_companion(params)
+
+            def step(carry, _):
+                s, e_t = carry
+                nxt = (Tmq @ s, params.phi * e_t)
+                return nxt, nxt
+
+            _, (sf, ef) = jax.lax.scan(
+                step, (f_means[-1], e[-1]), None, length=h
+            )
+            fit = f_means[:, :r] @ params.lam.T + e
+            x_hat_z = jnp.concatenate([fit, sf[:, :r] @ params.lam.T + ef], 0)
+            scale, shift = em.stds[None, :], em.means[None, :]
+            return Nowcast(
+                x_hat=x_hat_z * scale + shift,
+                factor=jnp.concatenate([f_means[:, :r], sf[:, :r]], axis=0),
+                filled=jnp.where(m, xw, fit * scale + shift),
+            )
+        check_dense_ar_budget(
+            int(xz.shape[0]), params.N, params.r, params.p,
+            itemsize=jnp.asarray(xz).dtype.itemsize,
         )
         means, _, _, _, _ = _filter_ar(params, fillz(xz), m)
         Tm, _ = _transition(params)

@@ -28,12 +28,19 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
-from ..models.bayes import _simulation_smoother_core
+from ..models.bayes import (
+    _simulation_smoother_core,
+    _simulation_smoother_core_collapsed,
+)
 from ..models.ssm import (
+    LARGE_N_THRESHOLD,
     SSMParams,
+    _collapse_obs,
     _companion,
     _filter_scan,
+    _filter_scan_collapsed_stats,
     _psd_floor,
+    _psd_sqrt,
     _smoother_scan,
 )
 from ..ops.masking import fillz, mask_of
@@ -47,6 +54,25 @@ __all__ = [
 ]
 
 
+def _validate_conditions(x, horizon: int, conditions):
+    """Shared condition-stack validation: returns (S, horizon, N) with NaN
+    at unconstrained cells (None = one unconditional lane)."""
+    if horizon < 1:
+        raise ValueError(f"horizon must be >= 1, got {horizon}")
+    N = x.shape[1]
+    if conditions is None:
+        return jnp.full((1, horizon, N), jnp.nan, x.dtype)
+    cond = jnp.asarray(conditions, x.dtype)
+    if cond.ndim == 2:
+        cond = cond[None]
+    if cond.ndim != 3 or cond.shape[1:] != (horizon, N):
+        raise ValueError(
+            f"conditions must be (S, horizon, N) = (*, {horizon}, {N}), "
+            f"got {tuple(cond.shape)}"
+        )
+    return cond
+
+
 def extend_panel(x, horizon: int, conditions=None):
     """Stack S condition paths onto a shared history: (S, T+h, N) panels.
 
@@ -54,26 +80,41 @@ def extend_panel(x, horizon: int, conditions=None):
     NaN = unconstrained (None = one unconditional lane); the validation
     mirrors `forecast.conditional_forecast` so the fan and the loop
     reject the same inputs."""
-    if horizon < 1:
-        raise ValueError(f"horizon must be >= 1, got {horizon}")
     x = jnp.asarray(x)
-    N = x.shape[1]
-    if conditions is None:
-        cond = jnp.full((1, horizon, N), jnp.nan, x.dtype)
-    else:
-        cond = jnp.asarray(conditions, x.dtype)
-        if cond.ndim == 2:
-            cond = cond[None]
-        if cond.ndim != 3 or cond.shape[1:] != (horizon, N):
-            raise ValueError(
-                f"conditions must be (S, horizon, N) = (*, {horizon}, {N}), "
-                f"got {tuple(cond.shape)}"
-            )
+    cond = _validate_conditions(x, horizon, conditions)
     S = cond.shape[0]
     x_ext = jnp.concatenate(
         [jnp.broadcast_to(x, (S,) + x.shape), cond], axis=1
     )
     return fillz(x_ext), mask_of(x_ext)
+
+
+def _collapse_fan_stats(params: SSMParams, x, horizon: int, conditions):
+    """Collapsed observation statistics of the whole fan: the HISTORY is
+    collapsed ONCE — the one (T, N) projection every lane shares — and
+    only each lane's h condition rows pay a per-lane collapse.  Returns
+    (C (S, T+h, r, r), b (S, T+h, r), ld_R (S, T+h), xrx_sum (S,),
+    n_obs (S, T+h)) — the memory footprint of a 1k-lane fan at N = 10k
+    drops from the (S, T+h, N) panel stacks (~GBs) to the r-sized stacks
+    (~MBs)."""
+    x = jnp.asarray(x)
+    cond = _validate_conditions(x, horizon, conditions)
+    xh = fillz(x)
+    mh = mask_of(x).astype(xh.dtype)
+    Ch, bh, ldh, xrxh, noh = _collapse_obs(params.lam, params.R, xh, mh)
+    xc = fillz(cond)
+    mc = mask_of(cond).astype(xh.dtype)
+    Cc, bc, ldc, xrxc, noc = jax.vmap(
+        lambda xs, ms: _collapse_obs(params.lam, params.R, xs, ms)
+    )(xc, mc)
+    S = cond.shape[0]
+    tile = lambda a: jnp.broadcast_to(a[None], (S,) + a.shape)
+    C = jnp.concatenate([tile(Ch), Cc], axis=1)
+    b = jnp.concatenate([tile(bh), bc], axis=1)
+    ld = jnp.concatenate([tile(ldh), ldc], axis=1)
+    no = jnp.concatenate([tile(noh), noc], axis=1)
+    xrx_sum = xrxh.sum() + xrxc.sum(axis=1)  # (S,)
+    return C, b, ld, xrx_sum, no
 
 
 @partial(jax.jit, static_argnames=("horizon",))
@@ -95,22 +136,76 @@ def _conditional_fan_impl(params, xz_stack, mask_stack, horizon: int):
     return jax.vmap(one)(xz_stack, mask_stack)
 
 
-def conditional_fan(params: SSMParams, x, horizon: int, conditions=None):
+@partial(jax.jit, static_argnames=("horizon", "observables"))
+def _conditional_fan_collapsed_impl(
+    params, C, b, ld, xrx, no, horizon: int, observables: bool
+):
+    """Collapsed conditional fan: each lane filters/smooths the r*p-state
+    collapsed statistics — no N-sized operand inside the vmapped scans.
+    `observables=False` skips the (S, h, N) mean/sd projection entirely
+    (the 10k-series outputs usually ARE the memory bill at large fans)."""
+
+    def one(C_s, b_s, ld_s, xr_s, no_s):
+        filt = _filter_scan_collapsed_stats(
+            params, C_s, b_s, ld_s, no_s, -0.5 * xr_s
+        )
+        sm, cov, _ = _smoother_scan(params, filt)
+        r = params.r
+        return sm[-horizon:, :r], cov[-horizon:, :r, :r]
+
+    f, Pf = jax.vmap(one)(C, b, ld, xrx, no)
+    if not observables:
+        return f, Pf
+    mean = f @ params.lam.T
+    var_common = jnp.einsum("nr,shrq,nq->shn", params.lam, Pf, params.lam)
+    sd = jnp.sqrt(var_common + params.R[None, None, :])
+    return mean, sd, f, Pf
+
+
+def conditional_fan(
+    params: SSMParams,
+    x,
+    horizon: int,
+    conditions=None,
+    collapsed: bool | None = None,
+    observables: bool = True,
+):
     """Conditional-forecast fan: S scenarios through ONE vmapped masked
     smoother.  Returns (mean (S, h, N), sd, factor_mean (S, h, r),
     factor_cov (S, h, r, r)); lane s equals
     `conditional_forecast(params, x, horizon, conditions[s])` to float
-    tolerance (pinned at 1e-12)."""
+    tolerance (pinned at 1e-12).
+
+    `collapsed` routes through the shared-projection variant: the history
+    is collapsed once for ALL lanes and each lane's scan touches only
+    r-sized statistics (default None auto-enables for
+    N > ssm.LARGE_N_THRESHOLD — exact, not an approximation).
+    `observables=False` returns just (factor_mean, factor_cov), keeping
+    every output N-free."""
     from ..utils.compile import aot_call, aot_statics
 
     params = params._replace(Q=_psd_floor(params.Q))
+    x = jnp.asarray(x)
+    if collapsed is None:
+        collapsed = x.shape[1] > LARGE_N_THRESHOLD
+    if collapsed:
+        stats = _collapse_fan_stats(params, x, horizon, conditions)
+        return aot_call(
+            "scenario_cond_fan_collapsed",
+            lambda pa, *st: _conditional_fan_collapsed_impl(
+                pa, *st, horizon=horizon, observables=observables
+            ),
+            params, *stats,
+            statics=aot_statics(horizon, observables),
+        )
     xz, mask = extend_panel(x, horizon, conditions)
-    return aot_call(
+    out = aot_call(
         "scenario_cond_fan",
         lambda pa, xe, me: _conditional_fan_impl(pa, xe, me, horizon),
         params, xz, mask,
         statics=aot_statics(horizon),
     )
+    return out if observables else out[2:]
 
 
 @partial(jax.jit, static_argnames=("horizon",))
@@ -137,6 +232,47 @@ def _draw_fan_impl(params, xz_stack, mask_stack, keys, horizon: int):
     return jax.vmap(one_path)(xz_stack, mask_stack, keys)
 
 
+@partial(jax.jit, static_argnames=("horizon", "observables"))
+def _draw_fan_collapsed_impl(
+    params, C, b, ld, xrx, no, keys, horizon: int, observables: bool
+):
+    """Collapsed simulation-smoother fan: one shared collapse feeds every
+    (lane, draw); each draw is ONE r*p-state filter+RTS pass on the
+    mean-correction difference (see bayes._simulation_smoother_core_
+    collapsed).  The real-data loglik is computed once per LANE — it is
+    draw-independent — and broadcast across draws.  `observables=False`
+    keeps the whole fan N-free (no (S, D, h, N) panel ever built)."""
+
+    def one_path(C_s, b_s, ld_s, xr_s, no_s, ks):
+        ll_corr = -0.5 * xr_s
+        filt = _filter_scan_collapsed_stats(
+            params, C_s, b_s, ld_s, no_s, ll_corr
+        )
+        sqrtC = _psd_sqrt(C_s)
+
+        def one_draw(k):
+            kf, ke = jax.random.split(k)
+            f = _simulation_smoother_core_collapsed(
+                params, C_s, b_s, ld_s, no_s, ll_corr, sqrtC, kf
+            )
+            fh = f[-horizon:]
+            if not observables:
+                return fh
+            eps = jax.random.normal(
+                ke, (horizon, params.lam.shape[0]), b_s.dtype
+            )
+            y = fh @ params.lam.T + eps * jnp.sqrt(params.R)
+            return fh, y
+
+        out = jax.vmap(one_draw)(ks)
+        ll = jnp.broadcast_to(filt.loglik, (ks.shape[0],))
+        if not observables:
+            return out, ll
+        return out[0], out[1], ll
+
+    return jax.vmap(one_path)(C, b, ld, xrx, no, keys)
+
+
 def draw_fan(
     params: SSMParams,
     x,
@@ -144,27 +280,54 @@ def draw_fan(
     n_draws: int,
     conditions=None,
     seed: int = 0,
+    collapsed: bool | None = None,
+    observables: bool = True,
 ):
     """Sampled scenario fans: for each of S conditioning paths, D
     Durbin-Koopman factor-path draws + posterior-predictive observable
     paths over the horizon.  One compiled program for the whole
-    S x D fan (kernel "scenario_draw_fan")."""
+    S x D fan (kernel "scenario_draw_fan").
+
+    `collapsed` (default None = auto for N > ssm.LARGE_N_THRESHOLD)
+    shares one observation collapse across the fan and draws through the
+    N-free one-scan DK core — same posterior, different PRNG stream, so
+    draws match the dense path in DISTRIBUTION, not elementwise.
+    `observables=False` drops the (S, D, h, N) predictive panel from the
+    outputs, returning (f_draws, loglik)."""
     from ..utils.compile import aot_call, aot_statics
 
     if n_draws < 1:
         raise ValueError(f"n_draws must be >= 1, got {n_draws}")
     params = params._replace(Q=_psd_floor(params.Q))
+    x = jnp.asarray(x)
+    if collapsed is None:
+        collapsed = x.shape[1] > LARGE_N_THRESHOLD
+    if collapsed:
+        stats = _collapse_fan_stats(params, x, horizon, conditions)
+        S = stats[0].shape[0]
+        keys = jax.random.split(
+            jax.random.PRNGKey(seed), S * n_draws
+        ).reshape(S, n_draws, 2)
+        return aot_call(
+            "scenario_draw_fan_collapsed",
+            lambda pa, *a: _draw_fan_collapsed_impl(
+                pa, *a, horizon=horizon, observables=observables
+            ),
+            params, *stats, keys,
+            statics=aot_statics(horizon, observables),
+        )
     xz, mask = extend_panel(x, horizon, conditions)
     S = xz.shape[0]
     keys = jax.random.split(
         jax.random.PRNGKey(seed), S * n_draws
     ).reshape(S, n_draws, 2)
-    return aot_call(
+    out = aot_call(
         "scenario_draw_fan",
         lambda pa, xe, me, ks: _draw_fan_impl(pa, xe, me, ks, horizon),
         params, xz, mask, keys,
         statics=aot_statics(horizon),
     )
+    return out if observables else (out[0], out[2])
 
 
 @partial(jax.jit, static_argnames=("horizon",))

@@ -196,7 +196,11 @@ def donation_enabled() -> bool:
 # itself.  Override via DFM_T_BUCKETS / DFM_N_BUCKETS (comma lists) or
 # per call.
 DEFAULT_T_BUCKETS = (64, 128, 256, 512, 704, 1024, 2048)
-DEFAULT_N_BUCKETS = (16, 64, 256, 512, 1024, 4096)
+# The 16384 / 131072 tails are the large-N regime (bench.py --large-n):
+# a 10k-series panel lands in 16384 and a 100k panel in 131072, so the
+# N-free collapsed kernels compile once per decade of panel width
+# instead of once per tenant panel.
+DEFAULT_N_BUCKETS = (16, 64, 256, 512, 1024, 4096, 16384, 131072)
 
 # Nominal (T, N) of the five BASELINE.json configs (estimation windows of
 # the Stock-Watson quarterly panel and the euro-area two-level panel).
@@ -432,6 +436,7 @@ class CompileSpec:
         "em_step_sqrt",
         "em_step_sqrt_collapsed",
         "em_step_ar",
+        "em_step_ar_qd",
         "als_core",
         "bootstrap_core",
         "em_loop",
@@ -692,6 +697,45 @@ def _kernel_plan(spec: CompileSpec):
 
         plans["em_step_ar"] = (
             ssm_ar.em_step_ar, (arparams_s, x_s, mask_s), {}, (), ar_inputs
+        )
+
+    if "em_step_ar_qd" in spec.kernels:
+        from ..models import ssm_ar
+
+        qdarparams_s = ssm_ar.SSMARParams(
+            _sds((Nb, r), dt),
+            _sds((Nb,), dt),
+            _sds((Nb,), dt),
+            _sds((p, r, r), dt),
+            _sds((r, r), dt),
+        )
+        qd_s = ssm_ar.QDStats(
+            m=_sds((Tb, Nb), dt),
+            first=_sds((Tb, Nb), dt),
+            interior=_sds((Tb, Nb), dt),
+            x_prev=_sds((Tb, Nb), dt),
+            mT=_sds((Nb, Tb), dt),
+            firstT=_sds((Nb, Tb), dt),
+            interiorT=_sds((Nb, Tb), dt),
+            xT=_sds((Nb, Tb), dt),
+            x_prevT=_sds((Nb, Tb), dt),
+            n_int=_sds((Nb,), dt),
+            n_obs=_sds((Tb,), dt),
+        )
+
+        def ar_qd_inputs():
+            pa, x, mask, _ = em_inputs()
+            arp = ssm_ar.SSMARParams(
+                pa.lam, jnp.zeros(Nb, dt), jnp.ones(Nb, dt) * 0.5, pa.A, pa.Q
+            )
+            return arp, x, ssm_ar.compute_qd_stats(x, mask)
+
+        plans["em_step_ar_qd"] = (
+            ssm_ar.em_step_ar_qd,
+            (qdarparams_s, x_s, qd_s),
+            {},
+            (),
+            ar_qd_inputs,
         )
 
     if "als_core" in spec.kernels:
@@ -1043,6 +1087,47 @@ def _kernel_plan(spec: CompileSpec):
             aot_statics(h),
             draw_inputs,
         )
+
+        # large-N collapsed fan variants: the traced stacks are r-sized
+        # (no N anywhere past the one-time collapse), so one executable
+        # serves EVERY panel width — the registry key varies only with
+        # (S, T+h, r) and the (horizon, observables) statics
+        Cc_s = _sds((S, Tb + h, r, r), dt)
+        bc_s = _sds((S, Tb + h, r), dt)
+        ldc_s = _sds((S, Tb + h), dt)
+        xrxc_s = _sds((S,), dt)
+        noc_s = _sds((S, Tb + h), dt)
+
+        def cond_collapsed_inputs():
+            pa, x, mask, _ = em_inputs()
+            return (pa,) + fanout._collapse_fan_stats(
+                pa, jnp.where(mask, x, jnp.nan), h,
+                jnp.full((S, h, Nb), jnp.nan, dt),
+            )
+
+        def draw_collapsed_inputs():
+            keys = jax.random.split(
+                jax.random.PRNGKey(0), S * D
+            ).reshape(S, D, 2)
+            return cond_collapsed_inputs() + (keys,)
+
+        for obs in (True, False):
+            tag = "obs" if obs else "noobs"
+            plans[f"scenario_cond_fan_collapsed@{tag}"] = (
+                fanout._conditional_fan_collapsed_impl,
+                (params_s, Cc_s, bc_s, ldc_s, xrxc_s, noc_s),
+                {"horizon": h, "observables": obs},
+                aot_statics(h, obs),
+                cond_collapsed_inputs,
+            )
+            plans[f"scenario_draw_fan_collapsed@{tag}"] = (
+                fanout._draw_fan_collapsed_impl,
+                (params_s, Cc_s, bc_s, ldc_s, xrxc_s, noc_s,
+                 _sds((S, D, 2), jnp.uint32)),
+                {"horizon": h, "observables": obs},
+                aot_statics(h, obs),
+                draw_collapsed_inputs,
+            )
 
         def fan_inputs():
             pa, _, _, _ = em_inputs()

@@ -599,6 +599,16 @@ def _shape_str(rec: dict) -> str:
     return ",".join(f"{k}={v}" for k, v in s.items()) or "-"
 
 
+def _n_series_str(rec: dict) -> str:
+    """N column: the cross-section width a run actually carried — from
+    the explicit `n_series` stamp (large-N entry points) with the shapes
+    dict's N as fallback, '-' when neither is recorded."""
+    n = rec.get("n_series")
+    if n is None:
+        n = (rec.get("shapes") or {}).get("N")
+    return str(int(n)) if isinstance(n, (int, float)) else "-"
+
+
 def _dev_str(rec: dict) -> str:
     """Devices column: '-' for single-device records, 'NxM' for a sharded
     mesh (its shape), else the raw device count when a record ran
@@ -676,6 +686,7 @@ def summarize(path: str, entry: str | None = None) -> str:
             str(r.get("platform", "?")),
             _dev_str(r),
             _shape_str(r),
+            _n_series_str(r),
             str(it) if isinstance(it, (int, float, str)) else "-",
             {True: "y", False: "n"}.get(r.get("converged"), "-"),
             f"{ll:.5g}" if isinstance(ll, (int, float)) else "-",
@@ -686,8 +697,8 @@ def summarize(path: str, entry: str | None = None) -> str:
             "ERR" if r.get("error") else "",
         ])
     per_run = _fmt_table(
-        ["time", "entry", "kind", "plat", "dev", "shape", "iters", "conv",
-         "loglik", "wall_s", "peak_MB", "aot h/m", "faults", ""],
+        ["time", "entry", "kind", "plat", "dev", "shape", "N", "iters",
+         "conv", "loglik", "wall_s", "peak_MB", "aot h/m", "faults", ""],
         rows,
     )
 

@@ -1,0 +1,165 @@
+"""Exactness of the quasi-differenced (collapsed) BM-AR path.
+
+`em_step_ar_qd` runs EM for the kappa = 0 AR(1)-idiosyncratic model with a
+state of r*max(p,2) factor lags only — the N idio states are eliminated by
+exact quasi-differencing (z_it = x_it - phi_i x_{i,t-1}, unit Jacobian).
+`em_step_ar_dense0` is the dense parity oracle: the IDENTICAL kappa = 0
+model filtered in covariance form with the full r*max(p,2)+N state.  The
+two must agree to float-reorder error (the ISSUE-10 acceptance pins 1e-8;
+observed agreement is ~1e-13) — any drift means the collapse stopped being
+an algebraic identity.
+"""
+
+import warnings
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dynamic_factor_models_tpu.models import ssm_ar as M
+
+pytestmark = pytest.mark.large_n
+
+TOL = 1e-8  # acceptance bound; observed ~1e-13 in f64
+
+
+def _ar_dgp(rng, T=40, N=24, r=2, p=1):
+    """Ragged contiguous-run panel (heads/tails missing, one dead series)
+    from a factor + AR(1)-idio DGP, plus a perturbed-truth init."""
+    phi_true = rng.uniform(-0.6, 0.8, N)
+    lam_true = rng.normal(size=(N, r))
+    A1 = 0.6 * np.eye(r)
+    f = np.zeros((T, r))
+    for t in range(1, T):
+        f[t] = f[t - 1] @ A1.T + rng.normal(size=r) * 0.5
+    e = np.zeros((T, N))
+    for t in range(1, T):
+        e[t] = phi_true * e[t - 1] + rng.normal(size=N) * 0.4
+    x = f @ lam_true.T + e
+    mask = np.ones((T, N), bool)
+    for i in range(N):
+        head, tail = rng.integers(0, 5), rng.integers(0, 5)
+        mask[:head, i] = False
+        if tail:
+            mask[T - tail:, i] = False
+    mask[:, 3] = False  # one fully-missing series
+    xz = jnp.asarray(np.where(mask, x, 0.0))
+    m = jnp.asarray(mask)
+    params = M.SSMARParams(
+        lam=jnp.asarray(lam_true + 0.1 * rng.normal(size=(N, r))),
+        phi=jnp.asarray(
+            np.clip(phi_true + 0.1 * rng.normal(size=N), -0.9, 0.9)
+        ),
+        sigv2=jnp.full((N,), 0.3),
+        A=jnp.asarray(A1)[None],
+        Q=0.25 * jnp.eye(r),
+    )
+    return params, xz, m
+
+
+def test_qd_em_matches_dense_oracle(rng):
+    """Params AND loglik of the collapsed EM step track the dense kappa=0
+    oracle through 5 iterations at <= 1e-8 (the tentpole acceptance)."""
+    params, xz, m = _ar_dgp(rng)
+    qd = M.compute_qd_stats(xz, m)
+    assert M.qd_mask_supported(np.asarray(m))
+    pq = pd = params
+    for _ in range(5):
+        pq2, llq = M.em_step_ar_qd(pq, xz, qd)
+        pd2, lld = M.em_step_ar_dense0(pd, xz, m, qd)
+        assert abs(float(llq) - float(lld)) <= TOL * (1 + abs(float(lld)))
+        for a, b in zip(pq2, pd2):
+            np.testing.assert_allclose(a, b, atol=TOL)
+        pq, pd = pq2, pd2
+
+
+def test_qd_em_loglik_monotone(rng):
+    params, xz, m = _ar_dgp(rng)
+    qd = M.compute_qd_stats(xz, m)
+    lls, pp = [], params
+    for _ in range(12):
+        pp, ll = M.em_step_ar_qd(pp, xz, qd)
+        lls.append(float(ll))
+    assert all(np.isfinite(lls))
+    assert all(b >= a - 1e-9 for a, b in zip(lls, lls[1:]))
+
+
+def test_qd_smoothed_factors_and_idio_match_dense(rng):
+    """The O(T N) idio recovery (observed residual + phi-decay fill) equals
+    the dense oracle's smoothed idio STATES, and the factor blocks agree —
+    the E-step moments the M-step consumes are the same numbers."""
+    params, xz, m = _ar_dgp(rng)
+    qd = M.compute_qd_stats(xz, m)
+    pq = params
+    for _ in range(3):
+        pq, _ = M.em_step_ar_qd(pq, xz, qd)
+    pqg = M._guard_params_qd(pq)
+    mm, cc, pm, pc, _ = M._filter_ar_qd(pqg, xz, qd)
+    Tmq, _ = M._qd_companion(pqg)
+    s_sm_q, _, _ = M._rts_scan(Tmq, mm, cc, pm, pc)
+    idio_q = M.idio_moments_qd(pqg, xz, qd, s_sm_q)
+    md, cd, pmd, pcd, _ = M._filter_ar_dense0(pqg, xz, m)
+    Tmd, _, _, _ = M._dense0_system(pqg)
+    s_sm_d, _, _ = M._rts_scan(Tmd, md, cd, pmd, pcd)
+    rpt = pqg.r * max(pqg.p, 2)
+    np.testing.assert_allclose(
+        s_sm_q[:, : pqg.r], s_sm_d[:, : pqg.r], atol=TOL
+    )
+    np.testing.assert_allclose(idio_q, s_sm_d[:, rpt:], atol=TOL)
+
+
+def test_qd_mask_class_gate():
+    """Contiguous runs (ragged heads/tails) are in; interior gaps are out."""
+    m = np.ones((10, 3), bool)
+    m[:4, 0] = False
+    m[8:, 1] = False
+    assert M.qd_mask_supported(m)
+    m[5, 2] = False  # interior gap
+    assert not M.qd_mask_supported(m)
+    assert M.qd_mask_supported(np.zeros((10, 3), bool))  # all-missing ok
+
+
+def test_collapsed_method_falls_back_on_interior_gaps(rng):
+    """estimate_dfm_em_ar(method='collapsed') on an interior-gap panel must
+    warn and produce the dense path's answer, not silently mis-filter."""
+    from dynamic_factor_models_tpu.models.dfm import DFMConfig
+
+    T, N = 80, 8
+    x = np.cumsum(rng.normal(size=(T, N)), axis=0) * 0.1 + rng.normal(
+        size=(T, N)
+    )
+    x[40, 2] = np.nan  # interior gap -> outside the QD mask class
+    inclcode = np.ones(N, np.int64)
+    cfg = DFMConfig(nfac_u=1, n_factorlag=1)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        res = M.estimate_dfm_em_ar(
+            x, inclcode, 0, T - 1, cfg, max_em_iter=3, method="collapsed"
+        )
+    assert any("falling back" in str(wi.message) for wi in w)
+    assert np.isfinite(np.asarray(res.loglik_path)).all()
+
+
+def test_dense_budget_guard_raises_with_pointer(monkeypatch):
+    """The dense-path memory estimate fails LOUDLY against DFM_MEM_BUDGET
+    and names the collapsed escape hatch."""
+    monkeypatch.setenv("DFM_MEM_BUDGET", "1000000")  # 1 MB
+    with pytest.raises(MemoryError) as ei:
+        M.check_dense_ar_budget(512, 10_000, 4, 1, itemsize=4)
+    msg = str(ei.value)
+    assert "DFM_MEM_BUDGET" in msg and "collapsed" in msg
+
+
+def test_dense_budget_guard_passes_small(monkeypatch):
+    monkeypatch.delenv("DFM_MEM_BUDGET", raising=False)
+    M.check_dense_ar_budget(128, 64, 2, 1, itemsize=8)  # no raise
+
+
+def test_estimate_method_validated():
+    from dynamic_factor_models_tpu.models.dfm import DFMConfig
+
+    with pytest.raises(ValueError, match="method"):
+        M.estimate_dfm_em_ar(
+            np.zeros((10, 3)), np.ones(3, np.int64), 0, 9,
+            DFMConfig(nfac_u=1, n_factorlag=1), method="nope",
+        )

@@ -48,6 +48,18 @@ def bench(tmp_path, monkeypatch):
         lambda: calls.append("refscale") or {"em_refscale_best_ips": 180.0},
     )
 
+    class _FakeMultichipChild:
+        stdout = '{"n_devices": 8, "tpu_unreachable": false}'
+        stderr = ""
+        returncode = 0
+
+    monkeypatch.setattr(
+        b, "_run_child",
+        lambda args, env_extra=None, timeout_s=3600: (
+            calls.append("multichip") or _FakeMultichipChild()
+        ),
+    )
+
     class _FakeDS:
         pass
 
@@ -61,12 +73,13 @@ def bench(tmp_path, monkeypatch):
 def test_remainder_section_order_and_stores(bench, tmp_path, capsys):
     bench.run_tpu_remainder()
     assert bench._test_calls == [
-        "pallas", "parity", "large", "refscale", "crossover"
+        "pallas", "parity", "large", "refscale", "multichip", "crossover"
     ]
     out = capsys.readouterr().out.strip().splitlines()[-1]
     final = json.loads(out)
     assert final["parity_ok"] is True
     assert final["pallas_gram_speedup_large_panel"] == 1.5
+    assert final["multichip"]["n_devices"] == 8
     assert "crossover_markdown" in final
     # per-section persistence: the partial file holds the full accumulation
     partial = json.loads((tmp_path / "partial.json").read_text())

@@ -22,6 +22,7 @@ from dynamic_factor_models_tpu.models.mixed_freq import (
 from dynamic_factor_models_tpu.models.ssm import (
     SSMParams,
     _collapse_obs,
+    _collapse_obs_stats,
     _companion,
     _filter_scan,
     _filter_scan_full,
@@ -244,6 +245,85 @@ def test_mf_em_step_stats_exact(rng):
     assert np.abs(ll_a - ll_b) <= TOL * (1.0 + np.abs(ll_a))
     for a, b in zip(new_a, new_b):
         np.testing.assert_allclose(a, b, atol=1e-9)
+
+
+def test_collapse_obs_all_missing_step(rng):
+    """A fully-missing period collapses to the exact zero element — C = 0,
+    b = 0, ld_R = 0, xRx = 0, n_obs = 0 — and the filter treats it as pure
+    prediction: posterior == prior at that step, no NaN from the empty
+    information matrix."""
+    params, x, m = _dgp(rng, T=16, N=7, r=2, p=1, missing=0.0)
+    t_gap = 5
+    m = m.at[t_gap].set(False)
+    x = x.at[t_gap].set(0.0)
+    mf = m.astype(x.dtype)
+    C, b, ld_R, xRx, n_obs = _collapse_obs(params.lam, params.R, x, mf)
+    assert np.all(np.asarray(C[t_gap]) == 0.0)
+    assert np.all(np.asarray(b[t_gap]) == 0.0)
+    assert float(ld_R[t_gap]) == 0.0
+    assert float(xRx[t_gap]) == 0.0
+    assert float(n_obs[t_gap]) == 0.0
+    res = _filter_scan(params, x, m)
+    np.testing.assert_allclose(
+        res.means[t_gap], res.pred_means[t_gap], atol=TOL
+    )
+    np.testing.assert_allclose(res.covs[t_gap], res.pred_covs[t_gap], atol=TOL)
+    assert np.isfinite(float(res.loglik))
+    _assert_same(res, _filter_scan_full(params, x, m))
+
+
+def test_collapse_obs_q1_sym_pack(rng):
+    """q = 1 degenerates the sym-pack to a single pair column (iu = iv = 0,
+    unpack is the identity on one cell) — the packed GEMM must still
+    produce the scalar C_t = sum_i m_it lam_i^2 / R_i."""
+    T, N = 12, 6
+    lam = jnp.asarray(rng.standard_normal((N, 1)))
+    R = jnp.asarray(0.3 + rng.random(N))
+    x = jnp.asarray(rng.standard_normal((T, N)))
+    m = jnp.asarray((rng.random((T, N)) > 0.3).astype(x.dtype))
+    C, b, ld_R, xRx, n_obs = _collapse_obs(lam, R, x * m, m)
+    assert C.shape == (T, 1, 1) and b.shape == (T, 1)
+    rinv = np.asarray(m) / np.asarray(R)
+    l0 = np.asarray(lam[:, 0])
+    np.testing.assert_allclose(
+        C[:, 0, 0], (rinv * l0**2).sum(axis=1), atol=TOL
+    )
+    np.testing.assert_allclose(
+        b[:, 0], (rinv * np.asarray(x * m) * l0).sum(axis=1), atol=TOL
+    )
+    np.testing.assert_allclose(
+        ld_R, (np.asarray(m) * np.log(np.asarray(R))).sum(axis=1), atol=TOL
+    )
+
+
+def test_collapse_obs_stats_bf16_vs_f64(rng):
+    """The bf16 PanelStats twins feed `_collapse_obs_stats` through the
+    mixed-precision GEMM contract (bf16 operands, f32 accumulation).  C and
+    b must track the f64 reference to bf16 resolution — loose RELATIVE
+    agreement, not the f64 identity — and the exact fields (ld_R from the
+    fused column, n_obs, ll_corr from full-precision Sxx) must not degrade
+    beyond the panel quantization itself."""
+    from dynamic_factor_models_tpu.models.ssm import compute_panel_stats
+
+    params, x, m = _dgp(rng, T=48, N=31, r=3, p=1)
+    stats64 = compute_panel_stats(x, m)
+    stats16 = compute_panel_stats(x, m, bf16=True)
+    assert stats16.m16 is not None and stats16.m16.dtype == jnp.bfloat16
+    ref = _collapse_obs_stats(params.lam, params.R, x, stats64)
+    got = _collapse_obs_stats(params.lam, params.R, x, stats16)
+    C_r, b_r, ld_r, _, no_r, llc_r = ref
+    C_g, b_g, ld_g, _, no_g, llc_g = got
+    # bf16 keeps ~8 mantissa bits: elementwise agreement to ~0.4% of the
+    # per-step statistic's scale (accumulation is f32, so no sum blowup)
+    scale_C = np.abs(np.asarray(C_r)).max()
+    scale_b = np.abs(np.asarray(b_r)).max()
+    np.testing.assert_allclose(C_g, C_r, atol=0.02 * scale_C)
+    np.testing.assert_allclose(b_g, b_r, atol=0.02 * scale_b)
+    # the mask is 0/1-exact in bf16, so the fused log|R| column and counts
+    # stay exact; ll_corr never routes through bf16 at all
+    np.testing.assert_allclose(ld_g, ld_r, rtol=1e-2)
+    np.testing.assert_allclose(no_g, no_r, atol=0)
+    np.testing.assert_allclose(float(llc_g), float(llc_r), rtol=1e-12)
 
 
 def test_collapse_obs_statistics(rng):

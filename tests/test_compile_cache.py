@@ -47,8 +47,11 @@ def test_baseline_shapes_share_one_bucket():
     assert buckets == {(256, 256)}
     # the large-panel bench regime maps to itself (no padding waste)
     assert cc.bucket_shape(2048, 4096) == (2048, 4096)
+    # the large-N regimes land on the round-6 wide buckets
+    assert cc.bucket_shape(5000, 10000) == (5000, 16384)
+    assert cc.bucket_shape(128, 100_000) == (128, 131072)
     # beyond the largest bucket: pass through unpadded rather than fail
-    assert cc.bucket_shape(5000, 10000) == (5000, 10000)
+    assert cc.bucket_shape(5000, 200_000) == (5000, 200_000)
 
 
 def test_pad_panel_exact_structure():

@@ -1,0 +1,219 @@
+"""Large-N collapse: N-free per-step filtering, CPU-sized tier-1 lane.
+
+The ISSUE-10 contract is that per-step filter cost is independent of the
+cross-section width N everywhere in the estimation stack.  These tests pin
+the two properties that make that true, at sizes a CPU test runner can
+afford (the 10k-100k scaling numbers live in `bench.py --large-n` /
+docs/BENCH_large_n.json):
+
+* HLO pins — the scan bodies (stablehlo.while regions) of the collapsed
+  kernels carry NO N-sized operand.  N = 1999 (prime, and not a bucket
+  size) so a shape leak cannot hide behind a coincidental constant; the
+  match is on shape tokens ([<x]1999x), not the bare digits, so float
+  literals like 1.999e0 cannot false-positive.
+* Memory-regression guard — the compiled collapsed-AR EM step's total
+  footprint at N = 2048 stays O(T N): the dense-path state (r p + N)^2
+  covariance scan at this shape would need ~13 GB of scan stacks, the
+  collapsed step measures ~tens of MB, and the 1 GB assert sits two
+  orders of magnitude above the measurement but three below the
+  regression.
+* N ~ 2k smoke — the collapsed EM step, fan, news, and simulation
+  smoother all auto-dispatch (N > LARGE_N_THRESHOLD) and produce finite
+  output at a width above every dispatch threshold.
+"""
+
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dynamic_factor_models_tpu.models import ssm_ar as M
+from dynamic_factor_models_tpu.models.ssm import LARGE_N_THRESHOLD, SSMParams
+
+pytestmark = pytest.mark.large_n
+
+N_PIN = 1999  # prime, not a bucket size: shape leaks cannot hide
+_SHAPE_TOKEN = re.compile(r"[<x]%dx" % N_PIN)
+
+
+def _while_bodies(hlo: str):
+    """Extract every stablehlo.while op's full region text by brace
+    matching from each occurrence to its closing brace."""
+    bodies = []
+    start = 0
+    while True:
+        i = hlo.find("stablehlo.while", start)
+        if i < 0:
+            break
+        j = hlo.find("{", i)
+        depth, k = 1, j + 1
+        while depth and k < len(hlo):
+            if hlo[k] == "{":
+                depth += 1
+            elif hlo[k] == "}":
+                depth -= 1
+            k += 1
+        bodies.append(hlo[i:k])
+        start = k
+    return bodies
+
+
+def _ragged_panel(T, N, r=2, seed=5, dtype=np.float32):
+    rng = np.random.default_rng(seed)
+    f = np.zeros((T, r))
+    for t in range(1, T):
+        f[t] = 0.6 * f[t - 1] + rng.standard_normal(r)
+    lam = 0.5 * rng.standard_normal((N, r))
+    x = f @ lam.T + rng.standard_normal((T, N))
+    heads = rng.integers(0, T // 6, N)
+    tails = rng.integers(0, T // 6, N)
+    for i in range(N):
+        x[: heads[i], i] = np.nan
+        if tails[i]:
+            x[T - tails[i]:, i] = np.nan
+    return x.astype(dtype)
+
+
+def _qd_setup(T, N, r=2, dtype=np.float32):
+    from dynamic_factor_models_tpu.ops.masking import fillz, mask_of
+
+    x = jnp.asarray(_ragged_panel(T, N, r, dtype=dtype))
+    xz, m = fillz(x), mask_of(x)
+    qd = M.compute_qd_stats(xz, m)
+    rng = np.random.default_rng(0)
+    params = M.SSMARParams(
+        lam=jnp.asarray(0.3 * rng.standard_normal((N, r)), xz.dtype),
+        phi=jnp.zeros(N, xz.dtype),
+        sigv2=jnp.ones(N, xz.dtype),
+        A=0.5 * jnp.eye(r, dtype=xz.dtype)[None],
+        Q=jnp.eye(r, dtype=xz.dtype),
+    )
+    return params, xz, m, qd
+
+
+def test_qd_em_scan_bodies_are_n_free():
+    """No scan body of the collapsed-AR EM step carries an N-sized
+    operand: all O(N) work (collapse GEMMs, M-step Grams) lowers OUTSIDE
+    the whiles, so per-step filter cost cannot depend on N."""
+    params, xz, _, qd = _qd_setup(64, N_PIN)
+    hlo = M.em_step_ar_qd.lower(params, xz, qd).as_text()
+    bodies = _while_bodies(hlo)
+    assert bodies, "no while loops found — scan lowering changed?"
+    for body in bodies:
+        leak = _SHAPE_TOKEN.search(body)
+        assert leak is None, (
+            f"N-sized operand inside a scan body: ...{body[max(0, leak.start() - 120):leak.start() + 60]}..."
+        )
+
+
+def test_collapsed_fan_scan_bodies_are_n_free():
+    from dynamic_factor_models_tpu.scenarios import fanout
+
+    rng = np.random.default_rng(2)
+    params = SSMParams(
+        lam=jnp.asarray(0.3 * rng.standard_normal((N_PIN, 2)), jnp.float32),
+        R=jnp.ones(N_PIN, jnp.float32),
+        A=0.5 * jnp.eye(2, dtype=jnp.float32)[None],
+        Q=jnp.eye(2, dtype=jnp.float32),
+    )
+    x = jnp.asarray(_ragged_panel(24, N_PIN))
+    stats = fanout._collapse_fan_stats(params, x, 4, None)
+    hlo = fanout._conditional_fan_collapsed_impl.lower(
+        params, *stats, horizon=4, observables=True
+    ).as_text()
+    bodies = _while_bodies(hlo)
+    assert bodies
+    for body in bodies:
+        assert _SHAPE_TOKEN.search(body) is None, (
+            "N-sized operand inside a collapsed-fan scan body"
+        )
+
+
+def test_qd_em_step_memory_stays_collapsed():
+    """Compiled-footprint regression guard: the collapsed-AR EM step at
+    (T, N) = (128, 2048) f32 must stay O(T N) — a reintroduced dense
+    (r p + N)-state scan would need gigabytes of (T, k, k) stacks."""
+    params, xz, _, qd = _qd_setup(128, 2048)
+    ex = jax.jit(M.em_step_ar_qd).lower(params, xz, qd).compile()
+    ma = ex.memory_analysis()
+    if ma is None:
+        pytest.skip("backend reports no memory analysis")
+    total = (
+        getattr(ma, "temp_size_in_bytes", 0)
+        + getattr(ma, "argument_size_in_bytes", 0)
+        + getattr(ma, "output_size_in_bytes", 0)
+    )
+    assert 0 < total < 1_000_000_000, (
+        f"collapsed-AR EM step footprint {total / 1e6:.0f} MB at "
+        f"(128, 2048) — dense-state scan stacks have crept back in"
+    )
+
+
+def test_dense_budget_estimate_vs_collapsed_footprint():
+    """The guard that routes users to method='collapsed' is calibrated:
+    the dense estimate at (128, 2048) exceeds the measured collapsed
+    footprint by >= 100x."""
+    dense = M._dense_ar_mem_bytes(128, 2048, 2, 1, 4)
+    assert dense > 100 * 100e6  # ~10 GB vs the ~tens-of-MB collapsed step
+
+
+def test_large_n_smoke_em_and_nowcast():
+    """N = 2048 (> LARGE_N_THRESHOLD) collapsed EM: two steps, finite and
+    improving; the O(T N) idio recovery returns a full panel."""
+    assert 2048 > LARGE_N_THRESHOLD
+    params, xz, m, qd = _qd_setup(96, 2048)
+    p1, ll1 = M.em_step_ar_qd(params, xz, qd)
+    p2, ll2 = M.em_step_ar_qd(p1, xz, qd)
+    assert np.isfinite(float(ll1)) and np.isfinite(float(ll2))
+    assert float(ll2) >= float(ll1) - 1e-6 * abs(float(ll1))
+    pg = M._guard_params_qd(p2)
+    mm, cc, pm, pc, _ = M._filter_ar_qd(pg, xz, qd)
+    Tmq, _ = M._qd_companion(pg)
+    s_sm, _, _ = M._rts_scan(Tmq, mm, cc, pm, pc)
+    idio = M.idio_moments_qd(pg, xz, qd, s_sm)
+    assert idio.shape == xz.shape and np.isfinite(np.asarray(idio)).all()
+
+
+def test_large_n_smoke_fan_news_simsmoother():
+    """The scenario fan, news decomposition, and simulation smoother all
+    auto-route through the collapsed paths at N = 2048 and return finite,
+    correctly-shaped results."""
+    from dynamic_factor_models_tpu.models import bayes, news
+    from dynamic_factor_models_tpu.scenarios import fanout
+
+    T, N, r, h, S = 48, 2048, 2, 4, 3
+    x = _ragged_panel(T, N, r, seed=9).astype(np.float64)
+    rng = np.random.default_rng(1)
+    params = SSMParams(
+        lam=jnp.asarray(0.3 * rng.standard_normal((N, r))),
+        R=jnp.ones(N),
+        A=0.5 * jnp.eye(r)[None],
+        Q=jnp.eye(r),
+    )
+    cond = np.full((S, h, N), np.nan)
+    cond[:, 0, 0] = np.linspace(-1, 1, S)
+    f, Pf = fanout.conditional_fan(params, x, h, cond, observables=False)
+    assert f.shape == (S, h, r) and np.isfinite(np.asarray(f)).all()
+    fd, ll = fanout.draw_fan(
+        params, x, h, 2, cond, seed=0, observables=False
+    )
+    assert fd.shape == (S, 2, h, r) and np.isfinite(np.asarray(ll)).all()
+
+    draw, ll1 = bayes.simulation_smoother(params, x, seed=0)
+    assert draw.shape == (T, r) and np.isfinite(float(ll1))
+
+    x_new = x.copy()
+    tgt = (T - 1, 0)
+    x_new[tgt] = np.nan
+    x_old = x_new.copy()
+    rel_i = np.where(~np.isnan(x_new[T - 2]))[0][:3]
+    x_old[T - 2, rel_i] = np.nan
+    res = news.nowcast_news(params, x_old, x_new, tgt)
+    assert np.isfinite(res.total_revision)
+    assert np.isfinite(np.asarray(res.news)).all()
+    np.testing.assert_allclose(
+        float(res.nowcast_path[-1] - res.nowcast_path[0]),
+        res.total_revision, rtol=1e-10, atol=1e-12,
+    )

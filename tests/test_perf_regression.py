@@ -347,3 +347,105 @@ def test_disabled_telemetry_path_is_free(monkeypatch):
     # 20k no-op cycles: generous 0.5 s ceiling (~25 us/cycle) — a path that
     # accidentally allocates records or touches the filesystem blows this
     assert dt < 0.5, f"disabled-path run_record cost {dt:.3f}s for 20k cycles"
+
+
+def test_collapsed_ar_scan_body_hlo_is_n_free():
+    """ISSUE-10 acceptance pin: no scan body in any collapsed large-N
+    kernel carries an N-sized operand.  N = 1999 — prime and not a compile
+    bucket, so a leaked cross-section dimension cannot masquerade as a
+    legitimate shape — and the match is on stableHLO shape tokens
+    ([<x]1999x), immune to float literals like 1.999e0.  Kernels pinned:
+    the quasi-differenced AR EM step, the collapsed conditional and draw
+    fans (both observables variants), the rank-1-increment news path, and
+    the collapsed simulation smoother.  All O(N) work — collapse GEMMs,
+    M-step Grams, observable projections — must lower OUTSIDE the whiles:
+    that is the whole N-free-per-step contract."""
+    import re
+
+    from dynamic_factor_models_tpu.models import bayes, news
+    from dynamic_factor_models_tpu.models import ssm_ar as ar
+    from dynamic_factor_models_tpu.models.ssm import SSMParams
+    from dynamic_factor_models_tpu.ops.masking import fillz, mask_of
+    from dynamic_factor_models_tpu.scenarios import fanout
+
+    N, T, r, h, S, D = 1999, 48, 2, 4, 3, 2
+    token = re.compile(r"[<x]%dx" % N)
+
+    def while_bodies(hlo):
+        bodies, start = [], 0
+        while True:
+            i = hlo.find("stablehlo.while", start)
+            if i < 0:
+                break
+            j = hlo.find("{", i)
+            depth, k = 1, j + 1
+            while depth and k < len(hlo):
+                depth += {"{": 1, "}": -1}.get(hlo[k], 0)
+                k += 1
+            bodies.append(hlo[i:k])
+            start = k
+        return bodies
+
+    def assert_n_free(lowered, name):
+        bodies = while_bodies(lowered.as_text())
+        assert bodies, f"{name}: no while loops — scan lowering changed?"
+        for body in bodies:
+            leak = token.search(body)
+            assert leak is None, (
+                f"{name}: N-sized operand inside a scan body near "
+                f"...{body[max(0, leak.start() - 120):leak.start() + 60]}..."
+            )
+
+    rng = np.random.default_rng(0)
+    dt = jnp.float32
+    x = rng.standard_normal((T, N)).astype(np.float32)
+    x[:3, 0] = np.nan
+    xj = jnp.asarray(x)
+    xz, m = fillz(xj), mask_of(xj)
+
+    arp = ar.SSMARParams(
+        lam=jnp.asarray(0.3 * rng.standard_normal((N, r)), dt),
+        phi=jnp.zeros(N, dt),
+        sigv2=jnp.ones(N, dt),
+        A=0.5 * jnp.eye(r, dtype=dt)[None],
+        Q=jnp.eye(r, dtype=dt),
+    )
+    qd = ar.compute_qd_stats(xz, m)
+    assert_n_free(ar.em_step_ar_qd.lower(arp, xz, qd), "em_step_ar_qd")
+
+    params = SSMParams(
+        lam=arp.lam, R=jnp.ones(N, dt), A=arp.A, Q=arp.Q
+    )
+    cond = np.full((S, h, N), np.nan, np.float32)
+    cond[:, 0, 0] = 1.0
+    stats = fanout._collapse_fan_stats(params, xj, h, cond)
+    keys = jax.random.split(jax.random.PRNGKey(0), S * D).reshape(S, D, 2)
+    for obs in (True, False):
+        assert_n_free(
+            fanout._conditional_fan_collapsed_impl.lower(
+                params, *stats, horizon=h, observables=obs
+            ),
+            f"conditional_fan_collapsed(observables={obs})",
+        )
+        assert_n_free(
+            fanout._draw_fan_collapsed_impl.lower(
+                params, *stats, keys, horizon=h, observables=obs
+            ),
+            f"draw_fan_collapsed(observables={obs})",
+        )
+
+    mf = m.astype(dt)
+    assert_n_free(
+        news._nowcast_paths_multi_collapsed.lower(
+            params, xz, mf,
+            jnp.asarray([1, 2]), jnp.asarray([0, 0]),
+            jnp.asarray([T - 1]), jnp.asarray([1]),
+        ),
+        "nowcast_news_collapsed",
+    )
+    assert_n_free(
+        bayes._simulation_smoother_collapsed_entry.lower(
+            params, xz, mf, jax.random.PRNGKey(0)
+        ),
+        "simulation_smoother_collapsed",
+    )
