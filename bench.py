@@ -1822,6 +1822,246 @@ def large_n_section(force_cpu: bool = False):
     print(json.dumps(out), flush=True)
 
 
+def _synthetic_heads_panel(T, N, r, dtype):
+    """`_synthetic_ragged_panel` with heads-only raggedness: the steady
+    tail requires a COMPLETE interior suffix (emcore.ar_steady_plan gates
+    off any panel whose last rows have missing cells), so the composed
+    grid confines missingness to contiguous head runs."""
+    import numpy as np
+
+    x = _synthetic_ragged_panel(T, N, r, dtype)
+    # refill the ragged tails from the same DGP statistics: any finite
+    # value keeps the mask class; zeros match the standardized scale
+    x[T - max(2, T // 8):] = np.nan_to_num(x[T - max(2, T // 8):])
+    return x
+
+
+def run_composed(force_cpu: bool = False, smoke: bool = False):
+    """--run-composed (child of --composed): do composed transform stacks
+    multiply their wins on ONE panel?
+
+    Grid: N in {1k, 10k, 100k} x {sequential, collapsed, steady, sharded,
+    all} on a T=384 heads-ragged AR panel, every step resolved from its
+    transform stack (models/transforms).  Per leg: iters/sec of the
+    compiled step and the XLA cost-model FLOPs.  On the 8-virtual-device
+    CPU platform the shard legs share one socket, so shard scaling is
+    reported as per-device FLOP partitioning (collapsed FLOPs / sharded
+    per-device FLOPs), honestly labeled via "flop_proxy": wall-clock
+    shard scaling needs the real mesh.  Acceptance fields: steady
+    speedup >= 2x over collapsed-alone at N=100k (wall clock), sharded
+    pre-scan FLOP scaling >= 3x at 8 devices, and the all-axes stack's
+    FLOP reduction within 40% of the steady x shard product.  The dense
+    sequential leg is O((r p + N)^3 T) — minutes of CPU per iteration
+    past N ~ 512 — so wide legs record the gate reason (never a silent
+    skip); docs/BENCH_large_n.json carries the measured dense point.
+    Prints one JSON line; the parent persists docs/BENCH_composed.json."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    if force_cpu:
+        from dynamic_factor_models_tpu.utils.backend import fall_back_to_cpu
+
+        fall_back_to_cpu("composed forced CPU", caller="bench")
+
+    from dynamic_factor_models_tpu.models import emcore, ssm_ar
+    from dynamic_factor_models_tpu.models import transforms as tfm
+    from dynamic_factor_models_tpu.ops.masking import fillz, mask_of
+    from dynamic_factor_models_tpu.parallel.mesh import series_pad
+
+    dev = jax.devices()[0]
+    n_dev = jax.device_count()
+    ns = min(8, n_dev)
+    T, r, p = (96, 2, 1) if smoke else (384, 4, 1)
+    Ns = (768,) if smoke else (1000, 10_000, 100_000)
+    budget = float(os.environ.get("DFM_MEM_BUDGET", 8e9))
+    out = {
+        "device": str(dev), "composed": True, "smoke": smoke,
+        "T": T, "r": r, "p": p, "n_devices": n_dev, "n_shards": ns,
+        "mem_budget_bytes": budget,
+        "flop_proxy": not _is_tpu_platform(dev.platform),
+    }
+
+    def _prep(N, dtype=np.float32):
+        x = _synthetic_heads_panel(T, N, r, dtype)
+        xj = jnp.asarray(x)
+        xz, m = fillz(xj), mask_of(xj)
+        assert ssm_ar.qd_mask_supported(np.asarray(m))
+        qd = ssm_ar.compute_qd_stats(xz, m)
+        rng = np.random.default_rng(0)
+        params = ssm_ar.SSMARParams(
+            lam=jnp.asarray(0.3 * rng.standard_normal((N, r)), xz.dtype),
+            phi=jnp.zeros(N, xz.dtype),
+            sigv2=jnp.ones(N, xz.dtype),
+            A=0.5 * jnp.eye(r, dtype=xz.dtype)[None],
+            Q=jnp.eye(r, dtype=xz.dtype),
+        )
+        return params, xz, m, qd
+
+    def _ips(ex, *args, n_timing_runs=3):
+        jax.block_until_ready(ex(*args))  # warm outside the clock
+        t = _time_fixed_iters(
+            lambda: jax.block_until_ready(ex(*args)), n_timing_runs
+        )
+        return round(1.0 / t, 2)
+
+    for N in Ns:
+        key = f"n{N // 1000}k" if N >= 1000 else f"n{N}"
+        est = 12 * T * N * 4  # QDStats panels + panel + shard copies
+        if est > budget:
+            for v in ("sequential", "collapsed", "steady", "sharded", "all"):
+                out[f"em_ar_{v}_iters_per_sec_{key}"] = None
+            out[f"em_ar_gated_{key}"] = (
+                f"estimated {est:.2e} B > DFM_MEM_BUDGET {budget:.2e} B"
+            )
+            continue
+        params, xz, m, qd = _prep(N)
+
+        # collapsed: the one-axis baseline every product is measured against
+        step_c = tfm.resolve(tfm.Stack("ar", (tfm.collapse(),))).step
+        exc = jax.jit(step_c).lower(params, xz, qd).compile()
+        ips_c = _ips(exc, params, xz, qd)
+        fc = _compiled_flops(exc)
+        out[f"em_ar_collapsed_iters_per_sec_{key}"] = ips_c
+
+        if N <= 1000 and not smoke:
+            # one timing run: ~2 min/iteration of dense filter at N=1k
+            exd = jax.jit(ssm_ar.em_step_ar).lower(params, xz, m).compile()
+            ips_d = _ips(exd, params, xz, m, n_timing_runs=1)
+            out[f"em_ar_sequential_iters_per_sec_{key}"] = ips_d
+            out[f"em_ar_collapse_speedup_{key}"] = round(ips_c / ips_d, 1)
+        else:
+            out[f"em_ar_sequential_iters_per_sec_{key}"] = None
+            out[f"em_ar_sequential_gated_{key}"] = (
+                f"dense AR state dim {r * p + N}: O(k^3) per scan step is "
+                "minutes of CPU wall clock per iteration; the measured "
+                "dense baseline lives in docs/BENCH_large_n.json (N=512)"
+            )
+
+        # + steady tail (host-gated, like estimate_dfm_em_ar(steady=True))
+        plan = emcore.ar_steady_plan(params, np.asarray(m))
+        sp = None
+        if plan is None:
+            out[f"em_ar_steady_iters_per_sec_{key}"] = None
+            out[f"em_ar_steady_gated_{key}"] = "ar_steady_plan gated off"
+        else:
+            t_star, st0, rho = plan
+            res_s = tfm.resolve(
+                tfm.Stack("ar", (tfm.collapse(), tfm.steady_tail(t_star)))
+            )
+            tail = emcore.compute_qd_tail_stats(qd, t_star)
+            state = emcore.ARSteadyState(
+                params=params,
+                Pp=jnp.asarray(st0.Pp, xz.dtype),
+                riccati_iters=jnp.asarray(0, jnp.int32),
+            )
+            exs = jax.jit(res_s.step).lower(state, xz, qd, tail).compile()
+            ips_s = _ips(exs, state, xz, qd, tail)
+            fs = _compiled_flops(exs)
+            sp = round(ips_s / ips_c, 2)
+            out[f"em_ar_steady_iters_per_sec_{key}"] = ips_s
+            out[f"t_star_{key}"] = int(t_star)
+            out[f"steady_frac_{key}"] = round(float(T - t_star) / T, 3)
+            out[f"em_ar_steady_speedup_{key}"] = sp
+            if fc and fs:
+                out[f"em_ar_steady_flop_reduction_{key}"] = round(fc / fs, 2)
+
+        # + shard: the collapse's pre-scan GEMMs shard-local on the mesh
+        if ns > 1:
+            Npad = series_pad(N, ns)
+            params_p, xz_p, m_p = params, xz, m
+            if Npad != N:
+                z = jnp.zeros((T, Npad - N), xz.dtype)
+                xz_p = jnp.concatenate([xz, z], axis=1)
+                m_p = jnp.concatenate([m, jnp.zeros(z.shape, bool)], axis=1)
+                params_p = emcore.pad_ar_params(params, Npad)
+            qd_p = ssm_ar.compute_qd_stats(xz_p, m_p)
+            res_h = tfm.resolve(
+                tfm.Stack("ar", (tfm.collapse(), tfm.shard(ns)))
+            )
+            exh = jax.jit(res_h.step).lower(params_p, xz_p, qd_p).compile()
+            ips_h = _ips(exh, params_p, xz_p, qd_p)
+            fh = _compiled_flops(exh)
+            out[f"em_ar_sharded_iters_per_sec_{key}"] = ips_h
+            if fc and fh:
+                # SPMD cost analysis counts ONE device's program, so the
+                # ratio is the per-device pre-scan work reduction
+                out[f"em_ar_shard_prescan_scaling_{key}"] = round(fc / fh, 2)
+            if plan is not None:
+                # all three speed axes on one panel
+                res_a = tfm.resolve(
+                    tfm.Stack(
+                        "ar",
+                        (tfm.collapse(), tfm.steady_tail(t_star),
+                         tfm.shard(ns)),
+                    )
+                )
+                tail_p = emcore.compute_qd_tail_stats(qd_p, t_star)
+                state_p = emcore.ARSteadyState(
+                    params=params_p,
+                    Pp=jnp.asarray(st0.Pp, xz.dtype),
+                    riccati_iters=jnp.asarray(0, jnp.int32),
+                )
+                exa = (
+                    jax.jit(res_a.step)
+                    .lower(state_p, xz_p, qd_p, tail_p)
+                    .compile()
+                )
+                ips_a = _ips(exa, state_p, xz_p, qd_p, tail_p)
+                fa = _compiled_flops(exa)
+                out[f"em_ar_all_iters_per_sec_{key}"] = ips_a
+                out[f"em_ar_all_speedup_{key}"] = round(ips_a / ips_c, 2)
+                if fc and fa:
+                    out[f"em_ar_all_flop_reduction_{key}"] = round(
+                        fc / fa, 2
+                    )
+        print(
+            json.dumps({k: v for k, v in out.items() if key in k}),
+            file=sys.stderr, flush=True,
+        )
+
+    # acceptance summary (None when the contributing leg was gated)
+    sp = out.get("em_ar_steady_speedup_n100k")
+    out["accept_steady_2x_n100k"] = None if sp is None else bool(sp >= 2.0)
+    sc = out.get("em_ar_shard_prescan_scaling_n100k")
+    out["accept_shard_scaling_3x_n100k"] = (
+        None if sc is None else bool(sc >= 3.0)
+    )
+    sf = out.get("em_ar_steady_flop_reduction_n100k")
+    fr = out.get("em_ar_all_flop_reduction_n100k")
+    out["accept_composed_multiplies_n100k"] = (
+        None
+        if None in (sf, sc, fr)
+        else bool(fr >= 0.6 * sf * sc)
+    )
+    print(json.dumps(out), flush=True)
+
+
+def composed_orchestrate(force_cpu: bool):
+    """--composed: run the composed transform-stack grid in a child with
+    the forced 8-device flag set BEFORE jax initializes (same reason
+    --multichip is a child), then persist docs/BENCH_composed.json."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        flags = (flags + " --xla_force_host_platform_device_count=8").strip()
+    child_args = ["--run-composed"]
+    if force_cpu or os.environ.get("DFM_BENCH_FORCE_CPU") == "1":
+        child_args.append("--force-cpu")
+    pr = _run_child(child_args, env_extra={"XLA_FLAGS": flags},
+                    timeout_s=7200)
+    fragment = _parse_fragment(pr)
+    if fragment is None:
+        print("bench: composed child produced no JSON", file=sys.stderr)
+        sys.exit(2)
+    path = os.path.join(REPO, "docs", "BENCH_composed.json")
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(fragment, fh, indent=1, sort_keys=True)
+    os.replace(tmp, path)
+    print(json.dumps(fragment))
+    sys.exit(pr.returncode)
+
+
 def crossover_table():
     """Manual mode: Pallas-vs-XLA crossover sweep on the live chip; prints a
     markdown table for ops/pallas_gram.py and docs/PARITY.md."""
@@ -2265,6 +2505,22 @@ def run_tpu_remainder(force_cpu: bool = False):
         partial["multichip"] = {"error": "multichip child produced no JSON"}
     else:
         partial["multichip"] = mc
+    _persist_partial(partial)
+    print(json.dumps(partial), file=sys.stderr, flush=True)
+
+    # composed transform-stack smoke: same 8-device child pattern — the
+    # full grid is bench.py --composed; the smoke proves the composed
+    # kernels (collapsed x steady x sharded AR steps) compile and run on
+    # the live chip inside a short window
+    cp_args = ["--run-composed", "--smoke"]
+    if force_cpu:
+        cp_args.append("--force-cpu")
+    cp_pr = _run_child(cp_args, env_extra={"XLA_FLAGS": mc_flags})
+    cp = _parse_fragment(cp_pr)
+    partial["composed_smoke"] = (
+        cp if cp is not None
+        else {"error": "composed child produced no JSON"}
+    )
     _persist_partial(partial)
     print(json.dumps(partial), file=sys.stderr, flush=True)
 
@@ -2928,6 +3184,19 @@ def main():
                          "(large_n_section); prints one JSON line and "
                          "persists docs/BENCH_large_n.json")
     ap.add_argument("--run-multichip", action="store_true")
+    ap.add_argument("--composed", action="store_true",
+                    help="composed transform-stack grid: N in {1k, 10k, "
+                         "100k} x {sequential, collapsed, steady, "
+                         "sharded, all} AR EM steps resolved from "
+                         "models/transforms stacks, with steady-speedup "
+                         "and shard-FLOP-partition acceptance fields; "
+                         "runs in an 8-device child and persists "
+                         "docs/BENCH_composed.json")
+    ap.add_argument("--run-composed", action="store_true")
+    ap.add_argument("--smoke", action="store_true",
+                    help="with --run-composed: tiny grid (T=96, N=768) "
+                         "proving the composed kernels compile and run; "
+                         "used by --run-tpu-remainder")
     ap.add_argument("--run-compile-split", action="store_true")
     ap.add_argument("--cache-dir")
     ap.add_argument("--warm-cache", action="store_true")
@@ -2957,6 +3226,12 @@ def main():
         return
     if args.large_n:
         large_n_section(force_cpu=args.force_cpu)
+        return
+    if args.composed:
+        composed_orchestrate(force_cpu=args.force_cpu)
+        return
+    if args.run_composed:
+        run_composed(force_cpu=args.force_cpu, smoke=args.smoke)
         return
     if args.run_multichip:
         run_multichip(force_cpu=args.force_cpu)

@@ -1,0 +1,799 @@
+"""Composed EM core steps: the kernels the transform stack resolves to.
+
+PRs 3-10 each made ONE axis of the Stock-Watson EM fast — steady-state
+tails (models/steady.py), cross-section sharding (`ssm._sharded_step_for`),
+batched refits (emloop.run_em_loop_batched), and the large-N
+quasi-differenced AR collapse (`ssm_ar.em_step_ar_qd`) — but every fast
+path was its own hand-written kernel, so no panel ever got two wins at
+once.  This module holds the PRODUCTS of those axes:
+
+  * `em_step_collapsed` — the explicit collapse pipeline for the iid
+    model (partial payload -> unpack -> pre-reduced-stats scan): the
+    single-device body of `ssm._sharded_step_for`, i.e. exactly what the
+    shard transform wraps a ring all-reduce around.  Drop-in for
+    `em_step_stats` (parity pinned), vmappable for batched refits.
+  * `_ar_steady_step_for(t_star, block)` — collapsed AR x steady tail:
+    a 100k-series panel pays neither N (quasi-differenced collapse) nor
+    T (constant-gain tail, closed-form tail moments) per iteration.
+    `ar_steady_plan` is the host-side gate; `QDTailStats` holds the
+    loop-invariant tail data moments that let the M-step's phi/sigv2
+    update skip the tail residual panels entirely.
+  * `_ar_sharded_step_for(n_shards)` — collapsed AR x data mesh: the
+    collapse's (T, N) pre-scan GEMMs (where ALL large-N FLOPs live) run
+    shard-local, one ring all-reduce restores the global payload, the
+    N-free scan runs replicated, the per-series M-step stays local.
+  * `_ar_steady_sharded_step_for(t_star, block, n_shards)` — all three.
+
+The composition algebra is deliberate: shard wraps the collapse's
+pre-scan (the reduction commutes with the series sum — partials reduce
+EXACTLY), steady splits the collapse's time axis (head exact, tail
+constant), and both leave the numerics of the wrapped pieces untouched —
+the steady head scan IS `_filter_ar_qd`'s scan at length t*, and the
+sharded payload after reduction IS `_collapse_obs_qd`'s output.
+models/transforms.py names these products; utils/compile.py derives AOT
+registration from the stack instead of enumerating kernels.
+
+Exactness of the AR x steady tail split: `ar_steady_plan` places t* so
+that every cell at t >= t* is INTERIOR (observed with the previous
+period observed — it requires a complete tail and pads past the last
+incomplete row).  On interior cells the quasi-differencing weights are
+per-series constants (Vinv = 1/sigv^2, beta = phi), so the per-step
+information matrix is the constant C_inf, log|V_t| is a constant, and
+the tail's share of every M-step panel contraction collapses to either
+a closed-form covariance sum (n_tail*Ps_inf + S_dev, as in
+`ssm._em_step_steady_impl`) or a loop-invariant data moment
+(`QDTailStats`).  The only full-T panel work left per iteration is the
+collapsed observation b_t (the tail recursion consumes it every step)
+and four tail cross GEMMs shared by the loading rhs and the phi/sigv2
+moments.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.linalg import solve_normal
+from .ssm import (
+    PanelStats,
+    SSMParams,
+    _collapse_obs_stats_partial,
+    _em_m_step,
+    _filter_scan_collapsed_stats,
+    _info_filter_scan,
+    _psd_floor,
+    _rts_scan,
+    _smoother_scan,
+    _sym_pack_idx,
+    _unpack_collapsed,
+)
+from .ssm_ar import (
+    QDStats,
+    SSMARParams,
+    _guard_params_qd,
+    _m_step_ar_qd,
+    _qd_companion,
+    _qd_weight_panels,
+)
+
+__all__ = [
+    "ARSteadyState",
+    "QDTailStats",
+    "compute_qd_tail_stats",
+    "em_step_collapsed",
+    "ar_steady_plan",
+    "em_step_ar_steady",
+    "em_step_ar_sharded",
+    "pad_ar_params",
+    "unpad_ar_params",
+]
+
+
+# ======================= iid model: explicit collapse ========================
+
+
+@jax.jit
+def em_step_collapsed(params: SSMParams, x, mask, stats: PanelStats):
+    """One EM iteration through the explicit collapse pipeline — the
+    single-device body of `ssm._sharded_step_for`: per-series partial
+    payload, unpack, pre-reduced-stats scan, shared M-step.  Same
+    (params, x, mask, stats) -> (params, loglik) contract and fixed point
+    as `em_step_stats` (parity pinned at 1e-10 in
+    tests/test_transform_stack.py); the program the shard transform
+    produces when the mesh has one device, kept mesh-free here so batched
+    refits can vmap it over wide buckets."""
+    del mask  # the collapse payload already carries the mask
+    params = params._replace(Q=_psd_floor(params.Q))
+    payload, ll_corr = _collapse_obs_stats_partial(
+        params.lam, params.R, x, stats
+    )
+    C, b, ld_R = _unpack_collapsed(payload, params.r)
+    filt, pinvs = _filter_scan_collapsed_stats(
+        params, C, b, ld_R, stats.n_obs, ll_corr, want_pinv=True
+    )
+    s_sm, P_sm, lag1 = _smoother_scan(params, filt, pinvs=pinvs)
+    return (
+        _em_m_step(params, x, stats.m, s_sm, P_sm, lag1, stats=stats),
+        filt.loglik,
+    )
+
+
+# ================== collapsed AR: shard-reducible payloads ===================
+
+
+def _collapse_obs_qd_partial(params: SSMARParams, x, qd: QDStats):
+    """Per-shard half of `ssm_ar._collapse_obs_qd`: every collapsed
+    statistic of the quasi-differenced model — the three packed blocks of
+    the [f_t, f_{t-1}] information matrix, the gain rhs b, log|V_t|, and
+    the data quadratic — is a SUM over series, so a shard computes the
+    same GEMMs on its N-slice and one all-reduce of the packed
+    (T, 3*npack + 2 + 2r) payload restores the full-panel values
+    exactly.  Column layout: [Cu00 | Cu01 | Cu11 | ld_V | xRx | b]."""
+    r = params.r
+    iu, iv, _ = _sym_pack_idx(r)
+    Vinv, beta = _qd_weight_panels(params, qd, transposed=False)
+    z = x - beta * qd.x_prev
+    u = Vinv * z
+    w1 = -Vinv * beta
+    pair = params.lam[:, iu] * params.lam[:, iv]  # (N, npack)
+    Cu00 = Vinv @ pair
+    Cu01 = w1 @ pair
+    Cu11 = (-w1 * beta) @ pair
+    b = jnp.concatenate([u @ params.lam, (w1 * z) @ params.lam], axis=1)
+    ld_V = qd.m @ jnp.log(params.sigv2) - qd.first @ jnp.log1p(
+        -params.phi * params.phi
+    )
+    xRx = (u * z).sum(axis=1)
+    return jnp.concatenate(
+        [Cu00, Cu01, Cu11, ld_V[:, None], xRx[:, None], b], axis=1
+    )
+
+
+def _unpack_qd_collapsed(payload, r: int):
+    """Invert the `_collapse_obs_qd_partial` packing after reduction:
+    returns (C (T, 2r, 2r), b (T, 2r), ld_V (T,), xRx (T,))."""
+    npack = r * (r + 1) // 2
+    _, _, unpack = _sym_pack_idx(r)
+    C00 = payload[:, :npack][:, unpack].reshape(-1, r, r)
+    C01 = payload[:, npack : 2 * npack][:, unpack].reshape(-1, r, r)
+    C11 = payload[:, 2 * npack : 3 * npack][:, unpack].reshape(-1, r, r)
+    C = jnp.concatenate(
+        [
+            jnp.concatenate([C00, C01], axis=2),
+            jnp.concatenate([C01, C11], axis=2),
+        ],
+        axis=1,
+    )
+    ld_V = payload[:, 3 * npack]
+    xRx = payload[:, 3 * npack + 1]
+    b = payload[:, 3 * npack + 2 :]
+    return C, b, ld_V, xRx
+
+
+def _qd_filter_from_collapsed(params: SSMARParams, C, b, ld_V, xRx, n_obs,
+                              want_pinv=False):
+    """`_filter_ar_qd`'s scan assembly on pre-reduced collapsed
+    statistics.  Kept as a separate function — not a refactor of
+    `ssm_ar._filter_ar_qd` — so the single-device collapsed-AR program
+    stays byte-identical to its HLO pin
+    (tests/test_perf_regression.py::test_collapsed_ar_scan_body_hlo_is_n_free),
+    mirroring `ssm._filter_scan_collapsed_stats`."""
+    r = params.r
+    Tm, Qs = _qd_companion(params)
+    k = Tm.shape[0]
+    dtype = b.dtype
+    s0 = jnp.zeros(k, dtype)
+    P0 = 1e2 * jnp.eye(k, dtype=dtype)
+    q2 = 2 * r
+
+    def obs_step(inp, sp):
+        Ct, bt, ld, xr, no = inp
+        f2 = sp[:q2]
+        Cf = jnp.zeros((k, k), dtype).at[:q2, :q2].set(Ct)
+        rhs = jnp.zeros(k, dtype).at[:q2].set(bt - Ct @ f2)
+        quad0 = xr - 2.0 * (f2 @ bt) + f2 @ Ct @ f2
+        return Cf, rhs, ld, quad0, no
+
+    return _info_filter_scan(
+        Tm, Qs, (C, b, ld_V, xRx, n_obs), obs_step, s0, P0,
+        want_pinv=want_pinv,
+    )
+
+
+# ===================== collapsed AR x steady-state tail ======================
+
+
+class ARSteadyState(NamedTuple):
+    """EM-loop carry of the collapsed-AR steady path: parameters plus the
+    previous iteration's steady predicted covariance Pp_inf (DARE warm
+    start) and the cumulative doubling count — the `SteadyEMState` twin
+    for the quasi-differenced model.  Rides `run_em_loop`'s opaque params
+    pytree; the guards' covariance maps and `emaccel.unwrap_state` both
+    recurse through the `.params` field."""
+
+    params: SSMARParams
+    Pp: jnp.ndarray  # (k, k) previous steady predicted covariance
+    riccati_iters: jnp.ndarray  # () i32 cumulative doubling steps
+
+
+class QDTailStats(NamedTuple):
+    """Loop-invariant tail data moments of the quasi-differenced model,
+    computed once per estimate at the static t* and threaded through the
+    EM loop.  They close the M-step's phi/sigv2 sums over the tail —
+    sum ehat^2, sum ehat*ehat_prev, sum ehat_prev^2 expand into these
+    data moments plus factor-moment contractions already needed for the
+    loading update — so no (n_tail, N) residual panel is ever built."""
+
+    sxx: jnp.ndarray  # (N,) sum_{t>=t*} x_it^2
+    sxx1: jnp.ndarray  # (N,) sum_{t>=t*} x_it x_{i,t-1}
+    spp: jnp.ndarray  # (N,) sum_{t>=t*} x_{i,t-1}^2
+
+
+def compute_qd_tail_stats(qd: QDStats, t_star: int) -> QDTailStats:
+    """Materialize the per-series tail data moments from the stored
+    transposed panels (contiguous (N, n_tail) reductions)."""
+    xt = qd.xT[:, t_star:]
+    xp = qd.x_prevT[:, t_star:]
+    return QDTailStats(
+        sxx=(xt * xt).sum(axis=1),
+        sxx1=(xt * xp).sum(axis=1),
+        spp=(xp * xp).sum(axis=1),
+    )
+
+
+def _qd_steady_collapse_partial(params: SSMARParams, x, qd: QDStats,
+                                t_star: int):
+    """Split collapse of the quasi-differenced model at the convergence
+    horizon: exact per-step statistics on the head rows only (GEMMs at
+    (t*, N)), per-series CONSTANTS on the tail — every tail cell is
+    interior by `ar_steady_plan`'s placement of t*, so Vinv = 1/sigv^2
+    and beta = phi there, making C_t = C_inf and log|V_t| constant.  b_t
+    stays full-T (the constant-gain recursion consumes it each step) and
+    the tail data quadratic leaves the scan as one scalar.
+
+    Returns a shard-reducible pair: `payload` (T, 3*npack + 2 + 2r) with
+    the head statistics in rows [:t*] of the leading columns and b in
+    the trailing 2r columns, and `const_vec` (3*npack + 2,) packing
+    [c00 | c01 | c11 | ld_inf | quad_tail].  Both are series sums, so
+    the sharded variant ring-reduces the payload and psums the
+    constants; the single-device step consumes them directly."""
+    r = params.r
+    iu, iv, _ = _sym_pack_idx(r)
+    npack = r * (r + 1) // 2
+    Vinv, beta = _qd_weight_panels(params, qd, transposed=False)
+    z = x - beta * qd.x_prev
+    u = Vinv * z
+    w1 = -Vinv * beta
+    pair = params.lam[:, iu] * params.lam[:, iv]  # (N, npack)
+    # head: exact per-step collapse on the (t*, N) slices
+    Cu00_h = Vinv[:t_star] @ pair
+    Cu01_h = w1[:t_star] @ pair
+    Cu11_h = (-w1[:t_star] * beta[:t_star]) @ pair
+    ld_h = qd.m[:t_star] @ jnp.log(params.sigv2) - qd.first[
+        :t_star
+    ] @ jnp.log1p(-params.phi * params.phi)
+    uz = u * z
+    xrx_h = uz[:t_star].sum(axis=1)
+    # tail: per-series constant weights -> one column sum each
+    vinv_c = 1.0 / params.sigv2
+    w1_c = -params.phi * vinv_c
+    w2_c = params.phi * params.phi * vinv_c
+    c00 = vinv_c @ pair
+    c01 = w1_c @ pair
+    c11 = w2_c @ pair
+    ld_inf = jnp.log(params.sigv2).sum()
+    quad_tail = uz[t_star:].sum()
+    b = jnp.concatenate([u @ params.lam, (w1 * z) @ params.lam], axis=1)
+    head_cols = jnp.concatenate(
+        [Cu00_h, Cu01_h, Cu11_h, ld_h[:, None], xrx_h[:, None]], axis=1
+    )
+    payload = (
+        jnp.zeros((x.shape[0], 3 * npack + 2 + 2 * r), x.dtype)
+        .at[:t_star, : 3 * npack + 2]
+        .set(head_cols)
+        .at[:, 3 * npack + 2 :]
+        .set(b)
+    )
+    const_vec = jnp.concatenate(
+        [c00, c01, c11, ld_inf[None], quad_tail[None]]
+    )
+    return payload, const_vec
+
+
+def _unpack_qd_steady(payload, const_vec, r: int, t_star: int):
+    """Invert the `_qd_steady_collapse_partial` packing after reduction."""
+    npack = r * (r + 1) // 2
+    _, _, unpack = _sym_pack_idx(r)
+
+    def blocks(c00u, c01u, c11u):
+        C00 = c00u[..., unpack].reshape(*c00u.shape[:-1], r, r)
+        C01 = c01u[..., unpack].reshape(*c00u.shape[:-1], r, r)
+        C11 = c11u[..., unpack].reshape(*c00u.shape[:-1], r, r)
+        return jnp.concatenate(
+            [
+                jnp.concatenate([C00, C01], axis=-1),
+                jnp.concatenate([C01, C11], axis=-1),
+            ],
+            axis=-2,
+        )
+
+    head = payload[:t_star]
+    C_head = blocks(
+        head[:, :npack], head[:, npack : 2 * npack],
+        head[:, 2 * npack : 3 * npack],
+    )
+    ld_h = head[:, 3 * npack]
+    xrx_h = head[:, 3 * npack + 1]
+    b = payload[:, 3 * npack + 2 :]
+    C_inf = blocks(
+        const_vec[:npack], const_vec[npack : 2 * npack],
+        const_vec[2 * npack : 3 * npack],
+    )
+    ld_inf = const_vec[3 * npack]
+    quad_tail = const_vec[3 * npack + 1]
+    return C_head, b, ld_h, xrx_h, C_inf, ld_inf, quad_tail
+
+
+def _ar_steady_core(params: SSMARParams, C_head, b, ld_h, xrx_h, C_inf,
+                    ld_inf, quad_tail, n_obs, Pp0, t_star: int, block: int):
+    """Forward + backward pass of the collapsed-AR steady split: DARE at
+    the 2r-dim collapsed observation (warm-started from the previous
+    iteration's Pp_inf), exact head scan of t* steps — the same scan body
+    as `_filter_ar_qd` — then the factorization-free constant-gain tail
+    and the boundary-closed RTS head, exactly as
+    `ssm._em_step_steady_impl` does for the iid model.  Returns
+    (steady, f_sm (T, k), P_head (t*, k, k), lag1_h (t*, k, k), ll)."""
+    from .steady import steady_smooth_tail, steady_state, steady_tail
+
+    Tm, Qs = _qd_companion(params)
+    k = Tm.shape[0]
+    q2 = 2 * params.r
+    dtype = b.dtype
+    s0 = jnp.zeros(k, dtype)
+    P0 = 1e2 * jnp.eye(k, dtype=dtype)
+    st = steady_state(Tm, C_inf, Qs, q=q2, Pp0=Pp0)
+
+    def obs_step(inp, sp):
+        Ct, bt, ld, xr, no = inp
+        f2 = sp[:q2]
+        Cf = jnp.zeros((k, k), dtype).at[:q2, :q2].set(Ct)
+        rhs = jnp.zeros(k, dtype).at[:q2].set(bt - Ct @ f2)
+        quad0 = xr - 2.0 * (f2 @ bt) + f2 @ Ct @ f2
+        return Cf, rhs, ld, quad0, no
+
+    means_h, covs_h, pmeans_h, pcovs_h, lls_h = _info_filter_scan(
+        Tm, Qs, (C_head, b[:t_star], ld_h, xrx_h, n_obs[:t_star]),
+        obs_step, s0, P0,
+    )
+    ld_const = ld_inf + st.ld_pp - st.ld_pu
+    su_tail, lls_tail = steady_tail(
+        Tm, C_inf, st.Pu[:q2, :q2], st.K, st.Abar, b[t_star:],
+        means_h[-1], n_obs[t_star:], ld_const, block=block,
+    )
+    s_sm_tail = steady_smooth_tail(Tm, st.J, su_tail, block=block)
+    s_all, P_head, lag1_h = _rts_scan(
+        Tm,
+        jnp.concatenate([means_h, s_sm_tail[:1]]),
+        jnp.concatenate([covs_h, st.Ps[None]]),
+        jnp.concatenate([pmeans_h, (Tm @ means_h[-1])[None]]),
+        jnp.concatenate([pcovs_h, st.Pp[None]]),
+    )
+    f_sm = jnp.concatenate([s_all[:t_star], s_sm_tail])
+    # steady_tail's quadratic omits the data term x'V^-1x (it rides the
+    # reduced scalar), so the tail likelihood closes with -quad_tail/2
+    ll = lls_h.sum() + lls_tail.sum() - 0.5 * quad_tail
+    return st, f_sm, P_head[:t_star], lag1_h, ll
+
+
+def _m_step_ar_qd_steady(params: SSMARParams, x, qd: QDStats,
+                         tail: QDTailStats, f_sm, P_head, lag1_h, st,
+                         t_star: int):
+    """`_m_step_ar_qd` with the tail contractions in closed form.
+
+    Every tail sum splits into loop-invariant data moments (QDTailStats),
+    the closed-form tail covariance sum Psum = n_tail*Ps_inf + S_dev, and
+    four (N, n_tail) x (n_tail, r) cross GEMMs Sxf0/Sxf1/Spf0/Spf1 that
+    the loading rhs and the phi/sigv2 moments share.  Head sums run on
+    (t*,)-sliced panels exactly as the full M-step does.  Same fixed
+    point as `_m_step_ar_qd` up to the steady approximation the plan
+    verified (tail covariances within the DARE tolerance of their exact
+    values)."""
+    r, p = params.r, params.p
+    rp = r * p
+    Tn = x.shape[0]
+    n_tail = Tn - t_star
+    iu, iv, unpack = _sym_pack_idx(r)
+    f0 = f_sm[:, :r]
+    f1 = f_sm[:, r : 2 * r]
+    f0h, f1h = f0[:t_star], f1[:t_star]
+    f0t, f1t = f0[t_star:], f1[t_star:]
+
+    # --- closed-form tail factor moments ---
+    Psum = n_tail * st.Ps + st.Sdev  # sum_{t>=t*} P_sm_t
+    Pt00 = Psum[:r, :r]
+    Pt01 = Psum[:r, r : 2 * r]
+    Pt11 = Psum[r : 2 * r, r : 2 * r]
+    sumF00_t = (f0t.T @ f0t + Pt00)[iu, iv]  # (npack,)
+    sumF11_t = (f1t.T @ f1t + Pt11)[iu, iv]
+    G01t = f0t.T @ f1t + Pt01
+    sumF01s_t = (G01t + G01t.T)[iu, iv]
+
+    # --- head factor moments (packed, per step) ---
+    P00h = P_head[:, :r, :r]
+    P01h = P_head[:, :r, r : 2 * r]
+    P11h = P_head[:, r : 2 * r, r : 2 * r]
+    F00u_h = f0h[:, iu] * f0h[:, iv] + P00h[:, iu, iv]
+    F11u_h = f1h[:, iu] * f1h[:, iv] + P11h[:, iu, iv]
+    F01_h = f0h[:, :, None] * f1h[:, None, :] + P01h
+    F01su_h = (F01_h + jnp.swapaxes(F01_h, 1, 2))[:, iu, iv]
+
+    # --- loadings: head weight panels + constant tail weights ---
+    VinvT_h, betaT_h = (
+        (qd.mT[:, :t_star] - qd.firstT[:, :t_star]
+         * (params.phi * params.phi)[:, None]) / params.sigv2[:, None],
+        params.phi[:, None] * qd.interiorT[:, :t_star],
+    )
+    w1T_h = -VinvT_h * betaT_h
+    w2T_h = -w1T_h * betaT_h
+    vinv_c = 1.0 / params.sigv2
+    w1_c = -params.phi * vinv_c
+    w2_c = params.phi * params.phi * vinv_c
+    G = (
+        VinvT_h @ F00u_h + w1T_h @ F01su_h + w2T_h @ F11u_h
+        + vinv_c[:, None] * sumF00_t[None, :]
+        + w1_c[:, None] * sumF01s_t[None, :]
+        + w2_c[:, None] * sumF11_t[None, :]
+    )
+    Gram = G[:, unpack].reshape(-1, r, r)
+    zT_h = qd.xT[:, :t_star] - betaT_h * qd.x_prevT[:, :t_star]
+    rhs_h = (VinvT_h * zT_h) @ f0h + (w1T_h * zT_h) @ f1h
+    # tail cross GEMMs, shared with the phi/sigv2 moments below
+    Sxf0 = qd.xT[:, t_star:] @ f0t  # (N, r)
+    Sxf1 = qd.xT[:, t_star:] @ f1t
+    Spf0 = qd.x_prevT[:, t_star:] @ f0t
+    Spf1 = qd.x_prevT[:, t_star:] @ f1t
+    rhs_t = (
+        vinv_c[:, None] * (Sxf0 - params.phi[:, None] * Spf0)
+        + w1_c[:, None] * (Sxf1 - params.phi[:, None] * Spf1)
+    )
+    lam = jax.vmap(solve_normal)(Gram, rhs_h + rhs_t)
+
+    # --- phi / sigv2 given the new loadings ---
+    dupe = jnp.where(iu == iv, 1.0, 2.0).astype(x.dtype)
+    pair2 = (lam[:, iu] * lam[:, iv]) * dupe[None, :]  # (N, npack)
+    # head: materialized residual panels at (t*, N), as in the full step
+    ehat_h = x[:t_star] - f0h @ lam.T
+    ehat_p_h = qd.x_prev[:t_star] - f1h @ lam.T
+    q00_h = P00h[:, iu, iv] @ pair2.T  # (t*, N)
+    q11_h = P11h[:, iu, iv] @ pair2.T
+    P01s_h = 0.5 * (P01h + jnp.swapaxes(P01h, 1, 2))
+    q01_h = P01s_h[:, iu, iv] @ pair2.T
+    int_h = qd.interior[:t_star]
+    num_h = jnp.einsum("tn,tn->n", int_h, ehat_h * ehat_p_h + q01_h)
+    den_h = jnp.einsum("tn,tn->n", int_h, ehat_p_h * ehat_p_h + q11_h)
+    S2_h = jnp.einsum("tn,tn->n", int_h, ehat_h * ehat_h + q00_h)
+    # tail: expand the residual sums into data moments + factor moments
+    #   sum ehat*ehat_p = sxx1 - lam.(Sxf1 + Spf0) + lam'(sym tail F01)lam
+    num_t = (
+        tail.sxx1 - (lam * (Sxf1 + Spf0)).sum(axis=1)
+        + pair2 @ (0.5 * sumF01s_t)
+    )
+    den_t = tail.spp - 2.0 * (lam * Spf1).sum(axis=1) + pair2 @ sumF11_t
+    S2_t = tail.sxx - 2.0 * (lam * Sxf0).sum(axis=1) + pair2 @ sumF00_t
+    num = num_h + num_t
+    den = den_h + den_t
+    S2 = S2_h + S2_t
+    phi = jnp.clip(num / jnp.maximum(den, 1e-12), -0.99, 0.99)
+    sigv2 = (S2 - 2.0 * phi * num + phi * phi * den) / jnp.maximum(
+        qd.n_int, 1.0
+    )
+    sigv2 = jnp.maximum(sigv2, 1e-8)
+    has = qd.n_int > 0
+    phi = jnp.where(has, phi, params.phi)
+    sigv2 = jnp.where(has, sigv2, params.sigv2)
+
+    # --- factor VAR: head sums + closed-form tail constants ---
+    s1, s0_ = f_sm[1:, :r], f_sm[:-1, :rp]
+    S11 = (
+        jnp.einsum("tr,ts->rs", s1, s1)
+        + P_head[1:, :r, :r].sum(axis=0)
+        + Psum[:r, :r]
+    )
+    S00 = (
+        jnp.einsum("tk,tl->kl", s0_, s0_)
+        + P_head[:, :rp, :rp].sum(axis=0)
+        + (Psum - st.Pu)[:rp, :rp]
+    )
+    S10 = (
+        jnp.einsum("tr,tk->rk", s1, s0_)
+        + lag1_h[:, :r, :rp].sum(axis=0)
+        + ((Psum - st.Ps) @ st.J.T)[:r, :rp]
+    )
+    Ak = S10 @ jnp.linalg.pinv(S00, hermitian=True)
+    Q = _psd_floor((S11 - Ak @ S10.T) / (Tn - 1))
+    A = jnp.stack([Ak[:, i * r : (i + 1) * r] for i in range(p)])
+    return SSMARParams(lam, phi, sigv2, A, Q)
+
+
+def _ar_steady_impl(state: ARSteadyState, x, qd: QDStats,
+                    tail: QDTailStats, t_star: int, block: int):
+    params = _guard_params_qd(state.params)
+    payload, const_vec = _qd_steady_collapse_partial(params, x, qd, t_star)
+    C_head, b, ld_h, xrx_h, C_inf, ld_inf, quad_tail = _unpack_qd_steady(
+        payload, const_vec, params.r, t_star
+    )
+    st, f_sm, P_head, lag1_h, ll = _ar_steady_core(
+        params, C_head, b, ld_h, xrx_h, C_inf, ld_inf, quad_tail,
+        qd.n_obs, state.Pp, t_star, block,
+    )
+    new = _m_step_ar_qd_steady(
+        params, x, qd, tail, f_sm, P_head, lag1_h, st, t_star
+    )
+    return (
+        ARSteadyState(new, st.Pp, state.riccati_iters + st.riccati_iters),
+        ll,
+    )
+
+
+@lru_cache(maxsize=None)
+def _ar_steady_step_for(t_star: int, block: int = 0):
+    """The jitted collapsed-AR steady EM step specialized to a static
+    convergence horizon and tail block size; lru_cached and named per
+    specialization so `run_em_loop`'s AOT-registry statics key
+    (utils.compile.aot_statics uses __module__ + __qualname__) is stable
+    across processes, like `ssm._steady_step_for`."""
+
+    def step(state: ARSteadyState, x, qd: QDStats, tail: QDTailStats):
+        return _ar_steady_impl(state, x, qd, tail, t_star, block)
+
+    step.__name__ = step.__qualname__ = (
+        f"em_step_ar_steady_t{t_star}_b{block}"
+    )
+    step.__module__ = __name__
+    return jax.jit(step)
+
+
+def em_step_ar_steady(state, x, qd: QDStats, tail: QDTailStats,
+                      t_star: int, block: int = 0):
+    """One collapsed-AR steady EM iteration (see `_ar_steady_impl`).
+    `state` is an `ARSteadyState`; a bare `SSMARParams` is wrapped with a
+    cold-start carry."""
+    if not isinstance(state, ARSteadyState):
+        k = state.r * max(state.p, 2)
+        state = ARSteadyState(
+            params=state,
+            Pp=jnp.zeros((k, k), state.lam.dtype),
+            riccati_iters=jnp.asarray(0, jnp.int32),
+        )
+    return _ar_steady_step_for(int(t_star), int(block))(state, x, qd, tail)
+
+
+def ar_steady_plan(params: SSMARParams, mask, min_tail: int = 8):
+    """Host-side dispatch gate for the collapsed-AR steady tail — the
+    `ssm._steady_plan` twin for the quasi-differenced model.
+
+    Requirements beyond the iid plan's: the tail must be INTERIOR, not
+    just complete — every tail cell needs its previous period observed so
+    the quasi-differencing weights are the per-series constants the
+    closed forms assume.  Placing t* at least one step past the last
+    incomplete row guarantees it (row t*-1 is fully observed), and the
+    same 1.5x + 8 safety pad as the iid plan covers EM's parameter drift
+    between horizon computations.  MUST be called on the unpadded mask:
+    an all-missing padded series would push `complete_from` to T and gate
+    the plan off, even though padded series contribute exactly zero to
+    every tail sum.
+
+    Returns (t_star, SteadyState at the init params, rho) or None."""
+    from .steady import convergence_horizon, steady_state
+
+    m_np = np.asarray(mask)
+    T = int(m_np.shape[0])
+    full = m_np.all(axis=1)
+    nz = np.nonzero(~full)[0]
+    complete_from = 0 if nz.size == 0 else int(nz[-1]) + 1
+    if complete_from >= T:
+        return None
+    params = _guard_params_qd(params)
+    r = params.r
+    Tm, Qs = _qd_companion(params)
+    vinv_c = np.asarray(1.0 / params.sigv2)
+    phi = np.asarray(params.phi)
+    lam = np.asarray(params.lam)
+    C00 = (lam.T * vinv_c) @ lam
+    C01 = (lam.T * (-phi * vinv_c)) @ lam
+    C11 = (lam.T * (phi * phi * vinv_c)) @ lam
+    C_inf = jnp.asarray(
+        np.block([[C00, C01], [C01.T, C11]]), lam.dtype
+    )
+    # C01 = sum_i w1_c_i lam_i lam_i' is symmetric; np.block keeps the
+    # exact float symmetry via the explicit transpose
+    st = steady_state(Tm, C_inf, Qs, q=2 * r)
+    if not bool(st.converged):
+        return None
+    k = Tm.shape[0]
+    P0 = 1e2 * jnp.eye(k, dtype=Tm.dtype)
+    t_model, rho = convergence_horizon(
+        Tm, C_inf, Qs, st, P0, t_max=max(4 * T, 64)
+    )
+    if t_model > T:
+        return None
+    t_pad = int(np.ceil(1.5 * t_model)) + 8
+    t_star = max(complete_from + t_pad, 2)
+    if T - t_star < max(t_pad, min_tail):
+        return None
+    return t_star, st, rho
+
+
+# ======================= collapsed AR x data mesh ============================
+
+
+def _ar_params_spec():
+    from ..parallel.mesh import P
+
+    return SSMARParams(
+        lam=P("data", None), phi=P("data"), sigv2=P("data"), A=P(), Q=P()
+    )
+
+
+def _qd_stats_spec():
+    from ..parallel.mesh import P
+
+    return QDStats(
+        m=P(None, "data"), first=P(None, "data"), interior=P(None, "data"),
+        x_prev=P(None, "data"), mT=P("data", None), firstT=P("data", None),
+        interiorT=P("data", None), xT=P("data", None),
+        x_prevT=P("data", None), n_int=P("data"), n_obs=P(),
+    )
+
+
+@lru_cache(maxsize=None)
+def _ar_sharded_step_for(n_shards: int):
+    """The collapsed-AR EM step sharded over the ``("data",)`` N-axis mesh
+    — same (params, x, qd) -> (params, loglik) contract as
+    `em_step_ar_qd`, N must be a shard multiple (`estimate_dfm_em_ar`
+    pads with inert series first).
+
+    The shard transform wraps exactly the collapse's pre-scan: the
+    (T, N) quasi-differencing GEMMs — where ALL the large-N FLOPs live —
+    run on local N-slices, one ring all-reduce of the packed payload
+    (`ops.pallas_gram.ring_allreduce`: Pallas RDMA ring on TPU, lax.psum
+    on the CPU mesh) restores the global collapsed statistics, the
+    N-free O(k^3) scan and factor-VAR moments run replicated, and the
+    M-step's per-series solves stay shard-local.  Inert-padding contract:
+    a padded series (lam = 0, phi = 0, sigv2 = 1, all-False mask) has
+    Vinv = beta = z = 0, so it contributes exactly zero to every payload
+    column, its Gram/rhs are zero (the minimum-norm solve returns
+    lam = 0), and has = n_int > 0 keeps its phi/sigv2 fixed."""
+    from jax.experimental.shard_map import shard_map
+
+    from ..ops.pallas_gram import ring_allreduce
+    from ..parallel.mesh import P, data_mesh
+
+    mesh = data_mesh(n_shards)
+
+    def step(params: SSMARParams, x, qd: QDStats):
+        params = _guard_params_qd(params)
+        payload = _collapse_obs_qd_partial(params, x, qd)
+        payload = ring_allreduce(payload, "data", n_shards)
+        C, b, ld_V, xRx = _unpack_qd_collapsed(payload, params.r)
+        means, covs, pmeans, pcovs, lls, pinvs = _qd_filter_from_collapsed(
+            params, C, b, ld_V, xRx, qd.n_obs, want_pinv=True
+        )
+        Tm, _ = _qd_companion(params)
+        s_sm, P_sm, lag1 = _rts_scan(
+            Tm, means, covs, pmeans, pcovs, pinvs=pinvs
+        )
+        return _m_step_ar_qd(params, x, qd, s_sm, P_sm, lag1), lls.sum()
+
+    step.__name__ = step.__qualname__ = f"em_step_ar_sharded_d{n_shards}"
+    step.__module__ = __name__
+
+    return jax.jit(
+        shard_map(
+            step,
+            mesh=mesh,
+            in_specs=(_ar_params_spec(), P(None, "data"), _qd_stats_spec()),
+            out_specs=(_ar_params_spec(), P()),
+            check_rep=False,
+        )
+    )
+
+
+def em_step_ar_sharded(params: SSMARParams, x, qd: QDStats, n_shards: int):
+    """One sharded collapsed-AR EM iteration (see `_ar_sharded_step_for`)."""
+    return _ar_sharded_step_for(int(n_shards))(params, x, qd)
+
+
+@lru_cache(maxsize=None)
+def _ar_steady_sharded_step_for(t_star: int, block: int, n_shards: int):
+    """All three composed axes on one panel: the quasi-differenced
+    collapse (N-free scan), the steady tail (T-free tail), and the data
+    mesh (shard-local pre-scan GEMMs).  The steady split's payload and
+    constant vector are both series sums, so the shard transform applies
+    unchanged: one ring all-reduce + one psum per iteration, then the
+    replicated steady core and the shard-local closed-form M-step."""
+    from jax.experimental.shard_map import shard_map
+
+    from ..ops.pallas_gram import ring_allreduce
+    from ..parallel.mesh import P, data_mesh
+
+    mesh = data_mesh(n_shards)
+
+    def step(state: ARSteadyState, x, qd: QDStats, tail: QDTailStats):
+        params = _guard_params_qd(state.params)
+        payload, const_vec = _qd_steady_collapse_partial(
+            params, x, qd, t_star
+        )
+        payload = ring_allreduce(payload, "data", n_shards)
+        const_vec = jax.lax.psum(const_vec, "data")
+        C_head, b, ld_h, xrx_h, C_inf, ld_inf, quad_tail = (
+            _unpack_qd_steady(payload, const_vec, params.r, t_star)
+        )
+        st, f_sm, P_head, lag1_h, ll = _ar_steady_core(
+            params, C_head, b, ld_h, xrx_h, C_inf, ld_inf, quad_tail,
+            qd.n_obs, state.Pp, t_star, block,
+        )
+        new = _m_step_ar_qd_steady(
+            params, x, qd, tail, f_sm, P_head, lag1_h, st, t_star
+        )
+        return (
+            ARSteadyState(
+                new, st.Pp, state.riccati_iters + st.riccati_iters
+            ),
+            ll,
+        )
+
+    step.__name__ = step.__qualname__ = (
+        f"em_step_ar_all_t{t_star}_b{block}_d{n_shards}"
+    )
+    step.__module__ = __name__
+
+    state_spec = ARSteadyState(params=_ar_params_spec(), Pp=P(), riccati_iters=P())
+    tail_spec = QDTailStats(sxx=P("data"), sxx1=P("data"), spp=P("data"))
+    return jax.jit(
+        shard_map(
+            step,
+            mesh=mesh,
+            in_specs=(
+                state_spec, P(None, "data"), _qd_stats_spec(), tail_spec,
+            ),
+            out_specs=((state_spec, P())),
+            check_rep=False,
+        )
+    )
+
+
+# ======================= inert AR-series padding =============================
+
+
+def pad_ar_params(params: SSMARParams, n_pad: int) -> SSMARParams:
+    """Extend an AR parameter set with `n_pad - N` inert series: zero
+    loadings, zero AR roots, unit innovation variances — together with an
+    all-False mask column these contribute exactly zero to every collapse
+    payload column, Gram, rhs, and log-det term (the `pad_ssm_params`
+    twin; inertness argued at `_ar_sharded_step_for`)."""
+    N = params.lam.shape[0]
+    if n_pad <= N:
+        return params
+    dtype = params.lam.dtype
+    extra = n_pad - N
+    return params._replace(
+        lam=jnp.concatenate(
+            [params.lam, jnp.zeros((extra, params.r), dtype)]
+        ),
+        phi=jnp.concatenate([params.phi, jnp.zeros(extra, dtype)]),
+        sigv2=jnp.concatenate([params.sigv2, jnp.ones(extra, dtype)]),
+    )
+
+
+def unpad_ar_params(params: SSMARParams, n_real: int) -> SSMARParams:
+    """Slice an AR parameter set back to the real series."""
+    return params._replace(
+        lam=params.lam[:n_real],
+        phi=params.phi[:n_real],
+        sigv2=params.sigv2[:n_real],
+    )

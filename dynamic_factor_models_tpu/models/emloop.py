@@ -1151,6 +1151,62 @@ def _run_device_guarded(
     )
 
 
+def run_em_stack(
+    stack,
+    params,
+    args: tuple,
+    tol: float,
+    max_em_iter: int,
+    **kwargs,
+):
+    """Run the convergence loop for a transform `Stack` (or an already
+    `Resolved` stack) from BARE parameters: resolve the step, wrap the
+    params into the carry the step iterates (SteadyEMState /
+    ARSteadyState for steady stacks), dispatch the matching loop driver
+    — `run_em_loop_batched` for `batch(B)` stacks, `run_em_loop` with
+    the resolved guard-ladder fallback otherwise — and unwrap the carry
+    in the returned params.
+
+    The estimation entry points (ssm / ssm_ar) keep calling `run_em_loop`
+    directly because they thread plan-derived warm starts and telemetry
+    through the wrap; this driver is the one-call form for callers with
+    no such state (serving/batch.py, tests, benches).  `kwargs` pass
+    through to the underlying driver.
+    """
+    from . import transforms as tfm
+
+    res = stack if isinstance(stack, tfm.Resolved) else tfm.resolve(stack)
+    if res.batch:
+        out = run_em_loop_batched(
+            res.step, params, args, tol, max_em_iter, **kwargs
+        )
+        return out
+    carry = tfm.wrap_params(res, params)
+    if res.fallback_step is not None:
+        kwargs.setdefault("fallback_step", res.fallback_step)
+        if res.carry != "bare":
+            from .emaccel import unwrap_state
+
+            kwargs.setdefault("fallback_unwrap", unwrap_state)
+    if res.guard is not None:
+        kwargs.setdefault("guard", res.guard)
+    out = run_em_loop(res.step, carry, args, tol, max_em_iter, **kwargs)
+    final = out[0]
+    # unwrap by TYPE, not by the requested stack: the recovery ladder's
+    # demote rung may already have peeled the carry
+    if res.carry != "bare" and hasattr(final, "params"):
+        out = EMLoopResult(
+            final.params, out[1], out[2], out[3],
+            converged=out.converged,
+            health=out.health,
+            faults_detected=out.faults_detected,
+            recoveries=out.recoveries,
+            ladder_rung=out.ladder_rung,
+            rungs_used=out.rungs_used,
+        )
+    return out
+
+
 def run_bulk_then_exact(
     bulk_step,
     exact_step,

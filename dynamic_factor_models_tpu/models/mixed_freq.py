@@ -470,7 +470,13 @@ def estimate_mixed_freq_dfm(
             stats = compute_panel_stats(xz, m_arr)._replace(tw=tw)
         else:
             stats = compute_panel_stats(xz, m_arr)
-        step = em_step_mf_stats
+        # the mixed-frequency core is the one-entry stack (no step
+        # transforms are defined for it yet — aggregation rows couple
+        # series across shards); resolving keeps the selection in the one
+        # table models/transforms owns
+        from . import transforms as tfm
+
+        step = tfm.resolve(tfm.Stack("mf")).step
         fallback_step = None
         fallback_unwrap = None
         if accel == "squarem":

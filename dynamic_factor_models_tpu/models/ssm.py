@@ -1695,17 +1695,22 @@ def estimate_dfm_em(
             data, inclcode, initperiod, lastperiod, config, xz, m_arr
         )
 
+        from . import transforms as tfm
         from .emloop import run_em_loop
 
         T0, N0 = xz.shape
         rec.set(shapes={"T": T0, "N": N0, "r": r, "p": config.n_factorlag})
         # recovery-ladder demotion target (emloop guarded path): the exact
         # sequential step the tripped method falls back to, with the loop
-        # state unwrapped to its bare parameter pytree
+        # state unwrapped to its bare parameter pytree.  Steps are chosen
+        # by RESOLVING a transform stack (models/transforms) — resolve
+        # returns the same module-level jitted objects this function used
+        # to name directly, so the dispatched programs (and their AOT
+        # statics keys) are byte-identical to the pre-stack selection.
         fallback_step = None
         fallback_unwrap = None
         if method == "sequential":
-            step = em_step_stats
+            step = tfm.resolve(tfm.Stack("ssm")).step
             if buckets is not None or ns > 1:
                 # pad up to the bucket and/or a shard multiple; even at
                 # exact size the padded program carries tw, so every panel
@@ -1729,10 +1734,10 @@ def estimate_dfm_em(
             else:
                 stats = compute_panel_stats(xz, m_arr)
             if ns > 1:
-                step = _sharded_step_for(ns)
                 # a tripped sharded run demotes to the exact single-device
                 # sequential step: same (xz, mask, stats) args
-                fallback_step = em_step_stats
+                res_t = tfm.resolve(tfm.Stack("ssm", (tfm.shard(ns),)))
+                step, fallback_step = res_t.step, res_t.fallback_step
                 rec.set(mesh_shape=[ns], sharded=True)
             args = (xz, m_arr, stats)
         elif method == "steady":
@@ -1747,7 +1752,10 @@ def estimate_dfm_em(
             else:
                 t_star, st0, rho = plan
                 block = _steady_block_for(T0 - t_star)
-                step = _steady_step_for(t_star, block)
+                res_t = tfm.resolve(
+                    tfm.Stack("ssm", (tfm.steady_tail(t_star, block),))
+                )
+                step = res_t.step
                 params = SteadyEMState(
                     params=params,
                     # warm-start iteration 1 from the init-params solve the
@@ -1759,7 +1767,7 @@ def estimate_dfm_em(
                 # step: same (xz, mask, stats) args, SteadyEMState peeled
                 from .emaccel import unwrap_state
 
-                fallback_step = em_step_stats
+                fallback_step = res_t.fallback_step
                 fallback_unwrap = unwrap_state
                 rec.set(
                     t_star=t_star,
@@ -1768,14 +1776,19 @@ def estimate_dfm_em(
                     steady_block=block,
                 )
         else:
-            step = {
-                "associative": em_step_assoc,
-                "sqrt": em_step_sqrt,
-                "sqrt_collapsed": em_step_sqrt_collapsed,
-            }[method]
+            res_t = tfm.resolve(
+                tfm.Stack(
+                    {
+                        "associative": "ssm.assoc",
+                        "sqrt": "ssm.sqrt",
+                        "sqrt_collapsed": "ssm.sqrt_collapsed",
+                    }[method]
+                )
+            )
+            step = res_t.step
             args = (xz, m_arr)
             # the exact sequential filter on the same (xz, mask) args
-            fallback_step = em_step
+            fallback_step = res_t.fallback_step
         if accel == "squarem":
             from .emaccel import squarem, squarem_state, unwrap_state
 

@@ -306,6 +306,27 @@ def qd_mask_supported(mask) -> bool:
     return bool((starts <= 1).all())
 
 
+def qd_gap_report(mask):
+    """The actionable half of the `qd_mask_supported` gate: which series
+    are outside the collapsed path's exact mask class, and where.
+
+    Returns (bad, first_gap): `bad` is the array of series indices whose
+    observations form more than one contiguous run, `first_gap[j]` the
+    time index of series `bad[j]`'s first interior missing cell (the
+    first gap after its first observation run).  A caller seeing the
+    dense-fallback warning can re-release or interpolate exactly these
+    cells to re-enter the N-free path."""
+    m = np.asarray(mask, bool)
+    starts = (np.diff(m.astype(np.int8), axis=0) == 1).sum(axis=0) + m[0]
+    bad = np.nonzero(starts > 1)[0]
+    first_gap = []
+    for i in bad:
+        col = m[:, i]
+        t0 = int(np.argmax(col))  # first observation
+        first_gap.append(t0 + int(np.nonzero(~col[t0:])[0][0]))
+    return bad, first_gap
+
+
 def _qd_companion(params: SSMARParams):
     """Factor-lag companion at pt = max(p, 2) lags: the quasi-differenced
     observation loads [f_t, f_{t-1}], so even a p = 1 VAR carries one extra
@@ -690,6 +711,8 @@ def estimate_dfm_em_ar(
     checkpoint_every: int = 25,
     accel: str | None = None,
     method: str = "dense",
+    steady: bool = False,
+    n_shards: int | None = None,
 ) -> EMARResults:
     """Full Banbura-Modugno EM: factors + AR(1) idiosyncratic states.
 
@@ -706,7 +729,29 @@ def estimate_dfm_em_ar(
     (`em_step_ar_qd`; exact kappa = 0 model) — the large-N production
     path.  Panels whose series have interior observation gaps are outside
     the collapsed path's exact mask class and fall back to dense with a
-    warning.
+    warning naming the offending series and their first gap positions
+    (telemetry records `collapse_gated`; `qd_gap_report` gives the full
+    list).
+
+    steady=True (collapsed only) additionally splits the time axis at the
+    Riccati convergence horizon — exact head scan, constant-gain tail
+    with closed-form tail moments (`emcore.em_step_ar_steady`) — so a
+    long-history panel pays neither N nor T per iteration.  Host-gated by
+    `emcore.ar_steady_plan` (the tail must be interior and the model
+    fast-mixing); gated-off runs fall back to plain collapsed with
+    telemetry `steady_gated`.
+
+    n_shards > 1 (collapsed only) shards the collapse's pre-scan (T, N)
+    GEMMs over the ``("data",)`` device mesh with one ring all-reduce of
+    the packed payload per iteration (`emcore.em_step_ar_sharded`); the
+    panel is padded with inert series to a shard multiple.  Composes with
+    steady=True (`emcore._ar_steady_sharded_step_for`): all three speed
+    axes — collapsed x steady x sharded — on one panel.
+
+    The step for any combination is resolved from a transform stack
+    (models/transforms), not hand-picked: `Stack("ar", (collapse(),
+    steady_tail(t*), shard(n)))` and its sub-stacks map to the same
+    module-level jitted objects this function always dispatched.
     """
     from ..utils.compile import configure_compilation_cache
 
@@ -717,6 +762,29 @@ def estimate_dfm_em_ar(
         raise ValueError(
             f"method must be 'dense' or 'collapsed', got {method!r}"
         )
+    ns = int(n_shards) if n_shards is not None else 0
+    if steady and method != "collapsed":
+        raise ValueError(
+            "steady=True requires method='collapsed' (the steady tail is "
+            "defined on the quasi-differenced collapse)"
+        )
+    if steady and accel is not None:
+        raise ValueError(
+            "accel is not combinable with steady=True: the steady EM "
+            "carry (ARSteadyState: params + warm-start Pp∞ + solver "
+            "counters) is not an extrapolable parameter vector"
+        )
+    if ns > 1:
+        if method != "collapsed":
+            raise ValueError(
+                "n_shards requires method='collapsed' (only the collapsed "
+                "pre-scan is sharded)"
+            )
+        if ns > jax.device_count():
+            raise ValueError(
+                f"n_shards={ns} exceeds the {jax.device_count()} visible "
+                "devices"
+            )
     from ..utils.telemetry import run_record
 
     with on_backend(backend), run_record(
@@ -724,6 +792,7 @@ def estimate_dfm_em_ar(
         config={
             "accel": accel, "tol": tol, "max_em_iter": max_em_iter,
             "checkpointed": checkpoint_path is not None, "method": method,
+            "steady": steady, "n_shards": ns,
         },
     ) as rec:
         data = jnp.asarray(data)
@@ -746,16 +815,28 @@ def estimate_dfm_em_ar(
             Q=em0.params.Q,
         )
 
+        from . import emcore, transforms as tfm
         from .emloop import run_em_loop
 
         use_collapsed = method == "collapsed"
         if use_collapsed and not qd_mask_supported(np.asarray(m_arr)):
+            bad, gaps = qd_gap_report(np.asarray(m_arr))
+            shown = ", ".join(
+                f"{int(i)} (first gap at t={int(g)})"
+                for i, g in list(zip(bad, gaps))[:8]
+            )
+            more = f", ... and {len(bad) - 8} more" if len(bad) > 8 else ""
             warnings.warn(
-                "estimate_dfm_em_ar(method='collapsed'): panel has interior "
-                "observation gaps (non-contiguous per-series runs) outside "
-                "the quasi-differenced path's exact mask class; falling "
-                "back to method='dense'",
+                f"estimate_dfm_em_ar(method='collapsed'): {len(bad)} series "
+                "have interior observation gaps (non-contiguous per-series "
+                "runs) outside the quasi-differenced path's exact mask "
+                f"class — series {shown}{more}; falling back to "
+                "method='dense' (qd_gap_report(mask) lists every gap)",
                 stacklevel=2,
+            )
+            rec.set(
+                collapse_gated=True,
+                gap_series=[int(i) for i in bad[:32]],
             )
             use_collapsed = False
         T_n, N_n = int(xz.shape[0]), int(xz.shape[1])
@@ -771,36 +852,110 @@ def estimate_dfm_em_ar(
             shapes={"T": T_n, "N": N_n, "r": r_n, "p": p_n},
             n_series=N_n, state_dim=state_dim,
         )
-        base_step = em_step_ar_qd if use_collapsed else em_step_ar
+
+        # build the transform stack for the requested axes; each gate that
+        # fails drops its axis (with telemetry) rather than erroring, so
+        # the call degrades to the strongest supported sub-stack
+        axes: list = []
+        t_star = None
+        st0 = None
         if use_collapsed:
-            qd = compute_qd_stats(xz, m_arr)
-            em_args = (xz, qd)
-        else:
-            em_args = (xz, m_arr)
-        step = base_step
+            axes.append(tfm.collapse())
+            if steady:
+                # host gate on the UNPADDED mask (an all-missing padded
+                # series would push the complete-tail point to T)
+                plan = emcore.ar_steady_plan(params, np.asarray(m_arr))
+                if plan is None:
+                    rec.set(steady_gated=True, steady_frac=0.0)
+                else:
+                    t_star, st0, rho = plan
+                    axes.append(tfm.steady_tail(t_star))
+                    rec.set(
+                        t_star=t_star,
+                        steady_frac=float(T_n - t_star) / float(T_n),
+                        riccati_rho=float(rho),
+                    )
+        elif steady or ns > 1:
+            rec.set(steady_gated=steady, shard_gated=ns > 1)
+
+        xz_em, m_em, params_em = xz, m_arr, params
+        if use_collapsed and ns > 1:
+            axes.append(tfm.shard(ns))
+            from ..parallel.mesh import series_pad
+
+            Npad = series_pad(N_n, ns)
+            if Npad != N_n:
+                # inert series padding: zero loadings, zero data, all-False
+                # mask — zero payload contribution (pinned by
+                # tests/test_transform_stack.py)
+                zcols = jnp.zeros((T_n, Npad - N_n), xz.dtype)
+                xz_em = jnp.concatenate([xz, zcols], axis=1)
+                m_em = jnp.concatenate(
+                    [m_arr, jnp.zeros(zcols.shape, bool)], axis=1
+                )
+                params_em = emcore.pad_ar_params(params, Npad)
+            rec.set(mesh_shape=[ns], sharded=True, n_padded=Npad)
+
+        res_t = tfm.resolve(tfm.Stack("ar", tuple(axes)))
+        base_step = res_t.step
         fallback_step = None
         fallback_unwrap = None
+        fallback_args = None
+        if use_collapsed:
+            qd = compute_qd_stats(xz_em, m_em)
+            em_args = (xz_em, qd)
+            if t_star is not None:
+                em_args = (
+                    xz_em, qd, emcore.compute_qd_tail_stats(qd, t_star)
+                )
+                # warm-start iteration 1 from the init-params solve the
+                # plan already paid for; a tripped steady run demotes to
+                # the plain collapsed step on (x, qd) args
+                params_em = emcore.ARSteadyState(
+                    params=params_em,
+                    Pp=jnp.asarray(st0.Pp, xz.dtype),
+                    riccati_iters=jnp.asarray(0, jnp.int32),
+                )
+                from .emaccel import unwrap_state
+
+                fallback_step = res_t.fallback_step
+                fallback_unwrap = unwrap_state
+                fallback_args = (xz_em, qd)
+            elif ns > 1:
+                # a tripped sharded run demotes to the exact single-device
+                # collapsed step: same (x, qd) args, padding stays inert
+                fallback_step = res_t.fallback_step
+        else:
+            em_args = (xz_em, m_em)
+        step = base_step
         if accel == "squarem":
             from .emaccel import squarem, squarem_state, unwrap_state
 
             step = squarem(base_step, _project_params_ar)
-            params = squarem_state(params)
+            params_em = squarem_state(params_em)
             # recovery-ladder demotion: drop the SQUAREM cycle back to the
             # plain AR EM map on the same args
             fallback_step = base_step
             fallback_unwrap = unwrap_state
+            fallback_args = None
         res = run_em_loop(
-            step, params, em_args, tol, max_em_iter,
+            step, params_em, em_args, tol, max_em_iter,
             collect_path=collect_path,
             trace_name="em_dfm_ar_qd" if use_collapsed else "em_dfm_ar",
             checkpoint_path=checkpoint_path, checkpoint_every=checkpoint_every,
             fallback_step=fallback_step, fallback_unwrap=fallback_unwrap,
+            fallback_args=fallback_args,
         )
         params, llpath, it, trace = res
         from .emaccel import SquaremState
 
         if isinstance(params, SquaremState):  # by type: demote may have peeled
             params = params.params
+        if isinstance(params, emcore.ARSteadyState):
+            rec.set(riccati_iters=int(params.riccati_iters))
+            params = params.params
+        if int(params.lam.shape[0]) != N_n:  # sharded padding
+            params = emcore.unpad_ar_params(params, N_n)
         rec.set(
             n_iter=it,
             converged=res.converged,
@@ -819,6 +974,8 @@ def estimate_dfm_em_ar(
         r, rp = config.nfac_u, config.nfac_u * config.n_factorlag
         if use_collapsed:
             params = _guard_params_qd(params)
+            if int(qd.n_int.shape[0]) != N_n:  # readout at the real width
+                qd = compute_qd_stats(xz, m_arr)
             means, covs, pmeans, pcovs, _ = _filter_ar_qd(params, xz, qd)
             Tmq, _ = _qd_companion(params)
             s_sm, _, _ = _rts_scan(Tmq, means, covs, pmeans, pcovs)

@@ -1,0 +1,479 @@
+"""Composable EM transform stacks: one core step, orthogonal wrappers.
+
+PRs 3-10 grew a cross-product of hand-enumerated EM kernel variants
+(`em_loop_guarded@steady`, `em_loop_batched`, `em_loop_guarded@sharded`,
+`em_step_ar_qd`, ...): every fast axis was its own kernel, every new axis
+multiplied the enumeration in emloop.py and utils/compile.py, and no
+panel could get two wins at once.  This module replaces the enumeration
+with a tiny algebra — the effect-handler idea of NumPyro and BlackJAX's
+kernel-composition API applied to EM: a `Stack` names a CORE step (which
+model's E/M maps run) and an ordered tuple of `Transform`s (how the
+step/carry are wrapped), and `resolve` maps the stack to the LITERAL
+jitted step object plus its calling convention.
+
+Two kinds of transform, two binding sites:
+
+* STEP transforms — `collapse`, `steady_tail`, `shard` — change what one
+  EM iteration computes around unchanged numerics: collapse reduces the
+  (T, N) panel to q-dim sufficient statistics before the scan,
+  steady_tail splits the time axis at the convergence horizon t* (exact
+  head scan, constant-gain tail with closed-form tail moments), shard
+  runs the collapse's pre-scan GEMMs shard-local under shard_map with
+  one ring all-reduce.  `resolve` maps (core, step transforms) to a step.
+* LOOP transforms — `guard`, `batch`, `donate`, `accel` — change how the
+  convergence loop drives any step: the guarded while-loop's sentinel +
+  rollback rungs, the vmapped per-lane carry, carry donation, SQUAREM
+  cycling.  They are step-agnostic by construction (models/emloop.py,
+  models/emaccel.py) and `resolve` records them as loop policy.
+
+Composition ORDER is part of the algebra and not arbitrary (see
+docs/ARCHITECTURE.md):
+
+* guard wraps batch wraps (accel wraps) the step: the health sentinel
+  must see the loop carry each lane actually iterates, so it lives in
+  the loop body OUTSIDE the vmapped step — guarding inside a lane would
+  roll back one lane's params mid-vmap and desynchronize the carry.
+* shard wraps the COLLAPSE'S PRE-SCAN, not the whole step: every
+  collapsed statistic is a sum over series, so the only cross-shard
+  communication an EM iteration needs is one all-reduce of the packed
+  payload; the N-free scan then runs replicated and the per-series
+  M-step stays shard-local.  Sharding outside collapse (whole-step SPMD)
+  would all-reduce O(T k^2) filter state per scan step instead.
+* steady_tail splits INSIDE collapse: the head scan consumes the same
+  per-step collapsed statistics the plain scan would, the tail replaces
+  them with their per-series-constant limit — so steady x shard composes
+  by reducing the split payload exactly like the unsplit one.
+
+`resolve` returns the SAME module-level jitted objects the hand-written
+call sites always dispatched (ssm.em_step_stats, ssm._steady_step_for,
+ssm._sharded_step_for, ssm_ar.em_step_ar_qd, ...), so every stack that
+reproduces a pre-stack variant is HLO byte-identical by construction —
+the PR 1-4/8 byte-identity pins define "no regression" and keep holding.
+The previously-unreachable PRODUCTS resolve to models/emcore.py.
+
+`enumerate_stacks(spec)` derives utils.compile's AOT kernel plan from
+the same table (one entry per reachable stack x loop kind), replacing
+the hand-enumerated plan bodies; tests/test_transform_stack.py pins the
+derived registry against the frozen pre-stack kernel set.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import NamedTuple
+
+__all__ = [
+    "Stack",
+    "Transform",
+    "accel",
+    "batch",
+    "collapse",
+    "donate",
+    "enumerate_stacks",
+    "guard",
+    "PlanEntry",
+    "resolve",
+    "Resolved",
+    "shard",
+    "steady_tail",
+    "unwrap_params",
+    "wrap_params",
+]
+
+_STEP_KINDS = ("collapse", "steady", "shard")
+_LOOP_KINDS = ("guard", "batch", "donate", "accel")
+
+CORES = (
+    "ssm",
+    "ssm.legacy",
+    "ssm.assoc",
+    "ssm.sqrt",
+    "ssm.sqrt_collapsed",
+    "ar",
+    "mf",
+)
+
+
+@dataclass(frozen=True)
+class Transform:
+    """One wrapper in a stack: a kind tag plus its static parameters
+    (hashable, so stacks can key caches and registry entries)."""
+
+    kind: str
+    args: tuple = ()
+
+
+@dataclass(frozen=True)
+class Stack:
+    """A core step name plus the transforms wrapped around it, outermost
+    last: Stack("ar", (collapse(), steady_tail(64), shard(8)))."""
+
+    core: str
+    transforms: tuple = field(default_factory=tuple)
+
+
+def collapse() -> Transform:
+    """Collapse the (T, N) observation panel to q-dim per-step sufficient
+    statistics before the scan (Jungbacker-Koopman for the iid core,
+    quasi-differenced for the AR core): the scan body becomes N-free."""
+    return Transform("collapse")
+
+
+def steady_tail(t_star: int, block: int = 0) -> Transform:
+    """Split the time axis at the static convergence horizon `t_star`:
+    exact scan on [0, t*), constant-gain recursion + closed-form tail
+    moments on [t*, T).  `block` >= 2 selects the blocked (einsum) form
+    of the tail recursions."""
+    return Transform("steady", (int(t_star), int(block)))
+
+
+def shard(n_shards: int) -> Transform:
+    """Run the collapse's pre-scan (T, N) GEMMs shard-local over the
+    ``("data",)`` series mesh, all-reducing the packed payload with the
+    Pallas/psum ring; the N-free scan runs replicated, the per-series
+    M-step shard-local."""
+    return Transform("shard", (int(n_shards),))
+
+
+def batch(B: int) -> Transform:
+    """vmap the step over B same-shape panels inside one device loop,
+    with per-lane convergence scalars and health flags in the carry
+    (models/emloop.run_em_loop_batched)."""
+    return Transform("batch", (int(B),))
+
+
+def guard(on: bool = True) -> Transform:
+    """The numerical-health sentinel + rollback rungs folded into the
+    convergence loop (utils/guards.py via the guarded while-loop)."""
+    return Transform("guard", (bool(on),))
+
+
+def donate() -> Transform:
+    """Donate the loop carry to XLA (input-output buffer aliasing)."""
+    return Transform("donate")
+
+
+def accel(name: str = "squarem") -> Transform:
+    """Wrap the step in an acceleration cycle (models/emaccel.squarem)."""
+    return Transform("accel", (str(name),))
+
+
+class Resolved(NamedTuple):
+    """A stack resolved to its executable pieces.
+
+    step       the literal module-level jitted step object
+    core       the stack's core name
+    arg_kind   step argument convention past the params/carry:
+               "stats" (x, mask, PanelStats), "panel" (x, mask),
+               "ar_panel" (x, mask), "qd" (x, QDStats),
+               "qd_tail" (x, QDStats, QDTailStats)
+    carry      what the loop iterates: "bare" params, "steady"
+               (ssm.SteadyEMState), "ar_steady" (emcore.ARSteadyState)
+    n_shards   data-mesh width (0 = unsharded)
+    t_star     steady split point (None = no steady tail)
+    block      steady tail block size
+    batch      vmapped lane count (0 = scalar loop)
+    guard      loop guard policy (None = env default DFM_GUARDS)
+    donate     carry donation policy (None = env default)
+    accel      acceleration name or None
+    fallback_step  the exact step the guard ladder's demote rung targets
+    """
+
+    step: object
+    core: str
+    arg_kind: str
+    carry: str
+    n_shards: int = 0
+    t_star: int | None = None
+    block: int = 0
+    batch: int = 0
+    guard: bool | None = None
+    donate: bool | None = None
+    accel: str | None = None
+    fallback_step: object = None
+
+
+def _split(stack: Stack):
+    step_t: dict[str, Transform] = {}
+    loop_t: dict[str, Transform] = {}
+    for t in stack.transforms:
+        if t.kind in _STEP_KINDS:
+            dst = step_t
+        elif t.kind in _LOOP_KINDS:
+            dst = loop_t
+        else:
+            raise ValueError(f"unknown transform kind {t.kind!r}")
+        if t.kind in dst:
+            raise ValueError(f"duplicate {t.kind!r} transform in {stack}")
+        dst[t.kind] = t
+    return step_t, loop_t
+
+
+def resolve(stack: Stack) -> Resolved:
+    """Map a stack to its step object + calling convention.
+
+    Imports lazily so this module stays import-cheap; every return value
+    is the module-level jitted object the hand-written call sites used
+    (byte-identical programs), or the emcore composed step for stacks no
+    hand-written variant covered.
+    """
+    if stack.core not in CORES:
+        raise ValueError(
+            f"unknown core {stack.core!r}; expected one of {CORES}"
+        )
+    step_t, loop_t = _split(stack)
+    axes = frozenset(step_t)
+    t_star, block = (
+        step_t["steady"].args if "steady" in step_t else (None, 0)
+    )
+    n_shards = step_t["shard"].args[0] if "shard" in step_t else 0
+    kw = dict(
+        n_shards=n_shards,
+        t_star=t_star,
+        block=block,
+        batch=loop_t["batch"].args[0] if "batch" in loop_t else 0,
+        guard=loop_t["guard"].args[0] if "guard" in loop_t else None,
+        donate=True if "donate" in loop_t else None,
+        accel=loop_t["accel"].args[0] if "accel" in loop_t else None,
+    )
+
+    if stack.core == "ssm":
+        from . import ssm
+
+        # em_step_stats already collapses inside its scan and the steady
+        # and sharded steps collapse by construction, so `collapse` is
+        # implied by `steady`/`shard` and only selects the explicit
+        # payload pipeline (emcore.em_step_collapsed) when alone
+        if axes <= {"collapse"}:
+            if "collapse" in axes:
+                from . import emcore
+
+                return Resolved(
+                    emcore.em_step_collapsed, "ssm", "stats", "bare",
+                    fallback_step=ssm.em_step_stats, **kw,
+                )
+            return Resolved(ssm.em_step_stats, "ssm", "stats", "bare", **kw)
+        if axes <= {"collapse", "steady"}:
+            return Resolved(
+                ssm._steady_step_for(t_star, block), "ssm", "stats",
+                "steady", fallback_step=ssm.em_step_stats, **kw,
+            )
+        if axes <= {"collapse", "shard"}:
+            return Resolved(
+                ssm._sharded_step_for(n_shards), "ssm", "stats", "bare",
+                fallback_step=ssm.em_step_stats, **kw,
+            )
+        raise ValueError(
+            "the iid core has no steady x shard product yet; compose "
+            "steady and shard on the 'ar' core (ROADMAP item 2)"
+        )
+
+    if stack.core in (
+        "ssm.legacy", "ssm.assoc", "ssm.sqrt", "ssm.sqrt_collapsed"
+    ):
+        from . import ssm
+
+        if axes:
+            raise ValueError(
+                f"core {stack.core!r} accepts no step transforms "
+                f"(got {sorted(axes)})"
+            )
+        step = {
+            "ssm.legacy": ssm.em_step,
+            "ssm.assoc": ssm.em_step_assoc,
+            "ssm.sqrt": ssm.em_step_sqrt,
+            "ssm.sqrt_collapsed": ssm.em_step_sqrt_collapsed,
+        }[stack.core]
+        # guard-ladder demotion target: the exact sequential filter on the
+        # same (x, mask) args (the legacy core IS that filter)
+        fb = None if stack.core == "ssm.legacy" else ssm.em_step
+        return Resolved(
+            step, stack.core, "panel", "bare", fallback_step=fb, **kw
+        )
+
+    if stack.core == "ar":
+        from . import ssm_ar
+
+        if not axes:
+            return Resolved(
+                ssm_ar.em_step_ar, "ar", "ar_panel", "bare", **kw
+            )
+        if "collapse" not in axes:
+            raise ValueError(
+                "the dense AR step has no collapsed statistics to split "
+                "or shard; 'steady'/'shard' on the 'ar' core require "
+                "'collapse' first"
+            )
+        from . import emcore
+
+        if axes == {"collapse"}:
+            return Resolved(
+                ssm_ar.em_step_ar_qd, "ar", "qd", "bare",
+                fallback_step=ssm_ar.em_step_ar, **kw,
+            )
+        if axes == {"collapse", "steady"}:
+            return Resolved(
+                emcore._ar_steady_step_for(t_star, block), "ar",
+                "qd_tail", "ar_steady",
+                fallback_step=ssm_ar.em_step_ar_qd, **kw,
+            )
+        if axes == {"collapse", "shard"}:
+            return Resolved(
+                emcore._ar_sharded_step_for(n_shards), "ar", "qd", "bare",
+                fallback_step=ssm_ar.em_step_ar_qd, **kw,
+            )
+        # all three speed axes on one panel
+        return Resolved(
+            emcore._ar_steady_sharded_step_for(t_star, block, n_shards),
+            "ar", "qd_tail", "ar_steady",
+            fallback_step=ssm_ar.em_step_ar_qd, **kw,
+        )
+
+    # stack.core == "mf"
+    from . import mixed_freq
+
+    if axes:
+        raise ValueError(
+            "the mixed-frequency core supports no step transforms yet "
+            "(aggregation rows couple series across shards; ROADMAP "
+            "item 5)"
+        )
+    return Resolved(
+        mixed_freq.em_step_mf_stats, "mf", "stats", "bare", **kw
+    )
+
+
+def wrap_params(res: Resolved, params):
+    """Wrap bare parameters into the carry `res.step` iterates."""
+    import jax.numpy as jnp
+
+    if res.carry == "bare":
+        return params
+    if res.carry == "steady":
+        from .ssm import SteadyEMState
+
+        k = params.r * params.p
+        return SteadyEMState(
+            params=params,
+            Pp=jnp.zeros((k, k), params.lam.dtype),
+            riccati_iters=jnp.asarray(0, jnp.int32),
+        )
+    if res.carry == "ar_steady":
+        from .emcore import ARSteadyState
+
+        k = params.r * max(params.p, 2)
+        return ARSteadyState(
+            params=params,
+            Pp=jnp.zeros((k, k), params.lam.dtype),
+            riccati_iters=jnp.asarray(0, jnp.int32),
+        )
+    raise ValueError(f"unknown carry kind {res.carry!r}")
+
+
+def unwrap_params(res: Resolved, state):
+    """Peel the loop carry back to bare parameters (inverse of
+    `wrap_params` up to the warm-started steady fields)."""
+    return state if res.carry == "bare" else state.params
+
+
+class PlanEntry(NamedTuple):
+    """One derived AOT-plan entry: the registry key utils.compile uses
+    (``@variant`` suffixes distinguish statics under one kernel name),
+    the stack it resolves, and the loop kind wrapped around it (None =
+    register the bare step, "plain"/"guarded"/"batched" = the matching
+    emloop while-loop program)."""
+
+    key: str
+    stack: Stack
+    loop: str | None = None
+
+
+def enumerate_stacks(spec) -> list:
+    """Derive the EM-family AOT kernel plan from a CompileSpec.
+
+    Every entry is a (key, stack, loop) triple; utils.compile._kernel_plan
+    builds avals/statics/warmup inputs generically from the resolved
+    stack, so adding a stack here is ALL it takes to make it
+    precompilable — there is no hand-written plan body per kernel left.
+
+    Keys, gating, and statics reproduce the pre-stack hand enumeration
+    exactly for the historical kernel names (the frozen set
+    tests/test_transform_stack.py pins); the composed emcore kernels are
+    opt-in by name so existing specs compile the same set as before.
+    """
+    ks = spec.kernels
+    st = (
+        (steady_tail(spec.t_star, spec.steady_block),)
+        if spec.t_star is not None
+        else None
+    )
+    sh = (shard(spec.n_shards),) if spec.n_shards > 1 else None
+    entries: list[PlanEntry] = []
+    add = entries.append
+
+    if "em_step_stats" in ks:
+        add(PlanEntry("em_step_stats", Stack("ssm")))
+    for key, core in (
+        ("em_step", "ssm.legacy"),
+        ("em_step_sqrt", "ssm.sqrt"),
+        ("em_step_sqrt_collapsed", "ssm.sqrt_collapsed"),
+    ):
+        if key in ks:
+            add(PlanEntry(key, Stack(core)))
+    if "em_step_collapsed" in ks:
+        add(PlanEntry("em_step_collapsed", Stack("ssm", (collapse(),))))
+    if st is not None:
+        if "em_step_steady" in ks:
+            add(PlanEntry("em_step_steady", Stack("ssm", st)))
+        if "em_loop@steady" in ks:
+            add(PlanEntry("em_loop@steady", Stack("ssm", st), "plain"))
+        if "em_loop_guarded@steady" in ks:
+            add(
+                PlanEntry(
+                    "em_loop_guarded@steady", Stack("ssm", st), "guarded"
+                )
+            )
+    if "em_step_ar" in ks:
+        add(PlanEntry("em_step_ar", Stack("ar")))
+    if "em_step_ar_qd" in ks:
+        add(PlanEntry("em_step_ar_qd", Stack("ar", (collapse(),))))
+    if st is not None and "em_step_ar_steady" in ks:
+        add(
+            PlanEntry(
+                "em_step_ar_steady", Stack("ar", (collapse(),) + st)
+            )
+        )
+    if sh is not None and "em_step_ar_sharded" in ks:
+        add(
+            PlanEntry(
+                "em_step_ar_sharded", Stack("ar", (collapse(),) + sh)
+            )
+        )
+    if st is not None and sh is not None and "em_step_ar_all" in ks:
+        add(
+            PlanEntry(
+                "em_step_ar_all", Stack("ar", (collapse(),) + st + sh)
+            )
+        )
+    if "em_loop" in ks:
+        add(PlanEntry("em_loop", Stack("ssm"), "plain"))
+    if "em_loop_guarded" in ks:
+        add(PlanEntry("em_loop_guarded", Stack("ssm"), "guarded"))
+    if sh is not None:
+        if "em_step_sharded" in ks:
+            add(PlanEntry("em_step_sharded", Stack("ssm", sh)))
+        if "em_loop_guarded@sharded" in ks:
+            add(
+                PlanEntry(
+                    "em_loop_guarded@sharded", Stack("ssm", sh), "guarded"
+                )
+            )
+    if spec.em_batch > 0:
+        add(
+            PlanEntry(
+                "em_loop_batched",
+                Stack("ssm", (batch(spec.em_batch),)),
+                "batched",
+            )
+        )
+    return entries

@@ -111,14 +111,30 @@ def refit_batch(
     come back with ``health=HEALTH_BUCKET_ERROR`` and their warm-start
     params untouched, and the other buckets still run — one poisoned
     bucket must not kill a multi-tenant flush.  Simulated external
-    kills (preemption/crash injections) are never contained."""
+    kills (preemption/crash injections) are never contained.
+
+    With `step=None` (the default) each bucket resolves its own step
+    from the transform stack: a bucket whose padded N crosses
+    ``ssm.LARGE_N_THRESHOLD`` dispatches the collapse-first kernel
+    (`emcore.em_step_collapsed` — the explicit-payload twin of
+    `em_step_stats`, bit-identical per iteration, pinned by
+    tests/test_serving_large_n.py), so wide-bucket refits collapse the
+    (T, N) panel before the vmapped scan instead of carrying it through.
+    An explicit `step=` suppresses the dispatch for every bucket."""
+    from ..models import transforms as _tfm
     from ..utils.faults import SimulatedCrash, SimulatedPreemption
 
     requests = list(requests)
+    auto_step = step is None
     step = step or _ssm.em_step_stats
     out: dict[int, RefitResult] = {}
     order = {id(req): i for i, req in enumerate(requests)}
     for (t_pad, n_pad), group in _group_by_bucket(requests).items():
+        bucket_step = step
+        if auto_step and n_pad > _ssm.LARGE_N_THRESHOLD:
+            bucket_step = _tfm.resolve(
+                _tfm.Stack("ssm", (_tfm.collapse(),))
+            ).step
         try:
             prepped = [_prepare(req, t_pad, n_pad) for req in group]
             params_B = jax.tree.map(lambda *xs: jnp.stack(xs),
@@ -128,7 +144,8 @@ def refit_batch(
             stats_B = jax.tree.map(lambda *xs: jnp.stack(xs),
                                    *[p[3] for p in prepped])
             res = run_em_loop_batched(
-                step, params_B, (x_B, mask_B, stats_B), tol, max_em_iter
+                bucket_step, params_B, (x_B, mask_B, stats_B), tol,
+                max_em_iter,
             )
         except (SimulatedPreemption, SimulatedCrash, KeyboardInterrupt):
             raise

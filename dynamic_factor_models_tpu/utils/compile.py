@@ -430,6 +430,14 @@ class CompileSpec:
     bucket: bool = True
     t_buckets: tuple = DEFAULT_T_BUCKETS
     n_buckets: tuple = DEFAULT_N_BUCKETS
+    # EM-family kernel names are stack ALIASES: each resolves through
+    # models/transforms.enumerate_stacks to a (core, transforms, loop)
+    # triple and the plan is derived from the resolved calling convention
+    # — there is no per-kernel plan body to add.  Composed stacks are
+    # opt-in by name: "em_step_collapsed" (ssm + collapse),
+    # "em_step_ar_steady" (ar + collapse + steady, needs t_star),
+    # "em_step_ar_sharded" (ar + collapse + shard, needs n_shards > 1),
+    # "em_step_ar_all" (all three axes, needs both).
     kernels: tuple = (
         "em_step_stats",
         "em_step",
@@ -547,196 +555,243 @@ def _kernel_plan(spec: CompileSpec):
             bparams, bx, bmask, bstats = _benign_em_inputs(Tb, Nb, r, p, dt)
         return bparams, bx, bmask, bstats
 
-    if "em_step_stats" in spec.kernels:
-        plans["em_step_stats"] = (
-            ssm.em_step_stats,
-            (params_s, x_s, mask_s, stats_s),
-            {},
-            (),
-            lambda: em_inputs(),
+    # ------------------------------------------------------------------
+    # EM family: DERIVED from the transform-stack table instead of one
+    # hand-written plan body per kernel.  models/transforms.enumerate_stacks
+    # yields (key, stack, loop) triples reproducing the historical keys,
+    # gating, and statics exactly (tests/test_transform_stack.py pins the
+    # derived registry against the frozen pre-stack kernel set); the code
+    # below builds avals and warmup inputs generically from the resolved
+    # calling convention, so a NEW stack precompiles with no new plan body.
+    # ------------------------------------------------------------------
+    from ..models import emloop
+    from ..models import transforms as tfm
+
+    ld = jnp.result_type(float)
+    _benign_cache = {}
+
+    def em_inputs_at(N):
+        if N == Nb:
+            return em_inputs()
+        if N not in _benign_cache:
+            _benign_cache[N] = _benign_em_inputs(Tb, N, r, p, dt)
+        return _benign_cache[N]
+
+    def _ssm_avals(N):
+        pa = SSMParams(
+            _sds((N, r), dt), _sds((N,), dt), _sds((p, r, r), dt),
+            _sds((r, r), dt),
         )
-    for name in ("em_step", "em_step_sqrt", "em_step_sqrt_collapsed"):
-        if name in spec.kernels:
-            plans[name] = (
-                getattr(ssm, name),
-                (params_s, x_s, mask_s),
-                {},
-                (),
-                lambda: em_inputs()[:3],
-            )
+        st = PanelStats(
+            m=_sds((Tb, N), dt),
+            xT=_sds((N, Tb), dt),
+            mT=_sds((N, Tb), dt),
+            Sxx=_sds((N,), dt),
+            n_i=_sds((N,), dt),
+            n_obs=_sds((Tb,), dt),
+            tw=_sds((Tb,), dt),
+        )
+        return pa, _sds((Tb, N), dt), _sds((Tb, N), jnp.bool_), st
 
-    if spec.t_star is not None and (
-        "em_step_steady" in spec.kernels
-        or "em_loop@steady" in spec.kernels
-        or "em_loop_guarded@steady" in spec.kernels
-    ):
-        # the steady EM step is a per-(t_star, block) jitted function
-        # (ssm._steady_step_for names it em_step_steady_t{t}_b{b}, so the
-        # aot_statics rendering of the step is stable across processes)
-        steady_step = ssm._steady_step_for(spec.t_star, spec.steady_block)
-        k = r * p
-        scarry_params_s = ssm.SteadyEMState(
-            params_s, _sds((k, k), dt), _sds((), jnp.int32)
+    def _ar_avals(N):
+        from ..models import ssm_ar
+
+        arp = ssm_ar.SSMARParams(
+            _sds((N, r), dt), _sds((N,), dt), _sds((N,), dt),
+            _sds((p, r, r), dt), _sds((r, r), dt),
+        )
+        qd = ssm_ar.QDStats(
+            m=_sds((Tb, N), dt),
+            first=_sds((Tb, N), dt),
+            interior=_sds((Tb, N), dt),
+            x_prev=_sds((Tb, N), dt),
+            mT=_sds((N, Tb), dt),
+            firstT=_sds((N, Tb), dt),
+            interiorT=_sds((N, Tb), dt),
+            xT=_sds((N, Tb), dt),
+            x_prevT=_sds((N, Tb), dt),
+            n_int=_sds((N,), dt),
+            n_obs=_sds((Tb,), dt),
+        )
+        return arp, _sds((Tb, N), dt), _sds((Tb, N), jnp.bool_), qd
+
+    def _ar_concrete(N):
+        from ..models import ssm_ar
+
+        pa, x, mask, _ = em_inputs_at(N)
+        arp = ssm_ar.SSMARParams(
+            pa.lam, jnp.zeros(N, dt), jnp.ones(N, dt) * 0.5, pa.A, pa.Q
+        )
+        return arp, x, mask
+
+    def _step_plan(res):
+        """(carry aval, step-arg avals past the carry, mk inputs with the
+        carry first) for one resolved stack."""
+        N = Nb
+        if res.n_shards > 1:
+            from ..parallel.mesh import series_pad
+
+            N = series_pad(Nb, res.n_shards)
+        if res.arg_kind in ("stats", "panel"):
+            pa_s, xa_s, ma_s, st_s = _ssm_avals(N)
+            if res.arg_kind == "panel":
+                return pa_s, (xa_s, ma_s), lambda: em_inputs_at(N)[:3]
+            if res.carry == "steady":
+                k = r * p
+                carry_s = ssm.SteadyEMState(
+                    pa_s, _sds((k, k), dt), _sds((), jnp.int32)
+                )
+
+                def mk_steady():
+                    pa, x, mask, stats = em_inputs_at(N)
+                    st = ssm.SteadyEMState(
+                        pa, jnp.zeros((k, k), dt), jnp.asarray(0, jnp.int32)
+                    )
+                    return st, x, mask, stats
+
+                return carry_s, (xa_s, ma_s, st_s), mk_steady
+            return pa_s, (xa_s, ma_s, st_s), lambda: em_inputs_at(N)
+        arp_s, xa_s, ma_s, qd_s = _ar_avals(N)
+        if res.arg_kind == "ar_panel":
+            return arp_s, (xa_s, ma_s), lambda: _ar_concrete(N)
+        if res.arg_kind == "qd":
+
+            def mk_qd():
+                from ..models import ssm_ar
+
+                arp, x, mask = _ar_concrete(N)
+                return arp, x, ssm_ar.compute_qd_stats(x, mask)
+
+            return arp_s, (xa_s, qd_s), mk_qd
+        # "qd_tail": steady AR carry + loop-invariant tail data moments
+        from ..models import emcore
+
+        k2 = r * max(p, 2)
+        carry_s = emcore.ARSteadyState(
+            arp_s, _sds((k2, k2), dt), _sds((), jnp.int32)
+        )
+        tail_s = emcore.QDTailStats(
+            _sds((N,), dt), _sds((N,), dt), _sds((N,), dt)
         )
 
-        def steady_inputs():
-            pa, x, mask, stats = em_inputs()
-            st = ssm.SteadyEMState(
-                pa, jnp.zeros((k, k), dt), jnp.asarray(0, jnp.int32)
+        def mk_qd_tail():
+            from ..models import ssm_ar
+
+            arp, x, mask = _ar_concrete(N)
+            qd = ssm_ar.compute_qd_stats(x, mask)
+            st = emcore.ARSteadyState(
+                arp, jnp.zeros((k2, k2), dt), jnp.asarray(0, jnp.int32)
             )
-            return st, x, mask, stats
+            return st, x, qd, emcore.compute_qd_tail_stats(qd, res.t_star)
 
-        if "em_step_steady" in spec.kernels:
-            plans["em_step_steady"] = (
-                steady_step,
-                (scarry_params_s, x_s, mask_s, stats_s),
-                {},
-                (),
-                steady_inputs,
+        return carry_s, (xa_s, qd_s, tail_s), mk_qd_tail
+
+    for pe in tfm.enumerate_stacks(spec):
+        res = tfm.resolve(pe.stack)
+        carry_s, args_s, mk_step = _step_plan(res)
+        if pe.loop is None:
+            plans[pe.key] = (
+                res.step, (carry_s,) + args_s, {}, (), mk_step
             )
+            continue
+        tol_c = jnp.asarray(1e-6, ld)
 
-        if "em_loop@steady" in spec.kernels:
-            # the on-device EM while-loop specialized to the steady step:
-            # registered under the "em_loop" name (the `@steady` suffix is
-            # stripped by `precompile`), distinguished from the sequential
-            # loop by the statics key run_em_loop reproduces at dispatch
-            from ..models import emloop
-
-            ld = jnp.result_type(float)
-            scarry_s = (
-                scarry_params_s,
-                _sds((), ld),
-                _sds((), ld),
-                _sds((), jnp.int32),
+        if pe.loop == "plain":
+            donate = donation_enabled()
+            lcarry_s = (
+                carry_s, _sds((), ld), _sds((), ld), _sds((), jnp.int32),
                 _sds((spec.max_em_iter,), ld),
             )
 
-            def steady_loop_inputs():
-                st, x, mask, stats = steady_inputs()
-                carry = emloop._fresh_carry(
-                    st, jnp.asarray(1e-6, ld), spec.max_em_iter
-                )
-                return (
-                    carry,
-                    (x, mask, stats),
-                    jnp.asarray(1e-6, ld),
-                    jnp.asarray(2, jnp.int32),
-                )
+            def mk_plain(mk_step=mk_step, tol_c=tol_c):
+                first, *rest = mk_step()
+                carry = emloop._fresh_carry(first, tol_c, spec.max_em_iter)
+                # stop_at=2: the traced bound keeps the warmup to two
+                # iterations of the SAME executable a full run uses
+                return (carry, tuple(rest), tol_c, jnp.asarray(2, jnp.int32))
 
-            sdonate = donation_enabled()
-            plans["em_loop@steady"] = (
-                emloop._em_while_jit(sdonate),
-                (steady_step, scarry_s, (x_s, mask_s, stats_s), _sds((), ld),
-                 spec.max_em_iter, _sds((), jnp.int32)),
+            plans[pe.key] = (
+                emloop._em_while_jit(donate),
+                (res.step, lcarry_s, args_s, _sds((), ld), spec.max_em_iter,
+                 _sds((), jnp.int32)),
                 {},
-                aot_statics(steady_step, spec.max_em_iter, sdonate, 0),
-                steady_loop_inputs,
+                # must mirror run_em_loop's dispatch key exactly: (step,
+                # max_em_iter, donate, heartbeat_every) — precompiled loops
+                # are heartbeat-free, so a DFM_HEARTBEAT run recompiles live
+                aot_statics(res.step, spec.max_em_iter, donate, 0),
+                mk_plain,
             )
-
-        if "em_loop_guarded@steady" in spec.kernels:
-            # guarded while-loop specialized to the steady step — same
-            # registry name "em_loop_guarded", distinguished by statics,
-            # so a guards-on method="steady" run AOT-hits like the
-            # unguarded steady loop does
-            from ..models import emloop
-
-            ld = jnp.result_type(float)
-            sgcarry_s = (
-                scarry_params_s,
-                scarry_params_s,
-                _sds((), ld),
-                _sds((), ld),
-                _sds((), jnp.int32),
-                _sds((spec.max_em_iter,), ld),
+        elif pe.loop == "guarded":
+            donate = donation_enabled()
+            gcarry_s = (
+                carry_s, carry_s, _sds((), ld), _sds((), ld),
+                _sds((), jnp.int32), _sds((spec.max_em_iter,), ld),
                 _sds((), jnp.int32),  # health
                 _sds((), jnp.int32),  # rung
                 _sds((), jnp.int32),  # trips
                 _sds((), jnp.int32),  # resume_from
             )
 
-            def steady_guarded_loop_inputs():
-                st, x, mask, stats = steady_inputs()
+            def mk_guarded(mk_step=mk_step, tol_c=tol_c):
+                first, *rest = mk_step()
                 carry = emloop._fresh_guarded_carry(
-                    st, jnp.asarray(1e-6, ld), spec.max_em_iter
+                    first, tol_c, spec.max_em_iter
                 )
                 return (
-                    carry,
-                    (x, mask, stats),
-                    jnp.asarray(1e-6, ld),
-                    jnp.asarray(1e-3, ld),
+                    carry, tuple(rest), tol_c, jnp.asarray(1e-3, ld),
                     jnp.asarray(2, jnp.int32),
                 )
 
-            sgdonate = donation_enabled()
-            plans["em_loop_guarded@steady"] = (
-                emloop._em_while_guarded_jit(sgdonate),
-                (steady_step, sgcarry_s, (x_s, mask_s, stats_s), _sds((), ld),
-                 _sds((), ld), spec.max_em_iter, _sds((), jnp.int32)),
+            plans[pe.key] = (
+                emloop._em_while_guarded_jit(donate),
+                (res.step, gcarry_s, args_s, _sds((), ld), _sds((), ld),
+                 spec.max_em_iter, _sds((), jnp.int32)),
                 {},
-                aot_statics(steady_step, spec.max_em_iter, sgdonate, 0, 0, 0),
-                steady_guarded_loop_inputs,
+                # mirrors the guarded dispatch key: (step, max_em_iter,
+                # donate, heartbeat_every, inject_nan_at, inject_chol_at) —
+                # precompiled loops are heartbeat- and injection-free; a
+                # DFM_FAULTS run compiles its injected program live
+                aot_statics(res.step, spec.max_em_iter, donate, 0, 0, 0),
+                mk_guarded,
             )
+        else:  # "batched"
+            B = res.batch
 
-    if "em_step_ar" in spec.kernels:
-        from ..models import ssm_ar
+            def _bsds(s, B=B):
+                return _sds((B,) + tuple(s.shape), s.dtype)
 
-        arparams_s = ssm_ar.SSMARParams(
-            _sds((Nb, r), dt),
-            _sds((Nb,), dt),
-            _sds((Nb,), dt),
-            _sds((p, r, r), dt),
-            _sds((r, r), dt),
-        )
-
-        def ar_inputs():
-            pa, x, mask, _ = em_inputs()
-            arp = ssm_ar.SSMARParams(
-                pa.lam, jnp.zeros(Nb, dt), jnp.ones(Nb, dt) * 0.5, pa.A, pa.Q
+            bcarry_first = jax.tree.map(_bsds, carry_s)
+            bcarry_s = (
+                bcarry_first, bcarry_first, _sds((B,), ld), _sds((B,), ld),
+                _sds((B,), jnp.int32), _sds((B, spec.max_em_iter), ld),
+                _sds((B,), jnp.int32),
             )
-            return arp, x, mask
+            bargs_s = jax.tree.map(_bsds, args_s)
 
-        plans["em_step_ar"] = (
-            ssm_ar.em_step_ar, (arparams_s, x_s, mask_s), {}, (), ar_inputs
-        )
+            def mk_batched(mk_step=mk_step, tol_c=tol_c, B=B):
+                first, *rest = mk_step()
+                stk = lambda t: jax.tree.map(  # noqa: E731
+                    lambda a: jnp.broadcast_to(a, (B,) + a.shape), t
+                )
+                carry = emloop._fresh_batched_carry(
+                    stk(first), tol_c, spec.max_em_iter, B
+                )
+                return (
+                    carry, stk(tuple(rest)), tol_c,
+                    jnp.asarray(1e-3, ld), jnp.asarray(2, jnp.int32),
+                )
 
-    if "em_step_ar_qd" in spec.kernels:
-        from ..models import ssm_ar
-
-        qdarparams_s = ssm_ar.SSMARParams(
-            _sds((Nb, r), dt),
-            _sds((Nb,), dt),
-            _sds((Nb,), dt),
-            _sds((p, r, r), dt),
-            _sds((r, r), dt),
-        )
-        qd_s = ssm_ar.QDStats(
-            m=_sds((Tb, Nb), dt),
-            first=_sds((Tb, Nb), dt),
-            interior=_sds((Tb, Nb), dt),
-            x_prev=_sds((Tb, Nb), dt),
-            mT=_sds((Nb, Tb), dt),
-            firstT=_sds((Nb, Tb), dt),
-            interiorT=_sds((Nb, Tb), dt),
-            xT=_sds((Nb, Tb), dt),
-            x_prevT=_sds((Nb, Tb), dt),
-            n_int=_sds((Nb,), dt),
-            n_obs=_sds((Tb,), dt),
-        )
-
-        def ar_qd_inputs():
-            pa, x, mask, _ = em_inputs()
-            arp = ssm_ar.SSMARParams(
-                pa.lam, jnp.zeros(Nb, dt), jnp.ones(Nb, dt) * 0.5, pa.A, pa.Q
+            plans[pe.key] = (
+                emloop._em_while_batched,
+                (res.step, bcarry_s, bargs_s, _sds((), ld), _sds((), ld),
+                 spec.max_em_iter, _sds((), jnp.int32)),
+                {},
+                # mirrors run_em_loop_batched's dispatch key: (step,
+                # max_em_iter, inject_nan_at) — precompiled loops are
+                # injection-free; a DFM_FAULTS run compiles live
+                aot_statics(res.step, spec.max_em_iter, 0),
+                mk_batched,
             )
-            return arp, x, ssm_ar.compute_qd_stats(x, mask)
-
-        plans["em_step_ar_qd"] = (
-            ssm_ar.em_step_ar_qd,
-            (qdarparams_s, x_s, qd_s),
-            {},
-            (),
-            ar_qd_inputs,
-        )
 
     if "als_core" in spec.kernels:
         from ..models import dfm
@@ -786,172 +841,6 @@ def _kernel_plan(spec: CompileSpec):
             boot_inputs,
         )
 
-    if "em_loop" in spec.kernels:
-        from ..models import emloop
-
-        ld = jnp.result_type(float)
-        carry_s = (
-            params_s,
-            _sds((), ld),
-            _sds((), ld),
-            _sds((), jnp.int32),
-            _sds((spec.max_em_iter,), ld),
-        )
-        args_s = (x_s, mask_s, stats_s)
-
-        def loop_inputs():
-            pa, x, mask, stats = em_inputs()
-            carry = emloop._fresh_carry(
-                pa, jnp.asarray(1e-6, ld), spec.max_em_iter
-            )
-            # stop_at=2: the traced bound keeps the warmup to two
-            # iterations of the SAME executable a full run uses
-            return (
-                carry,
-                (x, mask, stats),
-                jnp.asarray(1e-6, ld),
-                jnp.asarray(2, jnp.int32),
-            )
-
-        donate = donation_enabled()
-        plans["em_loop"] = (
-            emloop._em_while_jit(donate),
-            (ssm.em_step_stats, carry_s, args_s, _sds((), ld), spec.max_em_iter,
-             _sds((), jnp.int32)),
-            {},
-            # must mirror run_em_loop's dispatch key exactly: (step,
-            # max_em_iter, donate, heartbeat_every) — precompiled loops
-            # are heartbeat-free, so a DFM_HEARTBEAT run recompiles live
-            aot_statics(ssm.em_step_stats, spec.max_em_iter, donate, 0),
-            loop_inputs,
-        )
-
-    if "em_loop_guarded" in spec.kernels:
-        from ..models import emloop
-
-        ld = jnp.result_type(float)
-        # guarded carry: (params, prev_params, ll_prev, ll, it, path,
-        # health, rung, trips, resume_from)
-        gcarry_s = (
-            params_s,
-            params_s,
-            _sds((), ld),
-            _sds((), ld),
-            _sds((), jnp.int32),
-            _sds((spec.max_em_iter,), ld),
-            _sds((), jnp.int32),  # health
-            _sds((), jnp.int32),  # rung
-            _sds((), jnp.int32),  # trips
-            _sds((), jnp.int32),  # resume_from
-        )
-        gargs_s = (x_s, mask_s, stats_s)
-
-        def guarded_loop_inputs():
-            pa, x, mask, stats = em_inputs()
-            carry = emloop._fresh_guarded_carry(
-                pa, jnp.asarray(1e-6, ld), spec.max_em_iter
-            )
-            return (
-                carry,
-                (x, mask, stats),
-                jnp.asarray(1e-6, ld),
-                jnp.asarray(1e-3, ld),
-                jnp.asarray(2, jnp.int32),
-            )
-
-        gdonate = donation_enabled()
-        plans["em_loop_guarded"] = (
-            emloop._em_while_guarded_jit(gdonate),
-            (ssm.em_step_stats, gcarry_s, gargs_s, _sds((), ld), _sds((), ld),
-             spec.max_em_iter, _sds((), jnp.int32)),
-            {},
-            # mirrors the guarded dispatch key: (step, max_em_iter, donate,
-            # heartbeat_every, inject_nan_at, inject_chol_at) — precompiled
-            # loops are heartbeat- and injection-free; a DFM_FAULTS run
-            # compiles its injected program live
-            aot_statics(ssm.em_step_stats, spec.max_em_iter, gdonate, 0, 0, 0),
-            guarded_loop_inputs,
-        )
-
-    if spec.n_shards > 1 and (
-        "em_step_sharded" in spec.kernels
-        or "em_loop_guarded@sharded" in spec.kernels
-    ):
-        # cross-section-sharded EM: the shard_map'd step plus the guarded
-        # loop specialized to it, lowered at the shard-padded N so the
-        # executables match what estimate_dfm_em(n_shards=) dispatches
-        from ..models import emloop
-        from ..parallel.mesh import series_pad
-
-        Nsh = series_pad(Nb, spec.n_shards)
-        sh_step = ssm._sharded_step_for(spec.n_shards)
-        shparams_s = SSMParams(
-            _sds((Nsh, r), dt), _sds((Nsh,), dt), _sds((p, r, r), dt),
-            _sds((r, r), dt),
-        )
-        shx_s = _sds((Tb, Nsh), dt)
-        shmask_s = _sds((Tb, Nsh), jnp.bool_)
-        shstats_s = PanelStats(
-            m=_sds((Tb, Nsh), dt),
-            xT=_sds((Nsh, Tb), dt),
-            mT=_sds((Nsh, Tb), dt),
-            Sxx=_sds((Nsh,), dt),
-            n_i=_sds((Nsh,), dt),
-            n_obs=_sds((Tb,), dt),
-            tw=_sds((Tb,), dt),
-        )
-
-        def sharded_inputs():
-            return _benign_em_inputs(Tb, Nsh, r, p, dt)
-
-        if "em_step_sharded" in spec.kernels:
-            plans["em_step_sharded"] = (
-                sh_step,
-                (shparams_s, shx_s, shmask_s, shstats_s),
-                {},
-                (),
-                sharded_inputs,
-            )
-
-        ld = jnp.result_type(float)
-        shcarry_s = (
-            shparams_s,
-            shparams_s,
-            _sds((), ld),
-            _sds((), ld),
-            _sds((), jnp.int32),
-            _sds((spec.max_em_iter,), ld),
-            _sds((), jnp.int32),
-            _sds((), jnp.int32),
-            _sds((), jnp.int32),
-            _sds((), jnp.int32),
-        )
-
-        def sharded_guarded_loop_inputs():
-            pa, x, mask, stats = sharded_inputs()
-            carry = emloop._fresh_guarded_carry(
-                pa, jnp.asarray(1e-6, ld), spec.max_em_iter
-            )
-            return (
-                carry,
-                (x, mask, stats),
-                jnp.asarray(1e-6, ld),
-                jnp.asarray(1e-3, ld),
-                jnp.asarray(2, jnp.int32),
-            )
-
-        shdonate = donation_enabled()
-        if "em_loop_guarded@sharded" in spec.kernels:
-            plans["em_loop_guarded@sharded"] = (
-                emloop._em_while_guarded_jit(shdonate),
-                (sh_step, shcarry_s, (shx_s, shmask_s, shstats_s),
-                 _sds((), ld), _sds((), ld), spec.max_em_iter,
-                 _sds((), jnp.int32)),
-                {},
-                aot_statics(sh_step, spec.max_em_iter, shdonate, 0, 0, 0),
-                sharded_guarded_loop_inputs,
-            )
-
     if spec.serving_period > 0:
         # lazy import: serving.online imports this module for aot_call
         from ..serving import online
@@ -993,59 +882,6 @@ def _kernel_plan(spec: CompileSpec):
             {},
             (),
             tick_inputs,
-        )
-
-    if spec.em_batch > 0:
-        from ..models import emloop
-
-        B = spec.em_batch
-        ld = jnp.result_type(float)
-
-        def _bsds(s):
-            return _sds((B,) + tuple(s.shape), s.dtype)
-
-        bparams_s = jax.tree.map(_bsds, params_s)
-        bcarry_s = (
-            bparams_s,
-            bparams_s,
-            _sds((B,), ld),
-            _sds((B,), ld),
-            _sds((B,), jnp.int32),
-            _sds((B, spec.max_em_iter), ld),
-            _sds((B,), jnp.int32),
-        )
-        bargs_s = (
-            jax.tree.map(_bsds, x_s),
-            jax.tree.map(_bsds, mask_s),
-            jax.tree.map(_bsds, stats_s),
-        )
-
-        def batched_loop_inputs():
-            pa, x, mask, stats = em_inputs()
-            stk = lambda t: jax.tree.map(  # noqa: E731
-                lambda a: jnp.broadcast_to(a, (B,) + a.shape), t
-            )
-            carry = emloop._fresh_batched_carry(
-                stk(pa), jnp.asarray(1e-6, ld), spec.max_em_iter, B
-            )
-            return (
-                carry,
-                (stk(x), stk(mask), stk(stats)),
-                jnp.asarray(1e-6, ld),
-                jnp.asarray(1e-3, ld),
-                jnp.asarray(2, jnp.int32),
-            )
-
-        plans["em_loop_batched"] = (
-            emloop._em_while_batched,
-            (ssm.em_step_stats, bcarry_s, bargs_s, _sds((), ld), _sds((), ld),
-             spec.max_em_iter, _sds((), jnp.int32)),
-            {},
-            # mirrors run_em_loop_batched's dispatch key: (step,
-            # max_em_iter, inject_nan_at) — precompiled loops are
-            # injection-free; a DFM_FAULTS run compiles live
-            aot_statics(ssm.em_step_stats, spec.max_em_iter, 0),
-            batched_loop_inputs,
         )
 
     if spec.scenario_draws > 0:

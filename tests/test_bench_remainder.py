@@ -48,17 +48,21 @@ def bench(tmp_path, monkeypatch):
         lambda: calls.append("refscale") or {"em_refscale_best_ips": 180.0},
     )
 
-    class _FakeMultichipChild:
-        stdout = '{"n_devices": 8, "tpu_unreachable": false}'
+    class _FakeChild:
         stderr = ""
         returncode = 0
 
-    monkeypatch.setattr(
-        b, "_run_child",
-        lambda args, env_extra=None, timeout_s=3600: (
-            calls.append("multichip") or _FakeMultichipChild()
-        ),
-    )
+        def __init__(self, stdout):
+            self.stdout = stdout
+
+    def _fake_run_child(args, env_extra=None, timeout_s=3600):
+        if "--run-composed" in args:
+            calls.append("composed")
+            return _FakeChild('{"composed": true, "smoke": true}')
+        calls.append("multichip")
+        return _FakeChild('{"n_devices": 8, "tpu_unreachable": false}')
+
+    monkeypatch.setattr(b, "_run_child", _fake_run_child)
 
     class _FakeDS:
         pass
@@ -73,13 +77,15 @@ def bench(tmp_path, monkeypatch):
 def test_remainder_section_order_and_stores(bench, tmp_path, capsys):
     bench.run_tpu_remainder()
     assert bench._test_calls == [
-        "pallas", "parity", "large", "refscale", "multichip", "crossover"
+        "pallas", "parity", "large", "refscale", "multichip", "composed",
+        "crossover"
     ]
     out = capsys.readouterr().out.strip().splitlines()[-1]
     final = json.loads(out)
     assert final["parity_ok"] is True
     assert final["pallas_gram_speedup_large_panel"] == 1.5
     assert final["multichip"]["n_devices"] == 8
+    assert final["composed_smoke"]["smoke"] is True
     assert "crossover_markdown" in final
     # per-section persistence: the partial file holds the full accumulation
     partial = json.loads((tmp_path / "partial.json").read_text())

@@ -449,3 +449,43 @@ def test_collapsed_ar_scan_body_hlo_is_n_free():
         ),
         "simulation_smoother_collapsed",
     )
+
+
+def test_derived_kernel_registry_size_is_pinned():
+    """Kernel-count regression guard for the derived AOT plan: the
+    transform stack must neither leak orphan registry entries (a stack
+    enumerated twice, or an alias nobody dispatches) nor silently drop a
+    kernel a call site still asks for.  Counts are exact, not bounds —
+    adding a kernel on purpose means updating this pin in the same PR."""
+    import numpy as _np
+
+    from dynamic_factor_models_tpu.models import transforms as tfm
+    from dynamic_factor_models_tpu.utils import compile as cc
+
+    # default spec: the 8 EM-family aliases live at t_star=None plus the
+    # two non-EM cores
+    spec = cc.CompileSpec(T=60, N=12, r=2, p=1,
+                          dtype=str(_np.dtype(float)), max_em_iter=4)
+    assert len(tfm.enumerate_stacks(spec)) == 8
+    assert len(cc._kernel_plan(spec)) == 10
+
+    # maximal spec: every historical kernel (steady + sharded + batched)
+    full = cc.CompileSpec(
+        T=60, N=12, r=2, p=1, dtype=str(_np.dtype(float)), max_em_iter=4,
+        t_star=16, n_shards=2, em_batch=2,
+        kernels=cc.CompileSpec.kernels
+        + ("em_step_sharded", "em_loop_guarded@sharded"),
+    )
+    assert len(tfm.enumerate_stacks(full)) == 14
+    assert len(cc._kernel_plan(full)) == 16
+
+    # the four composed opt-ins add exactly four entries, nothing else
+    composed = full.kernels + (
+        "em_step_collapsed", "em_step_ar_steady",
+        "em_step_ar_sharded", "em_step_ar_all",
+    )
+    full_c = cc.CompileSpec(
+        T=60, N=12, r=2, p=1, dtype=str(_np.dtype(float)), max_em_iter=4,
+        t_star=16, n_shards=2, em_batch=2, kernels=composed,
+    )
+    assert len(cc._kernel_plan(full_c)) == 20

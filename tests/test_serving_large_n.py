@@ -1,0 +1,75 @@
+"""Wide-bucket serving refits dispatch the collapse-first kernel.
+
+`serving.batch.refit_batch` with the default `step=None` resolves each
+bucket's step from the transform stack: a bucket whose padded N crosses
+`ssm.LARGE_N_THRESHOLD` runs `emcore.em_step_collapsed` instead of
+`em_step_stats`.  Pinned claims:
+
+1. the auto-dispatched wide bucket matches the forced dense-step run at
+   1e-10 (params, loglik, iteration counts) — the collapse changes the
+   schedule, not the numbers;
+2. an explicit `step=` suppresses the dispatch (the two forced runs are
+   bit-identical), so callers pinning a step keep exactly that step;
+3. narrow buckets are unaffected: below the threshold the default path
+   still dispatches `em_step_stats`.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from dynamic_factor_models_tpu.models import ssm as _ssm
+from dynamic_factor_models_tpu.serving.batch import (
+    RefitRequest,
+    refit_batch,
+)
+from dynamic_factor_models_tpu.utils.compile import bucket_shape
+
+pytestmark = [pytest.mark.serving, pytest.mark.large_n]
+
+
+def _params(rng, N, r=2, a=0.5):
+    lam = jnp.asarray(rng.standard_normal((N, r)))
+    A = jnp.zeros((1, r, r)).at[0].set(a * jnp.eye(r))
+    return _ssm.SSMParams(lam, jnp.ones(N), A, jnp.eye(r))
+
+
+def _request(rng, tid, T, N, r=2):
+    params = _params(rng, N, r)
+    f = rng.standard_normal((T, r)) * 0.5
+    x = f @ np.asarray(params.lam).T + 0.5 * rng.standard_normal((T, N))
+    mask = np.ones((T, N), bool)
+    mask[: rng.integers(1, 4), 0] = False
+    return RefitRequest(tid, jnp.asarray(x), jnp.asarray(mask), params)
+
+
+def test_wide_bucket_crosses_threshold():
+    # the fixture regime: raw N=520 pads past LARGE_N_THRESHOLD=512
+    assert _ssm.LARGE_N_THRESHOLD == 512
+    assert bucket_shape(60, 520)[1] > _ssm.LARGE_N_THRESHOLD
+
+
+def test_wide_bucket_auto_dispatch_matches_forced_dense():
+    rng = np.random.default_rng(7)
+    reqs = [_request(rng, f"t{i}", T=60, N=520) for i in range(2)]
+    auto = refit_batch(reqs, max_em_iter=5)
+    forced = refit_batch(reqs, max_em_iter=5, step=_ssm.em_step_stats)
+    for a, f in zip(auto, forced):
+        assert a.tenant_id == f.tenant_id
+        assert a.n_iter == f.n_iter and a.converged == f.converged
+        assert a.health == f.health == 0
+        assert abs(a.loglik - f.loglik) <= 1e-10 * (1 + abs(f.loglik))
+        for pa, pf in zip(a.params, f.params):
+            np.testing.assert_allclose(pa, pf, atol=1e-10)
+
+
+def test_narrow_bucket_unchanged_by_auto_dispatch():
+    rng = np.random.default_rng(8)
+    reqs = [_request(rng, f"s{i}", T=40, N=12) for i in range(2)]
+    assert bucket_shape(40, 12)[1] <= _ssm.LARGE_N_THRESHOLD
+    auto = refit_batch(reqs, max_em_iter=5)
+    forced = refit_batch(reqs, max_em_iter=5, step=_ssm.em_step_stats)
+    for a, f in zip(auto, forced):
+        assert a.n_iter == f.n_iter
+        np.testing.assert_allclose(a.params.lam, f.params.lam, atol=0)

@@ -1,0 +1,396 @@
+"""Composable EM transform stacks (models/transforms.py).
+
+Pinned claims:
+
+1. `resolve` maps every stack that reproduces a pre-stack variant to the
+   LITERAL module-level jitted step object the hand-written call sites
+   dispatched — identity (`is`), not equivalence — so the PR 1-4/8 HLO
+   byte-identity pins keep holding by construction; the previously
+   unreachable PRODUCTS resolve to models/emcore.py;
+2. invalid stacks (unknown core/kind, duplicate axes, products no core
+   supports) fail loudly at resolve time, not at trace time;
+3. the composed steps are exact: `em_step_collapsed` tracks
+   `em_step_stats` at 1e-10 per iteration, and the public AR entry point
+   with steady=True / n_shards=8 / both matches the plain collapsed fit
+   at 1e-10 (observed ~1e-13) — the speed axes change the schedule, not
+   the numbers;
+4. AR series padding (emcore.pad_ar_params + zero data + all-False mask)
+   is exactly inert — the exactness the shard transform's N-padding
+   relies on;
+5. the AOT plan is DERIVED: `enumerate_stacks` on a maximal spec yields
+   exactly the frozen pre-stack kernel key set (no orphans, no
+   duplicates), composed kernels appear only by opt-in, and a composed
+   kernel precompiles once then serves warm AOT hits.
+"""
+
+import warnings
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dynamic_factor_models_tpu.models import emcore
+from dynamic_factor_models_tpu.models import mixed_freq
+from dynamic_factor_models_tpu.models import ssm
+from dynamic_factor_models_tpu.models import ssm_ar
+from dynamic_factor_models_tpu.models import transforms as tfm
+from dynamic_factor_models_tpu.models.dfm import DFMConfig
+from dynamic_factor_models_tpu.utils import compile as cc
+from dynamic_factor_models_tpu.utils import telemetry as T
+
+
+@pytest.fixture(autouse=True)
+def _clean_compile_env(monkeypatch):
+    for var in ("DFM_SHAPE_BUCKETS", "DFM_T_BUCKETS", "DFM_N_BUCKETS",
+                "DFM_REP_BUCKET"):
+        monkeypatch.delenv(var, raising=False)
+    monkeypatch.setenv("DFM_DONATE", "0")
+
+
+# ---------------------------------------------------------------------------
+# 1. resolution identity: stacks map to the hand-written step OBJECTS
+# ---------------------------------------------------------------------------
+
+
+def test_resolve_returns_literal_hand_written_steps():
+    assert tfm.resolve(tfm.Stack("ssm")).step is ssm.em_step_stats
+    assert (
+        tfm.resolve(tfm.Stack("ssm", (tfm.steady_tail(16),))).step
+        is ssm._steady_step_for(16, 0)
+    )
+    assert tfm.resolve(tfm.Stack("ssm.legacy")).step is ssm.em_step
+    assert tfm.resolve(tfm.Stack("ssm.assoc")).step is ssm.em_step_assoc
+    assert tfm.resolve(tfm.Stack("ssm.sqrt")).step is ssm.em_step_sqrt
+    assert (
+        tfm.resolve(tfm.Stack("ssm.sqrt_collapsed")).step
+        is ssm.em_step_sqrt_collapsed
+    )
+    assert tfm.resolve(tfm.Stack("ar")).step is ssm_ar.em_step_ar
+    assert (
+        tfm.resolve(tfm.Stack("ar", (tfm.collapse(),))).step
+        is ssm_ar.em_step_ar_qd
+    )
+    assert tfm.resolve(tfm.Stack("mf")).step is mixed_freq.em_step_mf_stats
+
+
+def test_resolve_records_loop_policy_and_fallbacks():
+    res = tfm.resolve(
+        tfm.Stack(
+            "ar",
+            (tfm.collapse(), tfm.steady_tail(32), tfm.guard(),
+             tfm.batch(4), tfm.donate()),
+        )
+    )
+    assert res.step is emcore._ar_steady_step_for(32, 0)
+    assert res.carry == "ar_steady" and res.arg_kind == "qd_tail"
+    assert res.t_star == 32 and res.batch == 4
+    assert res.guard is True and res.donate is True
+    # the guard ladder's demote rung: the exact plain collapsed step
+    assert res.fallback_step is ssm_ar.em_step_ar_qd
+
+
+def test_resolve_composed_products_live_in_emcore():
+    assert (
+        tfm.resolve(tfm.Stack("ssm", (tfm.collapse(),))).step
+        is emcore.em_step_collapsed
+    )
+    assert (
+        tfm.resolve(
+            tfm.Stack("ar", (tfm.collapse(), tfm.steady_tail(16)))
+        ).step
+        is emcore._ar_steady_step_for(16, 0)
+    )
+
+
+@pytest.mark.multidevice
+def test_resolve_sharded_steps_are_the_mesh_cached_objects():
+    assert (
+        tfm.resolve(tfm.Stack("ssm", (tfm.shard(2),))).step
+        is ssm._sharded_step_for(2)
+    )
+    assert (
+        tfm.resolve(tfm.Stack("ar", (tfm.collapse(), tfm.shard(2)))).step
+        is emcore._ar_sharded_step_for(2)
+    )
+    res = tfm.resolve(
+        tfm.Stack("ar", (tfm.collapse(), tfm.steady_tail(16), tfm.shard(2)))
+    )
+    assert res.step is emcore._ar_steady_sharded_step_for(16, 0, 2)
+    assert res.carry == "ar_steady" and res.n_shards == 2
+
+
+# ---------------------------------------------------------------------------
+# 2. invalid stacks fail at resolve time
+# ---------------------------------------------------------------------------
+
+
+def test_resolve_rejects_invalid_stacks():
+    with pytest.raises(ValueError, match="unknown core"):
+        tfm.resolve(tfm.Stack("svar"))
+    with pytest.raises(ValueError, match="unknown transform kind"):
+        tfm.resolve(tfm.Stack("ssm", (tfm.Transform("fuse"),)))
+    with pytest.raises(ValueError, match="duplicate"):
+        tfm.resolve(tfm.Stack("ssm", (tfm.collapse(), tfm.collapse())))
+    with pytest.raises(ValueError, match="steady x shard"):
+        tfm.resolve(
+            tfm.Stack("ssm", (tfm.steady_tail(16), tfm.shard(2)))
+        )
+    with pytest.raises(ValueError, match="require 'collapse'"):
+        tfm.resolve(tfm.Stack("ar", (tfm.steady_tail(16),)))
+    with pytest.raises(ValueError, match="no step transforms"):
+        tfm.resolve(tfm.Stack("ssm.sqrt", (tfm.collapse(),)))
+    with pytest.raises(ValueError, match="no step transforms"):
+        tfm.resolve(tfm.Stack("mf", (tfm.collapse(),)))
+
+
+def test_wrap_unwrap_params_roundtrip(rng):
+    N, r, p = 6, 2, 1
+    params = ssm_ar.SSMARParams(
+        lam=jnp.asarray(rng.standard_normal((N, r))),
+        phi=jnp.zeros(N),
+        sigv2=jnp.ones(N),
+        A=0.5 * jnp.eye(r)[None],
+        Q=jnp.eye(r),
+    )
+    res = tfm.resolve(
+        tfm.Stack("ar", (tfm.collapse(), tfm.steady_tail(16)))
+    )
+    state = tfm.wrap_params(res, params)
+    k = r * max(p, 2)
+    assert isinstance(state, emcore.ARSteadyState)
+    assert state.Pp.shape == (k, k)
+    assert tfm.unwrap_params(res, state) is params
+    res_bare = tfm.resolve(tfm.Stack("ar", (tfm.collapse(),)))
+    assert tfm.wrap_params(res_bare, params) is params
+
+
+# ---------------------------------------------------------------------------
+# 3. composed-step exactness
+# ---------------------------------------------------------------------------
+
+
+def test_em_step_collapsed_matches_em_step_stats(rng):
+    T_, N = 48, 14
+    f = rng.standard_normal((T_, 2))
+    lam = rng.standard_normal((N, 2))
+    x = f @ lam.T + 0.5 * rng.standard_normal((T_, N))
+    mask = np.ones((T_, N), bool)
+    mask[:5, 0] = False
+    mask[40:, 3] = False
+    xz = jnp.asarray(np.where(mask, x, 0.0))
+    m = jnp.asarray(mask)
+    stats = ssm.compute_panel_stats(xz, m)
+    params = ssm.SSMParams(
+        lam=jnp.asarray(lam + 0.1 * rng.standard_normal((N, 2))),
+        R=jnp.ones(N),
+        A=0.5 * jnp.eye(2)[None],
+        Q=jnp.eye(2),
+    )
+    pa = pb = params
+    for _ in range(4):
+        pa, lla = ssm.em_step_stats(pa, xz, m, stats)
+        pb, llb = emcore.em_step_collapsed(pb, xz, m, stats)
+        assert abs(float(lla) - float(llb)) <= 1e-10 * (1 + abs(float(lla)))
+        for a, b in zip(pa, pb):
+            np.testing.assert_allclose(a, b, atol=1e-10)
+
+
+def _ar_panel(rng, T_=220, N=20, r=2):
+    """Contiguous-prefix missingness only (the QD-exact mask class)."""
+    phi_true = rng.uniform(-0.5, 0.7, N)
+    lam = rng.standard_normal((N, r))
+    f = np.zeros((T_, r))
+    for t in range(1, T_):
+        f[t] = 0.6 * f[t - 1] + 0.5 * rng.standard_normal(r)
+    e = np.zeros((T_, N))
+    for t in range(1, T_):
+        e[t] = phi_true * e[t - 1] + 0.4 * rng.standard_normal(N)
+    x = f @ lam.T + e
+    for i in range(6):
+        x[: int(rng.integers(1, 6)), i] = np.nan
+    return x
+
+
+def _fit_ar(x, **kw):
+    cfg = DFMConfig(nfac_u=2, n_factorlag=1)
+    return ssm_ar.estimate_dfm_em_ar(
+        x, np.ones(x.shape[1]), 0, x.shape[0] - 1, cfg,
+        max_em_iter=10, method="collapsed", **kw,
+    )
+
+
+def test_ar_steady_stack_matches_plain_collapsed(rng):
+    x = _ar_panel(rng)
+    base = _fit_ar(x)
+    st = _fit_ar(x, steady=True)
+    for a, b in zip(base.params, st.params):
+        np.testing.assert_allclose(a, b, atol=1e-10)
+    np.testing.assert_allclose(
+        np.asarray(base.loglik_path), np.asarray(st.loglik_path),
+        atol=1e-8 * (1 + abs(float(base.loglik_path[-1]))),
+    )
+    np.testing.assert_allclose(base.factors, st.factors, atol=1e-10)
+
+
+@pytest.mark.multidevice
+def test_ar_sharded_and_all_stacks_match_plain_collapsed(rng):
+    x = _ar_panel(rng)
+    base = _fit_ar(x)
+    sh = _fit_ar(x, n_shards=8)  # N=20 pads to 24: 3 series per shard
+    assert sh.params.lam.shape == base.params.lam.shape
+    for a, b in zip(base.params, sh.params):
+        np.testing.assert_allclose(a, b, atol=1e-10)
+    both = _fit_ar(x, steady=True, n_shards=8)
+    for a, b in zip(base.params, both.params):
+        np.testing.assert_allclose(a, b, atol=1e-10)
+    np.testing.assert_allclose(base.factors, both.factors, atol=1e-10)
+
+
+def test_ar_series_padding_is_inert(rng):
+    x = _ar_panel(rng, T_=60, N=10)
+    mask = ~np.isnan(x)
+    xz = jnp.asarray(np.where(mask, x, 0.0))
+    m = jnp.asarray(mask)
+    N = x.shape[1]
+    params = ssm_ar.SSMARParams(
+        lam=jnp.asarray(rng.standard_normal((N, 2))),
+        phi=jnp.zeros(N),
+        sigv2=jnp.full((N,), 0.5),
+        A=0.5 * jnp.eye(2)[None],
+        Q=jnp.eye(2),
+    )
+    Npad = N + 6
+    xz_p = jnp.concatenate([xz, jnp.zeros((x.shape[0], 6))], axis=1)
+    m_p = jnp.concatenate([m, jnp.zeros((x.shape[0], 6), bool)], axis=1)
+    params_p = emcore.pad_ar_params(params, Npad)
+    assert params_p.lam.shape[0] == Npad
+    qd = ssm_ar.compute_qd_stats(xz, m)
+    qd_p = ssm_ar.compute_qd_stats(xz_p, m_p)
+    p1, ll1 = ssm_ar.em_step_ar_qd(params, xz, qd)
+    p2, ll2 = ssm_ar.em_step_ar_qd(params_p, xz_p, qd_p)
+    assert abs(float(ll1) - float(ll2)) <= 1e-12 * (1 + abs(float(ll1)))
+    p2u = emcore.unpad_ar_params(p2, N)
+    for a, b in zip(p1, p2u):
+        np.testing.assert_allclose(a, b, atol=1e-12)
+
+
+# ---------------------------------------------------------------------------
+# 4. derived AOT plan
+# ---------------------------------------------------------------------------
+
+# the complete EM-family kernel key set the pre-stack hand enumeration
+# produced for a maximal spec (t_star set, n_shards > 1, em_batch > 0,
+# sharded kernels requested) — the derived plan must reproduce it exactly
+FROZEN_EM_KEYS = {
+    "em_step_stats",
+    "em_step",
+    "em_step_sqrt",
+    "em_step_sqrt_collapsed",
+    "em_step_ar",
+    "em_step_ar_qd",
+    "em_loop",
+    "em_loop_guarded",
+    "em_step_steady",
+    "em_loop@steady",
+    "em_loop_guarded@steady",
+    "em_step_sharded",
+    "em_loop_guarded@sharded",
+    "em_loop_batched",
+}
+
+
+def _maximal_spec(**kw):
+    base = dict(
+        T=60, N=12, r=2, p=1, dtype=str(np.dtype(float)),
+        max_em_iter=4, t_star=16, n_shards=2, em_batch=2,
+        kernels=cc.CompileSpec.kernels
+        + ("em_step_sharded", "em_loop_guarded@sharded"),
+    )
+    base.update(kw)
+    return cc.CompileSpec(**base)
+
+
+def test_enumerate_stacks_reproduces_frozen_key_set():
+    entries = tfm.enumerate_stacks(_maximal_spec())
+    keys = [e.key for e in entries]
+    assert len(keys) == len(set(keys)), "duplicate derived plan keys"
+    assert set(keys) == FROZEN_EM_KEYS
+    # composed kernels are opt-in by name: absent unless requested
+    spec2 = _maximal_spec(
+        kernels=cc.CompileSpec.kernels
+        + ("em_step_sharded", "em_loop_guarded@sharded",
+           "em_step_collapsed", "em_step_ar_steady",
+           "em_step_ar_sharded", "em_step_ar_all"),
+    )
+    keys2 = {e.key for e in tfm.enumerate_stacks(spec2)}
+    assert keys2 == FROZEN_EM_KEYS | {
+        "em_step_collapsed", "em_step_ar_steady",
+        "em_step_ar_sharded", "em_step_ar_all",
+    }
+    # gating: the composed AR kernels need their static inputs
+    spec3 = _maximal_spec(
+        t_star=None, n_shards=0, em_batch=0,
+        kernels=("em_step_ar_steady", "em_step_ar_sharded",
+                 "em_step_ar_all"),
+    )
+    assert tfm.enumerate_stacks(spec3) == []
+
+
+@pytest.mark.multidevice
+def test_kernel_plan_keys_match_frozen_set():
+    """Every stack reachable from the spec registers exactly one plan
+    entry, and the derived registry equals the old hand-enumerated set
+    plus the two non-EM cores — no orphans, no duplicates."""
+    plans = cc._kernel_plan(_maximal_spec())
+    assert set(plans) == FROZEN_EM_KEYS | {"als_core", "bootstrap_core"}
+
+
+def test_composed_kernels_precompile_once_then_hit_warm():
+    cc.reset_counters()
+    spec = cc.CompileSpec(
+        T=60, N=12, r=2, p=1, dtype=str(np.dtype(float)),
+        max_em_iter=4, t_star=16,
+        kernels=("em_step_collapsed", "em_step_ar_qd",
+                 "em_step_ar_steady"),
+    )
+    r1 = cc.precompile(spec)
+    for k in spec.kernels:
+        assert not r1["kernels"][k]["aot_cached"]
+        assert cc.counters()[k]["compiles"] == 1
+    r2 = cc.precompile(spec)
+    for k in spec.kernels:
+        assert r2["kernels"][k]["aot_cached"]
+        c = cc.counters()[k]
+        assert c["compiles"] == 1 and c["aot_hits"] == 1
+
+
+# ---------------------------------------------------------------------------
+# 5. dense-fallback UX (satellite: the warning names the offenders)
+# ---------------------------------------------------------------------------
+
+
+def test_gap_report_and_fallback_warning_name_series(rng, tmp_path,
+                                                     monkeypatch):
+    x = _ar_panel(rng, T_=60, N=10)
+    x[25, 2] = np.nan  # interior gaps: outside the QD mask class
+    x[30:33, 5] = np.nan
+    mask = ~np.isnan(x)
+    bad, gaps = ssm_ar.qd_gap_report(mask)
+    assert list(bad) == [2, 5]
+    assert list(gaps) == [25, 30]
+
+    sink = str(tmp_path / "runs.jsonl")
+    monkeypatch.setenv("DFM_TELEMETRY", sink)
+    monkeypatch.setattr(T, "_explicit_enabled", None)
+    monkeypatch.setattr(T, "_explicit_sink", None)
+    T.reset()
+    with pytest.warns(UserWarning, match=r"2 \(first gap at t=25\)"):
+        res = _fit_ar(x)
+    assert np.isfinite(res.loglik_path[-1])
+    rec = [
+        r for r in T.records() if r["entry"] == "estimate_dfm_em_ar"
+    ][-1]
+    assert rec["collapse_gated"] is True
+    assert rec["gap_series"] == [2, 5]
