@@ -1047,6 +1047,221 @@ def chaos_serving_section():
     return fields
 
 
+def load_section(smoke: bool = False):
+    """Open-loop mixed-traffic load generator (bench.py --load).
+
+    Drives the serving engine with a 70/24/5/1 tick/nowcast/refit/
+    scenario mix at three synthetic-tenant scales (1k / 10k / 100k;
+    `--smoke` shrinks to one 50-tenant scale), each probed at three
+    offered rates (0.25x / 0.75x / 1.5x of the scale's measured
+    closed-loop capacity).  The generator is OPEN-LOOP: request i is
+    scheduled at ``t0 + i/rate`` regardless of when request i-1
+    finished, and latency is ``completion - scheduled arrival`` — so a
+    stalled server keeps accruing offered load and the p99/p99.9 numbers
+    include queueing delay (no coordinated omission, the closed-loop
+    generator's classic lie).  Registration at scale rides
+    `ServingEngine.register_shared` (shared fit + copy-on-append
+    history); tenant t0 is reserved for scenarios so its panel length —
+    and therefore the compiled fan program — never changes mid-run.
+
+    Per point: p50/p99/p99.9 (utils.histogram.LatencyHistogram, overall
+    and per kind), availability (fraction `Response.ok`), and a tick
+    SLO (p95 within 250 ms) judged on the open-loop latency via
+    utils.slo burn rates.  Acceptance fields:
+
+    - load_slo_green_at_low_load: the tick SLO is green at every
+      scale's LOWEST offered rate (bar: true);
+    - load_envelope_overhead_frac: instrumented clean-path envelope
+      (validation + breaker + telemetry + histogram + trace stamps)
+      over the bare online_tick wall, device program stubbed as in
+      chaos_serving_section (bar: < 1.05).
+
+    Persists docs/BENCH_load.json; prints one JSON line and returns the
+    headline dict.
+    """
+    import numpy as np
+
+    fields = {
+        "load_scales": None,
+        "load_slo_green_at_low_load": None,
+        "load_envelope_us": None,
+        "load_envelope_overhead_frac": None,
+    }
+    out = {"smoke": bool(smoke)}
+    try:
+        import jax
+
+        import dynamic_factor_models_tpu.serving.engine as _eng_mod
+        from dynamic_factor_models_tpu.serving.engine import ServingEngine
+        from dynamic_factor_models_tpu.serving.online import online_tick
+        from dynamic_factor_models_tpu.utils.histogram import (
+            LatencyHistogram,
+        )
+        from dynamic_factor_models_tpu.utils.slo import SLO
+
+        T, N = 64, 16
+        rng = np.random.default_rng(23)
+        f = rng.standard_normal((T, 4)).cumsum(0) * 0.1
+        lam = rng.standard_normal((N, 4))
+        panel = f @ lam.T + 0.5 * rng.standard_normal((T, N))
+
+        scales = [50] if smoke else [1_000, 10_000, 100_000]
+        n_req = 200 if smoke else 2_000
+        n_burst = 100 if smoke else 400
+        mix = {"tick": 0.70, "nowcast": 0.24, "refit": 0.05,
+               "scenario": 0.01}
+        slo_thresh_s, slo_obj = 0.25, 0.95
+        scenario_req = {
+            "kind": "scenario", "tenant": "t0",
+            "scenario": {"kind": "stress", "horizon": 4,
+                         "shocks": np.eye(4)[:1].tolist()},
+        }
+
+        def make_stream(rs, n, n_tenants):
+            kinds = rs.choice(
+                list(mix), size=n, p=list(mix.values())
+            )
+            reqs = []
+            for k in kinds:
+                if k == "scenario" or n_tenants == 1:
+                    reqs.append(dict(scenario_req) if k == "scenario"
+                                else {"kind": k, "tenant": "t0"})
+                    if k == "tick" and n_tenants == 1:
+                        reqs[-1]["x"] = rs.standard_normal(N)
+                    continue
+                r = {"kind": k, "tenant": f"t{rs.integers(1, n_tenants)}"}
+                if k == "tick":
+                    r["x"] = rs.standard_normal(N)
+                reqs.append(r)
+            return reqs
+
+        def run_point(eng, reqs, rate):
+            slo = SLO("tick_p95_250ms", kind="tick",
+                      threshold_s=slo_thresh_s, objective=slo_obj)
+            hist = LatencyHistogram()
+            per_kind = {k: LatencyHistogram() for k in mix}
+            n_ok = 0
+            t0 = time.perf_counter()
+            for i, req in enumerate(reqs):
+                sched = t0 + i / rate
+                now = time.perf_counter()
+                if now < sched:
+                    time.sleep(sched - now)
+                resp = eng.handle(req)
+                lat = time.perf_counter() - sched
+                hist.record(lat)
+                per_kind[req["kind"]].record(lat)
+                if req["kind"] == "tick":
+                    slo.observe(lat, resp.ok)
+                n_ok += bool(resp.ok)
+            wall = time.perf_counter() - t0
+            eng._refit_queue.clear()  # refits only queue in this drill
+            p = hist.percentiles()
+            st = slo.status()
+            return {
+                "offered_rps": round(rate, 1),
+                "achieved_rps": round(len(reqs) / wall, 1),
+                "n_requests": len(reqs),
+                "availability": round(n_ok / len(reqs), 4),
+                "p50_ms": round(p["p50_ms"], 3),
+                "p99_ms": round(p["p99_ms"], 3),
+                "p999_ms": round(p["p999_ms"], 3),
+                "per_kind": {
+                    k: {"n": h.n,
+                        "p50_ms": round(1e3 * h.quantile(0.5), 3),
+                        "p99_ms": round(1e3 * h.quantile(0.99), 3)}
+                    for k, h in sorted(per_kind.items()) if h.n
+                },
+                "slo": st,
+                "slo_green": st["green"],
+            }
+
+        scale_rows, green_low = [], True
+        for n_tenants in scales:
+            eng = ServingEngine(max_em_iter=5)
+            t_reg0 = time.perf_counter()
+            eng.register("t0", panel)
+            for i in range(1, n_tenants):
+                eng.register_shared(f"t{i}", "t0")
+            reg_s = time.perf_counter() - t_reg0
+            rs = np.random.default_rng(n_tenants)
+            # warm every program in the mix before any timing
+            for req in make_stream(rs, 8, n_tenants) + [scenario_req]:
+                eng.handle(req)
+            burst = make_stream(rs, n_burst, n_tenants)
+            tb = time.perf_counter()
+            for req in burst:
+                eng.handle(req)
+            cap_rps = n_burst / (time.perf_counter() - tb)
+            eng._refit_queue.clear()
+            points = []
+            for frac in (0.25, 0.75, 1.5):
+                reqs = make_stream(rs, n_req, n_tenants)
+                pt = run_point(eng, reqs, frac * cap_rps)
+                pt["offered_frac"] = frac
+                points.append(pt)
+            green_low = green_low and points[0]["slo_green"]
+            scale_rows.append({
+                "n_tenants": n_tenants,
+                "register_s": round(reg_s, 3),
+                "capacity_rps": round(cap_rps, 1),
+                "points": points,
+            })
+
+        # instrumented clean-path envelope, device stubbed (same
+        # protocol as chaos_serving_section: wall-clock A/B against the
+        # live device program swings with dispatch-queue noise)
+        n_bench = 500 if smoke else 2000
+        eng2 = ServingEngine(max_em_iter=5)
+        eng2.register("t", panel)
+        ten = eng2._tenants["t"]
+        model, st_pin = ten.model, ten.state
+        xr = [rng.standard_normal(N) for _ in range(n_bench)]
+
+        def handle_loop():
+            for i in range(n_bench):
+                eng2.handle({"kind": "tick", "tenant": "t", "x": xr[i]})
+
+        def raw_loop():
+            s = st_pin
+            for i in range(n_bench):
+                m = np.isfinite(xr[i])
+                s = online_tick(model, s, np.where(m, xr[i], 0.0), m)
+            return jax.block_until_ready(s)
+
+        raw_loop()
+        handle_loop()
+        wall_r = _time_fixed_iters(raw_loop)
+        real_tick = _eng_mod.online_tick
+        _eng_mod.online_tick = lambda model, state, x, m: st_pin
+        try:
+            wall_env = _time_fixed_iters(handle_loop)
+        finally:
+            _eng_mod.online_tick = real_tick
+
+        fields["load_scales"] = [s["n_tenants"] for s in scale_rows]
+        fields["load_slo_green_at_low_load"] = bool(green_low)
+        fields["load_envelope_us"] = round(1e6 * wall_env / n_bench, 1)
+        fields["load_envelope_overhead_frac"] = round(wall_env / wall_r, 4)
+        out.update({
+            "time_unix": round(time.time(), 1),
+            "mix": mix,
+            "slo": {"kind": "tick", "threshold_s": slo_thresh_s,
+                    "objective": slo_obj},
+            "scales": scale_rows,
+            **fields,
+        })
+        path = os.path.join(REPO, "docs", "BENCH_load.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(out, fh, indent=1, sort_keys=True)
+        os.replace(tmp, path)
+    except Exception as e:  # present-but-null contract
+        fields["load_error"] = f"{type(e).__name__}: {e}"
+    print(json.dumps(fields), flush=True)
+    return fields
+
+
 def scenarios_section():
     """Scenario-engine throughput (bench.py --scenarios).
 
@@ -3167,6 +3382,13 @@ def main():
                          "parity, and envelope overhead vs the bare tick "
                          "executable (chaos_serving_section); prints one "
                          "JSON line")
+    ap.add_argument("--load", action="store_true",
+                    help="open-loop mixed-traffic load generator at 1k-"
+                         "100k shared-fit tenants with p50/p99/p99.9, "
+                         "availability, and SLO burn-rate acceptance "
+                         "(load_section); persists docs/BENCH_load.json "
+                         "and prints one JSON line (--smoke: one tiny "
+                         "50-tenant scale)")
     ap.add_argument("--chaos-preempt-drill", action="store_true",
                     help="one injected-preemption resume on a small panel "
                          "(tpu_watch live-window drill); prints one JSON "
@@ -3223,6 +3445,9 @@ def main():
         return
     if args.chaos_preempt_drill:
         chaos_preempt_drill()
+        return
+    if args.load:
+        load_section(smoke=args.smoke)
         return
     if args.large_n:
         large_n_section(force_cpu=args.force_cpu)

@@ -32,6 +32,7 @@ from ..utils.compile import (
     pad_ssm_params,
     unpad_ssm_params,
 )
+from ..utils.telemetry import trace_span
 
 __all__ = [
     "HEALTH_BUCKET_ERROR",
@@ -135,33 +136,40 @@ def refit_batch(
             bucket_step = _tfm.resolve(
                 _tfm.Stack("ssm", (_tfm.collapse(),))
             ).step
-        try:
-            prepped = [_prepare(req, t_pad, n_pad) for req in group]
-            params_B = jax.tree.map(lambda *xs: jnp.stack(xs),
-                                    *[p[0] for p in prepped])
-            x_B = jnp.stack([p[1] for p in prepped])
-            mask_B = jnp.stack([p[2] for p in prepped])
-            stats_B = jax.tree.map(lambda *xs: jnp.stack(xs),
-                                   *[p[3] for p in prepped])
-            res = run_em_loop_batched(
-                bucket_step, params_B, (x_B, mask_B, stats_B), tol,
-                max_em_iter,
-            )
-        except (SimulatedPreemption, SimulatedCrash, KeyboardInterrupt):
-            raise
-        except Exception:
-            if not isolate_errors:
-                raise
-            for req in group:
-                out[order[id(req)]] = RefitResult(
-                    tenant_id=req.tenant_id,
-                    params=req.params,
-                    n_iter=0,
-                    converged=False,
-                    health=HEALTH_BUCKET_ERROR,
-                    loglik=float("nan"),
+        # bucket membership lands in the requesting span tree: a refit
+        # request's trace shows WHICH (T, N) bucket ran its tenant and
+        # who shared the compiled program
+        with trace_span(
+            "refit.bucket", t_pad=int(t_pad), n_pad=int(n_pad),
+            tenants=[req.tenant_id for req in group],
+        ):
+            try:
+                prepped = [_prepare(req, t_pad, n_pad) for req in group]
+                params_B = jax.tree.map(lambda *xs: jnp.stack(xs),
+                                        *[p[0] for p in prepped])
+                x_B = jnp.stack([p[1] for p in prepped])
+                mask_B = jnp.stack([p[2] for p in prepped])
+                stats_B = jax.tree.map(lambda *xs: jnp.stack(xs),
+                                       *[p[3] for p in prepped])
+                res = run_em_loop_batched(
+                    bucket_step, params_B, (x_B, mask_B, stats_B), tol,
+                    max_em_iter,
                 )
-            continue
+            except (SimulatedPreemption, SimulatedCrash, KeyboardInterrupt):
+                raise
+            except Exception:
+                if not isolate_errors:
+                    raise
+                for req in group:
+                    out[order[id(req)]] = RefitResult(
+                        tenant_id=req.tenant_id,
+                        params=req.params,
+                        n_iter=0,
+                        converged=False,
+                        health=HEALTH_BUCKET_ERROR,
+                        loglik=float("nan"),
+                    )
+                continue
         for b, req in enumerate(group):
             params_b = jax.tree.map(lambda a: a[b], res.params)
             ll_path = res.llpath[b]

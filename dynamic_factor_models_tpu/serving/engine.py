@@ -51,6 +51,7 @@ import argparse
 import json
 import re as _re
 import sys
+import time
 
 import jax.numpy as jnp
 import numpy as np
@@ -59,7 +60,17 @@ from ..models import ssm as _ssm
 from ..utils import faults as _faults
 from ..utils.compile import bucket_shape
 from ..utils.guards import host_finite
-from ..utils.telemetry import inc, run_record
+from ..utils.telemetry import (
+    _NULL_RECORD,
+    _NULL_TRACE,
+    emit_histograms,
+    gauge_set,
+    inc,
+    register_hist,
+    run_record,
+    trace_span,
+    trace_span_on,
+)
 from .batch import RefitRequest, refit_batch
 from .online import (
     FilterState,
@@ -108,13 +119,26 @@ class _History:
     log2 of the growth factor), which the perf regression test pins
     instead of flaky wall time."""
 
-    __slots__ = ("_x", "_mask", "n", "reallocs")
+    __slots__ = ("_x", "_mask", "n", "reallocs", "_shared")
 
     def __init__(self, x, mask):
         self.n = int(x.shape[0])
         self._x = np.array(x, float, copy=True)
         self._mask = np.array(mask, bool, copy=True)
         self.reallocs = 0
+        self._shared = False
+
+    @classmethod
+    def share(cls, other: "_History") -> "_History":
+        """Zero-copy clone sharing `other`'s buffers copy-on-append.
+        Safe against the source growing: the source writes rows only at
+        indices >= this clone's frozen `n`, outside its views; the first
+        append on the CLONE copies the prefix into private buffers."""
+        h = cls.__new__(cls)
+        h._x, h._mask, h.n = other._x, other._mask, other.n
+        h.reallocs = 0
+        h._shared = True
+        return h
 
     @property
     def x(self) -> np.ndarray:
@@ -125,7 +149,7 @@ class _History:
         return self._mask[: self.n]
 
     def append(self, x_row, mask_row) -> None:
-        if self.n == self._x.shape[0]:
+        if self._shared or self.n == self._x.shape[0]:
             cap = max(2 * self._x.shape[0], 8)
             nx = np.zeros((cap,) + self._x.shape[1:], self._x.dtype)
             nm = np.zeros((cap,) + self._mask.shape[1:], bool)
@@ -133,6 +157,7 @@ class _History:
             nm[: self.n] = self._mask[: self.n]
             self._x, self._mask = nx, nm
             self.reallocs += 1
+            self._shared = False
         self._x[self.n] = x_row
         self._mask[self.n] = mask_row
         self.n += 1
@@ -166,6 +191,7 @@ class ServingEngine:
         breaker_threshold: int = 3,
         breaker_cooldown: int = 4,
         max_refit_retries: int = 2,
+        slos=None,
     ):
         self.store = TenantStore(store_dir) if store_dir else None
         self.tol = tol
@@ -175,11 +201,15 @@ class ServingEngine:
         self.breaker_threshold = breaker_threshold
         self.breaker_cooldown = breaker_cooldown
         self.max_refit_retries = max_refit_retries
+        self.slos = list(slos or [])  # utils.slo.SLO monitors, by kind
         self._tenants: dict[str, _Tenant] = {}
         self._refit_queue: list[str] = []
         self._refit_retries: dict[str, int] = {}
         self._requests = 0  # admission counter (slow_req/engine_crash sites)
         self._ticks = 0     # computed-tick counter (tick_nan site)
+        # (kind, outcome) -> LatencyHistogram, held directly so the hot
+        # path never takes the registry lock (register_hist once per key)
+        self._lat_hists: dict = {}
 
     # -- registration ----------------------------------------------------
 
@@ -197,6 +227,23 @@ class ServingEngine:
         if params is None:
             params = default_params(x.shape[1])
         self._install(tenant_id, xz, mask, params)
+
+    def register_shared(self, tenant_id: str, like: str) -> None:
+        """Admit `tenant_id` by CLONING tenant `like`'s fit: params,
+        ServingModel (the DARE solve), and the history buffer are SHARED
+        (history copy-on-append); only the small FilterState is fresh
+        per clone.  O(1) per tenant instead of a DARE solve plus a full
+        refilter — what makes 1k-100k synthetic tenants registrable in
+        seconds for `bench.py --load`.  Ticks/nowcasts/refits/scenarios
+        behave exactly as after `register()` with the same panel."""
+        src = self._tenants[like]
+        state = FilterState(s=src.state.s, t=src.state.t)
+        self._persist(tenant_id, src.params, state)
+        self._tenants[tenant_id] = _Tenant(
+            None if src.hist is None else _History.share(src.hist),
+            src.params, src.model, state,
+            CircuitBreaker(self.breaker_threshold, self.breaker_cooldown),
+        )
 
     def _install(self, tenant_id, xz, mask, params) -> None:
         """(Re)derive a tenant's serving constants from `params` and its
@@ -270,9 +317,26 @@ class ServingEngine:
         if not isinstance(tenant_id, str):
             tenant_id = None
         rkind = kind if kind in _REQ_KINDS else "invalid"
-        with run_record(
+        t0 = time.perf_counter()
+        # one enabled() probe per request: run_record() already performs
+        # it, and returning the null singleton tells us the trace layer
+        # is off too — a second probe (~1.6µs of env lookups) would blow
+        # a visible hole in the <5% envelope bar
+        rec_cm = run_record(
             "serving", kind=rkind, config={"tenant": tenant_id}
-        ) as rec:
+        )
+        if rec_cm is _NULL_RECORD:
+            tr_cm = _NULL_TRACE
+        else:
+            # deterministic trace identity: the request's own id, else
+            # its admission index — identical request streams yield
+            # identical span trees (pinned by tests/test_request_obs.py)
+            rid = req.get("request_id") if isinstance(req, dict) else None
+            tr_cm = trace_span_on(
+                "serving.request", seed=rid or f"{tenant_id}:{reqno}",
+                kind=rkind, tenant=tenant_id,
+            )
+        with tr_cm, rec_cm as rec:
             try:
                 resp = self._dispatch(req, kind, tenant_id, reqno)
             except (
@@ -290,16 +354,53 @@ class ServingEngine:
                         f"{type(e).__name__}: {e}",
                     ),
                 )
-            rec.set(
-                outcome=(
-                    ("degraded" if resp.degraded else "ok")
-                    if resp.ok else resp.error.category
-                ),
-                error_kind=None if resp.error is None else resp.error.code,
-                retries=resp.retries,
-                breaker_state=resp.breaker_state,
+            outcome = (
+                ("degraded" if resp.degraded else "ok")
+                if resp.ok else resp.error.category
             )
+            latency_s = time.perf_counter() - t0
+            if rec is not _NULL_RECORD:
+                rec.set(
+                    outcome=outcome,
+                    error_kind=(
+                        None if resp.error is None else resp.error.code
+                    ),
+                    retries=resp.retries,
+                    breaker_state=resp.breaker_state,
+                    latency_s=round(latency_s, 9),
+                )
+        self._observe(rkind, outcome, latency_s, resp.ok)
+        if (reqno & 1023) == 0 and rec is not _NULL_RECORD:
+            self.flush_metrics()
         return resp
+
+    def _observe(self, kind, outcome, latency_s, ok) -> None:
+        """O(1) host-side per-request accounting: one histogram bucket
+        increment per (kind, outcome) plus the SLO window counters for
+        monitors matching this kind.  Never touches a device."""
+        try:
+            h = self._lat_hists[(kind, outcome)]
+        except KeyError:
+            h = register_hist(
+                "serving.request.latency",
+                entry="serving", kind=kind, outcome=outcome,
+            )
+            self._lat_hists[(kind, outcome)] = h
+        h.record(latency_s)
+        if self.slos:
+            for slo in self.slos:
+                if slo.kind == kind:
+                    slo.observe(latency_s, ok)
+
+    def flush_metrics(self) -> int:
+        """Push SLO burn-rate gauges into the telemetry registry and
+        snapshot the latency histograms into the JSONL sink (when one is
+        active).  Called every 1024th request automatically; call
+        explicitly at the end of a run to flush the tail."""
+        for slo in self.slos:
+            for name, val in slo.gauges().items():
+                gauge_set(name, val)
+        return emit_histograms()
 
     def _dispatch(self, req, kind, tenant_id, reqno) -> Response:
         if not isinstance(req, dict):
@@ -443,7 +544,8 @@ class ServingEngine:
         recovered = False
         if ten.replay:
             try:
-                self._reconcile(tenant_id, ten)
+                with trace_span("serving.reconcile", n_rows=len(ten.replay)):
+                    self._reconcile(tenant_id, ten)
                 ten = self._tenants[tenant_id]  # reconcile reinstalls
                 recovered = True
             except OSError as e:
@@ -518,12 +620,13 @@ class ServingEngine:
             journal = self.store.journal(tenant_id)
             t_idx = int(ten.state.t)
             try:
-                _, retries = call_with_retries(
-                    lambda: journal.append(t_idx, row[0], row[1]),
-                    self.retry_policy,
-                    key=f"{tenant_id}:tick:{t_idx}",
-                    deadline=deadline,
-                )
+                with trace_span("tick.journal_append", t=t_idx):
+                    _, retries = call_with_retries(
+                        lambda: journal.append(t_idx, row[0], row[1]),
+                        self.retry_policy,
+                        key=f"{tenant_id}:tick:{t_idx}",
+                        deadline=deadline,
+                    )
             except OSError as e:
                 ten.replay.append(row)
                 return self._fault_resp(
@@ -795,7 +898,6 @@ class ServingEngine:
             base_t, rows = rep
             if base_t == int(stored.t) and rows:
                 state = replay_ticks(model, state, rows)
-                inc("serving.journal.replayed", len(rows))
             # a journal anchored at a different t predates this snapshot
             # (crash between save and journal reset): already folded in
         self._tenants[tenant_id] = _Tenant(

@@ -139,6 +139,8 @@ class TickJournal:
                 break
             rows.append(rec)
             good.append(line)
+        if rows:  # recovery visible in metrics, not just logs
+            inc("serving.journal.replayed_ticks", len(rows))
         return hdr, rows
 
     def _parse_header(self, line: bytes):

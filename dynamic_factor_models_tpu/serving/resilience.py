@@ -47,6 +47,8 @@ import hashlib
 import time
 from typing import Any, NamedTuple
 
+from ..utils.telemetry import inc, trace_event
+
 __all__ = [
     "CLIENT_ERROR",
     "TENANT_FAULT",
@@ -131,6 +133,14 @@ class CircuitBreaker:
         self._cooldown_left = 0
         self.opens = 0  # lifetime open transitions (telemetry)
 
+    def _transition(self, new_state: str) -> None:
+        """Every state change lands in metrics and the active span tree
+        (``serving.breaker.transitions{state="..."}``) — recovery is
+        visible without reading logs."""
+        self.state = new_state
+        inc(f'serving.breaker.transitions{{state="{new_state}"}}')
+        trace_event("breaker.transition", state=new_state)
+
     def on_request(self) -> str:
         """Observe one request against this tenant; while open, burn one
         cooldown slot and half-open when it reaches zero.  Returns the
@@ -138,13 +148,13 @@ class CircuitBreaker:
         if self.state == BREAKER_OPEN:
             self._cooldown_left -= 1
             if self._cooldown_left <= 0:
-                self.state = BREAKER_HALF_OPEN
+                self._transition(BREAKER_HALF_OPEN)
         return self.state
 
     def record_success(self) -> None:
         self.consecutive = 0
         if self.state != BREAKER_CLOSED:
-            self.state = BREAKER_CLOSED
+            self._transition(BREAKER_CLOSED)
 
     def record_fault(self) -> None:
         self.consecutive += 1
@@ -152,7 +162,7 @@ class CircuitBreaker:
             self.state == BREAKER_CLOSED
             and self.consecutive >= self.threshold
         ):
-            self.state = BREAKER_OPEN
+            self._transition(BREAKER_OPEN)
             self._cooldown_left = self.cooldown
             self.opens += 1
 
@@ -224,5 +234,6 @@ def call_with_retries(
                 deadline is not None and deadline.exceeded()
             ):
                 raise
+            trace_event("retry", key=key, attempt=attempt)
             sleep(policy.delay_s(key, attempt))
             attempt += 1

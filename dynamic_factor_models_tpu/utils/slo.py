@@ -1,0 +1,183 @@
+"""SLO objects and multi-window burn-rate monitors.
+
+KATANA's real-time Kalman deployment (PAPERS.md) frames the serving
+target: hard latency budgets verified CONTINUOUSLY, not benchmarked
+once.  An `SLO` here is the standard latency objective "at least
+`objective` of requests of kind `kind` complete within `threshold_s`",
+and its health is judged the SRE way — by the BURN RATE of the error
+budget over two windows:
+
+    burn = (bad fraction in window) / (1 - objective)
+
+* burn == 1 means the budget is being consumed exactly at the
+  sustainable rate; burn <= 1 in the fast window is "green";
+* an ALERT requires BOTH windows hot (fast 5 m AND slow 1 h by
+  default, factor `alert_burn`, default 14.4 — the classic page-worthy
+  multi-window rule): the slow window keeps one latency spike from
+  paging, the fast window ends the alert promptly once the bleed
+  stops.
+
+Windows are ring buffers of (good, total) slot counters — O(1) per
+`observe`, O(n_slots) per read, no per-request allocation — so the
+monitor rides the serving envelope without touching a device.  The
+clock is injectable (`clock=`) so tests and the load generator can
+exercise hour-scale windows in microseconds.
+
+`SLO.gauges()` returns the monitor state as flat gauge values; the
+serving engine pushes them into the telemetry registry
+(``slo.<name>.burn_fast`` etc.) where the OpenMetrics exporter picks
+them up.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+
+__all__ = ["WindowedCounts", "SLO"]
+
+
+class WindowedCounts:
+    """Ring buffer of per-slot (good, total) counters covering the
+    trailing `window_s` seconds in `n_slots` slots.  Slots are reset
+    lazily on first write after their slot-id wraps, so an idle stream
+    costs nothing."""
+
+    __slots__ = ("window_s", "n_slots", "slot_w", "_ids", "_good", "_total")
+
+    def __init__(self, window_s: float, n_slots: int = 60):
+        if window_s <= 0 or n_slots < 1:
+            raise ValueError("window_s and n_slots must be positive")
+        self.window_s = float(window_s)
+        self.n_slots = int(n_slots)
+        self.slot_w = self.window_s / self.n_slots
+        self._ids = [-1] * self.n_slots
+        self._good = [0] * self.n_slots
+        self._total = [0] * self.n_slots
+
+    def record(self, good: bool, now: float) -> None:
+        sid = int(now / self.slot_w)
+        i = sid % self.n_slots
+        if self._ids[i] != sid:
+            self._ids[i] = sid
+            self._good[i] = 0
+            self._total[i] = 0
+        self._total[i] += 1
+        if good:
+            self._good[i] += 1
+
+    def totals(self, now: float) -> tuple[int, int]:
+        """(good, total) over slots still inside the window at `now`."""
+        sid = int(now / self.slot_w)
+        good = total = 0
+        for i in range(self.n_slots):
+            if sid - self._ids[i] < self.n_slots and self._ids[i] >= 0:
+                good += self._good[i]
+                total += self._total[i]
+        return good, total
+
+
+class SLO:
+    """One latency objective with a two-window burn-rate monitor."""
+
+    __slots__ = ("name", "kind", "threshold_s", "objective", "alert_burn",
+                 "clock", "fast", "slow")
+
+    def __init__(
+        self,
+        name: str,
+        kind: str = "tick",
+        threshold_s: float = 0.05,
+        objective: float = 0.99,
+        fast_window_s: float = 300.0,
+        slow_window_s: float = 3600.0,
+        alert_burn: float = 14.4,
+        clock=time.monotonic,
+    ):
+        if not 0.0 < objective < 1.0:
+            raise ValueError(f"objective must be in (0, 1), got {objective}")
+        self.name = name
+        self.kind = kind
+        self.threshold_s = float(threshold_s)
+        self.objective = float(objective)
+        self.alert_burn = float(alert_burn)
+        self.clock = clock
+        self.fast = WindowedCounts(fast_window_s)
+        self.slow = WindowedCounts(slow_window_s)
+
+    # -- the hot path ----------------------------------------------------
+
+    def observe(self, latency_s: float, ok: bool, now: float | None = None):
+        """Record one request: `ok` is availability (answered, possibly
+        degraded); a slow-but-answered request still burns budget."""
+        if now is None:
+            now = self.clock()
+        good = ok and latency_s <= self.threshold_s
+        self.fast.record(good, now)
+        self.slow.record(good, now)
+
+    # -- reads -----------------------------------------------------------
+
+    def _burn(self, win: WindowedCounts, now: float) -> tuple[float, int]:
+        good, total = win.totals(now)
+        if total == 0:
+            return 0.0, 0
+        bad_frac = (total - good) / total
+        return bad_frac / (1.0 - self.objective), total
+
+    def status(self, now: float | None = None) -> dict:
+        """Monitor snapshot: burn rates, the multi-window alert, and the
+        headline `green` flag (fast-window burn within budget)."""
+        if now is None:
+            now = self.clock()
+        burn_fast, n_fast = self._burn(self.fast, now)
+        burn_slow, n_slow = self._burn(self.slow, now)
+        return {
+            "name": self.name,
+            "kind": self.kind,
+            "threshold_ms": 1e3 * self.threshold_s,
+            "objective": self.objective,
+            "burn_fast": round(burn_fast, 4),
+            "burn_slow": round(burn_slow, 4),
+            "n_fast": n_fast,
+            "n_slow": n_slow,
+            "green": bool(n_fast > 0 and burn_fast <= 1.0),
+            "alerting": bool(
+                burn_fast > self.alert_burn and burn_slow > self.alert_burn
+            ),
+        }
+
+    def gauges(self, now: float | None = None) -> dict:
+        """Flat gauge dict for the telemetry registry / exporter."""
+        s = self.status(now)
+        p = f"slo.{self.name}."
+        return {
+            p + "burn_fast": s["burn_fast"],
+            p + "burn_slow": s["burn_slow"],
+            p + "green": float(s["green"]),
+            p + "alerting": float(s["alerting"]),
+            p + "objective": self.objective,
+            p + "threshold_s": self.threshold_s,
+        }
+
+    def __repr__(self):
+        s = self.status()
+        state = "ALERT" if s["alerting"] else ("green" if s["green"] else "hot")
+        return (
+            f"SLO({self.name}: p(ok & <= {1e3 * self.threshold_s:g}ms) "
+            f">= {self.objective}, burn {s['burn_fast']:.2f}/"
+            f"{s['burn_slow']:.2f}, {state})"
+        )
+
+
+def _self_check():  # pragma: no cover - debugging aid
+    clk = [0.0]
+    slo = SLO("t", clock=lambda: clk[0])
+    for i in range(1000):
+        clk[0] += 0.1
+        slo.observe(0.001 if i % 100 else 1.0, True)
+    print(slo.status(), math.isfinite(slo.status()["burn_fast"]))
+
+
+if __name__ == "__main__":  # pragma: no cover
+    _self_check()

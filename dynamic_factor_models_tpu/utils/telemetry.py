@@ -43,6 +43,39 @@ heartbeat
     without a host sync on the default path — the default program
     contains no callback at all.
 
+traces
+    ``trace_span(name, seed=...)`` opens one node of a per-request SPAN
+    TREE: the root derives a deterministic ``trace_id`` from the request
+    seed (sha256), children derive ``span_id`` from (trace_id, parent,
+    child index) — so two engines fed the identical request stream
+    produce byte-identical trees.  Spans pair with
+    ``jax.profiler.TraceAnnotation`` (visible in the Perfetto sink via
+    ``DFM_PROFILE_DIR``), and the completed tree is emitted as ONE JSONL
+    line (``entry="trace"``) when the root closes.  ``trace_event``
+    records a zero-duration child (breaker trips, retries, journal
+    appends).  RunRecords opened under an active trace stamp
+    ``trace_id``/``parent_span``, linking e.g. a batched refit's EM-loop
+    record into the requesting span tree.  Disabled path: the shared
+    no-op singleton, same guarantee as ``run_record``.
+
+latency histograms
+    ``register_hist(name, **labels)`` returns a process-registered
+    ``utils.histogram.LatencyHistogram`` — log-bucketed fixed-size int
+    counts, O(1) lock-free increments, mergeable — which the serving
+    engine increments directly per request-kind x outcome.
+    ``emit_histograms()`` snapshots every registered histogram into the
+    JSONL sink (``entry="hist"`` lines; LAST snapshot per key wins —
+    they are cumulative); ``dump_metrics(path)`` writes a standalone
+    metrics JSON; the ``export`` CLI renders either form (or the hist
+    lines of a RunRecord JSONL) as OpenMetrics text exposition.
+
+sink rotation
+    The JSONL sink rotates at ``DFM_TELEMETRY_MAX_MB`` (default 256):
+    when an append pushes the file past the cap it is atomically renamed
+    to ``<path>.1`` (one generation, overwritten on the next rotation)
+    and a fresh file begins — a long load run cannot grow one unbounded
+    file.
+
 Disabled-path guarantee: with neither env var set and no explicit
 ``enable()``, ``run_record`` returns a shared no-op singleton — no
 allocation, no registry traffic, nothing on the EM hot path (pinned by
@@ -54,6 +87,7 @@ renders per-run and per-entry aggregate tables (docs/observability.md).
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import threading
@@ -63,6 +97,8 @@ import uuid
 import numpy as np
 
 import jax
+
+from .histogram import LatencyHistogram, bucket_lower
 
 __all__ = [
     "enabled",
@@ -80,6 +116,17 @@ __all__ = [
     "device_memory_stats",
     "register_jax_monitoring_bridge",
     "heartbeat_every",
+    "trace_span",
+    "trace_span_on",
+    "null_trace",
+    "trace_event",
+    "current_trace",
+    "traces",
+    "register_hist",
+    "histograms",
+    "emit_histograms",
+    "dump_metrics",
+    "export_openmetrics",
     "summarize",
     "main",
 ]
@@ -97,6 +144,11 @@ _gauges: dict[str, float] = {}
 _timers: dict[str, list] = {}
 _records: list[dict] = []
 _MAX_RECORDS = 256
+# latency histograms: (name, sorted-label-items tuple) -> LatencyHistogram
+_hists: dict[tuple, LatencyHistogram] = {}
+# completed span trees (ring buffer, most recent last)
+_traces: list[dict] = []
+_MAX_TRACES = 64
 
 _profile_depth = 0
 _profile_active = False
@@ -183,6 +235,7 @@ def snapshot() -> dict:
                 for k, t in _timers.items()
             },
             "n_records": len(_records),
+            "n_hists": len(_hists),
             "compile": compile_counters(),
             "persistent_cache": persistent_cache_events(),
         }
@@ -196,6 +249,8 @@ def reset() -> None:
         _gauges.clear()
         _timers.clear()
         _records.clear()
+        _hists.clear()
+        _traces.clear()
 
 
 def records() -> list[dict]:
@@ -276,6 +331,277 @@ class _Span:
 
 def span(name: str) -> _Span:
     return _Span(name)
+
+
+# ---------------------------------------------------------------------------
+# trace contexts: deterministic per-request span trees
+# ---------------------------------------------------------------------------
+
+
+def _trace_stack() -> list:
+    s = getattr(_tls, "trace_stack", None)
+    if s is None:
+        s = _tls.trace_stack = []
+    return s
+
+
+def _trace_id_from_seed(seed) -> str:
+    return hashlib.sha256(repr(seed).encode()).hexdigest()[:32]
+
+
+def _span_id(trace_id: str, parent: str, idx: int) -> str:
+    return hashlib.sha256(
+        f"{trace_id}:{parent}:{idx}".encode()
+    ).hexdigest()[:16]
+
+
+class _TraceFrame:
+    __slots__ = ("name", "trace_id", "span_id", "parent_id",
+                 "t0", "t0_unix", "attrs", "n_children", "spans")
+
+    def __init__(self, name, trace_id, span_id, parent_id, attrs, spans):
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.attrs = attrs
+        self.n_children = 0
+        self.spans = spans  # the ROOT's completed-span list (shared)
+        self.t0_unix = time.time()
+        self.t0 = time.perf_counter()
+
+
+class _TraceSpan:
+    """One node of a request span tree (use via `trace_span`)."""
+
+    __slots__ = ("name", "seed", "attrs", "_frame", "_ann")
+
+    def __init__(self, name, seed, attrs):
+        self.name = name
+        self.seed = seed
+        self.attrs = attrs
+
+    def __enter__(self):
+        stack = _trace_stack()
+        if stack:
+            parent = stack[-1]
+            parent.n_children += 1
+            frame = _TraceFrame(
+                self.name, parent.trace_id,
+                _span_id(parent.trace_id, parent.span_id, parent.n_children),
+                parent.span_id, self.attrs, parent.spans,
+            )
+        else:
+            tid = (
+                _trace_id_from_seed(self.seed)
+                if self.seed is not None else uuid.uuid4().hex[:32]
+            )
+            frame = _TraceFrame(
+                self.name, tid, _span_id(tid, "", 0), None, self.attrs, [],
+            )
+        stack.append(frame)
+        self._frame = frame
+        self._ann = jax.profiler.TraceAnnotation(self.name)
+        self._ann.__enter__()
+        return self
+
+    def set(self, **attrs) -> "_TraceSpan":
+        self._frame.attrs.update(attrs)
+        return self
+
+    @property
+    def trace_id(self):
+        return self._frame.trace_id
+
+    @property
+    def span_id(self):
+        return self._frame.span_id
+
+    def __exit__(self, exc_type, exc, tb):
+        self._ann.__exit__(exc_type, exc, tb)
+        frame = self._frame
+        stack = _trace_stack()
+        if frame in stack:
+            stack.remove(frame)
+        sp = {
+            "name": frame.name,
+            "span_id": frame.span_id,
+            "parent": frame.parent_id,
+            "t_unix": round(frame.t0_unix, 6),
+            "dur_s": round(time.perf_counter() - frame.t0, 6),
+        }
+        if frame.attrs:
+            sp["attrs"] = _jsonable(frame.attrs)
+        if exc_type is not None:
+            sp["error"] = f"{exc_type.__name__}: {exc}"
+        frame.spans.append(sp)
+        if frame.parent_id is None:  # root: emit the completed tree
+            data = {
+                "entry": "trace",
+                "trace_id": frame.trace_id,
+                "time_unix": round(frame.t0_unix, 3),
+                "wall_s": sp["dur_s"],
+                "n_spans": len(frame.spans),
+                "spans": frame.spans,
+            }
+            with _lock:
+                _traces.append(data)
+                del _traces[:-_MAX_TRACES]
+            _emit_line(data)
+        return False
+
+
+class _NullTrace:
+    """Disabled-path singleton: nothing allocated, nothing recorded."""
+
+    __slots__ = ()
+
+    trace_id = None
+    span_id = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        return False
+
+    def set(self, **attrs):
+        return self
+
+
+_NULL_TRACE = _NullTrace()
+
+
+def trace_span(name: str, seed=None, **attrs):
+    """Open a span-tree node.  At the root (no enclosing span on this
+    thread), `seed` deterministically derives the trace_id; children
+    derive span ids from (trace_id, parent, child index).  Returns the
+    shared no-op singleton when telemetry is disabled."""
+    if not enabled():
+        return _NULL_TRACE
+    return _TraceSpan(name, seed, dict(attrs))
+
+
+def trace_span_on(name: str, seed=None, **attrs):
+    """`trace_span` WITHOUT the enabled() gate, for hot loops that have
+    already established telemetry is on this request (``enabled()`` costs
+    ~1.6µs of env lookups — real money against the serving envelope's
+    ~20µs budget).  Callers gated off must use ``null_trace()``."""
+    return _TraceSpan(name, seed, dict(attrs))
+
+
+def null_trace():
+    """The shared no-op span (see `trace_span_on`)."""
+    return _NULL_TRACE
+
+
+def trace_event(name: str, **attrs) -> None:
+    """Record a zero-duration child span (breaker trip, retry, journal
+    append) under the current trace; no-op when disabled or when no
+    trace is open on this thread."""
+    if not enabled():
+        return
+    stack = _trace_stack()
+    if not stack:
+        return
+    parent = stack[-1]
+    parent.n_children += 1
+    sp = {
+        "name": name,
+        "span_id": _span_id(parent.trace_id, parent.span_id,
+                            parent.n_children),
+        "parent": parent.span_id,
+        "t_unix": round(time.time(), 6),
+        "dur_s": 0.0,
+    }
+    if attrs:
+        sp["attrs"] = _jsonable(attrs)
+    parent.spans.append(sp)
+
+
+def current_trace():
+    """(trace_id, span_id) of the innermost open span on this thread,
+    or None."""
+    stack = _trace_stack()
+    if not stack:
+        return None
+    return stack[-1].trace_id, stack[-1].span_id
+
+
+def traces() -> list[dict]:
+    """The in-process completed-span-tree ring buffer."""
+    with _lock:
+        return list(_traces)
+
+
+# ---------------------------------------------------------------------------
+# latency histogram registry
+# ---------------------------------------------------------------------------
+
+
+def _hist_key(name: str, labels: dict) -> tuple:
+    return (name, tuple(sorted(labels.items())))
+
+
+def register_hist(name: str, **labels) -> LatencyHistogram:
+    """Get-or-create the process histogram for (name, labels).  Callers
+    keep the returned object and increment it DIRECTLY (`.record(dt)`)
+    — the hot path touches no lock and no registry lookup."""
+    key = _hist_key(name, labels)
+    h = _hists.get(key)
+    if h is None:
+        with _lock:
+            h = _hists.setdefault(key, LatencyHistogram())
+    return h
+
+
+def histograms() -> list[tuple[str, dict, LatencyHistogram]]:
+    """Every registered histogram as (name, labels, hist)."""
+    with _lock:
+        return [(name, dict(lbl), h) for (name, lbl), h in _hists.items()]
+
+
+def emit_histograms() -> int:
+    """Snapshot every non-empty registered histogram into the JSONL sink
+    (one ``entry="hist"`` line each; snapshots are CUMULATIVE, readers
+    keep the last per key).  Returns the number of lines written."""
+    n = 0
+    for name, labels, h in histograms():
+        if h.n == 0:
+            continue
+        _emit_line({
+            "entry": "hist",
+            "time_unix": round(time.time(), 3),
+            "name": name,
+            "labels": labels,
+            "hist": h.to_dict(),
+        })
+        n += 1
+    return n
+
+
+def dump_metrics(path: str) -> None:
+    """Write a standalone metrics JSON (counters, gauges, histograms)
+    for the `export` CLI — the cross-process hand-off in place of a
+    live scrape endpoint."""
+    data = {
+        "version": 1,
+        "time_unix": round(time.time(), 3),
+        "counters": dict(_counters),
+        "gauges": dict(_gauges),
+        "histograms": [
+            {"name": name, "labels": labels, "hist": h.to_dict()}
+            for name, labels, h in histograms()
+            if h.n
+        ],
+    }
+    parent = os.path.dirname(path)
+    if parent:
+        os.makedirs(parent, exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(data, f, indent=1)
+    os.replace(tmp, path)
 
 
 # ---------------------------------------------------------------------------
@@ -422,12 +748,22 @@ def _maybe_stop_profile() -> None:
         _profile_active = False
 
 
-def _emit(data: dict) -> None:
-    with _lock:
-        _records.append(data)
-        del _records[:-_MAX_RECORDS]
-    inc("records." + data.get("entry", "?"))
-    observe("run." + data.get("entry", "?"), data.get("wall_s", 0.0))
+def _sink_max_bytes() -> int:
+    """Size-based rotation cap for the JSONL sink (DFM_TELEMETRY_MAX_MB,
+    default 256; <= 0 disables rotation)."""
+    raw = os.environ.get("DFM_TELEMETRY_MAX_MB", "256") or "256"
+    try:
+        return int(float(raw) * 1e6)
+    except ValueError:
+        return 256_000_000
+
+
+def _emit_line(data: dict) -> None:
+    """Append one JSON line to the sink, rotating the file to
+    ``<path>.1`` (atomic rename, one generation kept) when the append
+    pushes it past the size cap — a long load run never grows one
+    unbounded file.  A broken sink is swallowed: telemetry must never
+    fail the instrumented call."""
     path = sink_path()
     if not path:
         return
@@ -440,8 +776,22 @@ def _emit(data: dict) -> None:
         # children, watcher runs) interleave whole lines, never fragments
         with open(path, "a") as f:
             f.write(line)
+            size = f.tell()
+        cap = _sink_max_bytes()
+        if cap > 0 and size > cap:
+            os.replace(path, path + ".1")
+            inc("telemetry.sink_rotations")
     except OSError:
-        pass  # a broken sink must never fail the estimation itself
+        pass
+
+
+def _emit(data: dict) -> None:
+    with _lock:
+        _records.append(data)
+        del _records[:-_MAX_RECORDS]
+    inc("records." + data.get("entry", "?"))
+    observe("run." + data.get("entry", "?"), data.get("wall_s", 0.0))
+    _emit_line(data)
 
 
 class RunRecord:
@@ -477,6 +827,10 @@ class RunRecord:
         stack = _record_stack()
         if stack:
             self.data.setdefault("parent", stack[-1].data["run_id"])
+        tr = _trace_stack()
+        if tr:  # link this record into the active request span tree
+            self.data.setdefault("trace_id", tr[-1].trace_id)
+            self.data.setdefault("parent_span", tr[-1].span_id)
         stack.append(self)
         self._c0 = counters()
         self._p0 = persistent_cache_events()
@@ -651,9 +1005,59 @@ def _health_str(rec: dict) -> str:
     return s
 
 
+def _latest_hists(recs: list[dict]) -> dict[tuple, LatencyHistogram]:
+    """Rebuild histograms from ``entry="hist"`` snapshot lines: snapshots
+    are cumulative, so the LAST line per (name, labels) wins."""
+    latest: dict[tuple, dict] = {}
+    for r in recs:
+        if r.get("entry") != "hist":
+            continue
+        try:
+            key = (r.get("name", "?"),
+                   tuple(sorted((r.get("labels") or {}).items())))
+            latest[key] = r["hist"]
+        except (TypeError, KeyError):
+            continue
+    out = {}
+    for key, d in latest.items():
+        try:
+            out[key] = LatencyHistogram.from_dict(d)
+        except (TypeError, ValueError, KeyError):
+            continue
+    return out
+
+
+def _kind_latency_rows(hists: dict[tuple, LatencyHistogram]):
+    """Per-request-kind latency table rows from the hist snapshots:
+    merge outcomes within a kind (merge is exact)."""
+    by_kind: dict[str, LatencyHistogram] = {}
+    for (name, lbl), h in hists.items():
+        kind = dict(lbl).get("kind")
+        if kind is None:
+            continue
+        by_kind.setdefault(kind, LatencyHistogram()).merge(h)
+    rows = []
+    for kind, h in sorted(by_kind.items()):
+        p = h.percentiles()
+        rows.append([
+            kind, str(p["n"]),
+            f"{p['p50_ms']:.3f}", f"{p['p99_ms']:.3f}",
+            f"{p['p999_ms']:.3f}", f"{p['max_ms']:.3f}",
+        ])
+    return rows
+
+
 def summarize(path: str, entry: str | None = None) -> str:
-    """Per-run and per-entry aggregate tables of a RunRecord JSONL file."""
+    """Per-run and per-entry aggregate tables of a RunRecord JSONL file,
+    plus (when the file carries ``entry="hist"`` snapshot lines) a
+    per-request-kind latency table sourced from the HDR histograms.
+    Files written before the histogram layer simply lack the extra
+    table and show "-" in the aggregate p50/p99 columns."""
     recs = _load_jsonl(path)
+    hists = _latest_hists(recs)
+    n_traces = sum(1 for r in recs if r.get("entry") == "trace")
+    # trace trees and hist snapshots are structural lines, not runs
+    recs = [r for r in recs if r.get("entry") not in ("trace", "hist")]
     if entry:
         recs = [r for r in recs if r.get("entry") == entry]
     if not recs:
@@ -736,8 +1140,24 @@ def summarize(path: str, entry: str | None = None) -> str:
         h, m = _aot_hm(r)
         a["hits"] += h
         a["misses"] += m
-    arows = [
-        [
+    # per-entry latency from the hist snapshots: merge every histogram
+    # whose `entry` label matches (engine histograms carry entry=serving)
+    ent_hist: dict[str, LatencyHistogram] = {}
+    for (name, lbl), h in hists.items():
+        e = dict(lbl).get("entry", "serving")
+        ent_hist.setdefault(e, LatencyHistogram()).merge(h)
+
+    def _lat(e):
+        h = ent_hist.get(e)
+        if h is None or h.n == 0:
+            return "-", "-"
+        return (f"{1e3 * h.quantile(0.5):.3f}",
+                f"{1e3 * h.quantile(0.99):.3f}")
+
+    arows = []
+    for e, a in sorted(agg.items()):
+        p50, p99 = _lat(e)
+        arows.append([
             e,
             str(a["runs"]),
             str(a["errors"]),
@@ -753,18 +1173,158 @@ def summarize(path: str, entry: str | None = None) -> str:
              if a["faults"] else "-"),
             (f"{100.0 * a['answered'] / a['outcomes']:.1f}%"
              if a["outcomes"] else "-"),
-        ]
-        for e, a in sorted(agg.items())
-    ]
+            p50,
+            p99,
+        ])
     aggregate = _fmt_table(
         ["entry", "runs", "err", "wall_s", "mean_s", "mean_iters",
-         "conv%", "compile_s", "aot h/m", "faults", "avail"],
+         "conv%", "compile_s", "aot h/m", "faults", "avail",
+         "p50_ms", "p99_ms"],
         arows,
     )
-    return (
+    out = (
         f"{len(recs)} record(s) in {path}\n\n{per_run}\n\n"
         f"aggregate by entry\n{aggregate}"
     )
+    lat_rows = _kind_latency_rows(hists)
+    if lat_rows:
+        out += "\n\nrequest latency by kind (HDR histograms)\n" + _fmt_table(
+            ["kind", "n", "p50_ms", "p99_ms", "p99.9_ms", "max_ms"],
+            lat_rows,
+        )
+    if n_traces:
+        out += f"\n\n{n_traces} trace tree(s) (entry=\"trace\" lines)"
+    return out
+
+
+# ---------------------------------------------------------------------------
+# OpenMetrics text exposition
+# ---------------------------------------------------------------------------
+
+
+def _om_escape(v: str) -> str:
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _om_name(name: str) -> str:
+    """Sanitize into an OpenMetrics metric name ([a-zA-Z0-9_:])."""
+    s = "".join(
+        ch if (ch.isascii() and ch.isalnum()) or ch in "_:" else "_"
+        for ch in name
+    )
+    return ("_" + s) if s and s[0].isdigit() else (s or "_")
+
+
+def _om_labelstr(labels: dict) -> str:
+    if not labels:
+        return ""
+    items = ",".join(
+        f'{_om_name(str(k))}="{_om_escape(v)}"'
+        for k, v in sorted(labels.items())
+    )
+    return "{" + items + "}"
+
+
+def _split_inline_labels(name: str) -> tuple[str, dict]:
+    """Parse label-suffixed registry names — the convention counters use
+    for dimensions, e.g. ``serving.breaker.transitions{state="open"}`` —
+    into (base, labels).  Malformed suffixes fall back to a sanitized
+    flat name so the exposition stays parseable."""
+    if not (name.endswith("}") and "{" in name):
+        return name, {}
+    base, _, rest = name.partition("{")
+    labels = {}
+    for part in rest[:-1].split(","):
+        k, eq, v = part.partition("=")
+        if not eq or not k.strip():
+            return name, None  # caller sanitizes
+        labels[k.strip()] = v.strip().strip('"')
+    return base, labels
+
+
+def export_openmetrics(path: str | None = None) -> str:
+    """Render metrics as OpenMetrics text exposition (counters as
+    ``_total``, gauges, histograms as cumulative ``_bucket{le=}`` series
+    plus a ``quantile=``-labelled p50/p99/p99.9 gauge family, ``# EOF``
+    terminated).
+
+    Source: the live in-process registries when `path` is None; a
+    metrics JSON written by :func:`dump_metrics`; or a RunRecord JSONL
+    sink (histograms rebuilt from the last ``entry="hist"`` snapshot per
+    key — counters/gauges are per-run deltas there and are omitted).
+    """
+    counters: dict = {}
+    gauges: dict = {}
+    hists: list = []
+    if path is None:
+        with _lock:
+            counters = dict(_counters)
+            gauges = dict(_gauges)
+        hists = [(n, lbl, h) for n, lbl, h in histograms() if h.n]
+    else:
+        dump = None
+        try:
+            with open(path) as f:
+                dump = json.load(f)
+        except (ValueError, OSError):
+            dump = None
+        if isinstance(dump, dict) and "histograms" in dump:
+            counters = dict(dump.get("counters") or {})
+            gauges = dict(dump.get("gauges") or {})
+            for hrec in dump["histograms"]:
+                try:
+                    hists.append((hrec["name"], dict(hrec.get("labels") or {}),
+                                  LatencyHistogram.from_dict(hrec["hist"])))
+                except (TypeError, KeyError, ValueError):
+                    continue
+        else:
+            for (name, lbl), h in _latest_hists(_load_jsonl(path)).items():
+                hists.append((name, dict(lbl), h))
+
+    lines: list[str] = []
+
+    def _family(raw: dict, mtype: str, suffix: str) -> None:
+        fams: dict[str, list] = {}
+        for name, val in sorted(raw.items()):
+            base, labels = _split_inline_labels(name)
+            if labels is None:
+                base, labels = _om_name(name), {}
+            fams.setdefault(_om_name(base), []).append((labels, val))
+        for fam, series in sorted(fams.items()):
+            lines.append(f"# TYPE {fam} {mtype}")
+            for labels, val in series:
+                lines.append(f"{fam}{suffix}{_om_labelstr(labels)} {val:g}")
+
+    _family(counters, "counter", "_total")
+    _family(gauges, "gauge", "")
+
+    qfams = set()
+    for name, labels, h in hists:
+        fam = _om_name(name) + "_seconds"
+        lines.append(f"# TYPE {fam} histogram")
+        occupied = np.flatnonzero(h.counts)
+        for i in occupied:
+            le = dict(labels, le=f"{bucket_lower(int(i) + 1):.6e}")
+            lines.append(
+                f"{fam}_bucket{_om_labelstr(le)} "
+                f"{h.cumulative_below(int(i) + 1)}"
+            )
+        inf = dict(labels, le="+Inf")
+        lines.append(f"{fam}_bucket{_om_labelstr(inf)} {h.n}")
+        lines.append(f"{fam}_sum{_om_labelstr(labels)} {h.sum_s:.9g}")
+        lines.append(f"{fam}_count{_om_labelstr(labels)} {h.n}")
+        qfam = fam + "_quantile"
+        if qfam not in qfams:
+            qfams.add(qfam)
+            lines.append(f"# TYPE {qfam} gauge")
+        for q in (0.5, 0.99, 0.999):
+            ql = dict(labels, quantile=f"{q:g}")
+            lines.append(
+                f"{qfam}{_om_labelstr(ql)} {h.quantile(q):.9g}"
+            )
+    lines.append("# EOF")
+    return "\n".join(lines) + "\n"
 
 
 def main(argv=None) -> int:
@@ -780,7 +1340,28 @@ def main(argv=None) -> int:
     sm.add_argument("--entry", default=None, help="filter to one entry point")
     sm.add_argument("--json", action="store_true",
                     help="dump the parsed records as a JSON array instead")
+    ex = sub.add_parser(
+        "export", help="OpenMetrics text exposition of metrics"
+    )
+    ex.add_argument(
+        "path", nargs="?", default=None,
+        help="metrics JSON from dump_metrics() or RunRecord .jsonl "
+             "(default: the live in-process registry)",
+    )
+    ex.add_argument("-o", "--output", default=None,
+                    help="write to this file instead of stdout")
     args = ap.parse_args(argv)
+    if args.cmd == "export":
+        if args.path is not None and not os.path.exists(args.path):
+            print(f"no such file: {args.path}")
+            return 1
+        text = export_openmetrics(args.path)
+        if args.output:
+            with open(args.output, "w") as f:
+                f.write(text)
+        else:
+            print(text, end="")
+        return 0
     if not os.path.exists(args.path):
         print(f"no such file: {args.path}")
         return 1

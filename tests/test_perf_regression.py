@@ -489,3 +489,114 @@ def test_derived_kernel_registry_size_is_pinned():
         t_star=16, n_shards=2, em_batch=2, kernels=composed,
     )
     assert len(cc._kernel_plan(full_c)) == 20
+
+
+# ---------------------------------------------------------------------------
+# PR-12 acceptance pins: request observability must be free on-device and
+# within the host envelope bar
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.telemetry
+def test_instrumented_clean_path_hlo_is_byte_identical(tmp_path, monkeypatch):
+    """The request-observability layer (trace spans, HDR histograms, SLO
+    counters) is host-side only: lowering the tick and nowcast programs
+    with telemetry fully live — sink configured, a request span open,
+    histograms populated — must produce byte-identical StableHLO to the
+    uninstrumented lowering.  A deterministic pin, unlike the wall-clock
+    envelope bar below."""
+    from dynamic_factor_models_tpu.serving import engine as _eng
+    from dynamic_factor_models_tpu.serving.online import _nowcast, _tick
+    from dynamic_factor_models_tpu.utils import telemetry as T
+
+    monkeypatch.delenv("DFM_TELEMETRY", raising=False)
+    monkeypatch.delenv("DFM_PROFILE_DIR", raising=False)
+    monkeypatch.setattr(T, "_explicit_enabled", None)
+    monkeypatch.setattr(T, "_explicit_sink", None)
+    assert not T.enabled()
+
+    rng = np.random.default_rng(0)
+    eng = _eng.ServingEngine(max_em_iter=4)
+    eng.register("t", rng.standard_normal((40, 8)))
+    ten = eng._tenants["t"]
+    row = jnp.asarray(rng.standard_normal(8))
+    mask = jnp.ones(8, bool)
+
+    off_tick = _tick.lower(ten.model, ten.state, row, mask).as_text()
+    off_now = _nowcast.lower(ten.model, ten.state.s).as_text()
+
+    monkeypatch.setenv("DFM_TELEMETRY", str(tmp_path / "t.jsonl"))
+    monkeypatch.setattr(T, "_explicit_enabled", None)
+    T.reset()
+    assert T.enabled()
+    assert eng.handle(
+        {"kind": "tick", "tenant": "t", "x": np.asarray(row)}
+    ).ok
+    assert eng.handle({"kind": "nowcast", "tenant": "t"}).ok
+    with T.trace_span("serving.request", seed="hlo-pin", kind="tick"):
+        on_tick = _tick.lower(ten.model, ten.state, row, mask).as_text()
+        on_now = _nowcast.lower(ten.model, ten.state.s).as_text()
+
+    assert on_tick == off_tick
+    assert on_now == off_now
+
+
+@pytest.mark.telemetry
+def test_clean_path_envelope_overhead_within_bar(monkeypatch):
+    """PR-12 acceptance bar: the full request envelope — validation,
+    breaker, histogram + SLO accounting, the single disabled-telemetry
+    probe — costs <= 5% of the bare online_tick wall (device program
+    stubbed, same protocol as bench.py's load/chaos sections).  The
+    fraction is computed per round and the min over rounds taken: the
+    numerator and denominator share each round's machine noise, and the
+    min rejects scheduler spikes."""
+    from dynamic_factor_models_tpu.serving import engine as _eng
+    from dynamic_factor_models_tpu.serving.online import online_tick
+    from dynamic_factor_models_tpu.utils import telemetry as T
+
+    monkeypatch.delenv("DFM_TELEMETRY", raising=False)
+    monkeypatch.delenv("DFM_PROFILE_DIR", raising=False)
+    monkeypatch.setattr(T, "_explicit_enabled", None)
+    monkeypatch.setattr(T, "_explicit_sink", None)
+    assert not T.enabled()
+
+    rng = np.random.default_rng(1)
+    eng = _eng.ServingEngine(max_em_iter=4)
+    eng.register("t", rng.standard_normal((40, 8)))
+    ten = eng._tenants["t"]
+    model, st_pin = ten.model, ten.state
+    n = 1000
+    xr = [rng.standard_normal(8) for _ in range(n)]
+
+    def handle_loop():
+        for i in range(n):
+            eng.handle({"kind": "tick", "tenant": "t", "x": xr[i]})
+
+    def raw_loop():
+        s = st_pin
+        for i in range(n):
+            m = np.isfinite(xr[i])
+            s = online_tick(model, s, np.where(m, xr[i], 0.0), m)
+        return jax.block_until_ready(s)
+
+    raw_loop()
+    handle_loop()  # warm both paths (compiles) before the clock starts
+    real_tick = _eng.online_tick
+    _eng.online_tick = lambda model, state, x, m: st_pin
+    try:
+        fracs = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            raw_loop()
+            wall_r = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            handle_loop()
+            wall_e = time.perf_counter() - t0
+            fracs.append(wall_e / wall_r)
+    finally:
+        _eng.online_tick = real_tick
+    best = min(fracs)
+    assert best < 0.05, (
+        f"clean-path envelope {100 * best:.1f}% of raw tick wall "
+        f"(rounds: {[round(f, 4) for f in fracs]})"
+    )

@@ -1,0 +1,617 @@
+"""Request-scale observability (PR 12): the HDR latency histogram's
+quantile error bound against exact sorts of adversarial samples, merge
+associativity and concatenation-equality, SLO burn-rate monitors under
+an injected clock, deterministic request span trees across the serving
+engine, breaker-transition / journal-replay counters, JSONL sink
+rotation, the summarize latency columns (with the pre-PR-12 "-"
+fallback), the OpenMetrics exporter, and `register_shared`'s
+copy-on-append tenant cloning parity."""
+
+import json
+import math
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from dynamic_factor_models_tpu.models.ssm import SSMParams
+from dynamic_factor_models_tpu.serving import FilterState, ServingEngine
+from dynamic_factor_models_tpu.serving.resilience import CircuitBreaker
+from dynamic_factor_models_tpu.utils import telemetry as T
+from dynamic_factor_models_tpu.utils.histogram import (
+    MIN_S,
+    N_BUCKETS,
+    REL_ERR,
+    LatencyHistogram,
+    bucket_lower,
+)
+from dynamic_factor_models_tpu.utils.slo import SLO, WindowedCounts
+
+pytestmark = pytest.mark.telemetry
+
+
+@pytest.fixture
+def sink(tmp_path, monkeypatch):
+    """Point DFM_TELEMETRY at a fresh JSONL file and clear the registry."""
+    path = str(tmp_path / "runs.jsonl")
+    monkeypatch.setenv("DFM_TELEMETRY", path)
+    monkeypatch.delenv("DFM_PROFILE_DIR", raising=False)
+    monkeypatch.delenv("DFM_TELEMETRY_MAX_MB", raising=False)
+    monkeypatch.setattr(T, "_explicit_enabled", None)
+    monkeypatch.setattr(T, "_explicit_sink", None)
+    T.reset()
+    return path
+
+
+def _recs(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def _exact_quantile(samples, q):
+    """Nearest-rank from a full sort — the oracle `quantile()` is judged
+    against (the same definition histogram.py documents)."""
+    s = np.sort(samples)
+    rank = max(1, math.ceil(q * len(s)))
+    return float(s[rank - 1])
+
+
+def _fill(samples):
+    h = LatencyHistogram()
+    for v in samples:
+        h.record(float(v))
+    return h
+
+
+_QS = (0.5, 0.9, 0.99, 0.999)
+
+
+def _assert_quantiles_bounded(samples):
+    h = _fill(samples)
+    for q in _QS:
+        exact = _exact_quantile(samples, q)
+        est = h.quantile(q)
+        rel = abs(est - exact) / exact
+        assert rel <= REL_ERR * (1 + 1e-9), (
+            f"q={q}: est {est:.6g} vs exact {exact:.6g} "
+            f"(rel {rel:.4f} > bound {REL_ERR:.4f})"
+        )
+
+
+# ---------------------------------------------------------------------------
+# 1. histogram correctness (satellite: quantile bound, merge, edge cases)
+# ---------------------------------------------------------------------------
+
+
+def test_quantile_bound_bimodal():
+    """Two modes three decades apart — the distribution that breaks
+    mean-based summaries and linear-bucket histograms."""
+    rng = np.random.default_rng(0)
+    fast = np.exp(rng.normal(math.log(2e-4), 0.3, size=9000))
+    slow = np.exp(rng.normal(math.log(0.4), 0.2, size=1000))
+    _assert_quantiles_bounded(np.concatenate([fast, slow]))
+
+
+def test_quantile_bound_heavy_tail():
+    """Pareto(alpha=1.2) latencies: p99.9 sits decades above p50."""
+    rng = np.random.default_rng(1)
+    samples = 1e-4 * (1.0 + rng.pareto(1.2, size=20_000))
+    _assert_quantiles_bounded(samples)
+
+
+def test_merge_is_associative_and_equals_concatenation():
+    rng = np.random.default_rng(2)
+    parts = [
+        np.exp(rng.normal(math.log(1e-3), 1.0, size=n))
+        for n in (700, 1, 2500)
+    ]
+    ab_c = LatencyHistogram.merged(
+        [_fill(parts[0]).merge(_fill(parts[1])), _fill(parts[2])]
+    )
+    a_bc = _fill(parts[0]).merge(_fill(parts[1]).merge(_fill(parts[2])))
+    whole = _fill(np.concatenate(parts))
+    for h in (ab_c, a_bc):
+        assert h.counts == whole.counts
+        assert h.n == whole.n
+        assert h.min_s == whole.min_s and h.max_s == whole.max_s
+        assert h.sum_s == pytest.approx(whole.sum_s, rel=1e-12)
+        for q in _QS:
+            assert h.quantile(q) == whole.quantile(q)
+
+
+def test_empty_histogram():
+    h = LatencyHistogram()
+    assert h.n == 0
+    assert math.isnan(h.quantile(0.5))
+    p = h.percentiles()
+    assert p["n"] == 0 and math.isnan(p["p50_ms"])
+    # merging an empty histogram is the identity
+    g = _fill([1e-3]).merge(h)
+    assert g.n == 1 and g.quantile(0.5) == pytest.approx(1e-3)
+
+
+def test_single_sample():
+    h = _fill([3.7e-3])
+    # min/max clamp makes every interior quantile the exact sample
+    for q in (0.0, 0.5, 0.999, 1.0):
+        assert h.quantile(q) == pytest.approx(3.7e-3)
+    assert h.n == 1 and h.min_s == h.max_s == pytest.approx(3.7e-3)
+
+
+def test_out_of_range_clamps_min_max_exact():
+    h = _fill([0.0, 1e-9, 1e7])  # below MIN_S and above the top bucket
+    assert h.counts[0] == 2 and h.counts[N_BUCKETS - 1] == 1
+    assert h.quantile(0.0) == 0.0      # min tracked exactly
+    assert h.quantile(1.0) == 1e7      # max tracked exactly
+    assert h.n == 3
+
+
+def test_dict_roundtrip_is_exact():
+    rng = np.random.default_rng(3)
+    h = _fill(np.exp(rng.normal(math.log(5e-4), 1.5, size=4000)))
+    d = json.loads(json.dumps(h.to_dict()))
+    g = LatencyHistogram.from_dict(d)
+    assert g.counts == h.counts
+    assert (g.n, g.sum_s, g.min_s, g.max_s) == (
+        h.n, h.sum_s, h.min_s, h.max_s
+    )
+    assert d["counts"], "sparse dict should carry only occupied buckets"
+    assert len(d["counts"]) < N_BUCKETS / 2
+
+
+# ---------------------------------------------------------------------------
+# 2. SLO burn-rate monitors under an injected clock
+# ---------------------------------------------------------------------------
+
+
+def _clocked_slo(**kw):
+    clk = [10_000.0]
+    slo = SLO("t", clock=lambda: clk[0], **kw)
+    return clk, slo
+
+
+def test_slo_green_then_alert_then_recovery():
+    clk, slo = _clocked_slo(
+        kind="tick", threshold_s=0.1, objective=0.99
+    )
+    # healthy hour: everything fast
+    for _ in range(600):
+        slo.observe(0.01, True)
+        clk[0] += 1.0
+    s = slo.status()
+    assert s["green"] and not s["alerting"]
+    assert s["burn_fast"] == 0.0 and s["n_fast"] > 0
+
+    # sustained bleed: every request over threshold for >5 minutes —
+    # both windows hot, the multi-window rule pages
+    for _ in range(600):
+        slo.observe(0.5, True)
+        clk[0] += 1.0
+    s = slo.status()
+    assert not s["green"]
+    assert s["burn_fast"] > slo.alert_burn
+    assert s["burn_slow"] > slo.alert_burn
+    assert s["alerting"]
+
+    # bleed stops: the fast window drains in 5 minutes and ends the
+    # alert while the slow window is still hot (the promptness half of
+    # the multi-window rule)
+    for _ in range(400):
+        slo.observe(0.01, True)
+        clk[0] += 1.0
+    s = slo.status()
+    assert s["burn_fast"] == 0.0 and s["burn_slow"] > 1.0
+    assert s["green"] and not s["alerting"]
+
+
+def test_slo_failed_request_burns_budget_even_when_fast():
+    clk, slo = _clocked_slo(threshold_s=1.0, objective=0.5)
+    slo.observe(0.001, False)  # fast but errored
+    assert slo.status()["burn_fast"] == pytest.approx(2.0)
+
+
+def test_slo_empty_windows_are_not_green():
+    _, slo = _clocked_slo()
+    s = slo.status()
+    assert not s["green"] and not s["alerting"] and s["n_fast"] == 0
+
+
+def test_windowed_counts_expire():
+    w = WindowedCounts(window_s=60.0, n_slots=60)
+    w.record(False, now=1000.0)
+    assert w.totals(now=1030.0) == (0, 1)
+    assert w.totals(now=1120.0) == (0, 0)  # slot aged out of the window
+
+
+def test_slo_gauges_shape():
+    clk, slo = _clocked_slo()
+    slo.observe(0.001, True)
+    g = slo.gauges()
+    assert g["slo.t.green"] == 1.0
+    assert g["slo.t.alerting"] == 0.0
+    assert set(g) == {
+        "slo.t.burn_fast", "slo.t.burn_slow", "slo.t.green",
+        "slo.t.alerting", "slo.t.objective", "slo.t.threshold_s",
+    }
+
+
+# ---------------------------------------------------------------------------
+# 3. span trees: determinism, structure, counters
+# ---------------------------------------------------------------------------
+
+
+def _small_engine(store_dir=None, **kw):
+    rng = np.random.default_rng(7)
+    N, r = 6, 2
+    lam = jnp.asarray(rng.standard_normal((N, r)))
+    params = SSMParams(
+        lam, jnp.ones(N), jnp.zeros((1, r, r)).at[0].set(0.5 * jnp.eye(r)),
+        jnp.eye(r),
+    )
+    f = rng.standard_normal((30, r)) * 0.5
+    x = np.asarray(f @ np.asarray(lam).T) + 0.3 * rng.standard_normal((30, N))
+    eng = ServingEngine(store_dir=store_dir, max_em_iter=4, **kw)
+    eng.register("acme", x, params=params)
+    return eng, x
+
+
+def _strip_tree(tr):
+    """A span tree minus wall-clock noise: ids, names, topology, attrs."""
+    return {
+        "trace_id": tr["trace_id"],
+        "n_spans": tr["n_spans"],
+        "spans": [
+            {
+                "name": s["name"],
+                "span_id": s["span_id"],
+                "parent": s["parent"],
+                "attrs": s.get("attrs"),
+            }
+            for s in tr["spans"]
+        ],
+    }
+
+
+def test_trace_trees_are_deterministic(sink, tmp_path):
+    """Identical request streams against fresh engines yield identical
+    span trees — ids and topology, not just shapes."""
+    rng = np.random.default_rng(11)
+    rows = rng.standard_normal((5, 6))
+
+    def run(tag):
+        T.reset()
+        eng, _ = _small_engine(store_dir=str(tmp_path / tag))
+        for i, row in enumerate(rows):
+            resp = eng.handle({
+                "kind": "tick", "tenant": "acme", "x": row,
+                "request_id": f"req-{i}",
+            })
+            assert resp.ok
+        assert eng.handle({"kind": "nowcast", "tenant": "acme"}).ok
+        return [_strip_tree(t) for t in T.traces()]
+
+    a = run("s1")
+    b = run("s2")
+    assert len(a) == 6
+    assert a == b
+    # the trace id is the documented hash of the request id
+    assert a[0]["trace_id"] == T._trace_id_from_seed("req-0")
+
+
+def test_tick_span_tree_structure(sink, tmp_path):
+    """A journaled tick's tree: serving.request root with the
+    write-ahead journal append as a child carrying the commit index."""
+    eng, _ = _small_engine(store_dir=str(tmp_path / "store"))
+    resp = eng.handle({
+        "kind": "tick", "tenant": "acme", "x": np.zeros(6),
+        "request_id": "tick-0",
+    })
+    assert resp.ok
+    (tr,) = T.traces()
+    spans = {s["name"]: s for s in tr["spans"]}
+    root = spans["serving.request"]
+    assert root["parent"] is None
+    assert root["attrs"]["kind"] == "tick"
+    assert root["attrs"]["tenant"] == "acme"
+    child = spans["tick.journal_append"]
+    assert child["parent"] == root["span_id"]
+    assert child["attrs"]["t"] == 30  # panel length = committed index
+    # children finish (and append) before the root
+    assert tr["spans"][-1] is root
+
+
+def test_breaker_transitions_counted_and_traced(sink):
+    br = CircuitBreaker(threshold=2, cooldown=1)
+    with T.trace_span("outer", seed="breaker-test"):
+        br.record_fault()
+        br.record_fault()          # -> open
+        assert br.state == "open"
+        br.on_request()            # cooldown burnt -> half_open
+        assert br.state == "half_open"
+        br.record_success()        # probe succeeded -> closed
+        assert br.state == "closed"
+    c = T.snapshot()["counters"]
+    assert c['serving.breaker.transitions{state="open"}'] == 1
+    assert c['serving.breaker.transitions{state="half_open"}'] == 1
+    assert c['serving.breaker.transitions{state="closed"}'] == 1
+    (tr,) = T.traces()
+    events = [
+        s["attrs"]["state"] for s in tr["spans"]
+        if s["name"] == "breaker.transition"
+    ]
+    assert events == ["open", "half_open", "closed"]
+    assert all(
+        s["parent"] is not None
+        for s in tr["spans"] if s["name"] == "breaker.transition"
+    )
+
+
+def test_refit_bucket_span_carries_membership(sink):
+    eng, _ = _small_engine()
+    assert eng.handle({"kind": "refit", "tenant": "acme"}).ok
+    assert eng.flush_refits().ok
+    buckets = [
+        s for tr in T.traces() for s in tr["spans"]
+        if s["name"] == "refit.bucket"
+    ]
+    (b,) = buckets
+    assert b["attrs"]["tenants"] == ["acme"]
+    assert b["attrs"]["t_pad"] >= 30 and b["attrs"]["n_pad"] >= 6
+
+
+def test_journal_replay_counter(sink, tmp_path):
+    eng, _ = _small_engine(store_dir=str(tmp_path / "store"))
+    for i in range(4):
+        assert eng.handle(
+            {"kind": "tick", "tenant": "acme", "x": np.full(6, 0.1 * i)}
+        ).ok
+    before = T.snapshot()["counters"].get("serving.journal.replayed_ticks", 0)
+    eng2 = ServingEngine(store_dir=str(tmp_path / "store"))
+    assert eng2.resume("acme")
+    after = T.snapshot()["counters"]["serving.journal.replayed_ticks"]
+    assert after - before == 4
+    # the replayed state answers identically to the surviving engine
+    a = eng.handle({"kind": "nowcast", "tenant": "acme"})
+    b = eng2.handle({"kind": "nowcast", "tenant": "acme"})
+    np.testing.assert_allclose(a.result, b.result, atol=1e-10)
+
+
+# ---------------------------------------------------------------------------
+# 4. engine histograms + SLOs on the request path
+# ---------------------------------------------------------------------------
+
+
+def test_engine_populates_histograms_and_slos(sink):
+    slo = SLO("tick_avail", kind="tick", threshold_s=5.0, objective=0.5)
+    eng, _ = _small_engine(slos=[slo])
+    for i in range(20):
+        assert eng.handle(
+            {"kind": "tick", "tenant": "acme", "x": np.full(6, 0.01 * i)}
+        ).ok
+    assert eng.handle({"kind": "nowcast", "tenant": "acme"}).ok
+    bad = eng.handle({"kind": "tick", "tenant": "ghost", "x": np.zeros(6)})
+    assert not bad.ok
+
+    by_key = {
+        (labels["kind"], labels["outcome"]): h
+        for name, labels, h in T.histograms()
+        if name == "serving.request.latency"
+    }
+    assert by_key[("tick", "ok")].n == 20
+    assert by_key[("nowcast", "ok")].n == 1
+    assert by_key[("tick", "client_error")].n == 1
+    assert by_key[("tick", "ok")].quantile(0.5) > 0
+
+    # the unknown-tenant tick burns SLO budget; 1 bad / 21 total is
+    # well inside a 0.5 objective
+    s = slo.status()
+    assert s["n_fast"] == 21 and s["green"]
+
+    n_lines = eng.flush_metrics()
+    assert n_lines == len(by_key)
+    hist_recs = [r for r in _recs(sink) if r["entry"] == "hist"]
+    assert len(hist_recs) == n_lines
+    assert T.snapshot()["gauges"]["slo.tick_avail.green"] == 1.0
+
+
+def test_engine_histogram_increment_is_not_device_bound(sink):
+    """`_observe` must stay O(1) host-side: 50k increments through the
+    engine's accounting path complete in well under a millisecond each
+    (a single device sync costs more)."""
+    import time as _time
+
+    eng, _ = _small_engine()
+    t0 = _time.perf_counter()
+    for _ in range(50_000):
+        eng._observe("tick", "ok", 5e-4, True)
+    dt = _time.perf_counter() - t0
+    assert dt < 1.0, f"50k _observe calls took {dt:.3f}s"
+
+
+# ---------------------------------------------------------------------------
+# 5. sink rotation
+# ---------------------------------------------------------------------------
+
+
+def test_sink_rotates_at_size_cap(sink, monkeypatch):
+    monkeypatch.setenv("DFM_TELEMETRY_MAX_MB", "0.002")  # 2000 bytes
+    for i in range(40):
+        T._emit_line({"entry": "x", "i": i, "pad": "z" * 120})
+    assert os.path.exists(sink + ".1")
+    assert T.snapshot()["counters"]["telemetry.sink_rotations"] >= 1
+    # both generations hold only whole, parseable lines
+    for p in (sink, sink + ".1"):
+        recs = _recs(p)
+        assert recs and all(r["entry"] == "x" for r in recs)
+    # the live file restarted below the cap after the last rotation
+    assert os.path.getsize(sink + ".1") > 2000
+    T._emit_line({"entry": "x", "i": -1})
+    assert _recs(sink)[-1]["i"] == -1
+
+
+def test_sink_rotation_disabled_below_cap(sink, monkeypatch):
+    monkeypatch.setenv("DFM_TELEMETRY_MAX_MB", "0")  # <= 0 disables
+    for i in range(50):
+        T._emit_line({"entry": "x", "i": i, "pad": "z" * 200})
+    assert not os.path.exists(sink + ".1")
+    assert len(_recs(sink)) == 50
+
+
+# ---------------------------------------------------------------------------
+# 6. summarize latency columns + pre-PR-12 fallback
+# ---------------------------------------------------------------------------
+
+
+def test_summarize_shows_latency_columns(sink):
+    eng, _ = _small_engine()
+    for i in range(10):
+        assert eng.handle(
+            {"kind": "tick", "tenant": "acme", "x": np.full(6, 0.1)}
+        ).ok
+    assert eng.handle({"kind": "nowcast", "tenant": "acme"}).ok
+    eng.flush_metrics()
+    out = T.summarize(sink)
+    assert "p50_ms" in out and "p99_ms" in out
+    assert "request latency by kind" in out
+    assert "tick" in out and "nowcast" in out
+    assert "trace tree(s)" in out
+
+
+def test_summarize_pre_pr12_files_fall_back_to_dash(sink, tmp_path):
+    """A sink written before histograms existed (no `hist` lines) must
+    still summarize, with '-' latency columns and no per-kind table."""
+    eng, _ = _small_engine()
+    assert eng.handle(
+        {"kind": "tick", "tenant": "acme", "x": np.zeros(6)}
+    ).ok
+    eng.flush_metrics()
+    old = str(tmp_path / "old.jsonl")
+    with open(sink) as f, open(old, "w") as g:
+        for line in f:
+            if json.loads(line)["entry"] not in ("hist", "trace"):
+                g.write(line)
+    out = T.summarize(old)
+    assert "serving" in out
+    assert "-" in out
+    assert "request latency by kind" not in out
+
+
+# ---------------------------------------------------------------------------
+# 7. OpenMetrics exposition
+# ---------------------------------------------------------------------------
+
+
+def _parse_om_value(text, needle):
+    for line in text.splitlines():
+        if line.startswith(needle):
+            return float(line.rsplit(" ", 1)[1])
+    raise AssertionError(f"no line starting with {needle!r}:\n{text}")
+
+
+def test_openmetrics_from_live_registry(sink):
+    h = T.register_hist(
+        "serving.request.latency", entry="serving", kind="tick",
+        outcome="ok",
+    )
+    for v in (1e-4, 2e-4, 5e-4, 1e-3, 0.02):
+        h.record(v)
+    T.inc("serving.client_errors")
+    T.inc('serving.breaker.transitions{state="open"}', 2)
+    T.gauge_set("slo.tick.green", 1.0)
+    text = T.export_openmetrics()
+    assert text.endswith("# EOF\n")
+    assert "# TYPE serving_request_latency_seconds histogram" in text
+    assert "serving_request_latency_seconds_bucket{" in text
+    assert 'le="+Inf"' in text
+    # the +Inf bucket equals the sample count
+    for line in text.splitlines():
+        if 'le="+Inf"' in line:
+            assert float(line.rsplit(" ", 1)[1]) == 5.0
+    assert _parse_om_value(
+        text, "serving_request_latency_seconds_count"
+    ) == 5.0
+    # label-suffixed registry counters come out as proper OM labels
+    assert 'serving_breaker_transitions_total{state="open"} 2' in text
+    assert _parse_om_value(text, "serving_client_errors_total") == 1.0
+    assert _parse_om_value(text, "slo_tick_green") == 1.0
+    assert 'quantile="0.99"' in text
+
+
+def test_openmetrics_from_jsonl_matches_live(sink, tmp_path):
+    h = T.register_hist("lat", entry="serving", kind="tick", outcome="ok")
+    rng = np.random.default_rng(5)
+    for v in np.exp(rng.normal(math.log(1e-3), 1.0, size=500)):
+        h.record(float(v))
+    T.emit_histograms()
+    # cumulative snapshots: a SECOND emit must not double the export
+    for v in (0.01, 0.02):
+        h.record(v)
+    T.emit_histograms()
+    live = T.export_openmetrics()
+    from_file = T.export_openmetrics(sink)
+    def bucket_lines(text):
+        return sorted(
+            ln for ln in text.splitlines() if "lat_seconds_bucket{" in ln
+        )
+    assert bucket_lines(live) == bucket_lines(from_file)
+    assert _parse_om_value(from_file, "lat_seconds_count") == 502.0
+    assert from_file.endswith("# EOF\n")
+
+
+def test_openmetrics_cli_writes_file(sink, tmp_path, capsys):
+    h = T.register_hist("lat", entry="serving", kind="tick", outcome="ok")
+    h.record(1e-3)
+    T.emit_histograms()
+    out_path = str(tmp_path / "metrics.om")
+    rc = T.main(["export", sink, "-o", out_path])
+    assert rc == 0
+    with open(out_path) as f:
+        text = f.read()
+    assert text.endswith("# EOF\n") and "lat_seconds_bucket{" in text
+
+
+# ---------------------------------------------------------------------------
+# 8. register_shared: clone parity + copy-on-append isolation
+# ---------------------------------------------------------------------------
+
+
+def test_register_shared_matches_fresh_register():
+    rng = np.random.default_rng(21)
+    eng, x = _small_engine()
+    eng.register_shared("clone", "acme")
+    ref = ServingEngine(max_em_iter=4)
+    ref.register("ref", x, params=eng._tenants["acme"].params)
+
+    rows = rng.standard_normal((6, 6))
+    for row in rows:
+        a = eng.handle({"kind": "tick", "tenant": "clone", "x": row})
+        b = ref.handle({"kind": "tick", "tenant": "ref", "x": row})
+        assert a.ok and b.ok
+        np.testing.assert_allclose(
+            np.asarray(a.result.s), np.asarray(b.result.s), atol=1e-12
+        )
+    a = eng.handle({"kind": "nowcast", "tenant": "clone", "horizon": 2})
+    b = ref.handle({"kind": "nowcast", "tenant": "ref", "horizon": 2})
+    np.testing.assert_allclose(a.result, b.result, atol=1e-12)
+
+
+def test_register_shared_history_is_copy_on_append():
+    eng, x = _small_engine()
+    eng.register_shared("clone", "acme")
+    src = eng._tenants["acme"]
+    clone = eng._tenants["clone"]
+    assert clone.hist._x is src.hist._x  # shared until first append
+    n0 = src.hist.n
+    assert eng.handle(
+        {"kind": "tick", "tenant": "clone", "x": np.ones(6)}
+    ).ok
+    assert clone.hist._x is not src.hist._x  # forked on first append
+    assert src.hist.n == n0 and clone.hist.n == n0 + 1
+    # and the fork is two-way: source appends never reach the clone
+    assert eng.handle(
+        {"kind": "tick", "tenant": "acme", "x": 2 * np.ones(6)}
+    ).ok
+    assert clone.hist.n == n0 + 1
+    np.testing.assert_array_equal(clone.hist.x[-1], np.ones(6))
