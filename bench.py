@@ -826,7 +826,7 @@ def serving_section():
         _, n_pad = bucket_shape(T, N)
         precompile(CompileSpec(
             T=T, N=N, r=r, p=p, dtype=str(dt),
-            kernels=(), serving_period=1,
+            kernels=(), serving_period=1, tick_batch=64,
         ))
         model = derive_serving_model(mk_params(), n_pad=n_pad)
         st = FilterState(
@@ -1074,7 +1074,20 @@ def load_section(smoke: bool = False):
     - load_envelope_overhead_frac: instrumented clean-path envelope
       (validation + breaker + telemetry + histogram + trace stamps)
       over the bare online_tick wall, device program stubbed as in
-      chaos_serving_section (bar: < 1.05).
+      chaos_serving_section (bar: < 1.05);
+    - load_eviction_resident_frac: the EVICTION-PRESSURE leg registers
+      100k tenants (200 under --smoke) against a resident budget of 10%
+      and drives locality-skewed tick traffic, so the hot working set
+      stays resident while the cold tail lives in the snapshot+journal
+      store (bar: <= 0.1 + slack for the protected scenario tenant);
+    - load_eviction_batched_vs_sequential_x: ticks/sec through the
+      continuous-batching submit/flush_period path over the same
+      traffic through sequential handle() (bar: >= 1.0 — batching must
+      not lose to the PR 12 sequential baseline).
+
+    The eviction leg also records resident bytes, p99 fault-in latency
+    (HDR histogram + a fault_in SLO), and whole-process `recover()`
+    timing, all nested under ``eviction`` in docs/BENCH_load.json.
 
     Persists docs/BENCH_load.json; prints one JSON line and returns the
     headline dict.
@@ -1086,6 +1099,8 @@ def load_section(smoke: bool = False):
         "load_slo_green_at_low_load": None,
         "load_envelope_us": None,
         "load_envelope_overhead_frac": None,
+        "load_eviction_resident_frac": None,
+        "load_eviction_batched_vs_sequential_x": None,
     }
     out = {"smoke": bool(smoke)}
     try:
@@ -1238,6 +1253,116 @@ def load_section(smoke: bool = False):
             wall_env = _time_fixed_iters(handle_loop)
         finally:
             _eng_mod.online_tick = real_tick
+
+        # -- eviction-pressure leg (PR 13) ------------------------------
+        # 100k registered tenants, resident budget 10%, locality-skewed
+        # traffic: the hot set stays resident, the cold tail faults in
+        # through snapshot + journal replay.  Batched admission
+        # (submit/flush_period) races sequential handle() on identical
+        # traffic shapes; a fresh engine then times whole-process
+        # recover() against the populated store.
+        import shutil
+        import tempfile
+
+        n_ev = 200 if smoke else 100_000
+        ev_budget = max(4, n_ev // 10)
+        n_ev_req = 400 if smoke else 4_000
+        flush_lanes = 64  # submissions coalesced per serving period
+        ev_dir = tempfile.mkdtemp(prefix="dfm-bench-evict-")
+        try:
+            fault_slo = SLO("fault_in_p99_250ms", kind="fault_in",
+                            threshold_s=0.25, objective=0.99)
+            ev_eng = ServingEngine(
+                max_em_iter=5, store_dir=ev_dir,
+                resident_tenants=ev_budget, slos=[fault_slo],
+            )
+            t_reg0 = time.perf_counter()
+            ev_eng.register("e0", panel)
+            for i in range(1, n_ev):
+                ev_eng.register_shared(f"e{i}", "e0")
+            ev_reg_s = time.perf_counter() - t_reg0
+
+            rs = np.random.default_rng(13)
+            hot = max(2, ev_budget // 2)
+
+            def ev_stream(n):
+                ids = np.where(
+                    rs.random(n) < 0.8,
+                    rs.integers(0, hot, size=n),
+                    rs.integers(0, n_ev, size=n),
+                )
+                return [
+                    {"kind": "tick", "tenant": f"e{j}",
+                     "x": rs.standard_normal(N)}
+                    for j in ids
+                ]
+
+            for req in ev_stream(32):  # warm tick + fault-in programs
+                ev_eng.handle(req)
+            for req in ev_stream(flush_lanes):  # warm the batched kernel
+                ev_eng.submit(req)
+            ev_eng.flush_period()
+
+            # both admission paths race the SAME request list — the
+            # fault-in count under an LRU budget is sensitive to the
+            # exact id sequence, so distinct random streams would
+            # measure stream luck, not admission overhead
+            race_reqs = ev_stream(n_ev_req)
+            t_seq = time.perf_counter()
+            for req in race_reqs:
+                ev_eng.handle(req)
+            seq_rps = n_ev_req / (time.perf_counter() - t_seq)
+
+            t_bat = time.perf_counter()
+            for i, req in enumerate(race_reqs):
+                ev_eng.submit(req)
+                if (i + 1) % flush_lanes == 0:
+                    ev_eng.flush_period()
+            ev_eng.flush_period()
+            bat_rps = n_ev_req / (time.perf_counter() - t_bat)
+
+            fi_hist = ev_eng._lat_hists.get(("fault_in", "ok"))
+            resident = len(ev_eng._tenants)
+            resident_bytes = ev_eng._resident_nbytes
+
+            rec_eng = ServingEngine(
+                max_em_iter=5, store_dir=ev_dir,
+                resident_tenants=ev_budget,
+            )
+            rec_info = rec_eng.recover(prewarm=min(ev_budget, 64))
+
+            fields["load_eviction_resident_frac"] = round(
+                resident / n_ev, 4
+            )
+            fields["load_eviction_batched_vs_sequential_x"] = round(
+                bat_rps / seq_rps, 3
+            )
+            out["eviction"] = {
+                "n_tenants": n_ev,
+                "resident_budget": ev_budget,
+                "register_s": round(ev_reg_s, 3),
+                "resident_tenants": resident,
+                "resident_bytes": int(resident_bytes),
+                "sequential_rps": round(seq_rps, 1),
+                "batched_rps": round(bat_rps, 1),
+                "flush_lanes": flush_lanes,
+                "fault_in": (
+                    None if fi_hist is None or fi_hist.n == 0 else {
+                        "n": fi_hist.n,
+                        "p50_ms": round(
+                            1e3 * fi_hist.quantile(0.5), 3),
+                        "p99_ms": round(
+                            1e3 * fi_hist.quantile(0.99), 3),
+                    }
+                ),
+                "fault_in_slo": fault_slo.status(),
+                "recover": {
+                    k: (round(v, 3) if isinstance(v, float) else v)
+                    for k, v in rec_info.items()
+                },
+            }
+        finally:
+            shutil.rmtree(ev_dir, ignore_errors=True)
 
         fields["load_scales"] = [s["n_tenants"] for s in scale_rows]
         fields["load_slo_green_at_low_load"] = bool(green_low)

@@ -36,8 +36,11 @@ from ..utils.telemetry import trace_span
 
 __all__ = [
     "HEALTH_BUCKET_ERROR",
+    "LANE_BUCKETS",
     "RefitRequest",
     "RefitResult",
+    "lane_bucket",
+    "batched_tick_dispatch",
     "refit_batch",
     "refit_sequential",
 ]
@@ -89,6 +92,112 @@ def _group_by_bucket(requests):
         key = bucket_shape(*req.x.shape)
         groups.setdefault(key, []).append(req)
     return groups
+
+
+# ---------------------------------------------------------------------------
+# continuous tick batching: lane grouping + bucket padding
+# ---------------------------------------------------------------------------
+
+# Lane-count compile buckets for the batched tick, mirroring the (T, N)
+# panel buckets: the admitted lane count is padded UP to the nearest
+# bucket so a varying admission queue cycles through a handful of
+# executables instead of compiling per batch size.
+LANE_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024)
+
+
+def lane_bucket(n: int) -> int:
+    """Smallest lane bucket >= n (past the table: next power of two)."""
+    if n < 1:
+        raise ValueError(f"lane count must be >= 1, got {n}")
+    for b in LANE_BUCKETS:
+        if n <= b:
+            return b
+    b = LANE_BUCKETS[-1]
+    while b < n:
+        b *= 2
+    return b
+
+
+def _lane_sig(model, state, x):
+    """Shape/dtype signature two lanes must share to stack: model leaf
+    shapes carry (N, q, k, d), the state carries k, x carries N."""
+    leaves = jax.tree.leaves((model, state))
+    return (
+        tuple((a.shape, str(a.dtype)) for a in leaves),
+        (np.asarray(x).shape, str(np.asarray(x).dtype)),
+    )
+
+
+def batched_tick_dispatch(lanes):
+    """Advance many tenants one tick each in as few vmapped dispatches
+    as possible.
+
+    `lanes` is a list of ``(model, state, x, mask)`` — one admitted tick
+    per tenant (the engine's admission queue guarantees at most one lane
+    per tenant per round).  Lanes are grouped by exact leaf signature,
+    each group stacked along a new leading lane axis, padded to
+    `lane_bucket` with INERT lanes (lane 0's model replicated over a
+    zero state / zero row / all-False mask — vmap carries no cross-lane
+    op, so padding cannot perturb a real lane; the real-lane outputs are
+    pinned bit-identical to sequential `_tick` calls by
+    tests/test_eviction.py), and dispatched through ONE
+    `online_tick_batched` per group.  Returns the new FilterStates in
+    input order.  Pure compute: no journal, no commit — the engine owns
+    the write-ahead ordering around this call."""
+    from .online import FilterState, online_tick_batched
+
+    if not lanes:
+        return []
+    groups: dict[tuple, list[int]] = {}
+    for i, (model, state, x, _mask) in enumerate(lanes):
+        groups.setdefault(_lane_sig(model, state, x), []).append(i)
+    out: list = [None] * len(lanes)
+    for idxs in groups.values():
+        n = len(idxs)
+        bucket = lane_bucket(n)
+        models = [lanes[i][0] for i in idxs]
+        states = [lanes[i][1] for i in idxs]
+        xs = [np.asarray(lanes[i][2]) for i in idxs]
+        masks = [np.asarray(lanes[i][3], bool) for i in idxs]
+        if bucket > n:  # inert padding lanes
+            pad = bucket - n
+            s0 = np.asarray(states[0].s)
+            zs = FilterState(
+                s=np.zeros_like(s0),
+                t=np.zeros((), np.asarray(states[0].t).dtype),
+            )
+            states += [zs] * pad
+            xs += [np.zeros_like(xs[0])] * pad
+            masks += [np.zeros_like(masks[0])] * pad
+        # register_shared clones carry the SAME model object — stack it
+        # as one broadcast per leaf instead of a B-way concatenation
+        # (padding lanes replicate lane 0's model either way)
+        if all(m is models[0] for m in models[1:]):
+            model_B = jax.tree.map(
+                lambda a: jnp.broadcast_to(a, (bucket,) + a.shape),
+                models[0],
+            )
+        else:
+            models += [models[0]] * (bucket - n)
+            model_B = jax.tree.map(lambda *ls: jnp.stack(ls), *models)
+        # states/rows stack on HOST (np): per-flush glue must not cost
+        # a device dispatch per lane or batching loses to sequential
+        state_B = jax.tree.map(
+            lambda *ls: np.stack([np.asarray(a) for a in ls]), *states
+        )
+        with trace_span(
+            "tick.batch", lanes=n, bucket=bucket,
+        ):
+            new_B = online_tick_batched(
+                model_B, state_B, np.stack(xs), np.stack(masks)
+            )
+        # materialize once, hand out zero-copy numpy row views — the
+        # same floats the device produced, so per-lane bit-identity to
+        # sequential ticks is preserved through the unstack
+        new_np = jax.tree.map(np.asarray, new_B)
+        for j, i in enumerate(idxs):
+            out[i] = jax.tree.map(lambda a, j=j: a[j], new_np)
+    return out
 
 
 def refit_batch(

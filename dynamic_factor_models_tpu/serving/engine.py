@@ -49,10 +49,12 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import re as _re
 import sys
 import time
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -64,6 +66,7 @@ from ..utils.telemetry import (
     _NULL_RECORD,
     _NULL_TRACE,
     emit_histograms,
+    emit_metrics,
     gauge_set,
     inc,
     register_hist,
@@ -71,7 +74,7 @@ from ..utils.telemetry import (
     trace_span,
     trace_span_on,
 )
-from .batch import RefitRequest, refit_batch
+from .batch import RefitRequest, batched_tick_dispatch, refit_batch
 from .online import (
     FilterState,
     derive_serving_model,
@@ -166,6 +169,7 @@ class _History:
 class _Tenant:
     __slots__ = (
         "hist", "params", "model", "state", "breaker", "replay", "suspect",
+        "dirty", "breaker_saved", "nbytes", "journal",
     )
 
     def __init__(self, hist, params, model, state, breaker):
@@ -176,10 +180,41 @@ class _Tenant:
         self.breaker = breaker  # CircuitBreaker
         self.replay = []        # [(x_row, mask_row)] failed-tick rows
         self.suspect = False    # force a deep finite check on next tick
+        self.dirty = 0          # journaled ticks since the last snapshot
+        self.breaker_saved = None  # packed breaker at last snapshot
+        self.nbytes = 0         # resident-bytes accounting (upper bound)
+        self.journal = None     # cached TickJournal (built on first use)
+
+
+def _tenant_nbytes(ten: _Tenant) -> int:
+    """Upper-bound resident-bytes accounting: the array leaves of
+    params / model / state plus a PRIVATE history's live buffers.
+    Clones from `register_shared` count their shared fit leaves once
+    per clone — the budget is a conservative ceiling, not an
+    allocator.  `.nbytes` is shape metadata on both numpy and jax
+    arrays: no device transfer happens here."""
+    n = 0
+    for leaf in jax.tree.leaves((ten.params, ten.model, ten.state)):
+        n += int(getattr(leaf, "nbytes", 0))
+    h = ten.hist
+    if h is not None and not h._shared:
+        n += h._x.nbytes + h._mask.nbytes
+    return n
 
 
 class ServingEngine:
-    """Single-process, synchronous multi-tenant serving driver."""
+    """Single-process, synchronous multi-tenant serving driver.
+
+    Memory is BOUNDED when a resident budget is set (`resident_tenants`
+    / `resident_bytes`, env ``DFM_RESIDENT_TENANTS`` /
+    ``DFM_RESIDENT_BYTES``): the tenant table is kept in LRU order and
+    cold tenants are EVICTED through the snapshot + write-ahead-journal
+    path, then faulted back in on next touch by replaying the journal
+    through the same tick executable — bit-identical to never having
+    been evicted (tests/test_eviction.py).  Eviction drops a tenant's
+    in-memory panel history: a faulted-in tenant serves ticks and
+    nowcasts normally but answers ``no_history`` to refit/scenario until
+    re-registered with a panel (exactly the crash-restart contract)."""
 
     def __init__(
         self,
@@ -192,6 +227,8 @@ class ServingEngine:
         breaker_cooldown: int = 4,
         max_refit_retries: int = 2,
         slos=None,
+        resident_tenants: int | None = None,
+        resident_bytes: int | None = None,
     ):
         self.store = TenantStore(store_dir) if store_dir else None
         self.tol = tol
@@ -202,7 +239,36 @@ class ServingEngine:
         self.breaker_cooldown = breaker_cooldown
         self.max_refit_retries = max_refit_retries
         self.slos = list(slos or [])  # utils.slo.SLO monitors, by kind
-        self._tenants: dict[str, _Tenant] = {}
+        if resident_tenants is None:
+            env = os.environ.get("DFM_RESIDENT_TENANTS")
+            resident_tenants = int(env) if env else None
+        if resident_bytes is None:
+            env = os.environ.get("DFM_RESIDENT_BYTES")
+            resident_bytes = int(env) if env else None
+        if resident_tenants is not None and resident_tenants < 1:
+            raise ValueError("resident_tenants must be >= 1")
+        if resident_bytes is not None and resident_bytes < 1:
+            raise ValueError("resident_bytes must be >= 1")
+        self.resident_tenants = resident_tenants
+        self.resident_bytes = resident_bytes
+        self._budget_on = (
+            resident_tenants is not None or resident_bytes is not None
+        )
+        if self._budget_on and self.store is None:
+            raise ValueError(
+                "a resident budget requires store_dir: eviction demotes "
+                "cold tenants to the snapshot + journal store"
+            )
+        self._tenants: dict[str, _Tenant] = {}  # insertion order == LRU
+        self._resident_nbytes = 0
+        self._tick_queue: list = []  # (req, Deadline, t_submit)
+        # tenants of the in-flight batched round, pinned against BUDGET
+        # eviction: faulting in lane k must not evict lane j's tenant
+        # mid-round (j < k) — the re-fault would both thrash the store
+        # and commit lane j's tick onto an orphaned object.  The budget
+        # may transiently overshoot by at most one round's lane width;
+        # flush_period re-enforces it after every round.
+        self._admission_pin: set[str] = set()
         self._refit_queue: list[str] = []
         self._refit_retries: dict[str, int] = {}
         self._requests = 0  # admission counter (slow_req/engine_crash sites)
@@ -236,14 +302,21 @@ class ServingEngine:
         refilter — what makes 1k-100k synthetic tenants registrable in
         seconds for `bench.py --load`.  Ticks/nowcasts/refits/scenarios
         behave exactly as after `register()` with the same panel."""
-        src = self._tenants[like]
+        src = self._lookup(like)
+        if src is None:
+            raise KeyError(like)
         state = FilterState(s=src.state.s, t=src.state.t)
-        self._persist(tenant_id, src.params, state)
-        self._tenants[tenant_id] = _Tenant(
-            None if src.hist is None else _History.share(src.hist),
-            src.params, src.model, state,
-            CircuitBreaker(self.breaker_threshold, self.breaker_cooldown),
+        breaker = CircuitBreaker(
+            self.breaker_threshold, self.breaker_cooldown
         )
+        self._persist(tenant_id, src.params, state, breaker)
+        ten = _Tenant(
+            None if src.hist is None else _History.share(src.hist),
+            src.params, src.model, state, breaker,
+        )
+        if self.store is not None:
+            ten.breaker_saved = breaker.pack()
+        self._account_insert(tenant_id, ten)
 
     def _install(self, tenant_id, xz, mask, params) -> None:
         """(Re)derive a tenant's serving constants from `params` and its
@@ -258,20 +331,36 @@ class ServingEngine:
             s=jnp.asarray(filt.means[-1]),
             t=jnp.asarray(xz.shape[0], jnp.int32),
         )
-        self._persist(tenant_id, params, state)
         prev = self._tenants.get(tenant_id)
         breaker = prev.breaker if prev is not None else CircuitBreaker(
             self.breaker_threshold, self.breaker_cooldown
         )
-        self._tenants[tenant_id] = _Tenant(
-            _History(xz, mask), params, model, state, breaker
-        )
+        self._persist(tenant_id, params, state, breaker)
+        ten = _Tenant(_History(xz, mask), params, model, state, breaker)
+        if self.store is not None:
+            ten.breaker_saved = breaker.pack()
+        self._account_insert(tenant_id, ten)
 
-    def _persist(self, tenant_id, params, state) -> int:
-        """Snapshot + journal reset, retried on transient I/O faults.
-        Returns the retry count consumed (0 without a store)."""
+    def _persist(self, tenant_id, params, state, breaker=None) -> int:
+        """Snapshot (fsynced, atomic) + journal truncation, retried on
+        transient I/O faults.  Returns the retry count consumed (0
+        without a store).
+
+        ORDERING INVARIANT: the snapshot is durable on disk BEFORE the
+        journal is truncated — never the reverse.  A crash between the
+        two leaves a STALE journal (anchored at a t older than the new
+        snapshot) whose rows are already folded into the snapshot; the
+        fault-in path skips it (satellite regression in
+        tests/test_eviction.py).  The truncation is skipped entirely
+        when no journal file exists yet — `TickJournal.append` creates
+        its header lazily at the snapshot's own t, so a mass
+        registration never touches a journal file."""
         if self.store is None:
             return 0
+        packed = (
+            breaker.pack() if breaker is not None
+            else np.zeros((3,), np.int32)
+        )
 
         def _save():
             self.store.save(
@@ -282,9 +371,12 @@ class ServingEngine:
                     t=state.t,
                     r=jnp.asarray(params.r, jnp.int32),
                     p=jnp.asarray(params.p, jnp.int32),
+                    breaker=jnp.asarray(packed),
                 ),
             )
-            self.store.journal(tenant_id).reset(int(state.t))
+            journal = self.store.journal(tenant_id)
+            if journal.exists():
+                journal.reset(int(state.t))
 
         _, retries = call_with_retries(
             _save, self.retry_policy, key=f"{tenant_id}:install"
@@ -292,7 +384,176 @@ class ServingEngine:
         return retries
 
     def tenant_ids(self) -> list[str]:
+        """Sorted ids of RESIDENT tenants (evicted tenants live in the
+        store only — `store.list()` enumerates everything on disk)."""
         return sorted(self._tenants)
+
+    # -- resident-set management (LRU eviction / fault-in) ---------------
+
+    def _resident_gauges(self) -> None:
+        gauge_set("serving.resident_tenants", len(self._tenants))
+        gauge_set("serving.resident_bytes", self._resident_nbytes)
+
+    def _account_insert(self, tenant_id: str, ten: _Tenant) -> None:
+        """Install `ten` as the MOST-RECENT entry, maintain the byte
+        accounting, and enforce the resident budget (never evicting the
+        tenant just inserted)."""
+        prev = self._tenants.pop(tenant_id, None)
+        if prev is not None:
+            self._resident_nbytes -= prev.nbytes
+        ten.nbytes = _tenant_nbytes(ten)
+        self._tenants[tenant_id] = ten
+        self._resident_nbytes += ten.nbytes
+        self._enforce_budget(protect=tenant_id)
+        self._resident_gauges()
+
+    def _lookup(self, tenant_id):
+        """Resident-set accessor: returns the tenant, faulting it back
+        in from the store when evicted, None when unknown there too.
+        Under an active budget a hit refreshes LRU recency (one dict
+        pop / re-insert, O(1)); without a budget this is exactly the
+        old single dict probe, keeping the clean-path host envelope
+        intact (tests/test_perf_regression.py)."""
+        ten = self._tenants.get(tenant_id)
+        if ten is not None:
+            if self._budget_on:
+                del self._tenants[tenant_id]
+                self._tenants[tenant_id] = ten
+            return ten
+        if self.store is not None:
+            return self._fault_in(tenant_id)
+        return None
+
+    def _enforce_budget(self, protect: str | None = None) -> int:
+        """Evict coldest-first until both budgets are satisfied (or
+        nothing further is evictable — e.g. every candidate is pinned
+        by a non-empty replay buffer).  Returns evictions performed."""
+        if not self._budget_on:
+            return 0
+        evicted = 0
+        while (
+            self.resident_tenants is not None
+            and len(self._tenants) > self.resident_tenants
+        ) or (
+            self.resident_bytes is not None
+            and self._resident_nbytes > self.resident_bytes
+        ):
+            if not self._evict_coldest(protect):
+                break
+            evicted += 1
+        return evicted
+
+    def _evict_coldest(self, protect: str | None = None) -> bool:
+        # fast path: the LRU head is evictable (the common case) — O(1)
+        pin = self._admission_pin
+        first = next(iter(self._tenants), None)
+        if (
+            first is not None and first != protect and first not in pin
+            and self.evict(first)
+        ):
+            return True
+        # slow path: scan for the coldest evictable tenant
+        for tid in list(self._tenants):
+            if tid == protect or tid == first or tid in pin:
+                continue
+            if self.evict(tid):
+                return True
+        return False
+
+    def evict(self, tenant_id: str) -> bool:
+        """Demote a resident tenant to the store and free its memory.
+
+        Returns False when the tenant is not resident, there is no
+        store, the tenant is PINNED (a non-empty replay buffer exists
+        only in memory — evicting would drop acknowledged degradation
+        state), or the snapshot write keeps failing.  A CLEAN tenant —
+        zero journaled ticks and an unchanged breaker since its last
+        snapshot — evicts with ZERO I/O: the write-ahead invariant
+        already guarantees disk reproduces memory."""
+        ten = self._tenants.get(tenant_id)
+        if ten is None or self.store is None:
+            return False
+        if ten.replay:
+            inc("serving.evict.pinned")
+            return False
+        packed = ten.breaker.pack()
+        clean = ten.dirty == 0 and (
+            ten.breaker_saved is not None
+            and np.array_equal(packed, ten.breaker_saved)
+        )
+        if not clean:
+            try:
+                self._persist(tenant_id, ten.params, ten.state, ten.breaker)
+            except OSError:
+                inc("serving.evict.failures")
+                return False
+        del self._tenants[tenant_id]
+        self._resident_nbytes -= ten.nbytes
+        inc("serving.evictions")
+        self._resident_gauges()
+        return True
+
+    def _fault_in(self, tenant_id: str, defer_replay: bool = False):
+        """Re-admit an evicted (or restart-orphaned) tenant from its
+        snapshot + write-ahead journal.
+
+        Read-only except for stale-journal cleanup; the replay runs
+        every journaled row through the SAME tick executable the live
+        path used, so the faulted-in FilterState is bit-identical to
+        the never-evicted one (pinned by tests/test_eviction.py).  The
+        circuit breaker is RESTORED from its packed snapshot leaf — an
+        open breaker stays open across eviction.  Returns None when
+        the store has no intact, consistent state for the id; with
+        `defer_replay=True` returns ``(tenant, journal_rows)`` and
+        leaves the rows un-applied (recover()'s concurrent replay)."""
+        t0 = time.perf_counter()
+        stored = self.store.load(tenant_id, template_state(1, 1, 1))
+        if stored is None:
+            return None
+        params = stored.params
+        r, p = int(stored.r), int(stored.p)
+        if params.lam.shape[1] != r or params.A.shape[0] != p:
+            inc("serving.store.inconsistent")
+            return None
+        try:
+            model = derive_serving_model(params)
+        except ValueError:
+            inc("serving.store.inconsistent")
+            return None
+        state = FilterState(
+            s=jnp.asarray(stored.s), t=jnp.asarray(stored.t, jnp.int32)
+        )
+        journal = self.store.journal(tenant_id)
+        rows = []
+        rep = journal.replay()
+        if rep is not None:
+            base_t, jrows = rep
+            if base_t == int(stored.t):
+                rows = jrows
+            else:
+                # a journal anchored below the snapshot's t is STALE:
+                # the crash landed between the snapshot save and the
+                # journal truncate, so every row is already folded into
+                # the fsynced snapshot.  Skip it — never quarantine (the
+                # file is intact, just superseded) — and delete it so
+                # the next append re-anchors its header at the
+                # snapshot's own t.
+                if base_t < int(stored.t):
+                    inc("serving.journal.stale_skipped")
+                else:  # cannot happen under the persist ordering
+                    inc("serving.store.inconsistent")
+                journal.delete()
+        breaker = CircuitBreaker.from_packed(
+            self.breaker_threshold, self.breaker_cooldown, stored.breaker
+        )
+        ten = _Tenant(None, params, model, state, breaker)
+        ten.breaker_saved = breaker.pack()
+        if rows and not defer_replay:
+            ten.state = replay_ticks(model, state, rows)
+        self._account_insert(tenant_id, ten)
+        inc("serving.fault_ins")
+        self._observe("fault_in", "ok", time.perf_counter() - t0, True)
+        return (ten, rows) if defer_replay else ten
 
     # -- request routing -------------------------------------------------
 
@@ -393,13 +654,17 @@ class ServingEngine:
                     slo.observe(latency_s, ok)
 
     def flush_metrics(self) -> int:
-        """Push SLO burn-rate gauges into the telemetry registry and
-        snapshot the latency histograms into the JSONL sink (when one is
-        active).  Called every 1024th request automatically; call
-        explicitly at the end of a run to flush the tail."""
+        """Push SLO burn-rate gauges and the resident-set gauges into
+        the telemetry registry, then snapshot one ``entry="metrics"``
+        counters/gauges line plus the latency histograms into the JSONL
+        sink (when one is active).  Called every 1024th request
+        automatically; call explicitly at the end of a run to flush the
+        tail."""
         for slo in self.slos:
             for name, val in slo.gauges().items():
                 gauge_set(name, val)
+        self._resident_gauges()
+        emit_metrics()
         return emit_histograms()
 
     def _dispatch(self, req, kind, tenant_id, reqno) -> Response:
@@ -427,12 +692,12 @@ class ServingEngine:
                 kind, None, "missing_field",
                 "request is missing 'tenant'", field="tenant",
             )
-        if tenant_id not in self._tenants:
+        ten = self._lookup(tenant_id)
+        if ten is None:
             return self._client_err(
                 kind, tenant_id, "unknown_tenant",
                 f"unknown tenant {tenant_id!r}", field="tenant",
             )
-        ten = self._tenants[tenant_id]
         deadline = Deadline(req.get("deadline_s", self.deadline_s))
         if _faults.site_hits("slow_req", reqno):
             _faults.fault_fired("slow_req")
@@ -490,23 +755,27 @@ class ServingEngine:
 
     # -- tick ------------------------------------------------------------
 
-    def _tick(self, tenant_id, ten, req, deadline, bstate) -> Response:
+    def _parse_tick_row(self, tenant_id, ten, req):
+        """Validate a tick request's x/mask against the tenant's series
+        dimension; returns ``(row, None)`` on success or ``(None,
+        Response)`` carrying the client error — one shared path for the
+        sequential `_tick` and the batched `flush_period`."""
         # validation: name the offending field, never a raw KeyError
         if "x" not in req:
-            return self._client_err(
+            return None, self._client_err(
                 "tick", tenant_id, "missing_field",
                 "tick request is missing 'x'", field="x",
             )
         try:
             x_t = np.asarray(req["x"], float)
         except (TypeError, ValueError):
-            return self._client_err(
+            return None, self._client_err(
                 "tick", tenant_id, "bad_value",
                 "'x' is not convertible to a float array", field="x",
             )
         N = ten.model.Wb.shape[0]
         if x_t.shape != (N,):
-            return self._client_err(
+            return None, self._client_err(
                 "tick", tenant_id, "bad_shape",
                 f"'x' must have shape ({N},), got {x_t.shape}", field="x",
             )
@@ -516,18 +785,23 @@ class ServingEngine:
             try:
                 mask_t = np.asarray(req["mask"], bool)
             except (TypeError, ValueError):
-                return self._client_err(
+                return None, self._client_err(
                     "tick", tenant_id, "bad_value",
                     "'mask' is not convertible to a bool array",
                     field="mask",
                 )
             if mask_t.shape != (N,):
-                return self._client_err(
+                return None, self._client_err(
                     "tick", tenant_id, "bad_shape",
                     f"'mask' must have shape ({N},), got {mask_t.shape}",
                     field="mask",
                 )
-        row = (np.where(mask_t, x_t, 0.0), mask_t)
+        return (np.where(mask_t, x_t, 0.0), mask_t), None
+
+    def _tick(self, tenant_id, ten, req, deadline, bstate) -> Response:
+        row, err = self._parse_tick_row(tenant_id, ten, req)
+        if err is not None:
+            return err
 
         if bstate == BREAKER_OPEN:
             ten.replay.append(row)
@@ -617,7 +891,9 @@ class ServingEngine:
         # write-ahead: the journal append is the commit point
         retries = 0
         if self.store is not None:
-            journal = self.store.journal(tenant_id)
+            journal = ten.journal
+            if journal is None:
+                journal = ten.journal = self.store.journal(tenant_id)
             t_idx = int(ten.state.t)
             try:
                 with trace_span("tick.journal_append", t=t_idx):
@@ -640,6 +916,7 @@ class ServingEngine:
                 )
 
         ten.state = new_state
+        ten.dirty += 1  # this tick lives in the journal, not the snapshot
         if deep:
             ten.suspect = False  # committed state re-verified on host
         if ten.hist is not None:
@@ -679,6 +956,7 @@ class ServingEngine:
                         )
                     state = online_tick(ten.model, state, x_row, m_row)
                 ten.state = state
+                ten.dirty += len(rows)
         except OSError:
             ten.replay = rows + ten.replay  # keep the rows for next try
             raise
@@ -795,8 +1073,11 @@ class ServingEngine:
             )
         reqs = []
         for tid in queue:
-            ten = self._tenants[tid]
-            if ten.hist is None:  # panel-less: nothing to refit against
+            ten = self._tenants.get(tid)
+            if ten is None or ten.hist is None:
+                # panel-less (nothing to refit against) or evicted while
+                # queued (an evicted tenant faults back panel-less — its
+                # refit would be a no-op anyway)
                 self._refit_retries.pop(tid, None)
                 continue
             reqs.append(RefitRequest(
@@ -814,7 +1095,10 @@ class ServingEngine:
             )
             installed, requeued, permanent = 0, [], []
             for res in results:
-                ten = self._tenants[res.tenant_id]
+                ten = self._tenants.get(res.tenant_id)
+                if ten is None:  # evicted mid-flush by budget pressure
+                    self._refit_retries.pop(res.tenant_id, None)
+                    continue
                 ok = res.health == 0
                 if ok:
                     try:
@@ -855,7 +1139,352 @@ class ServingEngine:
                   "permanent_failures": permanent},
         )
 
+    # -- continuous tick batching ----------------------------------------
+
+    def submit(self, req) -> int:
+        """Admit one TICK request into the continuous-batching queue;
+        returns the queue depth after admission.
+
+        Ticks submitted here are coalesced across tenants and executed
+        by `flush_period()` — one vmapped constant-gain dispatch per
+        lane-shape group per round — with write-ahead / exactly-once
+        guarantees identical to `handle()`'s sequential path (journal
+        appends, in admission order, are the per-lane commit points).
+        Non-tick kinds are answered at flush time with a typed
+        ``unbatchable_kind`` client error rather than silently dropped.
+        Admission shares `handle()`'s fault sites: ``engine_crash`` and
+        ``slow_req`` fire against the same request counter."""
+        self._requests += 1
+        reqno = self._requests
+        if _faults.site_hits("engine_crash", reqno):
+            _faults.fault_fired("engine_crash")
+            raise _faults.SimulatedCrash(
+                f"injected engine_crash at request {reqno}"
+            )
+        budget = (
+            req.get("deadline_s", self.deadline_s)
+            if isinstance(req, dict) else self.deadline_s
+        )
+        deadline = Deadline(budget)
+        if _faults.site_hits("slow_req", reqno):
+            _faults.fault_fired("slow_req")
+            deadline.expire()
+        self._tick_queue.append((req, deadline, time.perf_counter()))
+        return len(self._tick_queue)
+
+    def flush_period(self) -> list:
+        """Execute the admission queue as ONE serving period.
+
+        Each ROUND takes at most one queued tick per tenant (per-tenant
+        FIFO order preserved), batches the survivors into one vmapped
+        dispatch per lane-shape group — padded to a compile bucket with
+        inert lanes (serving/batch.py) — and returns one typed Response
+        per submitted request, in submission order.
+
+        Exactly-once: every surviving lane's journal append (fsynced,
+        admission order) completes BEFORE any lane of the round commits
+        in memory.  A kill between the two replays the journaled ticks
+        on restart, while un-appended lanes never happened and their
+        callers were never acked — no tick is double-applied or
+        dropped.  One tenant's failure (tick_nan poison, journal
+        OSError) freezes only its own lane."""
+        entries, self._tick_queue = self._tick_queue, []
+        if not entries:
+            return []
+        responses: list = [None] * len(entries)
+        with run_record(
+            "serving", kind="tick_flush",
+            config={"n_lanes": len(entries)},
+        ) as rec:
+            pending = list(range(len(entries)))
+            rounds = 0
+            while pending:
+                rounds += 1
+                seen, now_round, later = set(), [], []
+                for qi in pending:
+                    req = entries[qi][0]
+                    tid = (
+                        req.get("tenant") if isinstance(req, dict) else None
+                    )
+                    if isinstance(tid, str) and tid in seen:
+                        later.append(qi)  # same tenant again: next round
+                        continue
+                    if isinstance(tid, str):
+                        seen.add(tid)
+                    now_round.append(qi)
+                self._flush_round(entries, now_round, responses)
+                pending = later
+            inc("serving.batch.flushes")
+            ok_n = sum(1 for r in responses if r is not None and r.ok)
+            if rec is not _NULL_RECORD:
+                rec.set(
+                    outcome="ok" if ok_n == len(responses) else "partial",
+                    n_lanes=len(entries), n_rounds=rounds, n_ok=ok_n,
+                    breaker_state="closed",
+                )
+        now = time.perf_counter()
+        for (req, _dl, t_sub), resp in zip(entries, responses):
+            outcome = (
+                ("degraded" if resp.degraded else "ok")
+                if resp.ok else resp.error.category
+            )
+            self._observe("tick", outcome, now - t_sub, resp.ok)
+        return responses
+
+    def _flush_round(self, entries, idxs, responses) -> None:
+        """One batched round: validate/admit each lane sequentially in
+        admission order, run ONE batched dispatch for the survivors,
+        then journal-append every lane (admission order — the commit
+        points) before committing ANY lane in memory."""
+        lanes = []  # (qi, tenant_id, ten, row, deadline, recovered)
+        self._admission_pin = {
+            tid for qi in idxs
+            if isinstance(entries[qi][0], dict)
+            and isinstance(tid := entries[qi][0].get("tenant"), str)
+        }
+        try:
+            self._flush_round_pinned(entries, idxs, responses, lanes)
+        finally:
+            self._admission_pin = set()
+            self._enforce_budget()
+
+    def _flush_round_pinned(self, entries, idxs, responses, lanes) -> None:
+        for qi in idxs:
+            req, deadline, _t_sub = entries[qi]
+            if not isinstance(req, dict):
+                inc("serving.client_errors")
+                responses[qi] = Response(
+                    ok=False, kind="invalid", tenant=None,
+                    error=ErrorInfo(
+                        CLIENT_ERROR, "bad_request",
+                        f"request must be a dict, got {type(req).__name__}",
+                    ),
+                )
+                continue
+            kind = req.get("kind")
+            tenant_id = req.get("tenant")
+            if not isinstance(tenant_id, str):
+                tenant_id = None
+            if kind != "tick":
+                responses[qi] = self._client_err(
+                    kind if kind in _REQ_KINDS else "invalid", tenant_id,
+                    "unbatchable_kind",
+                    "only 'tick' requests can be batch-submitted; use "
+                    "handle() for other kinds", field="kind",
+                )
+                continue
+            if tenant_id is None:
+                responses[qi] = self._client_err(
+                    "tick", None, "missing_field",
+                    "request is missing 'tenant'", field="tenant",
+                )
+                continue
+            ten = self._lookup(tenant_id)
+            if ten is None:
+                responses[qi] = self._client_err(
+                    "tick", tenant_id, "unknown_tenant",
+                    f"unknown tenant {tenant_id!r}", field="tenant",
+                )
+                continue
+            row, err = self._parse_tick_row(tenant_id, ten, req)
+            if err is not None:
+                responses[qi] = err
+                continue
+            if ten.breaker.on_request() == BREAKER_OPEN:
+                ten.replay.append(row)
+                responses[qi] = self._fault_resp(
+                    "tick", tenant_id, ten,
+                    ErrorInfo(
+                        TENANT_FAULT, "breaker_open",
+                        "circuit breaker open; tick buffered for replay",
+                    ),
+                    count_fault=False,
+                )
+                continue
+            recovered = False
+            if ten.replay:
+                try:
+                    with trace_span(
+                        "serving.reconcile", n_rows=len(ten.replay)
+                    ):
+                        self._reconcile(tenant_id, ten)
+                    ten = self._tenants[tenant_id]
+                    recovered = True
+                except OSError as e:
+                    ten.replay.append(row)
+                    responses[qi] = self._fault_resp(
+                        "tick", tenant_id, ten,
+                        ErrorInfo(
+                            SYSTEM_FAULT, "store_io",
+                            f"reconcile persistence failed: {e}",
+                        ),
+                    )
+                    continue
+            if deadline.exceeded():
+                ten.replay.append(row)
+                responses[qi] = self._fault_resp(
+                    "tick", tenant_id, ten,
+                    ErrorInfo(
+                        SYSTEM_FAULT, "deadline_exceeded",
+                        f"deadline of {deadline.budget_s}s exceeded",
+                    ),
+                    recovered=recovered,
+                )
+                continue
+            lanes.append((qi, tenant_id, ten, row, deadline, recovered))
+        if not lanes:
+            return
+
+        # compute: the tick counter advances per lane in admission
+        # order, so the tick_nan site fires on exactly the tick index it
+        # would have under sequential serving
+        poisoned = []
+        for _lane in lanes:
+            self._ticks += 1
+            hit = _faults.site_hits("tick_nan", self._ticks)
+            if hit:
+                _faults.fault_fired("tick_nan")
+            poisoned.append(hit)
+        new_states = batched_tick_dispatch(
+            [(ten.model, ten.state, row[0], row[1])
+             for _qi, _tid, ten, row, _dl, _rc in lanes]
+        )
+
+        # per-lane isolation: batched serving always deep-checks (the
+        # states just materialized on host) and journal-appends; a
+        # failed lane buffers its row and freezes only that tenant
+        commits = []
+        for (qi, tenant_id, ten, row, deadline, recovered), st, poi in zip(
+            lanes, new_states, poisoned
+        ):
+            if poi:
+                st = FilterState(s=st.s * np.nan, t=st.t)
+            if not host_finite(st.s):
+                ten.replay.append(row)
+                responses[qi] = self._fault_resp(
+                    "tick", tenant_id, ten,
+                    ErrorInfo(
+                        TENANT_FAULT, "nonfinite_state",
+                        "tick produced a non-finite filter state; "
+                        "row buffered for replay",
+                    ),
+                    recovered=recovered,
+                )
+                continue
+            retries = 0
+            if self.store is not None:
+                journal = ten.journal
+                if journal is None:
+                    journal = ten.journal = self.store.journal(tenant_id)
+                t_idx = int(ten.state.t)
+                try:
+                    with trace_span("tick.journal_append", t=t_idx):
+                        _, retries = call_with_retries(
+                            lambda j=journal, t=t_idx, r=row: j.append(
+                                t, r[0], r[1]
+                            ),
+                            self.retry_policy,
+                            key=f"{tenant_id}:tick:{t_idx}",
+                            deadline=deadline,
+                        )
+                except OSError as e:
+                    ten.replay.append(row)
+                    responses[qi] = self._fault_resp(
+                        "tick", tenant_id, ten,
+                        ErrorInfo(
+                            SYSTEM_FAULT, "store_io",
+                            f"tick journal append failed: {e}",
+                        ),
+                        retries=self.retry_policy.max_retries,
+                        recovered=recovered,
+                    )
+                    continue
+            commits.append((qi, tenant_id, ten, row, st, recovered, retries))
+        # memory commits only after EVERY lane's append has settled
+        for qi, tenant_id, ten, row, st, recovered, retries in commits:
+            ten.state = st
+            ten.suspect = False
+            ten.dirty += 1
+            if ten.hist is not None:
+                ten.hist.append(row[0], row[1])
+            ten.breaker.record_success()
+            inc("serving.batch.lanes")
+            responses[qi] = Response(
+                ok=True, kind="tick", tenant=tenant_id, result=st,
+                retries=retries, breaker_state=ten.breaker.state,
+                recovered=recovered,
+            )
+
     # -- persistence -----------------------------------------------------
+
+    def recover(self, prewarm: int | None = None) -> dict:
+        """Whole-process restart recovery: scan the store and rebuild
+        the serving set with BOUNDED memory.
+
+        All on-disk tenants stay COLD by default — `_lookup` faults
+        each back in lazily on first touch, so recovery cost is O(1) in
+        tenant count beyond the directory scan.  ``prewarm > 0``
+        eagerly faults in the `prewarm` most-recently-snapshotted
+        tenants (capped by the resident budget) and replays their
+        journals CONCURRENTLY: round i advances every prewarmed tenant
+        holding an i-th journaled row through one batched vmapped
+        dispatch — bit-identical to sequential replay.  Returns a
+        summary dict (``tenants_on_disk`` / ``prewarmed`` /
+        ``resident`` / ``resident_bytes`` / ``wall_s``)."""
+        if self.store is None:
+            raise ValueError("recover() requires a store_dir")
+        t0 = time.perf_counter()
+        ids = self.store.list()
+        warmed = 0
+        if prewarm:
+            cap = int(prewarm)
+            if self.resident_tenants is not None:
+                cap = min(cap, self.resident_tenants)
+            hot = sorted(
+                ids, key=self.store.snapshot_mtime, reverse=True
+            )[:cap]
+            pending = []  # (tenant_id, tenant, journal rows)
+            for tid in hot:
+                got = self._fault_in(tid, defer_replay=True)
+                if got is None:
+                    continue
+                warmed += 1
+                ten, rows = got
+                if rows:
+                    pending.append((tid, ten, rows))
+            step = 0
+            while pending:
+                lanes, keep = [], []
+                for tid, ten, rows in pending:
+                    # identity check: if budget pressure evicted this
+                    # tenant mid-replay, its partial state was safely
+                    # dropped (the journal still covers every row) — do
+                    # not clobber a re-faulted-in instance
+                    if self._tenants.get(tid) is not ten:
+                        continue
+                    _t, x_row, m_row = rows[step]
+                    lanes.append((ten.model, ten.state, x_row, m_row))
+                    keep.append((tid, ten, rows))
+                if not lanes:
+                    break
+                new_states = batched_tick_dispatch(lanes)
+                nxt = []
+                for (tid, ten, rows), st in zip(keep, new_states):
+                    ten.state = st
+                    if step + 1 < len(rows):
+                        nxt.append((tid, ten, rows))
+                pending = nxt
+                step += 1
+        gauge_set("serving.recover.tenants_on_disk", len(ids))
+        self._resident_gauges()
+        inc("serving.recoveries")
+        return {
+            "tenants_on_disk": len(ids),
+            "prewarmed": warmed,
+            "resident": len(self._tenants),
+            "resident_bytes": self._resident_nbytes,
+            "wall_s": time.perf_counter() - t0,
+        }
 
     def resume(self, tenant_id: str, x=None, mask=None) -> bool:
         """Re-admit a tenant from the store.  Returns False when the
@@ -864,14 +1493,18 @@ class ServingEngine:
 
         With a panel `x` supplied, the snapshot's params are re-derived
         against the caller's history (the classic path).  WITHOUT a
-        panel — the crash-restart path — the snapshot's FilterState is
-        restored and the write-ahead tick journal replayed through the
-        same tick executable, landing bit-identically on the killed
-        process's committed state; the tenant then serves ticks and
-        nowcasts normally but answers `no_history` to refit/scenario
-        until re-registered with history."""
+        panel — the crash-restart path — this is exactly the eviction
+        fault-in: the snapshot's FilterState is restored, the breaker
+        rebuilt from its packed snapshot leaf, and the write-ahead tick
+        journal replayed through the same tick executable, landing
+        bit-identically on the killed process's committed state; the
+        tenant then serves ticks and nowcasts normally but answers
+        `no_history` to refit/scenario until re-registered with
+        history."""
         if self.store is None:
             return False
+        if x is None:
+            return self._fault_in(tenant_id) is not None
         # the template is structure-only (leaf shapes come from the
         # archive), so one (1, 1, 1) template loads any (N, r, p) tenant
         stored = self.store.load(tenant_id, template_state(1, 1, 1))
@@ -882,28 +1515,11 @@ class ServingEngine:
         if params.lam.shape[1] != r or params.A.shape[0] != p:
             inc("serving.store.inconsistent")
             return False
-        if x is not None:
-            x = np.asarray(x, float)
-            if mask is None:
-                mask = np.isfinite(x)
-            mask = np.asarray(mask, bool)
-            self._install(tenant_id, np.where(mask, x, 0.0), mask, params)
-            return True
-        model = derive_serving_model(params)
-        state = FilterState(
-            s=jnp.asarray(stored.s), t=jnp.asarray(stored.t, jnp.int32)
-        )
-        rep = self.store.journal(tenant_id).replay()
-        if rep is not None:
-            base_t, rows = rep
-            if base_t == int(stored.t) and rows:
-                state = replay_ticks(model, state, rows)
-            # a journal anchored at a different t predates this snapshot
-            # (crash between save and journal reset): already folded in
-        self._tenants[tenant_id] = _Tenant(
-            None, params, model, state,
-            CircuitBreaker(self.breaker_threshold, self.breaker_cooldown),
-        )
+        x = np.asarray(x, float)
+        if mask is None:
+            mask = np.isfinite(x)
+        mask = np.asarray(mask, bool)
+        self._install(tenant_id, np.where(mask, x, 0.0), mask, params)
         return True
 
 

@@ -93,7 +93,14 @@ class TickJournal:
         """Write-ahead one tick: a single one-line append + fsync.  The
         caller commits its in-memory state only after this returns — an
         OSError here (real or ``store_io@n``-injected) means the tick
-        never happened."""
+        never happened.
+
+        A missing file is created with a header anchored at ``base_t =
+        t``: the first journaled tick after a snapshot is BY
+        CONSTRUCTION at the snapshot's own t (the engine journals the
+        pre-increment clock), so lazy header creation is equivalent to
+        an eager `reset` at snapshot time — and lets a million-tenant
+        registration skip a million empty journal files."""
         x = np.ascontiguousarray(x)
         mask = np.ascontiguousarray(mask, dtype=np.uint8)
         x_b64 = base64.b64encode(x.tobytes()).decode()
@@ -106,8 +113,17 @@ class TickJournal:
             "sha": _record_sha(t, x.dtype.str, x_b64, mask_b64),
         }
         self._probe()
+        lines = []
+        if not os.path.exists(self.path):
+            lines.append(json.dumps({
+                "magic": JOURNAL_MAGIC,
+                "version": _VERSION,
+                "base_t": int(t),
+                "sha": _header_sha(t),
+            }))
+        lines.append(json.dumps(rec))
         with open(self.path, "a") as f:
-            f.write(json.dumps(rec) + "\n")
+            f.write("\n".join(lines) + "\n")
             f.flush()
             os.fsync(f.fileno())
         inc("serving.journal.appends")

@@ -25,6 +25,7 @@ ticks by the serving tests for both the complete and period-3 masks.
 
 from __future__ import annotations
 
+from functools import partial
 from typing import NamedTuple
 
 import jax
@@ -41,6 +42,7 @@ __all__ = [
     "derive_serving_model",
     "derive_serving_model_mf",
     "online_tick",
+    "online_tick_batched",
     "replay_ticks",
     "nowcast",
 ]
@@ -87,6 +89,17 @@ def _pad_rows(M, n_pad: int | None):
     return jnp.zeros((n_pad, M.shape[1]), M.dtype).at[: M.shape[0]].set(M)
 
 
+# Jitted so repeated derives (tenant fault-ins under an eviction budget
+# call this once per fault) reuse ONE compiled solve per (shape, q)
+# bucket.  Calling `steady_state` eagerly would re-trace its inner
+# `lax.while_loop` each call — the closed-over numpy constants defeat
+# the dispatch cache, and every re-trace leaks an LLVM JIT code mapping,
+# which at serving rates exhausts vm.max_map_count within minutes.
+@partial(jax.jit, static_argnames=("q",))
+def _steady_state_jit(Tm, Cq, Qs, q: int):
+    return steady_state(Tm, Cq, Qs, q=q)
+
+
 def derive_serving_model(
     params: _ssm.SSMParams, n_pad: int | None = None
 ) -> ServingModel:
@@ -101,7 +114,7 @@ def derive_serving_model(
     params = params._replace(Q=_ssm._psd_floor(params.Q))
     Tm, Qs = _ssm._companion(params)
     C_inf = (params.lam.T * (1.0 / params.R)) @ params.lam
-    st = steady_state(Tm, C_inf, Qs, q=params.r)
+    st = _steady_state_jit(Tm, C_inf, Qs, q=params.r)
     if not bool(st.converged):
         raise ValueError(
             "derive_serving_model: DARE solve did not converge (factor VAR "
@@ -168,6 +181,37 @@ def online_tick(
     x_t = jnp.asarray(x_t, model.Wb.dtype)
     mask_t = jnp.asarray(mask_t, bool)
     return aot_call("serving_tick", _tick, model, state, x_t, mask_t)
+
+
+# The batched tick is DERIVED, not hand-written: exactly the transform-
+# stack batch() doctrine (models/transforms.py) applied to the serving
+# tick — vmap over a leading lane axis of the SAME jitted `_tick`, so
+# there is no second kernel body to keep in sync.  Per-lane results are
+# bit-identical to the sequential `_tick` on every output element: the
+# per-lane contractions (xz @ Wb, Abar[j] @ s, K[j] @ b) batch to
+# independent rows of a larger matmul with the same reduction order, so
+# one executable serves both the live batched commit and the sequential
+# journal replay that must reproduce it after a crash (pinned exactly by
+# tests/test_eviction.py).
+_tick_batched = jax.jit(jax.vmap(_tick))
+
+
+def online_tick_batched(models, states, x_B, mask_B) -> FilterState:
+    """Advance B tenants' filter states by one tick each in ONE vmapped
+    dispatch.
+
+    `models` / `states` are lane-stacked pytrees (every leaf carries a
+    leading B axis; lanes in one batch share leaf SHAPES — the engine
+    groups by (N, q, k, d) and pads the lane count to a compile bucket
+    with inert zero lanes).  x_B: (B, N) observation rows; mask_B:
+    (B, N) bool.  Dispatches to the precompiled "serving_tick_batched"
+    executable when `utils.compile.precompile` registered one for this
+    lane bucket, else the live jit."""
+    x_B = jnp.asarray(x_B, models.Wb.dtype)
+    mask_B = jnp.asarray(mask_B, bool)
+    return aot_call(
+        "serving_tick_batched", _tick_batched, models, states, x_B, mask_B
+    )
 
 
 def replay_ticks(model: ServingModel, state: FilterState, rows) -> FilterState:

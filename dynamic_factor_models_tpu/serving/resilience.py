@@ -166,6 +166,43 @@ class CircuitBreaker:
             self._cooldown_left = self.cooldown
             self.opens += 1
 
+    # -- snapshot persistence (serving/store.TenantState.breaker) --------
+
+    _STATE_CODES = (BREAKER_CLOSED, BREAKER_OPEN, BREAKER_HALF_OPEN)
+
+    def pack(self):
+        """The breaker's position as int32 ``(state_code, consecutive,
+        cooldown_left)`` — the leaf `TenantState` persists so eviction /
+        restart RESTORES the breaker instead of silently re-closing it
+        (docs/serving.md, breaker x eviction)."""
+        import numpy as np
+
+        return np.asarray(
+            [self._STATE_CODES.index(self.state), self.consecutive,
+             max(self._cooldown_left, 0)],
+            np.int32,
+        )
+
+    @classmethod
+    def from_packed(cls, threshold: int, cooldown: int, packed):
+        """Rebuild a breaker from a packed snapshot leaf.  Anything that
+        is not a 3-vector (the scalar default of a hand-built or legacy
+        TenantState) yields a fresh closed breaker.  Restoring does NOT
+        re-emit transition metrics — the state change happened in a past
+        process."""
+        import numpy as np
+
+        b = cls(threshold, cooldown)
+        arr = np.asarray(packed).ravel()
+        if arr.size != 3:
+            return b
+        code = int(arr[0])
+        if 0 <= code < len(cls._STATE_CODES):
+            b.state = cls._STATE_CODES[code]
+        b.consecutive = int(arr[1])
+        b._cooldown_left = int(arr[2])
+        return b
+
 
 class RetryPolicy(NamedTuple):
     """Bounded exponential backoff with deterministic jitter.

@@ -46,13 +46,22 @@ class TenantState(NamedTuple):
     tenant fitted with non-default (r, p) round-trips without the loader
     guessing shapes.  The ServingModel itself is NOT stored — it is a
     pure function of `params` (one DARE solve) and is re-derived on
-    load."""
+    load.
+
+    `breaker` packs the tenant's circuit-breaker position at snapshot
+    time as int32 ``(state_code, consecutive_faults, cooldown_left)``
+    (resilience.CircuitBreaker.pack) so an evicted open-breaker tenant
+    faults back in STILL OPEN — eviction must not silently re-admit a
+    tenant its breaker had quarantined.  The scalar default keeps
+    hand-built TenantStates (tests, older writers) valid; readers treat
+    anything that is not a 3-vector as "fresh breaker"."""
 
     params: SSMParams
     s: jnp.ndarray
     t: jnp.ndarray
     r: jnp.ndarray
     p: jnp.ndarray
+    breaker: jnp.ndarray = 0
 
 
 def template_state(N: int, r: int, p: int, dtype=float) -> TenantState:
@@ -74,6 +83,7 @@ def template_state(N: int, r: int, p: int, dtype=float) -> TenantState:
         t=jnp.zeros((), jnp.int32),
         r=jnp.asarray(r, jnp.int32),
         p=jnp.asarray(p, jnp.int32),
+        breaker=jnp.zeros((3,), jnp.int32),
     )
 
 
@@ -101,11 +111,21 @@ class TenantStore:
         return os.path.join(self.directory, tenant_id + ".npz")
 
     def io_probe(self) -> None:
-        """Count one store I/O operation against the ``store_io@n``
-        fault site.  Snapshot saves and journal writes share THIS
-        counter, so one spec drives a deterministic fault sequence
-        across both paths.  Raises OSError when the site fires."""
+        """Count one store I/O operation against the ``store_io@n`` and
+        ``crash_io@n`` fault sites.  Snapshot saves and journal writes
+        share THIS counter, so one spec drives a deterministic fault
+        sequence across both paths.  Raises OSError when the store_io
+        site fires (a transient fault the engine's retry absorbs) and
+        SimulatedCrash when the crash_io site fires (a process kill the
+        engine must NOT absorb — the kill-at-every-step drill: each
+        store op is atomic, so killing before op n covers every crash
+        point between consecutive ops)."""
         self._io_ops += 1
+        if _faults.site_hits("crash_io", self._io_ops):
+            _faults.fault_fired("crash_io")
+            raise _faults.SimulatedCrash(
+                f"injected crash_io kill (op {self._io_ops})"
+            )
         if _faults.site_hits("store_io", self._io_ops):
             _faults.fault_fired("store_io")
             raise OSError(
@@ -128,7 +148,18 @@ class TenantStore:
         self.io_probe()
         tmp = f"{path}.tmp.{os.getpid()}.{uuid.uuid4().hex[:8]}.npz"
         try:
-            save_pytree(tmp, state)
+            # stored (uncompressed): eviction compaction writes one of
+            # these per cold tenant; deflate would dominate its cost
+            save_pytree(tmp, state, compress=False)
+            # the eviction contract is snapshot DURABLE before the
+            # journal truncates (docs/robustness.md crash matrix), so
+            # fsync the archive before rename — rename alone orders
+            # metadata, not the data blocks
+            fd = os.open(tmp, os.O_RDONLY)
+            try:
+                os.fsync(fd)
+            finally:
+                os.close(fd)
             os.replace(tmp, path)
         except BaseException:
             try:  # a failed save must not leak its temp file
@@ -157,8 +188,23 @@ class TenantStore:
         return jax.tree.map(jnp.asarray, state)
 
     def list(self) -> list[str]:
-        """Live tenant ids, sorted (quarantined archives excluded)."""
+        """Live tenant ids, sorted.  Delegates to
+        `checkpoint.list_entries`, which admits only ``<id>.npz`` names —
+        quarantined ``*.corrupt`` files, in-flight ``*.npz.tmp.*``
+        temporaries, and the ``.journal`` / ``.journal.corrupt`` /
+        ``.journal.tmp.*`` siblings all fail the suffix filter and never
+        leak into the id listing (pinned by tests/test_eviction.py with
+        planted stray files)."""
         return list_entries(self.directory)
+
+    def snapshot_mtime(self, tenant_id: str) -> float:
+        """Last-modified time of the tenant's snapshot archive (0.0 when
+        absent) — `engine.recover` prewarms most-recently-written ids
+        first, a cheap proxy for 'hot before the crash'."""
+        try:
+            return os.path.getmtime(self._path(tenant_id))
+        except OSError:
+            return 0.0
 
     def delete(self, tenant_id: str) -> bool:
         path = self._path(tenant_id)

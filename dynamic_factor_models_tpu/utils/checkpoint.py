@@ -77,15 +77,21 @@ def list_entries(directory: str) -> list[str]:
     )
 
 
-def save_pytree(path: str, tree) -> None:
+def save_pytree(path: str, tree, compress: bool = True) -> None:
     """Save an arbitrary pytree of arrays/scalars to one .npz file,
-    including a sha256 checksum of the content for load-time verification."""
+    including a sha256 checksum of the content for load-time verification.
+
+    ``compress=False`` writes a stored (uncompressed) archive — the
+    serving tenant store uses it for its small per-tenant snapshots,
+    where deflate costs more wall time than the bytes it saves at
+    eviction rates of thousands of snapshots per minute.  The two forms
+    load identically."""
     leaves, treedef = jax.tree.flatten(tree)
     leaves = [np.asarray(leaf) for leaf in leaves]
     payload = {f"leaf{_SEP}{i}": leaf for i, leaf in enumerate(leaves)}
     payload["treedef"] = np.array(str(treedef))
     payload[_CHECKSUM_KEY] = np.array(_content_digest(leaves, str(treedef)))
-    np.savez_compressed(path, **payload)
+    (np.savez_compressed if compress else np.savez)(path, **payload)
 
 
 def load_pytree(path: str, like):

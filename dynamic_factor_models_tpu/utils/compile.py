@@ -468,9 +468,15 @@ class CompileSpec:
     # serving layer (serving/): serving_period > 0 adds the O(1) online
     # tick at that observation period (1 complete, 3 mixed-frequency);
     # em_batch > 0 adds the vmapped multi-tenant EM loop over that many
-    # stacked panels.  Both default off so existing specs are unchanged.
+    # stacked panels; tick_batch > 0 additionally adds the lane-batched
+    # tick at that lane bucket (serving/batch.LANE_BUCKETS) — derived
+    # from the serving_tick plan by prepending the lane axis, the same
+    # batch()-transform doctrine as em_loop_batched, never a hand-
+    # written aval body.  All default off so existing specs are
+    # unchanged.
     serving_period: int = 0
     em_batch: int = 0
+    tick_batch: int = 0
     # scenario engine (scenarios/): scenario_draws > 0 adds the fan-out
     # kernels — "scenario_fan" (the posterior_forecast / draw-fan forward
     # simulation over scenario_draws parameter draws), "scenario_cond_fan"
@@ -883,6 +889,38 @@ def _kernel_plan(spec: CompileSpec):
             (),
             tick_inputs,
         )
+
+        if spec.tick_batch > 0:
+            # the lane-batched tick plans are DERIVED from the scalar
+            # plan — prepend the lane axis to every aval and broadcast
+            # the warmup inputs — exactly how transforms.batch() derives
+            # em_loop_batched from the scalar loop; `_tick_batched` is
+            # itself vmap(_tick), so neither the program nor its plan
+            # has a hand-written batched variant to drift.  One plan per
+            # lane bucket UP TO tick_batch: an admission flush deduped
+            # into rounds shrinks through the bucket ladder (64-lane
+            # flush → rounds of 64, 16, 8, ... lanes), and every round
+            # must hit AOT dispatch for batched admission to beat the
+            # sequential path's AOT'd scalar tick
+            from ..serving.batch import lane_bucket, LANE_BUCKETS
+
+            B_top = lane_bucket(int(spec.tick_batch))
+            for B in [b for b in LANE_BUCKETS if b <= B_top]:
+                lane = lambda s, B=B: _sds((B,) + s.shape, s.dtype)  # noqa: E731
+
+                def tick_batch_inputs(B=B):
+                    args = tick_inputs()
+                    return jax.tree.map(
+                        lambda a: jnp.broadcast_to(a, (B,) + a.shape), args
+                    )
+
+                plans[f"serving_tick_batched@B{B}"] = (
+                    online._tick_batched,
+                    jax.tree.map(lane, plans["serving_tick"][1]),
+                    {},
+                    (),
+                    tick_batch_inputs,
+                )
 
     if spec.scenario_draws > 0:
         # lazy import: scenarios.fanout imports this module for aot_call

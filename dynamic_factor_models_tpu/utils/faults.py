@@ -44,6 +44,13 @@ chaos-serving drills in tests/test_chaos_serving.py and
     engine_crash@n  raise SimulatedCrash at admission of the n-th
                     engine request — a process kill whose restart must
                     replay the tick journal bit-identically
+    crash_io@n      raise SimulatedCrash immediately BEFORE the n-th
+                    tenant-store I/O operation (the same shared counter
+                    as ``store_io``) — the kill-at-every-step drill:
+                    because each store op is atomic (temp+rename or a
+                    single fsynced append), killing before op n models
+                    every possible crash point in an evict / fault-in /
+                    batch-commit sequence
 
 Unsuffixed ``ckpt_corrupt`` / ``preempt`` / ``engine_crash`` default to
 n=1; every other kind requires an explicit site.
@@ -63,8 +70,8 @@ For the serving kinds ``+`` means a fault STORM rather than a one-shot:
 ``tick_nan@1+`` poisons EVERY tick from site 1 onward while the plan is
 active (the circuit-breaker open drill), ``store_io@2+`` fails every
 store op from the 2nd on (retry exhaustion), ``slow_req@1+`` stalls
-every request.  ``engine_crash`` is a kill — it fires once and cannot
-be persistent.
+every request.  ``engine_crash`` and ``crash_io`` are kills — they fire
+once and cannot be persistent.
 
 Everything here is host-side and import-cheap; with no spec active every
 probe returns the empty plan and the guarded program is unchanged.
@@ -95,7 +102,7 @@ _override: "FaultPlan | None" = None
 
 _KINDS = (
     "nan_estep", "chol_fail", "nan_draw", "ckpt_corrupt", "preempt",
-    "tick_nan", "store_io", "slow_req", "engine_crash",
+    "tick_nan", "store_io", "slow_req", "engine_crash", "crash_io",
 )
 # kinds where a bare clause means "at the first site"
 _DEFAULT_SITE = {"ckpt_corrupt": 1, "preempt": 1, "engine_crash": 1}
@@ -134,6 +141,7 @@ class FaultPlan(NamedTuple):
     store_io: int | None = None
     slow_req: int | None = None
     engine_crash: int | None = None
+    crash_io: int | None = None
     persistent: frozenset = frozenset()
 
     def any(self) -> bool:

@@ -125,6 +125,7 @@ __all__ = [
     "register_hist",
     "histograms",
     "emit_histograms",
+    "emit_metrics",
     "dump_metrics",
     "export_openmetrics",
     "summarize",
@@ -578,6 +579,26 @@ def emit_histograms() -> int:
         })
         n += 1
     return n
+
+
+def emit_metrics() -> int:
+    """Snapshot the counter/gauge registry into the JSONL sink as one
+    ``entry="metrics"`` line (cumulative — readers keep the last line,
+    exactly the hist-snapshot convention).  This is how resident-set
+    accounting (``serving.resident_tenants`` / ``serving.evictions`` /
+    ``serving.fault_ins``) reaches `summarize` without a live process.
+    Returns the number of lines written (0 without a sink)."""
+    if not sink_path():
+        return 0
+    with _lock:
+        data = {
+            "entry": "metrics",
+            "time_unix": round(time.time(), 3),
+            "counters": dict(_counters),
+            "gauges": dict(_gauges),
+        }
+    _emit_line(data)
+    return 1
 
 
 def dump_metrics(path: str) -> None:
@@ -1056,8 +1077,17 @@ def summarize(path: str, entry: str | None = None) -> str:
     recs = _load_jsonl(path)
     hists = _latest_hists(recs)
     n_traces = sum(1 for r in recs if r.get("entry") == "trace")
-    # trace trees and hist snapshots are structural lines, not runs
-    recs = [r for r in recs if r.get("entry") not in ("trace", "hist")]
+    # metrics snapshots are cumulative: the last line per file wins;
+    # files from sinks predating the metrics layer simply have none
+    # (the resident/evict/fault-in columns then render "-")
+    metrics = None
+    for r in recs:
+        if r.get("entry") == "metrics":
+            metrics = r
+    # trace trees and hist/metrics snapshots are structural lines, not runs
+    recs = [
+        r for r in recs if r.get("entry") not in ("trace", "hist", "metrics")
+    ]
     if entry:
         recs = [r for r in recs if r.get("entry") == entry]
     if not recs:
@@ -1154,9 +1184,26 @@ def summarize(path: str, entry: str | None = None) -> str:
         return (f"{1e3 * h.quantile(0.5):.3f}",
                 f"{1e3 * h.quantile(0.99):.3f}")
 
+    # resident-set columns (PR 13): the serving row shows the last
+    # metrics snapshot's resident-tenant gauge and the eviction /
+    # fault-in counters; other entries — and files written by sinks
+    # predating the metrics layer — show "-"
+    def _resident_cols(e):
+        if metrics is None or e != "serving":
+            return "-", "-", "-"
+        g = metrics.get("gauges") or {}
+        c = metrics.get("counters") or {}
+        res = g.get("serving.resident_tenants")
+        return (
+            str(int(res)) if res is not None else "-",
+            str(int(c.get("serving.evictions", 0))),
+            str(int(c.get("serving.fault_ins", 0))),
+        )
+
     arows = []
     for e, a in sorted(agg.items()):
         p50, p99 = _lat(e)
+        res, evd, fin = _resident_cols(e)
         arows.append([
             e,
             str(a["runs"]),
@@ -1173,13 +1220,16 @@ def summarize(path: str, entry: str | None = None) -> str:
              if a["faults"] else "-"),
             (f"{100.0 * a['answered'] / a['outcomes']:.1f}%"
              if a["outcomes"] else "-"),
+            res,
+            evd,
+            fin,
             p50,
             p99,
         ])
     aggregate = _fmt_table(
         ["entry", "runs", "err", "wall_s", "mean_s", "mean_iters",
          "conv%", "compile_s", "aot h/m", "faults", "avail",
-         "p50_ms", "p99_ms"],
+         "resident", "evict", "fault_in", "p50_ms", "p99_ms"],
         arows,
     )
     out = (

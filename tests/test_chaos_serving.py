@@ -385,8 +385,11 @@ def test_tick_journal_is_write_ahead(tmp_path):
                         "x": rng.standard_normal(N)})
     assert not r.ok
     assert int(eng._tenants["a"].state.t) == t_before
-    base, rows = eng.store.journal("a").replay()
-    assert rows == []  # nothing journaled, nothing committed
+    # journal headers are created lazily on the first successful append,
+    # so a failed first append leaves no file at all (replay → None) —
+    # either way, nothing was journaled and nothing was committed
+    out = eng.store.journal("a").replay()
+    assert out is None or out[1] == []
 
 
 # ---------------------------------------------------------------------------

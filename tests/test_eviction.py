@@ -1,0 +1,598 @@
+"""Bounded-memory serving: LRU tenant eviction, continuous tick
+batching, and whole-process restart recovery (PR: bounded-memory
+serving).
+
+Pinned claims:
+
+1. a resident budget (`resident_tenants` / `resident_bytes`) bounds the
+   tenant table: cold tenants are EVICTED through the snapshot +
+   write-ahead-journal path and faulted back in on next touch
+   BIT-identical to never having been evicted;
+2. batched admission (`submit` / `flush_period`) produces per-lane
+   FilterStates BITWISE equal to sequential `handle` ticks — including
+   non-power-of-two lane counts, where the compile-bucket padding lanes
+   are exactly inert;
+3. exactly-once across kills: `crash_io@n` killed at EVERY i/o site of
+   a tick + eviction workload restarts to a state holding exactly the
+   journaled ticks — acked ticks are never dropped, no tick is applied
+   twice (replay is idempotent across repeated restarts);
+4. a crash BETWEEN `TenantStore.save` and the journal reset leaves a
+   stale journal (base_t <= snapshot t) that fault-in SKIPS and
+   deletes — never quarantines (satellite: stale-skip regression);
+5. `TenantStore.list()` admits only live ``<id>.npz`` snapshots —
+   planted ``*.corrupt``, in-flight ``*.npz.tmp.*`` temporaries and
+   journal siblings never leak into the id listing;
+6. an OPEN circuit breaker survives eviction: the packed breaker state
+   rides the snapshot and a faulted-in tenant resumes its cooldown
+   instead of silently closing;
+7. `engine.recover()` rebuilds the serving set lazily with bounded
+   memory; `prewarm` replays the hottest journals through the batched
+   dispatch, bit-identical to the pre-kill live states;
+8. `telemetry summarize` renders resident / eviction / fault-in columns
+   from the cumulative metrics snapshot line, and falls back to "-" for
+   sinks written before the metrics layer.
+"""
+
+import glob
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+from dynamic_factor_models_tpu.serving.batch import batched_tick_dispatch
+from dynamic_factor_models_tpu.serving.engine import ServingEngine
+from dynamic_factor_models_tpu.serving.online import online_tick
+from dynamic_factor_models_tpu.serving.resilience import RetryPolicy
+from dynamic_factor_models_tpu.serving.store import TenantStore, template_state
+from dynamic_factor_models_tpu.utils import faults, telemetry
+
+pytestmark = [pytest.mark.serving]
+
+_POLICY = RetryPolicy(max_retries=2, backoff_base_s=0.0)
+
+T, N = 48, 6
+
+
+def _panel(seed=0):
+    rng = np.random.default_rng(seed)
+    f = rng.standard_normal((T, 4)).cumsum(0) * 0.1
+    lam = rng.standard_normal((N, 4))
+    return f @ lam.T + 0.5 * rng.standard_normal((T, N))
+
+
+def _engine(store_dir=None, **kw):
+    kw.setdefault("retry_policy", _POLICY)
+    kw.setdefault("max_em_iter", 5)
+    return ServingEngine(store_dir=store_dir, **kw)
+
+
+def _states(eng, ids):
+    return {
+        tid: (np.asarray(eng._tenants[tid].state.s).copy(),
+              int(eng._tenants[tid].state.t))
+        for tid in ids if tid in eng._tenants
+    }
+
+
+# ---------------------------------------------------------------------------
+# 1. LRU budget + bit-identical fault-in
+# ---------------------------------------------------------------------------
+
+
+def test_budget_bounds_residency_and_fault_in_is_bit_identical(tmp_path):
+    rng = np.random.default_rng(3)
+    d = str(tmp_path / "store")
+    eng = _engine(d, resident_tenants=2)
+    ref = _engine()  # no store, no budget: the never-evicted control
+
+    pan = _panel(seed=4)
+    for e in (eng, ref):
+        e.register("a", pan)
+        for tid in ("b", "c", "d"):
+            e.register_shared(tid, "a")
+    assert len(eng._tenants) <= 2  # registration already enforces
+    assert len(ref._tenants) == 4
+
+    rows = [rng.standard_normal(N) for _ in range(12)]
+    order = ["a", "b", "c", "d", "a", "c", "b", "d", "d", "a", "b", "c"]
+    for tid, row in zip(order, rows):
+        r1 = eng.handle({"kind": "tick", "tenant": tid, "x": row})
+        r2 = ref.handle({"kind": "tick", "tenant": tid, "x": row})
+        assert r1.ok and r2.ok
+        assert len(eng._tenants) <= 2
+
+    assert telemetry._counters.get("serving.fault_ins", 0) > 0
+    for tid in ("a", "b", "c", "d"):
+        budgeted = eng._lookup(tid)
+        control = ref._tenants[tid]
+        assert int(budgeted.state.t) == int(control.state.t)
+        np.testing.assert_array_equal(
+            np.asarray(budgeted.state.s), np.asarray(control.state.s)
+        )
+
+
+def test_resident_bytes_budget_and_clean_eviction_is_zero_io(tmp_path):
+    d = str(tmp_path / "store")
+    eng = _engine(d, resident_bytes=1)  # everything but the MRU evicts
+    eng.register("a", _panel())
+    eng.register_shared("b", "a")
+    assert len(eng._tenants) == 1  # byte budget of 1 keeps only the MRU
+
+    # fault "a" back, tick it (dirty), evict -> snapshot written
+    assert eng.handle(
+        {"kind": "tick", "tenant": "a", "x": np.zeros(N)}
+    ).ok
+    path = eng.store._path("a")
+    mtime = os.path.getmtime(path)
+    # "a" is the MRU and resident; "b" was evicted to make room.  A
+    # second touch of "b" evicts "a" (dirty -> persists), then
+    # re-touching "a" faults it in CLEAN; evicting clean is zero i/o
+    assert eng.handle({"kind": "nowcast", "tenant": "b"}).ok
+    assert os.path.getmtime(path) > mtime  # dirty eviction saved
+    mtime = os.path.getmtime(path)
+    assert eng.handle({"kind": "nowcast", "tenant": "a"}).ok  # fault in
+    assert eng.handle({"kind": "nowcast", "tenant": "b"}).ok  # evict a
+    assert os.path.getmtime(path) == mtime  # clean eviction: no write
+
+
+def test_budget_requires_store_and_positive_values(tmp_path):
+    with pytest.raises(ValueError, match="store_dir"):
+        _engine(None, resident_tenants=2)
+    with pytest.raises(ValueError, match=">= 1"):
+        _engine(str(tmp_path / "s"), resident_tenants=0)
+
+
+# ---------------------------------------------------------------------------
+# 2. batched admission == sequential, padding inert
+# ---------------------------------------------------------------------------
+
+
+def test_batched_flush_matches_sequential_bitwise(tmp_path):
+    rng = np.random.default_rng(7)
+    bat = _engine(str(tmp_path / "b"))
+    seq = _engine(str(tmp_path / "s"))
+    for e in (bat, seq):
+        e.register("a", _panel(seed=8))
+        for tid in ("b", "c"):
+            e.register_shared(tid, "a")
+
+    # 7 lanes over 3 tenants: duplicates force multiple rounds, and the
+    # 3-unique-lane first round pads to bucket 4 (one inert lane)
+    order = ["a", "b", "c", "a", "b", "a", "c"]
+    rows = [rng.standard_normal(N) for _ in order]
+    for tid, row in zip(order, rows):
+        bat.submit({"kind": "tick", "tenant": tid, "x": row})
+        assert seq.handle({"kind": "tick", "tenant": tid, "x": row}).ok
+    resps = bat.flush_period()
+    assert len(resps) == len(order) and all(r.ok for r in resps)
+
+    for tid in ("a", "b", "c"):
+        np.testing.assert_array_equal(
+            np.asarray(bat._tenants[tid].state.s),
+            np.asarray(seq._tenants[tid].state.s),
+        )
+        assert int(bat._tenants[tid].state.t) == int(
+            seq._tenants[tid].state.t
+        )
+
+
+def test_batched_dispatch_padding_lanes_are_inert():
+    rng = np.random.default_rng(9)
+    eng = _engine()
+    eng.register("a", _panel(seed=10))
+    ten = eng._tenants["a"]
+
+    lanes, want = [], []
+    state = ten.state
+    for _ in range(3):  # 3 lanes -> bucket 4, one padding lane
+        x = rng.standard_normal(N)
+        mask = np.isfinite(x)
+        lanes.append((ten.model, state, np.where(mask, x, 0.0), mask))
+        want.append(online_tick(ten.model, state, np.where(mask, x, 0.0),
+                                mask))
+    got = batched_tick_dispatch(lanes)
+    assert len(got) == 3
+    for g, w in zip(got, want):
+        np.testing.assert_array_equal(np.asarray(g.s), np.asarray(w.s))
+        assert int(g.t) == int(w.t)
+
+
+def test_flush_isolates_lanes_and_types_errors(tmp_path):
+    eng = _engine(str(tmp_path / "store"))
+    eng.register("a", _panel(seed=11))
+    eng.register_shared("b", "a")
+
+    eng.submit({"kind": "tick", "tenant": "a", "x": np.zeros(N)})
+    eng.submit({"kind": "nowcast", "tenant": "a"})          # unbatchable
+    eng.submit({"kind": "tick", "tenant": "ghost", "x": np.zeros(N)})
+    eng.submit({"kind": "tick", "tenant": "b"})             # missing x
+    eng.submit("not a dict")
+    r = eng.flush_period()
+    assert [x.ok for x in r] == [True, False, False, False, False]
+    assert r[1].error.code == "unbatchable_kind"
+    assert r[2].error.code == "unknown_tenant"
+    assert r[3].error.code == "missing_field"
+    assert r[4].error.code == "bad_request"
+
+
+def test_flush_tick_nan_poisons_only_its_lane(tmp_path):
+    eng = _engine(str(tmp_path / "store"))
+    eng.register("a", _panel(seed=12))
+    eng.register_shared("b", "a")
+    # warm one tick each so the fault site lands mid-flush
+    for tid in ("a", "b"):
+        assert eng.handle(
+            {"kind": "tick", "tenant": tid, "x": np.zeros(N)}
+        ).ok
+    eng.submit({"kind": "tick", "tenant": "a", "x": np.zeros(N)})
+    eng.submit({"kind": "tick", "tenant": "b", "x": np.zeros(N)})
+    with faults.inject("tick_nan@3"):  # 3rd computed tick = lane "a"
+        r = eng.flush_period()
+    assert not r[0].ok and r[0].error.code == "nonfinite_state"
+    assert r[0].degraded and eng._tenants["a"].replay
+    assert r[1].ok and not eng._tenants["b"].replay
+
+
+# ---------------------------------------------------------------------------
+# 3. kill-at-every-step: crash_io drill (chaos lane)
+# ---------------------------------------------------------------------------
+
+
+def _drill_workload(eng, rows):
+    """Fixed tick workload over 3 tenants under a resident budget of 2:
+    every third tick faults a cold tenant in (evicting a dirty one), so
+    the i/o site sequence covers journal appends, snapshot saves and
+    journal resets.  Returns the number of ACKED ticks."""
+    order = ["a", "b", "c", "a", "c", "b"]
+    acked = 0
+    for tid, row in zip(order, rows):
+        r = eng.handle({"kind": "tick", "tenant": tid, "x": row})
+        assert r.ok, r
+        acked += 1
+    return acked
+
+
+@pytest.mark.chaos_serving
+def test_crash_io_killed_at_every_step_recovers_exactly_once(tmp_path):
+    rng = np.random.default_rng(21)
+    rows = [rng.standard_normal(N) for _ in range(6)]
+    pan = _panel(seed=22)
+
+    # reference: the full workload, never killed, never budgeted
+    ref = _engine()
+    ref.register("a", pan)
+    for tid in ("b", "c"):
+        ref.register_shared(tid, "a")
+    _drill_workload(ref, rows)
+    ref_states = _states(ref, ("a", "b", "c"))
+
+    site = 0
+    while True:
+        site += 1
+        d = str(tmp_path / f"store{site}")
+        eng = _engine(d, resident_tenants=2)
+        eng.register("a", pan)
+        for tid in ("b", "c"):
+            eng.register_shared(tid, "a")
+        acked = 0
+        crashed = True
+        ops0 = eng.store._io_ops  # registration already consumed sites
+        with faults.inject(f"crash_io@{ops0 + site}"):
+            try:
+                order = ["a", "b", "c", "a", "c", "b"]
+                for tid, row in zip(order, rows):
+                    r = eng.handle({"kind": "tick", "tenant": tid,
+                                    "x": row})
+                    if r.ok:
+                        acked += 1
+                crashed = False
+            except faults.SimulatedCrash:
+                pass
+        if not crashed:
+            break  # site count exceeded the workload's i/o ops: done
+
+        # restart from disk only; acked ticks all present, none doubled
+        rec = _engine(d, resident_tenants=2)
+        seen = {}
+        for tid in ("a", "b", "c"):
+            ten = rec._lookup(tid)
+            assert ten is not None, f"site {site}: {tid} lost"
+            seen[tid] = int(ten.state.t) - T
+        # every acked tick survived the kill; the only extra tick
+        # allowed is un-acked work the journal had already made durable
+        assert sum(seen.values()) >= acked, (
+            f"site {site}: acked {acked}, recovered {seen}"
+        )
+        assert sum(seen.values()) <= acked + 1
+
+        # replay is idempotent: a SECOND restart from the same store
+        # lands on the bit-identical state (nothing applied twice)
+        rec2 = _engine(d, resident_tenants=2)
+        for tid in ("a", "b", "c"):
+            t1, t2 = rec._lookup(tid), rec2._lookup(tid)
+            np.testing.assert_array_equal(
+                np.asarray(t1.state.s), np.asarray(t2.state.s)
+            )
+        # no stale journal was quarantined anywhere in the drill
+        assert not glob.glob(os.path.join(d, "*.corrupt"))
+    assert site > 6  # the drill actually exercised multiple i/o sites
+
+    # a clean (uncrashed) budgeted run matches the reference bitwise
+    d = str(tmp_path / "clean")
+    eng = _engine(d, resident_tenants=2)
+    eng.register("a", pan)
+    for tid in ("b", "c"):
+        eng.register_shared(tid, "a")
+    _drill_workload(eng, rows)
+    for tid, (s, t) in ref_states.items():
+        ten = eng._lookup(tid)
+        assert int(ten.state.t) == t
+        np.testing.assert_array_equal(np.asarray(ten.state.s), s)
+
+
+@pytest.mark.chaos_serving
+def test_batched_flush_crash_is_exactly_once_across_restart(tmp_path):
+    """Kill the batched path at every i/o site of its second flush: on
+    restart each tenant holds its snapshot advanced by EXACTLY the rows
+    its journal had made durable — acked flush-1 ticks always survive,
+    nothing is applied twice (second restart is bit-identical)."""
+    rng = np.random.default_rng(31)
+    pan = _panel(seed=32)
+    flush1 = [("a", rng.standard_normal(N)), ("b", rng.standard_normal(N))]
+    flush2 = [("a", rng.standard_normal(N)), ("b", rng.standard_normal(N)),
+              ("a", rng.standard_normal(N))]
+
+    site = 0
+    crashes = 0
+    while True:
+        site += 1
+        d = str(tmp_path / f"store{site}")
+        eng = _engine(d)
+        eng.register("a", pan)
+        eng.register_shared("b", "a")
+        for tid, row in flush1:
+            eng.submit({"kind": "tick", "tenant": tid, "x": row})
+        r1 = eng.flush_period()
+        assert all(r.ok for r in r1)
+        acked = {"a": 1, "b": 1}
+        crashed = True
+        ops0 = eng.store._io_ops  # sites land inside the second flush
+        with faults.inject(f"crash_io@{ops0 + site}"):
+            try:
+                for tid, row in flush2:
+                    eng.submit({"kind": "tick", "tenant": tid, "x": row})
+                eng.flush_period()
+                crashed = False
+            except faults.SimulatedCrash:
+                crashes += 1
+        if not crashed:
+            break
+
+        rec = _engine(d)
+        rec2 = _engine(d)
+        for tid in ("a", "b"):
+            assert rec.resume(tid), f"site {site}: {tid} lost"
+            assert rec2.resume(tid)
+            got_t = int(rec._tenants[tid].state.t) - T
+            # acked (flush-1) ticks are durable; at most this tenant's
+            # flush-2 submissions can additionally have become durable
+            extra = sum(1 for t2, _ in flush2 if t2 == tid)
+            assert acked[tid] <= got_t <= acked[tid] + extra, (
+                f"site {site}: tenant {tid} t={got_t}"
+            )
+            np.testing.assert_array_equal(
+                np.asarray(rec._tenants[tid].state.s),
+                np.asarray(rec2._tenants[tid].state.s),
+            )
+    assert crashes > 0  # the drill crashed at least once before passing
+
+
+# ---------------------------------------------------------------------------
+# 4. satellite: stale journal skipped, never quarantined
+# ---------------------------------------------------------------------------
+
+
+def test_stale_journal_after_save_is_skipped_not_quarantined(tmp_path):
+    d = str(tmp_path / "store")
+    eng = _engine(d)
+    eng.register("a", _panel(seed=41))
+    assert eng.handle(
+        {"kind": "tick", "tenant": "a", "x": np.zeros(N)}
+    ).ok  # journal now holds one row at base T
+
+    # simulate the crash window between TenantStore.save and the
+    # journal reset: persist the CURRENT state (t = T+1) through the
+    # engine, then restore the stale journal file (base_t = T < T+1)
+    # that the reset truncated
+    ten = eng._tenants["a"]
+    j = eng.store.journal("a")
+    stale = open(j.path, "rb").read() if j.exists() else b""
+    eng._persist("a", ten.params, ten.state, ten.breaker)
+    with open(j.path, "wb") as f:
+        f.write(stale)
+    base, rows = j.replay()
+    assert base < int(ten.state.t)  # journal is genuinely stale
+
+    t_live = int(ten.state.t)
+    s_live = np.asarray(ten.state.s).copy()
+    telemetry.reset()
+    rec = _engine(d)
+    assert rec.resume("a")
+    assert telemetry._counters.get("serving.journal.stale_skipped") == 1
+    assert int(rec._tenants["a"].state.t) == t_live
+    np.testing.assert_array_equal(
+        np.asarray(rec._tenants["a"].state.s), s_live
+    )
+    assert not j.exists()  # stale journal deleted, not quarantined
+    assert not glob.glob(os.path.join(d, "*.corrupt"))
+
+
+# ---------------------------------------------------------------------------
+# 5. satellite: list() skips corrupt + in-flight temps
+# ---------------------------------------------------------------------------
+
+
+def test_store_list_skips_corrupt_and_inflight_temps(tmp_path):
+    d = str(tmp_path / "store")
+    eng = _engine(d)
+    eng.register("a", _panel(seed=51))
+    eng.register("b", _panel(seed=52))
+    for stray in (
+        "ghost.npz.corrupt", "a.npz.tmp.1234", "weird.corrupt",
+        "c.journal", "c.journal.corrupt", "c.journal.tmp.7",
+    ):
+        with open(os.path.join(d, stray), "wb") as f:
+            f.write(b"\x00junk")
+    assert TenantStore(d).list() == ["a", "b"]
+    # recover() sees the same filtered view: no crash on the strays
+    rec = _engine(d)
+    info = rec.recover()
+    assert info["tenants_on_disk"] == 2
+
+
+# ---------------------------------------------------------------------------
+# 6. satellite: breaker state survives eviction
+# ---------------------------------------------------------------------------
+
+
+def test_open_breaker_survives_eviction_and_fault_in(tmp_path):
+    d = str(tmp_path / "store")
+    eng = _engine(d, breaker_threshold=2, breaker_cooldown=50)
+    eng.register("a", _panel(seed=61))
+    with faults.inject("tick_nan@1+"):  # persistent: open the breaker
+        for _ in range(3):
+            eng.handle({"kind": "tick", "tenant": "a", "x": np.zeros(N)})
+    assert eng._tenants["a"].breaker.state == "open"
+
+    # an open-breaker tenant still has its replay buffer: reconcile it
+    # away first (replay-pinned tenants refuse eviction)
+    eng._tenants["a"].replay.clear()
+    assert eng.evict("a")
+    assert "a" not in eng._tenants
+
+    ten = eng._lookup("a")  # fault back in
+    assert ten is not None
+    assert ten.breaker.state == "open"  # NOT silently closed
+    r = eng.handle({"kind": "tick", "tenant": "a", "x": np.zeros(N)})
+    assert not r.ok and r.error.code == "breaker_open"
+
+
+def test_replay_pinned_tenant_refuses_eviction(tmp_path):
+    d = str(tmp_path / "store")
+    eng = _engine(d)
+    eng.register("a", _panel(seed=62))
+    with faults.inject("tick_nan@1"):
+        r = eng.handle({"kind": "tick", "tenant": "a", "x": np.zeros(N)})
+    assert not r.ok and eng._tenants["a"].replay
+    assert not eng.evict("a")  # pinned: buffered row exists only in RAM
+    assert "a" in eng._tenants
+
+
+# ---------------------------------------------------------------------------
+# 7. recover(): lazy + prewarm, bounded, bit-identical
+# ---------------------------------------------------------------------------
+
+
+def test_recover_is_lazy_prewarm_is_batched_and_bit_identical(tmp_path):
+    rng = np.random.default_rng(71)
+    d = str(tmp_path / "store")
+    eng = _engine(d, resident_tenants=3)
+    eng.register("a", _panel(seed=72))
+    for tid in ("b", "c", "d", "e"):
+        eng.register_shared(tid, "a")
+    for k in range(10):
+        tid = "abcde"[k % 5]
+        assert eng.handle(
+            {"kind": "tick", "tenant": tid, "x": rng.standard_normal(N)}
+        ).ok
+    live = {
+        tid: (np.asarray(eng._lookup(tid).state.s).copy(),
+              int(eng._lookup(tid).state.t))
+        for tid in "abcde"
+    }
+
+    rec = _engine(d, resident_tenants=3)
+    info = rec.recover(prewarm=2)
+    assert info["tenants_on_disk"] == 5
+    assert info["prewarmed"] == 2
+    assert info["resident"] <= 3
+    # prewarmed tenants replayed their journals through the batched
+    # dispatch; cold ones fault in lazily — all bit-identical to live
+    for tid, (s, t) in live.items():
+        ten = rec._lookup(tid)
+        assert ten is not None
+        assert int(ten.state.t) == t, tid
+        np.testing.assert_array_equal(np.asarray(ten.state.s), s)
+        assert len(rec._tenants) <= 3
+
+    with pytest.raises(ValueError, match="store"):
+        _engine().recover()
+
+
+# ---------------------------------------------------------------------------
+# 8. summarize: resident / eviction / fault-in columns
+# ---------------------------------------------------------------------------
+
+
+def test_summarize_renders_resident_columns(tmp_path, monkeypatch):
+    sink = str(tmp_path / "sink.jsonl")
+    monkeypatch.setenv("DFM_TELEMETRY", sink)
+    monkeypatch.setattr(telemetry, "_explicit_enabled", None)
+    monkeypatch.setattr(telemetry, "_explicit_sink", None)
+    telemetry.reset()
+    assert telemetry.enabled()
+
+    d = str(tmp_path / "store")
+    eng = _engine(d, resident_tenants=2)
+    eng.register("a", _panel(seed=81))
+    for tid in ("b", "c"):
+        eng.register_shared(tid, "a")
+    for tid in ("a", "b", "c", "a"):
+        assert eng.handle(
+            {"kind": "tick", "tenant": tid, "x": np.zeros(N)}
+        ).ok
+    eng.submit({"kind": "tick", "tenant": "a", "x": np.zeros(N)})
+    assert all(r.ok for r in eng.flush_period())
+    eng.flush_metrics()
+
+    out = telemetry.summarize(sink)
+    assert "resident" in out and "fault_in" in out
+    row = next(
+        ln for ln in out.splitlines() if ln.strip().startswith("serving")
+    )
+    cells = row.split()
+    assert "2" in cells  # resident_tenants gauge made it into the table
+
+    # sinks from before the metrics layer render "-" in those columns
+    old = str(tmp_path / "old.jsonl")
+    with open(sink) as f, open(old, "w") as g:
+        for ln in f:
+            if '"entry": "metrics"' not in ln:
+                g.write(ln)
+    out_old = telemetry.summarize(old)
+    row_old = next(
+        ln for ln in out_old.splitlines()
+        if ln.strip().startswith("serving")
+    )
+    assert "-" in row_old.split()
+
+
+# ---------------------------------------------------------------------------
+# 9. eviction drops history; refit/scenario answer typed envelopes
+# ---------------------------------------------------------------------------
+
+
+def test_faulted_in_tenant_answers_no_history(tmp_path):
+    d = str(tmp_path / "store")
+    eng = _engine(d)
+    eng.register("a", _panel(seed=91))
+    assert eng.evict("a")
+    assert eng.handle({"kind": "nowcast", "tenant": "a"}).ok
+    r = eng.handle({"kind": "scenario", "tenant": "a",
+                    "scenario": {"kind": "stress"}})
+    assert not r.ok and r.error.code == "no_history"
+    # a queued refit for a history-less tenant is skipped, not crashed
+    assert eng.handle({"kind": "refit", "tenant": "a"}).ok
+    fr = eng.flush_refits()
+    assert fr.ok and fr.info["installed"] == 0

@@ -600,3 +600,108 @@ def test_clean_path_envelope_overhead_within_bar(monkeypatch):
         f"clean-path envelope {100 * best:.1f}% of raw tick wall "
         f"(rounds: {[round(f, 4) for f in fracs]})"
     )
+
+
+# ---------------------------------------------------------------------------
+# PR-13 pin: the eviction layer is host-side only — the clean-path tick
+# program is byte-identical with a resident budget active, and the
+# request envelope (now including the LRU touch) stays within the bar
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.telemetry
+def test_eviction_layer_clean_path_hlo_is_byte_identical(tmp_path):
+    """Lowering the tick program from a BUDGETED engine (store + LRU
+    accounting live) must produce byte-identical StableHLO to the
+    unbudgeted lowering: eviction bookkeeping is dict + counter work on
+    the host and never enters the compiled program."""
+    from dynamic_factor_models_tpu.serving import engine as _eng
+    from dynamic_factor_models_tpu.serving.online import _tick
+
+    rng = np.random.default_rng(5)
+    pan = rng.standard_normal((40, 8))
+    row = jnp.asarray(rng.standard_normal(8))
+    mask = jnp.ones(8, bool)
+
+    plain = _eng.ServingEngine(max_em_iter=4)
+    plain.register("t", pan)
+    ten = plain._tenants["t"]
+    hlo_plain = _tick.lower(ten.model, ten.state, row, mask).as_text()
+
+    budgeted = _eng.ServingEngine(
+        max_em_iter=4, store_dir=str(tmp_path / "store"),
+        resident_tenants=1,
+    )
+    budgeted.register("t", pan)
+    assert budgeted.handle(
+        {"kind": "tick", "tenant": "t", "x": np.asarray(row)}
+    ).ok
+    ten_b = budgeted._tenants["t"]
+    hlo_budget = _tick.lower(ten_b.model, ten_b.state, row, mask).as_text()
+    assert hlo_budget == hlo_plain
+
+
+@pytest.mark.telemetry
+def test_eviction_layer_adds_within_bar_to_store_envelope(
+    tmp_path, monkeypatch
+):
+    """The LRU layer's ADDED host cost on the clean path — one dict pop
+    + re-insert per `_lookup`, the dirty counter on commit — is <= 5%
+    of the store-backed request envelope: a budgeted engine (budget
+    wide enough that no eviction fires) races an unbudgeted one over
+    the identical workload, with the device program and the write-ahead
+    append stubbed so both loops measure pure host bookkeeping.  (The
+    unbudgeted no-store envelope keeps its own absolute <= 5% bar in
+    the PR-12 test above, which runs with this layer present.)"""
+    from dynamic_factor_models_tpu.serving import engine as _eng
+    from dynamic_factor_models_tpu.serving.journal import TickJournal
+    from dynamic_factor_models_tpu.utils import telemetry as T
+
+    monkeypatch.delenv("DFM_TELEMETRY", raising=False)
+    monkeypatch.delenv("DFM_PROFILE_DIR", raising=False)
+    monkeypatch.setattr(T, "_explicit_enabled", None)
+    monkeypatch.setattr(T, "_explicit_sink", None)
+    assert not T.enabled()
+
+    rng = np.random.default_rng(6)
+    pan = rng.standard_normal((40, 8))
+    plain = _eng.ServingEngine(
+        max_em_iter=4, store_dir=str(tmp_path / "plain")
+    )
+    budget = _eng.ServingEngine(
+        max_em_iter=4, store_dir=str(tmp_path / "budget"),
+        resident_tenants=8,
+    )
+    assert budget._budget_on and not plain._budget_on
+    for e in (plain, budget):
+        e.register("t", pan)
+    st_pin = plain._tenants["t"].state
+    n = 1000
+    xr = [rng.standard_normal(8) for _ in range(n)]
+
+    def loop(e):
+        for i in range(n):
+            e.handle({"kind": "tick", "tenant": "t", "x": xr[i]})
+
+    loop(plain)
+    loop(budget)  # warm both paths before the clock starts
+    real_tick = _eng.online_tick
+    _eng.online_tick = lambda model, state, x, m: st_pin
+    monkeypatch.setattr(TickJournal, "append", lambda self, t, x, m: None)
+    try:
+        fracs = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            loop(plain)
+            wall_p = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            loop(budget)
+            wall_b = time.perf_counter() - t0
+            fracs.append(wall_b / wall_p)
+    finally:
+        _eng.online_tick = real_tick
+    best = min(fracs)
+    assert best < 1.05, (
+        f"eviction layer adds {100 * (best - 1):.1f}% to the store "
+        f"envelope (rounds: {[round(f, 4) for f in fracs]})"
+    )
