@@ -1503,6 +1503,133 @@ def scenarios_section():
     return fields
 
 
+def scenarios_nl_section(smoke: bool = False):
+    """Particle-filter scenario throughput (bench.py --scenarios-nl).
+
+    Fields into docs/BENCH_scenarios_nl.json (present-but-null when the
+    section fails):
+
+    - smc_particle_steps_per_sec: {model: {P: particles*steps*lanes /
+      sec}} for the lg and sv particle filters at P in {1k, 10k} with 8
+      vmapped scenario lanes (--smoke: P=256, 2 lanes) — the
+      scan-outside/vmap-inside program through the production
+      `smc_filter` entry;
+    - smc_vs_looped_x: the vmapped multi-lane filter vs the same pure
+      kernels (propose / weight+normalize / adaptive-resample) dispatched
+      individually from a Python loop over lanes and steps — the
+      composition the one-scan program replaces.  Measured at P=256 (the
+      tier-1 fast-lane particle count), where per-kernel compute is small
+      and host dispatch dominates — exactly the regime the fused scan
+      exists for (acceptance bar: >= 10x on CPU; at P >= 1k the kernels
+      are compute-bound and the ratio honestly shrinks to ~4x);
+    - smc_ess_trip_rate: fraction of (lane, step) pairs whose ESS fell
+      below the 0.5*P floor and triggered a systematic resample.
+
+    Persists docs/BENCH_scenarios_nl.json, prints one JSON line and
+    returns the dict.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    fields = {
+        "smc_particle_steps_per_sec": None,
+        "smc_vs_looped_x": None,
+        "smc_ess_trip_rate": None,
+        "smc_smoke": bool(smoke),
+    }
+    try:
+        from dynamic_factor_models_tpu.models.ssm import SSMParams
+        from dynamic_factor_models_tpu.scenarios import particles as pk
+        from dynamic_factor_models_tpu.scenarios import smc as smc_mod
+
+        T, N, r = 64, 16, 4
+        S = 2 if smoke else 8
+        plist = (256,) if smoke else (1_000, 10_000)
+        rng = np.random.default_rng(23)
+        dt = jnp.result_type(float)
+        lam = rng.standard_normal((N, r))
+        params = SSMParams(
+            lam=jnp.asarray(lam, dt),
+            R=jnp.ones(N, dt),
+            A=0.5 * jnp.eye(r, dtype=dt)[None],
+            Q=jnp.eye(r, dtype=dt),
+        )
+        f = np.zeros((T, r))
+        for t in range(1, T):
+            f[t] = 0.5 * f[t - 1] + rng.standard_normal(r)
+        x = f @ lam.T + 0.5 * rng.standard_normal((T, N))
+        aux_sv = (jnp.zeros(r, dt), jnp.full((r,), 0.95, dt),
+                  jnp.full((r,), 0.2, dt))
+
+        thr: dict = {}
+        trips: dict = {}
+        for model, aux in (("lg", ()), ("sv", aux_sv)):
+            thr[model] = {}
+            for P in plist:
+                kw = dict(model=model, aux=aux, n_particles=P, n_lanes=S)
+                res = smc_mod.smc_filter(params, x, **kw)  # compile
+                wall = _time_fixed_iters(lambda: jax.block_until_ready(
+                    smc_mod.smc_filter(params, x, **kw).summary
+                ))
+                thr[model][str(P)] = round(P * T * S / wall, 1)
+            trips[model] = round(float(np.asarray(res.resampled).mean()), 4)
+        fields["smc_particle_steps_per_sec"] = thr
+        fields["smc_ess_trip_rate"] = trips
+
+        # -- the same lg filter, the pure kernels dispatched one at a
+        # time from Python over lanes and steps: the composition style
+        # the single scan-outside/vmap-inside program replaces.  P=256
+        # is the dispatch-dominated fast-lane size the bar targets.
+        Pb = 256
+        pm = smc_mod._lg_model(params, (), Pb)
+        yz = jnp.asarray(np.nan_to_num(x), dt)
+        mk = jnp.ones((T, N), dt)
+
+        propose_j = jax.jit(lambda k, p_: pm.propose(k, p_, 0))
+        weight_j = jax.jit(lambda lw, p_, y, m: pk.normalize_logw(
+            lw + pm.log_obs(p_, y, m, 0)
+        ))
+        resample_j = jax.jit(
+            lambda k, p_, lw: pk.adaptive_resample(k, p_, lw, 0.5)
+        )
+        split_j = jax.jit(lambda k: jax.random.split(k, 3))
+        lw0 = jnp.full((Pb,), -np.log(Pb), dt)
+
+        def looped():
+            for s in range(S):
+                key = jax.random.PRNGKey(s)
+                parts = pm.init(key)
+                logw = lw0
+                for t in range(T):
+                    key, k1, k2 = split_j(key)
+                    parts = propose_j(k1, parts)
+                    logw, _ = weight_j(logw, parts, yz[t], mk[t])
+                    parts, logw, _, _ = resample_j(k2, parts, logw)
+            jax.block_until_ready(logw)
+
+        looped()  # compile
+        wall_loop = _time_fixed_iters(looped, n_timing_runs=2)
+        kw = dict(model="lg", n_particles=Pb, n_lanes=S)
+        smc_mod.smc_filter(params, x, **kw)
+        wall_vmap = _time_fixed_iters(lambda: jax.block_until_ready(
+            smc_mod.smc_filter(params, x, **kw).summary
+        ))
+        fields["smc_vs_looped_x"] = round(wall_loop / wall_vmap, 1)
+
+        out = {"time_unix": round(time.time(), 1), "T": T, "N": N, "r": r,
+               "lanes": S, **fields}
+        path = os.path.join(REPO, "docs", "BENCH_scenarios_nl.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(out, fh, indent=1, sort_keys=True)
+        os.replace(tmp, path)
+    except Exception as e:  # present-but-null contract
+        fields["scenarios_nl_error"] = f"{type(e).__name__}: {e}"
+    print(json.dumps(fields), flush=True)
+    return fields
+
+
 def chaos_preempt_drill():
     """One injected-preemption resume (bench.py --chaos-preempt-drill).
 
@@ -2864,6 +2991,16 @@ def run_tpu_remainder(force_cpu: bool = False):
     _persist_partial(partial)
     print(json.dumps(partial), file=sys.stderr, flush=True)
 
+    # particle-filter scenario smoke: proves the SMC scan compiles and
+    # runs on the live chip; the full P in {1k, 10k} sweep is
+    # bench.py --scenarios-nl on a long window
+    buf = _io.StringIO()
+    with redirect_stdout(buf):
+        nl = scenarios_nl_section(smoke=True)
+    partial["scenarios_nl_smoke"] = nl
+    _persist_partial(partial)
+    print(json.dumps(partial), file=sys.stderr, flush=True)
+
     # serving-resilience drill: cheap (tiny panel, no extra compile
     # surface beyond the serving bucket) and platform-agnostic, but the
     # live record wants the on-device envelope-overhead number
@@ -3500,6 +3637,13 @@ def main():
                     help="scenario-engine throughput: vmapped draw fans "
                          "vs python-looped dispatch + multi-chain Gibbs "
                          "(scenarios_section); prints one JSON line")
+    ap.add_argument("--scenarios-nl", action="store_true",
+                    help="particle-filter scenario throughput: lg/sv SMC "
+                         "particles*steps/sec at P in {1k, 10k} x 8 "
+                         "lanes, vmapped-vs-looped dispatch ratio, and "
+                         "ESS-floor trip rates (scenarios_nl_section); "
+                         "persists docs/BENCH_scenarios_nl.json and "
+                         "prints one JSON line (--smoke: P=256, 2 lanes)")
     ap.add_argument("--chaos-serving", action="store_true",
                     help="serving-resilience drill: typed-response "
                          "fraction / availability / degraded fraction "
@@ -3564,6 +3708,9 @@ def main():
         return
     if args.scenarios:
         scenarios_section()
+        return
+    if args.scenarios_nl:
+        scenarios_nl_section(smoke=args.smoke)
         return
     if args.chaos_serving:
         chaos_serving_section()

@@ -24,6 +24,7 @@ shards over a device mesh exactly like bootstrap replications
 
 from __future__ import annotations
 
+import warnings
 from functools import partial
 from typing import NamedTuple
 
@@ -501,7 +502,12 @@ def ess(draws):
     Standard autocorrelation estimator: per-chain FFT autocovariances
     averaged across chains, combined with the between-chain variance
     into split-R-hat's var_plus, truncated by Geyer's initial positive
-    sequence.  Shapes as in `rhat`; returns min(c*n, c*n/tau)."""
+    sequence.  Shapes as in `rhat`; returns min(c*n, c*n/tau).
+
+    Degenerate inputs — fewer than 4 draws per chain, or chains with no
+    within/between variance (constant draws) — cannot support the
+    autocorrelation estimate; they return NaN with a warning rather
+    than a silently optimistic ``c * n``."""
     x = np.asarray(draws, np.float64)
     if x.ndim == 1:
         x = x[None, :]
@@ -512,7 +518,12 @@ def ess(draws):
         return out.reshape(x.shape[2:])
     c, n = x.shape
     if n < 4:
-        return float(c * n)
+        warnings.warn(
+            f"ess needs >= 4 draws per chain to estimate autocorrelation, "
+            f"got {n}; returning NaN",
+            stacklevel=2,
+        )
+        return float("nan")
     xc = x - x.mean(axis=1, keepdims=True)
     nfft = 1 << (2 * n - 1).bit_length()
     f = np.fft.rfft(xc, nfft, axis=1)
@@ -522,7 +533,13 @@ def ess(draws):
     B = n * x.mean(axis=1).var(ddof=1) if c > 1 else 0.0
     var_plus = (n - 1.0) / n * W + B / n
     if not var_plus > 0:
-        return float(c * n)
+        warnings.warn(
+            "ess got constant chains (zero within- and between-chain "
+            "variance); the effective sample size is undefined, "
+            "returning NaN",
+            stacklevel=2,
+        )
+        return float("nan")
     rho = 1.0 - (W - mean_acov * n / (n - 1.0)) / var_plus
     tau, t = 1.0, 1
     while t + 1 < n:

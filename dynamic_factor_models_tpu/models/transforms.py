@@ -477,3 +477,34 @@ def enumerate_stacks(spec) -> list:
             )
         )
     return entries
+
+
+class SMCPlanEntry(NamedTuple):
+    """One derived SMC AOT-plan entry: the ``smc_filter@<model>``
+    registry key, the particle-model name, and the particle count.
+    `scenarios/smc.aot_plan` builds the avals/statics/warmup generically
+    from the entry, so — like the EM stacks above — adding a model here
+    is ALL it takes to precompile it."""
+
+    key: str
+    model: str
+    particles: int
+
+
+# the particle models with a data-free plan: "tvp" is excluded because
+# its aux carries a panel-length factor path (the plan would key on a
+# run's data, not its shape), so tvp requests warm through the jit cache
+SMC_AOT_MODELS = ("lg", "sv", "msdfm")
+
+
+def enumerate_smc(spec) -> list:
+    """Derive the SMC-family AOT kernel plan from a CompileSpec: one
+    ``smc_filter@<model>`` entry per AOT-able particle model, gated on
+    ``particle_count > 0`` so existing specs register nothing new (the
+    kernel-count pin in tests/test_perf_regression.py holds the line)."""
+    P = getattr(spec, "particle_count", 0)
+    if P <= 0:
+        return []
+    return [
+        SMCPlanEntry(f"smc_filter@{m}", m, int(P)) for m in SMC_AOT_MODELS
+    ]

@@ -14,15 +14,29 @@ guardrails):
   utils.compile keyed on (bucket, n_draws).
 * `api`    — ScenarioRequest/ScenarioResult and the `run_scenario`
   dispatcher the serving engine routes `kind="scenario"` requests to.
+* `particles` / `smc` — the composable SMC subsystem: pure per-step
+  kernels (systematic resampling, adaptive ESS triggering, Liu-West
+  jitter) assembled into ONE guarded scan-outside/vmap-inside particle
+  filter over scenario lanes, with linear-Gaussian, stochastic-
+  volatility, Markov-switching and TVP-loading particle models — the
+  nonlinear density backends behind `kind="nowcast_density"` /
+  `"regime_stress"` / `"hierarchical"` requests.
 """
 
-from .api import ScenarioRequest, ScenarioResult, run_scenario
+from .api import (
+    ScenarioRequest,
+    ScenarioResult,
+    ScenarioValidationError,
+    run_scenario,
+)
 from .fanout import conditional_fan, draw_fan, forecast_fan, stress_fan
 from .gibbs import MultiChainResult, sample_chains
+from .smc import SMCResult, smc_filter
 
 __all__ = [
     "ScenarioRequest",
     "ScenarioResult",
+    "ScenarioValidationError",
     "run_scenario",
     "conditional_fan",
     "draw_fan",
@@ -30,4 +44,6 @@ __all__ = [
     "stress_fan",
     "MultiChainResult",
     "sample_chains",
+    "SMCResult",
+    "smc_filter",
 ]

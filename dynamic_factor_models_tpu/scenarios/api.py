@@ -14,6 +14,22 @@ Request kinds:
     stress           S factor-shock vectors -> shifted forecast fans
     draw_fan         S paths x n_draws simulation-smoother draws
     news             batched nowcast-news decomposition over targets
+    nowcast_density  particle quantile-BAND densities from the SMC
+                     subsystem (scenarios/smc.py) under `model` ("sv"
+                     stochastic volatility, "tvp" drifting loadings,
+                     "lg" the linear-Gaussian check model) — densities,
+                     not point nowcasts
+    regime_stress    Markov-switching stress fans: shocks applied with
+                     the latent regime distributed per `msdfm.kim_filter`
+                     filtered probabilities (model="msdfm")
+    hierarchical     multilevel scenarios: shock a GLOBAL factor, fan the
+                     response out per block (model="multilevel")
+
+Validation raises `ScenarioValidationError`, a ValueError subclass that
+NAMES the offending request field (`.field`) — the serving engine maps
+it onto the `serving/resilience.ErrorInfo.field` slot, so a malformed
+scenario comes back as a typed client error pointing at the exact field
+instead of a generic message.
 """
 
 from __future__ import annotations
@@ -21,22 +37,68 @@ from __future__ import annotations
 from typing import NamedTuple
 
 import jax.numpy as jnp
+import numpy as np
 
 from ..models.ssm import SSMParams
 from ..utils.telemetry import run_record
 from . import fanout
 
-__all__ = ["ScenarioRequest", "ScenarioResult", "run_scenario", "KINDS"]
+__all__ = [
+    "ScenarioRequest",
+    "ScenarioResult",
+    "ScenarioValidationError",
+    "run_scenario",
+    "KINDS",
+    "NL_KINDS",
+]
 
-KINDS = ("conditional_fan", "stress", "draw_fan", "news")
+KINDS = (
+    "conditional_fan", "stress", "draw_fan", "news",
+    "nowcast_density", "regime_stress", "hierarchical",
+)
+# the particle/nonlinear kinds added with the SMC subsystem; the first
+# four dispatch to scenarios/fanout.py exactly as before (their device
+# programs are untouched — the clean-path HLO pin)
+NL_KINDS = ("nowcast_density", "regime_stress", "hierarchical")
+
+_NL_MODELS = {
+    "nowcast_density": ("sv", "lg", "tvp"),  # first entry = default
+    "regime_stress": ("msdfm",),
+    "hierarchical": ("multilevel",),
+}
+
+
+class ScenarioValidationError(ValueError):
+    """A malformed ScenarioRequest; `field` names the offending request
+    field (the `serving/resilience.ErrorInfo.field` convention, so the
+    engine's typed client-error envelope can point at it)."""
+
+    def __init__(self, field: str, message: str):
+        super().__init__(message)
+        self.field = field
+
+
+def _fail(field: str, message: str):
+    raise ScenarioValidationError(field, message)
 
 
 class ScenarioRequest(NamedTuple):
     """One scenario fan.  Unused fields stay None/0 per kind:
     `conditions` (S, horizon, N) NaN-unconstrained paths
     (conditional_fan / draw_fan; None = one unconditional lane);
-    `shocks` (S, r) factor-innovation impulses (stress); `x_new` +
-    `targets` the new vintage and (n_tgt, 2) target entries (news)."""
+    `shocks` (S, r) factor-innovation impulses (stress / the particle
+    kinds; regime_stress shocks the scalar factor, so (S, 1)); `x_new` +
+    `targets` the new vintage and (n_tgt, 2) target entries (news).
+
+    The nonlinear kinds add: `model` selecting the particle model
+    (default per kind — see NL_KINDS in the module docstring),
+    `particles` the particle count (0 = default 1024), `ess_floor` the
+    adaptive-resampling ESS fraction, `quantiles` the density-band
+    levels (None = (.05, .25, .5, .75, .95)), `blocks` the per-block
+    column-index lists (hierarchical), and `model_config` a dict of
+    model knobs (sv: mu_h/phi_h/sig_h; tvp: q; msdfm: msdfm_params to
+    skip the fit, or fit_steps/fit_restarts; hierarchical: r_global /
+    r_block)."""
 
     kind: str
     horizon: int = 12
@@ -46,13 +108,30 @@ class ScenarioRequest(NamedTuple):
     seed: int = 0
     x_new: object | None = None
     targets: object | None = None
+    model: str | None = None
+    particles: int = 0
+    ess_floor: float = 0.5
+    quantiles: object | None = None
+    blocks: object | None = None
+    model_config: dict | None = None
 
 
 class ScenarioResult(NamedTuple):
     """Fan output; populated fields depend on the request kind.  mean/sd
     are (S, horizon, N); factor_mean (S, horizon, r); draws
     (S, n_draws, horizon, N) posterior-predictive paths; news is a
-    models.news.NowcastNewsBatch for kind="news"."""
+    models.news.NowcastNewsBatch for kind="news".
+
+    The particle kinds return density BANDS instead of draws:
+    `bands` (S, horizon, n_quantiles, N) predictive quantile bands at
+    the `quantiles` levels, plus per-lane weight/ESS telemetry —
+    `ess` (S, T) the pre-resample effective-sample-size trace,
+    `ess_min` (S,) its per-lane minimum, `resample_rate` (S,) the
+    ESS-floor trip rate, `health` (S,) utils.guards codes (a frozen
+    degenerate lane reports nonzero health; its bands are stale) —
+    and kind-specific extras: `regime_probs` (T, M) Kim-filtered regime
+    probabilities (regime_stress), `block_means` (S, horizon, n_blocks)
+    per-block mean responses (hierarchical)."""
 
     kind: str
     mean: jnp.ndarray | None = None
@@ -62,6 +141,14 @@ class ScenarioResult(NamedTuple):
     draws: jnp.ndarray | None = None
     factor_draws: jnp.ndarray | None = None
     news: object | None = None
+    bands: jnp.ndarray | None = None
+    quantiles: tuple | None = None
+    ess: jnp.ndarray | None = None
+    ess_min: jnp.ndarray | None = None
+    resample_rate: jnp.ndarray | None = None
+    health: np.ndarray | None = None
+    regime_probs: jnp.ndarray | None = None
+    block_means: jnp.ndarray | None = None
 
 
 def run_scenario(
@@ -69,12 +156,16 @@ def run_scenario(
 ) -> ScenarioResult:
     """Dispatch one ScenarioRequest against a fitted model and its
     (standardized) panel.  Each kind is one or two vmapped device
-    programs (scenarios/fanout.py) — never a host loop over scenarios
-    or draws."""
+    programs (scenarios/fanout.py for the linear-Gaussian kinds,
+    scenarios/smc.py's guarded multi-lane particle filter for the
+    nonlinear ones) — never a host loop over scenarios or draws."""
     if req.kind not in KINDS:
-        raise ValueError(
-            f"unknown scenario kind {req.kind!r}; valid: {', '.join(KINDS)}"
+        _fail(
+            "kind",
+            f"unknown scenario kind {req.kind!r}; valid: {', '.join(KINDS)}",
         )
+    if req.kind in NL_KINDS:
+        return _run_nonlinear(params, x, req)
     with run_record(
         "scenario",
         kind=req.kind,
@@ -100,7 +191,7 @@ def run_scenario(
             )
         if req.kind == "stress":
             if req.shocks is None:
-                raise ValueError("stress scenarios need `shocks` (S, r)")
+                _fail("shocks", "stress scenarios need `shocks` (S, r)")
             mean, sd, f = fanout.stress_fan(
                 params, x, req.horizon, req.shocks
             )
@@ -111,7 +202,7 @@ def run_scenario(
         if req.kind == "draw_fan":
             n_draws = int(req.n_draws or 0)
             if n_draws < 1:
-                raise ValueError("draw_fan needs n_draws >= 1")
+                _fail("n_draws", "draw_fan needs n_draws >= 1")
             f_draws, draws, _ = fanout.draw_fan(
                 params, x, req.horizon, n_draws,
                 conditions=req.conditions, seed=req.seed,
@@ -125,10 +216,216 @@ def run_scenario(
                 factor_draws=f_draws,
             )
         # news
-        if req.x_new is None or req.targets is None:
-            raise ValueError("news scenarios need `x_new` and `targets`")
+        if req.x_new is None:
+            _fail("x_new", "news scenarios need `x_new` and `targets`")
+        if req.targets is None:
+            _fail("targets", "news scenarios need `x_new` and `targets`")
         from ..models.news import nowcast_news_batch
 
         nb = nowcast_news_batch(params, x, req.x_new, req.targets)
         rec.set(n_paths=int(nb.targets.shape[0]))
         return ScenarioResult(req.kind, news=nb)
+
+
+def _validate_nl(params, req: ScenarioRequest):
+    """Shared validation of the nonlinear-kind knobs; returns the
+    resolved (model, particles, quantiles, ess_floor, config)."""
+    valid = _NL_MODELS[req.kind]
+    model = req.model or valid[0]
+    if model not in valid:
+        _fail(
+            "model",
+            f"scenario kind {req.kind!r} needs model in "
+            f"{valid}; got {model!r}",
+        )
+    particles = int(req.particles or 1024)
+    if particles < 2:
+        _fail("particles", f"particles must be >= 2, got {req.particles}")
+    ess_floor = float(req.ess_floor)
+    if not 0.0 < ess_floor <= 1.0:
+        _fail(
+            "ess_floor",
+            f"ess_floor must be in (0, 1], got {req.ess_floor}",
+        )
+    from . import smc as _smc
+
+    if req.quantiles is None:
+        quantiles = _smc.DEFAULT_QUANTILES
+    else:
+        quantiles = tuple(float(q) for q in np.asarray(req.quantiles).ravel())
+        if not quantiles or any(not 0.0 < q < 1.0 for q in quantiles):
+            _fail(
+                "quantiles",
+                "quantiles must be a non-empty sequence inside (0, 1)",
+            )
+    if int(req.horizon) < 1:
+        _fail("horizon", f"horizon must be >= 1, got {req.horizon}")
+    config = req.model_config or {}
+    if not isinstance(config, dict):
+        _fail(
+            "model_config",
+            f"model_config must be a dict, got {type(config).__name__}",
+        )
+    return model, particles, quantiles, ess_floor, config
+
+
+def _nl_shocks(req: ScenarioRequest, sd: int, required: bool):
+    """Coerce/validate the stress shocks for a particle kind; returns a
+    (S, sd) float array (S = 1 unshocked lane when optional + absent)."""
+    if req.shocks is None:
+        if required:
+            _fail("shocks", f"{req.kind} scenarios need `shocks` (S, {sd})")
+        return None
+    shocks = np.asarray(req.shocks, float)
+    if shocks.ndim == 1:
+        shocks = shocks[:, None] if sd == 1 else shocks[None, :]
+    if shocks.ndim != 2 or shocks.shape[1] != sd:
+        _fail(
+            "shocks",
+            f"{req.kind} shocks must be (S, {sd}), "
+            f"got {tuple(shocks.shape)}",
+        )
+    return shocks
+
+
+def _particle_result(req, res, quantiles, **extra) -> ScenarioResult:
+    """Fold an smc.SMCResult into the ScenarioResult envelope with the
+    per-lane weights/ESS telemetry every particle kind reports."""
+    return ScenarioResult(
+        req.kind,
+        mean=res.mean,
+        sd=res.sd,
+        bands=res.bands,
+        quantiles=tuple(quantiles),
+        ess=res.ess,
+        ess_min=res.ess.min(axis=1),
+        resample_rate=res.resampled.mean(axis=1),
+        health=res.health,
+        **extra,
+    )
+
+
+def _rec_particles(rec, res, particles: int) -> None:
+    rec.set(
+        n_paths=int(res.ess.shape[0]),
+        n_particles=int(particles),
+        ess_min=float(np.asarray(res.ess.min())),
+        faults_detected=int((res.health != 0).sum()) or None,
+    )
+
+
+def _run_nonlinear(params, x, req: ScenarioRequest) -> ScenarioResult:
+    model, particles, quantiles, ess_floor, config = _validate_nl(params, req)
+    from . import smc as _smc
+
+    with run_record(
+        "scenario",
+        kind=req.kind,
+        config={
+            "horizon": int(req.horizon),
+            "model": model,
+            "particles": particles,
+        },
+    ) as rec:
+        if req.kind == "nowcast_density":
+            r = params.r
+            shocks = _nl_shocks(req, _smc.shock_dim(model, r), required=False)
+            aux = ()
+            if model == "sv":
+                to_r = lambda v, d: jnp.broadcast_to(  # noqa: E731
+                    jnp.asarray(float(config.get(v, d))), (r,)
+                ).astype(params.lam.dtype)
+                aux = (to_r("mu_h", 0.0), to_r("phi_h", 0.95),
+                       to_r("sig_h", 0.2))
+            elif model == "tvp":
+                from ..models.ssm import kalman_filter
+
+                F = kalman_filter(params, x).means[:, :r]
+                aux = (F, jnp.asarray(float(config.get("q", 1e-3)),
+                                      params.lam.dtype))
+            res = _smc.smc_filter(
+                params, x, model=model, aux=aux, n_particles=particles,
+                n_lanes=1 if shocks is None else None, shocks=shocks,
+                horizon=int(req.horizon), quantiles=quantiles,
+                ess_frac=ess_floor, seed=int(req.seed),
+            )
+            _rec_particles(rec, res, particles)
+            return _particle_result(req, res, quantiles)
+
+        if req.kind == "regime_stress":
+            from ..models.msdfm import MSDFMParams, kim_filter
+            from ..ops.masking import mask_of
+
+            shocks = _nl_shocks(req, 1, required=True)
+            mp = config.get("msdfm_params")
+            if mp is not None:
+                mp = MSDFMParams(*[jnp.asarray(a) for a in mp])
+                xs = jnp.asarray(x)
+            else:
+                from ..models.msdfm import fit_ms_dfm
+
+                fit = fit_ms_dfm(
+                    x,
+                    n_regimes=int(config.get("n_regimes", 2)),
+                    n_steps=int(config.get("fit_steps", 300)),
+                    n_restarts=int(config.get("fit_restarts", 1)),
+                    seed=int(req.seed),
+                )
+                mp = fit.params
+                # the fit standardizes internally; filter the same panel
+                xs = (jnp.asarray(x) - fit.means) / fit.stds
+            _, filt_probs, _, _, _ = kim_filter(
+                mp, jnp.nan_to_num(xs), mask_of(xs)
+            )
+            res = _smc.smc_filter(
+                mp, xs, model="msdfm", n_particles=particles,
+                shocks=shocks, horizon=int(req.horizon),
+                quantiles=quantiles, ess_frac=ess_floor,
+                seed=int(req.seed),
+            )
+            _rec_particles(rec, res, particles)
+            return _particle_result(
+                req, res, quantiles, regime_probs=filt_probs
+            )
+
+        # hierarchical (model == "multilevel")
+        if req.blocks is None:
+            _fail(
+                "blocks",
+                "hierarchical scenarios need `blocks` (per-block "
+                "column-index lists)",
+            )
+        try:
+            blocks = [np.asarray(b, int) for b in req.blocks]
+        except (TypeError, ValueError):
+            _fail("blocks", "blocks must be a sequence of index sequences")
+        if not blocks or any(b.ndim != 1 or b.size == 0 for b in blocks):
+            _fail("blocks", "blocks must be non-empty index sequences")
+        r_global = int(config.get("r_global", 1))
+        shocks = _nl_shocks(req, r_global, required=True)
+        from ..models.multilevel import estimate_multilevel_dfm
+
+        mr = estimate_multilevel_dfm(
+            x, blocks, r_global, int(config.get("r_block", 1)),
+            max_outer=int(config.get("max_outer", 50)),
+        )
+        gf = np.asarray(mr.global_factors)  # (T, r_g)
+        # AR(1) persistence per global factor drives the impulse decay
+        num = (gf[1:] * gf[:-1]).sum(axis=0)
+        den = (gf[:-1] ** 2).sum(axis=0)
+        rho = np.clip(num / np.maximum(den, 1e-12), -0.99, 0.99)
+        H = int(req.horizon)
+        decay = rho[None, :] ** np.arange(H)[:, None]  # (H, r_g)
+        f_path = shocks[:, None, :] * decay[None, :, :]  # (S, H, r_g)
+        gl = np.asarray(mr.global_loadings)  # (N, r_g)
+        mean = np.einsum("shr,nr->shn", f_path, gl)
+        block_means = np.stack(
+            [mean[:, :, b].mean(axis=2) for b in blocks], axis=2
+        )
+        rec.set(n_paths=int(mean.shape[0]))
+        return ScenarioResult(
+            req.kind,
+            mean=jnp.asarray(mean),
+            factor_mean=jnp.asarray(f_path),
+            block_means=jnp.asarray(block_means),
+        )

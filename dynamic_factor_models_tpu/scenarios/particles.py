@@ -1,0 +1,131 @@
+"""Pure SMC kernel primitives, BlackJAX-style: small stateless functions.
+
+Each primitive does ONE thing on a flat particle axis and composes into
+the `scenarios/smc.py` scan program exactly like the transform stack
+composes EM pieces — policy (when to resample, whether to jitter
+parameters) lives in the caller, numerics live here, and nothing in this
+module knows about lanes, guards, or serving.
+
+Conventions:
+
+* log-weights are carried UN-exponentiated everywhere; `normalize_logw`
+  is the only place a normalizer is computed, so the particle loglik
+  estimator (sum of per-step increments) and the ESS share one numeric
+  path;
+* resampling is systematic via the sorted-uniform construction: the
+  stratified uniforms ``(i + u)/P`` are already sorted, so the inverse
+  CDF lookup is one cumulative-sum scan plus one monotone merge
+  (`jnp.searchsorted`) — no per-particle host loop, no O(P^2) compare;
+* `adaptive_resample` wraps the resampler in a ``lax.cond`` on the
+  effective sample size, so the clean-path HLO contains both branches
+  but executes the cheap one when the ESS is healthy — under an outer
+  ``vmap`` over scenario lanes the cond lowers to a per-lane select,
+  which is exactly the lane-isolation property the degenerate-lane
+  drill pins;
+* `liu_west_jitter` is the opt-in parameter-learning kernel (Liu-West
+  kernel shrinkage / Storvik-style rejuvenation): it never runs unless a
+  model asks for it, so state-only filters pay nothing.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.scipy.special import logsumexp
+
+__all__ = [
+    "normalize_logw",
+    "ess_of",
+    "systematic_indices",
+    "systematic_resample",
+    "adaptive_resample",
+    "liu_west_jitter",
+]
+
+
+def normalize_logw(logw: jnp.ndarray):
+    """Normalize log-weights; returns (normalized logw, log normalizer).
+
+    The normalizer ``logsumexp(logw)`` is the per-step marginal-likelihood
+    increment when `logw` entered as (previous normalized weights +
+    observation log-density), which is how the smc.py scan calls it."""
+    lse = logsumexp(logw)
+    return logw - lse, lse
+
+
+def ess_of(logw: jnp.ndarray) -> jnp.ndarray:
+    """Effective sample size 1/sum(w_i^2) of NORMALIZED log-weights.
+
+    P for uniform weights, 1.0 when one particle carries everything;
+    NaN weights propagate to a NaN ESS (the guard layer's freeze
+    signal, never silently clipped here)."""
+    return jnp.exp(-logsumexp(2.0 * logw))
+
+
+def systematic_indices(key, logw: jnp.ndarray) -> jnp.ndarray:
+    """Systematic-resampling ancestor indices from normalized log-weights.
+
+    One shared uniform strata offset: positions ``(i + u)/P`` are sorted
+    by construction, so inverting the empirical CDF is ``cumsum`` (the
+    scan) + ``searchsorted`` (a monotone merge of two sorted sequences).
+    Returns (P,) int32 ancestor indices; low-variance (each particle's
+    offspring count differs from P*w_i by < 1)."""
+    P = logw.shape[0]
+    w = jnp.exp(logw - logsumexp(logw))
+    u = (jax.random.uniform(key, dtype=w.dtype) + jnp.arange(P, dtype=w.dtype)) / P
+    cw = jnp.cumsum(w)
+    # guard the top edge: float cumsum can land at 1 - eps, and the last
+    # stratum must still find an ancestor
+    cw = cw.at[-1].set(jnp.maximum(cw[-1], 1.0))
+    return jnp.searchsorted(cw, u).astype(jnp.int32)
+
+
+def systematic_resample(key, particles, logw: jnp.ndarray):
+    """Resample a particle pytree (leading axis P) to uniform weights.
+
+    Returns (resampled particles, uniform normalized log-weights)."""
+    idx = systematic_indices(key, logw)
+    parts = jax.tree_util.tree_map(lambda a: a[idx], particles)
+    P = logw.shape[0]
+    return parts, jnp.full((P,), -jnp.log(float(P)), logw.dtype)
+
+
+def adaptive_resample(key, particles, logw: jnp.ndarray, ess_frac: float):
+    """ESS-triggered systematic resampling as a ``lax.cond``.
+
+    `logw` must be normalized.  When ``ESS < ess_frac * P`` the particles
+    are resampled and the weights reset to uniform; otherwise both pass
+    through untouched.  Returns (particles, logw, resampled?, ess) with
+    `ess` the PRE-resample value — the telemetry the floor-trip-rate
+    counters and the degenerate-lane guard read."""
+    P = logw.shape[0]
+    e = ess_of(logw)
+
+    def _do(_):
+        parts, lw = systematic_resample(key, particles, logw)
+        return parts, lw
+
+    def _skip(_):
+        return particles, logw
+
+    trip = e < ess_frac * P
+    parts, lw = jax.lax.cond(trip, _do, _skip, None)
+    return parts, lw, trip, e
+
+
+def liu_west_jitter(key, theta: jnp.ndarray, logw: jnp.ndarray,
+                    delta: float = 0.98) -> jnp.ndarray:
+    """Liu-West kernel-shrinkage jitter of (P, d) parameter particles.
+
+    Shrinks each particle toward the weighted mean by ``a = (3δ-1)/(2δ)``
+    and adds N(0, (1-a²) diag(V)) noise, so the first two weighted
+    moments of the parameter cloud are preserved exactly while ties from
+    resampling are broken — the opt-in rejuvenation wrapper for models
+    that carry static parameters in the particle state.  `logw` must be
+    normalized."""
+    w = jnp.exp(logw)[:, None]
+    a = (3.0 * delta - 1.0) / (2.0 * delta)
+    mean = (w * theta).sum(axis=0)
+    var = (w * (theta - mean) ** 2).sum(axis=0)
+    eps = jax.random.normal(key, theta.shape, theta.dtype)
+    return a * theta + (1.0 - a) * mean + eps * jnp.sqrt((1.0 - a * a) * var)

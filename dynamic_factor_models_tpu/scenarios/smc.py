@@ -1,0 +1,613 @@
+"""Guarded multi-lane particle filtering: S scenario lanes in one program.
+
+The nonlinear counterpart of `scenarios/gibbs.py`, with the same shape:
+the time scan stays on the OUTSIDE and every step body is one
+``jax.vmap`` over the scenario-lane axis; inside a lane the particle
+axis is plain batched array algebra, so the whole filter is a single
+``lax.scan`` program — no host loop over lanes, steps, or particles.
+The per-step kernels (proposal, weighting, ESS-triggered systematic
+resampling, optional Liu-West jitter) come from `scenarios/particles.py`
+and compose BlackJAX-style: a *model* is four closures (init / propose /
+log_obs / forecast + a summarize reducer), and the program is model-
+agnostic — adding a state-space model means writing four small functions
+here, never touching the scan.
+
+Models (built inside the jit trace from traced parameters, selected by a
+static name so each model compiles its own specialized program):
+
+    lg     bootstrap filter on the linear-Gaussian companion DFM — the
+           validation model: its loglik and filtered means must match
+           `models/ssm.kalman_filter` within Monte-Carlo error
+           (~1/sqrt(P), pinned by tests/test_scenario_nl.py)
+    sv     stochastic-volatility factors (models/sv.py's model): factor
+           VAR with log-variance AR(1) states riding in the particle
+    msdfm  Markov-switching factor (models/msdfm.py's model): the
+           particle carries (z, S_t) and regime probabilities are the
+           weighted regime frequencies — validated against `kim_filter`
+    tvp    random-walk time-varying loadings (models/tvp.py's model)
+           given a factor path, the particle carries vec(Lambda_t)
+
+Degenerate-weight lanes freeze via the PR 7 guarded pattern, verbatim
+from gibbs.py: after each vmapped step a per-lane
+`utils.guards.batched_tree_finite` check marks lanes whose particles,
+weights, or loglik went non-finite (an all-zero weight step collapses to
+``logsumexp = -inf`` and is caught here too — ESS floor breaches above
+total collapse resample adaptively, only a fully degenerate lane goes
+non-finite); the lane's carry rolls back to last-good and is FROZEN —
+later steps still ride through the vmapped body but every result is
+discarded by the per-lane select, so surviving lanes are bit-identical
+to a fault-free run (vmap is elementwise across lanes).  The host drops
+frozen lanes afterwards.  ``DFM_FAULTS=nan_draw@k`` NaNs lane 0's k-th
+step's weights — the same deterministic drill grammar as the Gibbs
+divergence drill, compiled as a static so the clean-path HLO carries no
+injection code.
+
+AOT: `utils/compile._kernel_plan` registers one ``smc_filter@<model>``
+plan per `models/transforms.enumerate_smc` entry (gated on
+``CompileSpec.particle_count``); `aot_plan` below builds the generic
+(avals, statics, warmup) triple so there is no hand-written plan body
+per model — the transform-stack doctrine applied to SMC.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.msdfm import MSDFMParams
+from ..models.ssm import SSMParams, _companion
+from ..ops.masking import fillz, mask_of
+from ..utils import faults as _faults
+from ..utils import guards as _guards
+from ..utils.compile import aot_call, aot_statics
+from ..utils.telemetry import inc
+from . import particles as _pk
+
+__all__ = [
+    "ParticleModel",
+    "SMCResult",
+    "smc_filter",
+    "shock_dim",
+    "summary_dim",
+    "aot_plan",
+    "DEFAULT_QUANTILES",
+    "SMC_MODELS",
+]
+
+DEFAULT_QUANTILES = (0.05, 0.25, 0.5, 0.75, 0.95)
+
+# models the serving/AOT layer knows; "tvp" filters through the jit
+# cache only (its aux carries a panel-length factor path, so an AOT
+# entry would key on data, not shape — see transforms.enumerate_smc)
+SMC_MODELS = ("lg", "sv", "msdfm", "tvp")
+
+
+class ParticleModel(NamedTuple):
+    """One state-space model as four pure closures over a (P, d) particle
+    block.  `init(key) -> (P, d)`; `propose(key, parts, t) -> (P, d)`
+    advances one transition; `log_obs(parts, y_t, m_t, t) -> (P,)` is the
+    observation log-density with a {0,1} mask; `forecast(key, parts,
+    shock) -> (parts, y_pred)` simulates one unconditional step INCLUDING
+    measurement noise (the predictive-density sample the quantile bands
+    are cut from), with `shock` added to the latent innovation mean;
+    `summarize(parts, w) -> (d_sum,)` reduces the weighted cloud to the
+    per-step filtered summary the scan materializes."""
+
+    init: Callable
+    propose: Callable
+    log_obs: Callable
+    forecast: Callable
+    summarize: Callable
+
+
+class SMCResult(NamedTuple):
+    """Multi-lane SMC output, lane axis leading everywhere.
+
+    `loglik` (S,) particle marginal-likelihood estimates; `summary`
+    (S, T, d_sum) per-step filtered summaries (model-specific layout —
+    see `summary_dim`; a frozen lane repeats its last-good summary);
+    `ess` (S, T) PRE-resample effective sample sizes — the diagnostic
+    trace, live even after a freeze; `resampled` (S, T) ESS-floor trips
+    (False after a freeze); `health` (S,) utils.guards codes, 0 = ok;
+    `bands`/`mean`/`sd` the predictive fan over `horizon` steps —
+    bands (S, horizon, n_quantiles, N), None when horizon == 0."""
+
+    loglik: jnp.ndarray
+    summary: jnp.ndarray
+    ess: jnp.ndarray
+    resampled: jnp.ndarray
+    health: np.ndarray
+    bands: jnp.ndarray | None = None
+    mean: jnp.ndarray | None = None
+    sd: jnp.ndarray | None = None
+
+
+def _masked_gauss_ll(mu, y_t, m_t, Rdiag):
+    """(P, N) predicted means -> (P,) masked diag-Gaussian log-density."""
+    log2pi = jnp.asarray(np.log(2.0 * np.pi), mu.dtype)
+    e2 = (y_t[None, :] - mu) ** 2 / Rdiag[None, :]
+    per = e2 + jnp.log(Rdiag)[None, :] + log2pi
+    return -0.5 * (m_t[None, :] * per).sum(axis=1)
+
+
+def _lg_model(params: SSMParams, aux, P: int) -> ParticleModel:
+    """Bootstrap filter on the companion-form linear-Gaussian DFM.
+
+    Matches `kalman_filter`'s generative model exactly — same diffuse
+    init N(0, 100 I) (ssm._init_state), same transition, same masked
+    diagonal observation density — so the parity pin has no model gap,
+    only Monte-Carlo error."""
+    r = params.r
+    Tm, _ = _companion(params)
+    Lq = jnp.linalg.cholesky(params.Q)
+    k = Tm.shape[0]
+
+    def init(key):
+        return 10.0 * jax.random.normal(key, (P, k), params.lam.dtype)
+
+    def propose(key, parts, t):
+        eps = jax.random.normal(key, (P, r), parts.dtype)
+        sp = parts @ Tm.T
+        return sp.at[:, :r].add(eps @ Lq.T)
+
+    def log_obs(parts, y_t, m_t, t):
+        return _masked_gauss_ll(parts[:, :r] @ params.lam.T, y_t, m_t, params.R)
+
+    def forecast(key, parts, shock):
+        k1, k2 = jax.random.split(key)
+        sp = propose(k1, parts, 0).at[:, :r].add(shock[None, :])
+        eps = jax.random.normal(k2, (P, params.lam.shape[0]), parts.dtype)
+        y = sp[:, :r] @ params.lam.T + eps * jnp.sqrt(params.R)[None, :]
+        return sp, y
+
+    def summarize(parts, w):
+        return (w[:, None] * parts).sum(axis=0)
+
+    return ParticleModel(init, propose, log_obs, forecast, summarize)
+
+
+def _sv_model(params: SSMParams, aux, P: int) -> ParticleModel:
+    """Stochastic-volatility factor DFM (models/sv.py's model): the
+    factor VAR innovation variance is exp(h_t) with h AR(1); the particle
+    is [companion state (k,), h (r,)].  aux = (mu_h, phi_h, sig_h), each
+    (r,).  Summary = [filtered state mean (k,), filtered vol exp(h/2)
+    mean (r,)]."""
+    r = params.r
+    Tm, _ = _companion(params)
+    k = Tm.shape[0]
+    mu_h, phi_h, sig_h = aux
+
+    def init(key):
+        k1, k2 = jax.random.split(key)
+        s = 10.0 * jax.random.normal(k1, (P, k), params.lam.dtype)
+        h_sd = sig_h / jnp.sqrt(1.0 - phi_h**2)
+        h = mu_h[None, :] + h_sd[None, :] * jax.random.normal(
+            k2, (P, r), params.lam.dtype
+        )
+        return jnp.concatenate([s, h], axis=1)
+
+    def _step(key, parts):
+        k1, k2 = jax.random.split(key)
+        s, h = parts[:, :k], parts[:, k:]
+        h2 = mu_h + phi_h * (h - mu_h) + sig_h * jax.random.normal(
+            k1, (P, r), parts.dtype
+        )
+        eps = jax.random.normal(k2, (P, r), parts.dtype) * jnp.exp(0.5 * h2)
+        sp = (s @ Tm.T).at[:, :r].add(eps)
+        return sp, h2
+
+    def propose(key, parts, t):
+        sp, h2 = _step(key, parts)
+        return jnp.concatenate([sp, h2], axis=1)
+
+    def log_obs(parts, y_t, m_t, t):
+        return _masked_gauss_ll(parts[:, :r] @ params.lam.T, y_t, m_t, params.R)
+
+    def forecast(key, parts, shock):
+        k1, k2 = jax.random.split(key)
+        sp, h2 = _step(k1, parts)
+        sp = sp.at[:, :r].add(shock[None, :])
+        eps = jax.random.normal(k2, (P, params.lam.shape[0]), parts.dtype)
+        y = sp[:, :r] @ params.lam.T + eps * jnp.sqrt(params.R)[None, :]
+        return jnp.concatenate([sp, h2], axis=1), y
+
+    def summarize(parts, w):
+        sm = (w[:, None] * parts[:, :k]).sum(axis=0)
+        vol = (w[:, None] * jnp.exp(0.5 * parts[:, k:])).sum(axis=0)
+        return jnp.concatenate([sm, vol])
+
+    return ParticleModel(init, propose, log_obs, forecast, summarize)
+
+
+def _ms_model(params: MSDFMParams, aux, P: int) -> ParticleModel:
+    """Markov-switching single-factor DFM (models/msdfm.py's model):
+    x_t = lam (mu_{S_t} + z_t) + e, z AR(1) with regime-switching
+    innovation variance.  The particle is [z, S_t] with the regime
+    carried as a float index; regime probabilities are the weighted
+    regime frequencies.  Summary = [filtered z mean, regime probs (M,)]."""
+    M = params.mu.shape[0]
+    dtype = params.lam.dtype
+    # ergodic regime distribution for the init (M tiny: a matrix power
+    # is cheaper and simpler than an eigensolve inside the trace)
+    pi = jnp.linalg.matrix_power(params.P, 64)[0]
+    sig_bar = (pi * params.sigma2).sum()
+
+    def init(key):
+        k1, k2 = jax.random.split(key)
+        z_sd = jnp.sqrt(sig_bar / jnp.maximum(1.0 - params.phi**2, 1e-6))
+        z = z_sd * jax.random.normal(k1, (P,), dtype)
+        u = jax.random.uniform(k2, (P,), dtype)
+        s = (jnp.cumsum(pi)[None, :] < u[:, None]).sum(axis=1)
+        return jnp.stack([z, s.astype(dtype)], axis=1)
+
+    def _trans(key, parts, shock):
+        k1, k2 = jax.random.split(key)
+        z, s = parts[:, 0], parts[:, 1].astype(jnp.int32)
+        u = jax.random.uniform(k1, (P,), dtype)
+        cdf = jnp.cumsum(params.P[s], axis=1)
+        s2 = jnp.minimum((cdf < u[:, None]).sum(axis=1), M - 1)
+        eps = jax.random.normal(k2, (P,), dtype)
+        z2 = params.phi * z + shock + jnp.sqrt(params.sigma2[s2]) * eps
+        return jnp.stack([z2, s2.astype(dtype)], axis=1)
+
+    def propose(key, parts, t):
+        return _trans(key, parts, 0.0)
+
+    def log_obs(parts, y_t, m_t, t):
+        z, s = parts[:, 0], parts[:, 1].astype(jnp.int32)
+        mu = params.lam[None, :] * (params.mu[s] + z)[:, None]
+        return _masked_gauss_ll(mu, y_t, m_t, params.R)
+
+    def forecast(key, parts, shock):
+        k1, k2 = jax.random.split(key)
+        p2 = _trans(k1, parts, shock[0])
+        z, s = p2[:, 0], p2[:, 1].astype(jnp.int32)
+        mu = params.lam[None, :] * (params.mu[s] + z)[:, None]
+        eps = jax.random.normal(k2, mu.shape, dtype)
+        return p2, mu + eps * jnp.sqrt(params.R)[None, :]
+
+    def summarize(parts, w):
+        zm = (w * parts[:, 0]).sum()
+        onehot = parts[:, 1].astype(jnp.int32)[:, None] == jnp.arange(M)[None, :]
+        probs = (w[:, None] * onehot).sum(axis=0)
+        return jnp.concatenate([zm[None], probs])
+
+    return ParticleModel(init, propose, log_obs, forecast, summarize)
+
+
+def _tvp_model(params: SSMParams, aux, P: int) -> ParticleModel:
+    """Random-walk time-varying loadings (models/tvp.py's model) given a
+    factor path: the particle is vec(Lambda_t) (N*r,), proposed as a
+    random walk with per-step variance q, weighted against x_t = Lam_t
+    f_t + e.  aux = (F (T, r) factor path, q scalar).  The forecast stage
+    freezes the factor at F[-1] (+ shock) and keeps the loadings walking.
+    Summary = weighted vec(Lambda_t) mean."""
+    N, r = params.lam.shape
+    F, q = aux
+    sq = jnp.sqrt(q)
+    lam0 = params.lam.reshape(-1)
+
+    def init(key):
+        return lam0[None, :] + 3.0 * sq * jax.random.normal(
+            key, (P, N * r), params.lam.dtype
+        )
+
+    def propose(key, parts, t):
+        return parts + sq * jax.random.normal(key, parts.shape, parts.dtype)
+
+    def _mu(parts, f):
+        return jnp.einsum("pnr,r->pn", parts.reshape(P, N, r), f)
+
+    def log_obs(parts, y_t, m_t, t):
+        return _masked_gauss_ll(_mu(parts, F[t]), y_t, m_t, params.R)
+
+    def forecast(key, parts, shock):
+        k1, k2 = jax.random.split(key)
+        p2 = propose(k1, parts, 0)
+        y = _mu(p2, F[-1] + shock) + jax.random.normal(
+            k2, (P, N), parts.dtype
+        ) * jnp.sqrt(params.R)[None, :]
+        return p2, y
+
+    def summarize(parts, w):
+        return (w[:, None] * parts).sum(axis=0)
+
+    return ParticleModel(init, propose, log_obs, forecast, summarize)
+
+
+_MODELS = {
+    "lg": _lg_model,
+    "sv": _sv_model,
+    "msdfm": _ms_model,
+    "tvp": _tvp_model,
+}
+
+
+def shock_dim(model: str, r: int) -> int:
+    """Width of one stress-shock vector for `model` (msdfm's factor is
+    scalar; every other model shocks the r factor innovations)."""
+    return 1 if model == "msdfm" else r
+
+
+def summary_dim(model: str, params, M: int = 2) -> int:
+    """Trailing width of SMCResult.summary for `model` (layout doc:
+    lg = companion state (k,); sv = state (k,) + vols (r,); msdfm =
+    [z, regime probs (M,)]; tvp = vec(Lambda) (N*r,))."""
+    if model == "msdfm":
+        return 1 + M
+    r, p = params.r, params.p
+    k = r * p
+    if model == "lg":
+        return k
+    if model == "sv":
+        return k + r
+    return params.lam.shape[0] * r
+
+
+@partial(
+    jax.jit,
+    static_argnames=("model", "n_particles", "horizon", "ess_frac", "inject_at"),
+)
+def _smc_impl(
+    params,
+    aux,
+    keys,
+    yz,
+    m,
+    shocks,
+    quantiles,
+    *,
+    model: str,
+    n_particles: int,
+    horizon: int,
+    ess_frac: float,
+    inject_at: int = 0,
+):
+    """All S lanes through the filter (+ forecast) scans together, guarded.
+
+    `keys` (S, 2) per-lane PRNG keys; `yz` (T, N) zero-filled panel with
+    `m` its {0,1} mask; `shocks` (S, shock_dim) latent-innovation
+    impulses applied at the first forecast step (zeros = plain
+    predictive density); `quantiles` (Q,) band levels.  Statics select
+    the model program and size the particle block, so one executable per
+    (model, P, horizon, ess_frac) serves every panel of the same shape."""
+    S = keys.shape[0]
+    T = yz.shape[0]
+    P = n_particles
+    pm = _MODELS[model](params, aux, P)
+
+    ks2 = jax.vmap(lambda k_: jax.random.split(k_))(keys)  # (S, 2, 2)
+    k_init, k_scan = ks2[:, 0], ks2[:, 1]
+    parts0 = jax.vmap(pm.init)(k_init)
+    logw0 = jnp.full((S, P), -jnp.log(float(P)), yz.dtype)
+    ll0 = jnp.zeros((S,), yz.dtype)
+
+    def lane_step(key, parts, logw, inp):
+        t, y_t, m_t = inp
+        key, kp, kr = jax.random.split(key, 3)
+        newp = pm.propose(kp, parts, t)
+        lw, ll_inc = _pk.normalize_logw(logw + pm.log_obs(newp, y_t, m_t, t))
+        newp, lw, trip, e = _pk.adaptive_resample(kr, newp, lw, ess_frac)
+        return key, newp, lw, ll_inc, trip, e
+
+    vstep = jax.vmap(lane_step, in_axes=(0, 0, 0, None))
+
+    def body(carry, inp):
+        (ks, parts, logw, ll), health = carry
+        nk, np_, nlw, llinc, trip, e = vstep(ks, parts, logw, inp)
+        nll = ll + llinc
+        if inject_at:
+            hit = inp[0] + 1 == inject_at
+            nlw = nlw.at[0].set(
+                jnp.where(hit, jnp.full_like(nlw[0], jnp.nan), nlw[0])
+            )
+        finite = _guards.batched_tree_finite((np_, nlw, nll))
+        ok = health == _guards.HEALTH_OK
+        adv = ok & finite
+        ks2, parts2, logw2, ll2 = _guards.batched_where(
+            adv, (nk, np_, nlw, nll), (ks, parts, logw, ll)
+        )
+        health = jnp.where(
+            ok & ~finite, _guards.HEALTH_NONFINITE, health
+        ).astype(jnp.int32)
+        summ = jax.vmap(lambda p_, lw: pm.summarize(p_, jnp.exp(lw)))(
+            parts2, logw2
+        )
+        return ((ks2, parts2, logw2, ll2), health), (summ, e, trip & adv)
+
+    carry = ((k_scan, parts0, logw0, ll0), jnp.zeros((S,), jnp.int32))
+    xs = (jnp.arange(T), yz, m.astype(yz.dtype))
+    ((ks, parts, logw, ll), health), (summ, ess, trips) = jax.lax.scan(
+        body, carry, xs
+    )
+    # scan stacks steps leading: (T, S, ...) -> (S, T, ...)
+    summ = jnp.swapaxes(summ, 0, 1)
+    ess = ess.T
+    trips = trips.T
+
+    if horizon == 0:
+        return ll, summ, ess, trips, health, None, None, None
+
+    def lane_forecast(key, parts_l, logw_l, shock):
+        key, kr = jax.random.split(key)
+        # equalize weights once so the band quantiles are unweighted
+        parts_l, _ = _pk.systematic_resample(kr, parts_l, logw_l)
+
+        def fstep(c, t):
+            key, pl = c
+            key, k1 = jax.random.split(key)
+            pl, y = pm.forecast(
+                k1, pl, jnp.where(t == 0, shock, jnp.zeros_like(shock))
+            )
+            return (key, pl), y
+
+        _, ypred = jax.lax.scan(fstep, (key, parts_l), jnp.arange(horizon))
+        return ypred  # (horizon, P, N)
+
+    ypred = jax.vmap(lane_forecast)(ks, parts, logw, shocks)
+    bands = jnp.moveaxis(
+        jnp.quantile(ypred, quantiles, axis=2), 0, 2
+    )  # (S, horizon, Q, N)
+    return (
+        ll, summ, ess, trips, health,
+        bands, ypred.mean(axis=2), ypred.std(axis=2),
+    )
+
+
+def smc_filter(
+    params,
+    x,
+    *,
+    model: str = "lg",
+    aux: tuple = (),
+    n_particles: int = 1024,
+    n_lanes: int | None = None,
+    shocks=None,
+    horizon: int = 0,
+    quantiles=DEFAULT_QUANTILES,
+    ess_frac: float = 0.5,
+    seed: int = 0,
+) -> SMCResult:
+    """Run the guarded multi-lane particle filter over a (T, N) NaN-masked
+    panel; the production entry the scenario API dispatches to.
+
+    `shocks` (S, shock_dim) sets the lane count AND the per-lane stress
+    impulse (None = `n_lanes` unshocked density lanes, default 1); lanes
+    differ only in PRNG key and shock, so their Monte-Carlo error is
+    independent.  Applies the active fault plan (``nan_draw@k``) as a
+    compile-time static and dispatches through `aot_call` so a
+    `CompileSpec.particle_count` precompile serves matching requests
+    without retracing."""
+    if model not in _MODELS:
+        raise ValueError(
+            f"unknown particle model {model!r}; valid: {', '.join(_MODELS)}"
+        )
+    x = jnp.asarray(x)
+    mask = mask_of(x)
+    yz = fillz(x)
+    # empty aux is carried as a (0,)-shaped sentinel so the aot_call
+    # signature matches the registered plan (an empty tuple has no
+    # leaves and would vanish from the precompile key)
+    aux = (
+        tuple(jnp.asarray(a, yz.dtype) for a in aux)
+        if aux else (jnp.zeros((0,), yz.dtype),)
+    )
+    sd = shock_dim(model, 0 if model == "msdfm" else params.r)
+    if shocks is None:
+        S = int(n_lanes or 1)
+        shocks = jnp.zeros((S, sd), yz.dtype)
+    else:
+        shocks = jnp.asarray(shocks, yz.dtype)
+        if shocks.ndim != 2 or shocks.shape[1] != sd:
+            raise ValueError(
+                f"shocks must be (S, {sd}) for model {model!r}, "
+                f"got {tuple(shocks.shape)}"
+            )
+        S = int(shocks.shape[0])
+    keys = jax.random.split(jax.random.PRNGKey(seed), S)
+    q = jnp.asarray(quantiles, yz.dtype)
+    plan = _faults.active_plan()
+    inject_at = int(plan.nan_draw or 0)
+    if inject_at:
+        _faults.fault_fired("nan_draw")
+    fb = partial(
+        _smc_impl,
+        model=model,
+        n_particles=int(n_particles),
+        horizon=int(horizon),
+        ess_frac=float(ess_frac),
+        inject_at=inject_at,
+    )
+    out = aot_call(
+        "smc_filter",
+        fb,
+        params, aux, keys, yz, mask, shocks, q,
+        statics=aot_statics(
+            model, int(n_particles), int(horizon), float(ess_frac), inject_at
+        ),
+    )
+    ll, summ, ess, trips, health, bands, mean, sdv = out
+    health = np.asarray(health)
+    n_bad = int((health != _guards.HEALTH_OK).sum())
+    if n_bad:
+        inc("smc_guard.lanes_frozen", n_bad)
+    n_trips = int(np.asarray(trips).sum())
+    if n_trips:
+        inc("smc.ess_floor_trips", n_trips)
+    return SMCResult(ll, summ, ess, trips, health, bands, mean, sdv)
+
+
+def aot_plan(model: str, P: int, spec):
+    """Build the (fn, lower_args, lower_kwargs, statics, mk_inputs)
+    plan tuple for one ``smc_filter@<model>`` registry entry — called by
+    `utils/compile._kernel_plan` for every `transforms.enumerate_smc`
+    entry, so SMC kernels have no hand-written plan body either."""
+    dt = jnp.dtype(spec.dtype)
+    Tb, Nb = spec.padded_shape()
+    r, p = spec.r, spec.p
+    S = spec.scenario_paths
+    h = spec.scenario_horizon
+    sds = jax.ShapeDtypeStruct
+
+    if model == "msdfm":
+        M = 2
+        params_s = MSDFMParams(
+            lam=sds((Nb,), dt), R=sds((Nb,), dt), mu=sds((M,), dt),
+            phi=sds((), dt), P=sds((M, M), dt), sigma2=sds((M,), dt),
+        )
+        aux_s = (sds((0,), dt),)
+        sdim = 1
+    else:
+        params_s = SSMParams(
+            sds((Nb, r), dt), sds((Nb,), dt), sds((p, r, r), dt),
+            sds((r, r), dt),
+        )
+        aux_s = (
+            (sds((r,), dt),) * 3 if model == "sv" else (sds((0,), dt),)
+        )
+        sdim = r
+    lower_args = (
+        params_s, aux_s, sds((S, 2), jnp.uint32), sds((Tb, Nb), dt),
+        sds((Tb, Nb), jnp.bool_), sds((S, sdim), dt),
+        sds((len(DEFAULT_QUANTILES),), dt),
+    )
+    lower_kwargs = dict(
+        model=model, n_particles=int(P), horizon=int(h),
+        ess_frac=0.5, inject_at=0,
+    )
+    statics = aot_statics(model, int(P), int(h), 0.5, 0)
+
+    def mk_inputs():
+        rng = np.random.default_rng(0)
+        if model == "msdfm":
+            pa = MSDFMParams(
+                lam=jnp.asarray(0.5 + 0.1 * rng.standard_normal(Nb), dt),
+                R=jnp.ones(Nb, dt),
+                mu=jnp.asarray([-1.0, 1.0], dt),
+                phi=jnp.asarray(0.5, dt),
+                P=jnp.asarray([[0.9, 0.1], [0.1, 0.9]], dt),
+                sigma2=jnp.ones(2, dt),
+            )
+            aux = (jnp.zeros((0,), dt),)
+        else:
+            lam = jnp.asarray(0.3 * rng.standard_normal((Nb, r)), dt)
+            A = jnp.zeros((p, r, r), dt).at[0].set(0.5 * jnp.eye(r, dtype=dt))
+            pa = SSMParams(lam, jnp.ones(Nb, dt), A, jnp.eye(r, dtype=dt))
+            aux = (
+                (jnp.zeros(r, dt), jnp.full((r,), 0.95, dt),
+                 jnp.full((r,), 0.2, dt))
+                if model == "sv" else (jnp.zeros((0,), dt),)
+            )
+        return (
+            pa, aux, jax.random.split(jax.random.PRNGKey(0), S),
+            jnp.asarray(0.3 * rng.standard_normal((Tb, Nb)), dt),
+            jnp.ones((Tb, Nb), bool),
+            jnp.zeros((S, sdim), dt),
+            jnp.asarray(DEFAULT_QUANTILES, dt),
+        )
+
+    return _smc_impl, lower_args, lower_kwargs, statics, mk_inputs

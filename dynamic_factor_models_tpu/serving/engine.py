@@ -1000,7 +1000,11 @@ class ServingEngine:
         )
 
     def _scenario(self, tenant_id, ten, req) -> Response:
-        from ..scenarios import ScenarioRequest, run_scenario
+        from ..scenarios import (
+            ScenarioRequest,
+            ScenarioValidationError,
+            run_scenario,
+        )
 
         spec = req.get("scenario")
         if spec is None:
@@ -1036,7 +1040,14 @@ class ServingEngine:
         x = np.where(ten.hist.mask, ten.hist.x, np.nan)
         try:
             result = run_scenario(ten.params, x, sreq)
-        except ValueError as e:  # unknown scenario kind / bad spec values
+        except ScenarioValidationError as e:
+            # api-level validation names the offending field — surface it
+            # on the ErrorInfo.field slot like every other client error
+            return self._client_err(
+                "scenario", tenant_id, "bad_scenario",
+                str(e), field=f"scenario.{e.field}",
+            )
+        except ValueError as e:  # bad spec values below the validators
             return self._client_err(
                 "scenario", tenant_id, "bad_scenario",
                 str(e), field="scenario",

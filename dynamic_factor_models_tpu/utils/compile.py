@@ -488,6 +488,14 @@ class CompileSpec:
     scenario_draws: int = 0
     scenario_paths: int = 8
     scenario_horizon: int = 12
+    # particle-filter scenario kernels (scenarios/smc.py):
+    # particle_count > 0 registers one "smc_filter@<model>" plan per
+    # models/transforms.enumerate_smc entry at (scenario_paths lanes,
+    # particle_count particles, scenario_horizon forecast steps) — the
+    # plan bodies are derived by scenarios/smc.aot_plan, the same
+    # no-hand-written-plan doctrine as the EM stacks.  Default off so
+    # existing specs compile the same set as before.
+    particle_count: int = 0
     # cross-section sharding (models/ssm._sharded_step_for): n_shards > 1
     # additionally registers the sharded EM step ("em_step_sharded") and
     # the guarded loop specialized to it, lowered at the shard-padded N
@@ -1021,6 +1029,16 @@ def _kernel_plan(spec: CompileSpec):
             aot_statics(h),
             fan_inputs,
         )
+
+    # particle-filter scenario kernels: derived from the transform-stack
+    # enumeration exactly like the EM family — transforms.enumerate_smc
+    # lists the entries, scenarios/smc.aot_plan builds each plan tuple
+    smc_entries = tfm.enumerate_smc(spec)
+    if smc_entries:
+        from ..scenarios import smc as _smc_mod
+
+        for pe in smc_entries:
+            plans[pe.key] = _smc_mod.aot_plan(pe.model, pe.particles, spec)
 
     return plans
 

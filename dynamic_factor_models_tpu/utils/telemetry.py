@@ -1068,6 +1068,14 @@ def _kind_latency_rows(hists: dict[tuple, LatencyHistogram]):
     return rows
 
 
+def _count_str(n: int) -> str:
+    """Compact count: 256 -> "256", 1000 -> "1k", 2500 -> "2.5k"."""
+    if n >= 1000 and n % 100 == 0:
+        k = n / 1000.0
+        return f"{k:g}k"
+    return str(n)
+
+
 def summarize(path: str, entry: str | None = None) -> str:
     """Per-run and per-entry aggregate tables of a RunRecord JSONL file,
     plus (when the file carries ``entry="hist"`` snapshot lines) a
@@ -1105,14 +1113,23 @@ def summarize(path: str, entry: str | None = None) -> str:
         # render "-" rather than "None", and never assume wall_s exists.
         # Scenario records carry fan sizes instead of iterations: show
         # "<D>d" (draws) or "<S>p" (paths) in the iters column so fans
-        # are sized at a glance next to EM runs.
+        # are sized at a glance next to EM runs.  Particle-filter records
+        # carry both a particle count and a lane count — render
+        # "<P>P/<S>s" ("1kP/8s") so SMC work is sized at a glance too.
         it = r.get("n_iter")
         if it is None:
-            for key, suffix in (("n_draws", "d"), ("n_paths", "p")):
-                v = r.get(key)
-                if isinstance(v, (int, float)) and v:
-                    it = f"{int(v)}{suffix}"
-                    break
+            np_ = r.get("n_particles")
+            if isinstance(np_, (int, float)) and np_:
+                s = r.get("n_paths")
+                it = _count_str(int(np_)) + "P" + (
+                    f"/{int(s)}s" if isinstance(s, (int, float)) and s else ""
+                )
+            else:
+                for key, suffix in (("n_draws", "d"), ("n_paths", "p")):
+                    v = r.get(key)
+                    if isinstance(v, (int, float)) and v:
+                        it = f"{int(v)}{suffix}"
+                        break
         rows.append([
             ts,
             str(r.get("entry", "?")),
@@ -1142,7 +1159,7 @@ def summarize(path: str, entry: str | None = None) -> str:
             "runs": 0, "errors": 0, "wall": 0.0, "iters": 0, "iter_runs": 0,
             "conv": 0, "compile_s": 0.0, "hits": 0, "misses": 0,
             "faults": 0, "recovered": 0, "unhealthy": 0,
-            "outcomes": 0, "answered": 0,
+            "outcomes": 0, "answered": 0, "ess_min": None,
         })
         a["runs"] += 1
         a["errors"] += 1 if r.get("error") else 0
@@ -1160,6 +1177,14 @@ def summarize(path: str, entry: str | None = None) -> str:
             a["iters"] += r["n_iter"]
             a["iter_runs"] += 1
         a["conv"] += 1 if r.get("converged") else 0
+        # particle-filter records stamp the worst per-lane ESS; the
+        # aggregate keeps the minimum seen so weight collapse shows up
+        # in one column ("-" for entries/sinks that never stamp it)
+        em = r.get("ess_min")
+        if isinstance(em, (int, float)):
+            a["ess_min"] = (
+                em if a["ess_min"] is None else min(a["ess_min"], em)
+            )
         a["faults"] += r.get("faults_detected") or 0
         a["recovered"] += r.get("recoveries") or 0
         a["unhealthy"] += (
@@ -1218,6 +1243,7 @@ def summarize(path: str, entry: str | None = None) -> str:
             (f"{a['faults']}/{a['recovered']}"
              + (f" ({a['unhealthy']} bad)" if a["unhealthy"] else "")
              if a["faults"] else "-"),
+            (f"{a['ess_min']:.1f}" if a["ess_min"] is not None else "-"),
             (f"{100.0 * a['answered'] / a['outcomes']:.1f}%"
              if a["outcomes"] else "-"),
             res,
@@ -1228,7 +1254,7 @@ def summarize(path: str, entry: str | None = None) -> str:
         ])
     aggregate = _fmt_table(
         ["entry", "runs", "err", "wall_s", "mean_s", "mean_iters",
-         "conv%", "compile_s", "aot h/m", "faults", "avail",
+         "conv%", "compile_s", "aot h/m", "faults", "ess_min", "avail",
          "resident", "evict", "fault_in", "p50_ms", "p99_ms"],
         arows,
     )

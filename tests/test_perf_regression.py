@@ -490,6 +490,21 @@ def test_derived_kernel_registry_size_is_pinned():
     )
     assert len(cc._kernel_plan(full_c)) == 20
 
+    # particle_count opts the SMC family in: exactly one plan per
+    # AOT-able particle model (tvp is excluded — its aux carries a
+    # panel-length factor path, which would key the executable on data
+    # rather than shape), nothing else moves
+    assert tfm.enumerate_smc(spec) == []
+    pspec = cc.CompileSpec(
+        T=60, N=12, r=2, p=1, dtype=str(_np.dtype(float)), max_em_iter=4,
+        particle_count=256, scenario_paths=2, scenario_horizon=4,
+    )
+    smc_entries = tfm.enumerate_smc(pspec)
+    assert [e.key for e in smc_entries] == [
+        "smc_filter@lg", "smc_filter@sv", "smc_filter@msdfm",
+    ]
+    assert len(cc._kernel_plan(pspec)) == len(cc._kernel_plan(spec)) + 3
+
 
 # ---------------------------------------------------------------------------
 # PR-12 acceptance pins: request observability must be free on-device and
