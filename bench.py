@@ -2125,6 +2125,256 @@ def multichip_orchestrate(force_cpu: bool):
     sys.exit(pr.returncode)
 
 
+def _mh_sizes(smoke: bool):
+    """(T, N, r) grid for the multi-host legs; N is already a multiple of
+    8 so the sharded step needs no padding on either topology."""
+    return [(128, 1024, 4)] if smoke else [(256, 4096, 4), (256, 16384, 4)]
+
+
+def _mh_prep_sharded(T, N, r):
+    """Inputs for `_sharded_step_for` (run_multichip's _prep, returned as
+    HOST numpy arrays: committed single-device jax.Arrays cannot reshard
+    onto a process-spanning mesh, numpy can — the same contract the
+    estimators follow in a multi-process runtime)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dynamic_factor_models_tpu.models.ssm import (
+        SSMParams,
+        compute_panel_stats,
+    )
+    from dynamic_factor_models_tpu.ops.linalg import standardize_data
+    from dynamic_factor_models_tpu.ops.masking import fillz, mask_of
+
+    x = _synthetic_large_panel(T, N, r, np.float32)
+    xstd, _ = standardize_data(jnp.asarray(x))
+    xz, m = fillz(xstd), mask_of(xstd).astype(xstd.dtype)
+    params = SSMParams(
+        lam=jnp.zeros((N, r), xz.dtype).at[:, 0].set(1.0),
+        R=jnp.ones(N, xz.dtype),
+        A=0.5 * jnp.eye(r, dtype=xz.dtype)[None],
+        Q=jnp.eye(r, dtype=xz.dtype),
+    )
+    stats = compute_panel_stats(xz, m)._replace(tw=jnp.ones(T, xz.dtype))
+    to_np = lambda t: jax.tree.map(np.asarray, t)
+    return to_np(params), np.asarray(xz), np.asarray(m), to_np(stats)
+
+
+def _mh_measure(out, step, smoke):
+    """Per-size module FLOPs (XLA cost model on the ACTUAL partitioned
+    executable — per-partition, since SPMD runs one module per device) and
+    wall iters/sec into `out`."""
+    import jax
+
+    for T, N, r in _mh_sizes(smoke):
+        params, xz, m, stats = _mh_prep_sharded(T, N, r)
+        ex = step.lower(params, xz, m, stats).compile()
+        out[f"module_flops_n{N}"] = _compiled_flops(ex)
+        run = lambda: step(params, xz, m, stats)[0].lam.block_until_ready()
+        run()  # warm (jit dispatch path, shared executable cache with ex)
+        out[f"iters_per_sec_n{N}"] = round(
+            1.0 / _time_fixed_iters(run), 3
+        )
+
+
+def run_multihost_single(force_cpu: bool, smoke: bool):
+    """Child mode (spawned by multihost_section with the forced-8-device
+    flag): the 1-process x 8-device reference leg — the flat ("data",)
+    mesh program.  Prints one JSON line."""
+    import jax
+
+    if force_cpu:
+        from dynamic_factor_models_tpu.utils.backend import fall_back_to_cpu
+
+        fall_back_to_cpu("multihost forced CPU", caller="bench")
+
+    from dynamic_factor_models_tpu.models.ssm import _sharded_step_for
+
+    n_dev = jax.device_count()
+    ns = min(8, n_dev)
+    out = {
+        "role": "single",
+        "device": str(jax.devices()[0]),
+        "n_devices": n_dev,
+        "n_shards": ns,
+        "local_partitions": ns,
+        "mesh": [1, ns],
+        "flop_proxy": not _is_tpu_platform(jax.devices()[0].platform),
+    }
+    _mh_measure(out, _sharded_step_for(ns), smoke)
+    print(json.dumps(out), flush=True)
+
+
+def run_multihost_worker(nproc: int, pid: int, port: str, smoke: bool):
+    """Child mode: one of `nproc` OS processes (4 forced devices each)
+    joined by jax.distributed into a global mesh; `_sharded_step_for(8)`
+    auto-resolves hosts=nproc onto the ("dcn", "ici") topology.  Every
+    worker executes the same SPMD program; rank 0 prints the JSON line."""
+    from dynamic_factor_models_tpu.parallel.distributed import (
+        initialize_distributed,
+    )
+
+    ok = initialize_distributed(f"127.0.0.1:{port}", nproc, pid)
+    import jax
+
+    assert ok and jax.process_count() == nproc, "distributed init failed"
+
+    from dynamic_factor_models_tpu.models.ssm import _sharded_step_for
+
+    out = {
+        "role": "worker",
+        "device": str(jax.devices()[0]),
+        "process_count": nproc,
+        "n_devices": jax.device_count(),
+        "n_shards": 8,
+        "local_partitions": jax.local_device_count(),
+        "mesh": [nproc, 8 // nproc],
+        "flop_proxy": not _is_tpu_platform(jax.devices()[0].platform),
+    }
+    _mh_measure(out, _sharded_step_for(8), smoke)
+    if pid == 0:
+        print(json.dumps(out), flush=True)
+
+
+def multihost_section(force_cpu: bool, smoke: bool = False) -> dict:
+    """Both multi-host legs: 1proc x 8dev (flat mesh) vs 2proc x 4dev
+    (process-spanning mesh over real OS processes + Gloo DCN analogue),
+    then the FLOP-partition accounting.
+
+    The headline `flop_partition_speedup_nX` is per-PROCESS executed
+    FLOPs: local_partitions x module_flops.  Both topologies compile the
+    same per-partition module (the reduction epilogue differs only in
+    collective shape), so two hosts each execute ~half the program —
+    that, not CPU wall-clock, is the scale-out evidence; wall columns on
+    a CPU container carry `flop_proxy: true` and must be read as
+    'the program runs', never as perf."""
+    import re
+    import socket
+    import tempfile
+
+    forced = force_cpu or os.environ.get("DFM_BENCH_FORCE_CPU") == "1"
+    base = re.sub(
+        r"--xla_force_host_platform_device_count=\S+",
+        "",
+        os.environ.get("XLA_FLAGS", ""),
+    ).strip()
+    flags8 = (base + " --xla_force_host_platform_device_count=8").strip()
+    flags4 = (base + " --xla_force_host_platform_device_count=4").strip()
+    sizes = _mh_sizes(smoke)
+    out = {
+        "n_shards": 8,
+        "smoke": smoke,
+        "grid_t_n": [[T, N] for T, N, _ in sizes],
+    }
+
+    single_args = ["--run-multihost"] + (["--smoke"] if smoke else [])
+    if forced:
+        single_args.append("--force-cpu")
+    pr = _run_child(single_args, env_extra={"XLA_FLAGS": flags8},
+                    timeout_s=1800 if smoke else 3600)
+    single = _parse_fragment(pr)
+    out["single_process"] = single if single is not None else {
+        "error": "single-process child produced no JSON"
+    }
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = flags4
+    if forced:
+        env["JAX_PLATFORMS"] = "cpu"
+    nproc = 2
+    procs, tmpd = [], tempfile.mkdtemp(prefix="bench_mh_")
+    logs = [
+        (os.path.join(tmpd, f"w{i}.out"), os.path.join(tmpd, f"w{i}.err"))
+        for i in range(nproc)
+    ]
+    try:
+        for i in range(nproc):
+            with open(logs[i][0], "w") as fo, open(logs[i][1], "w") as fe:
+                procs.append(
+                    subprocess.Popen(
+                        [sys.executable, os.path.join(REPO, "bench.py"),
+                         "--run-multihost-worker", "--mh-pid", str(i),
+                         "--mh-nproc", str(nproc), "--mh-port", str(port)]
+                        + (["--smoke"] if smoke else []),
+                        stdout=fo, stderr=fe, env=env,
+                    )
+                )
+        deadline = time.monotonic() + (900 if smoke else 3600)
+        while any(p.poll() is None for p in procs):
+            if any(p.poll() not in (None, 0) for p in procs):
+                break  # dead worker strands the peer at the DCN barrier
+            if time.monotonic() > deadline:
+                break
+            time.sleep(0.5)
+    finally:
+        for p in procs:  # never leak an orphan worker
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+
+    import types
+
+    with open(logs[0][0]) as fh:
+        worker = _parse_fragment(types.SimpleNamespace(stdout=fh.read()))
+    if worker is None or any(p.returncode != 0 for p in procs):
+        tails = {
+            f"worker{i}_stderr_tail": open(logs[i][1]).read()[-1500:]
+            for i in range(nproc)
+        }
+        out["two_process"] = {
+            "error": "worker pair failed",
+            "rc": [p.returncode for p in procs],
+            **tails,
+        }
+        if worker is not None:
+            out["two_process"]["fragment"] = worker
+        return out
+    out["two_process"] = worker
+
+    for T, N, r in sizes:
+        fa = (single or {}).get(f"module_flops_n{N}")
+        fb = worker.get(f"module_flops_n{N}")
+        if fa and fb:
+            per_proc_a = fa * single["local_partitions"]
+            per_proc_b = fb * worker["local_partitions"]
+            out[f"flop_partition_speedup_n{N}"] = round(
+                per_proc_a / per_proc_b, 3
+            )
+        # one cross-host DCN psum per EM iteration: the packed collapse
+        # payload (T, q(q+1)/2 + 1 + q) at q = r*p, float32
+        q = r * 1
+        out[f"dcn_payload_bytes_per_iter_n{N}"] = (
+            T * (q * (q + 1) // 2 + 1 + q) * 4
+        )
+    out["flop_proxy"] = bool(
+        (single or {}).get("flop_proxy", True) or worker.get("flop_proxy")
+    )
+    sp = out.get("flop_partition_speedup_n16384")
+    if not smoke:
+        out["accept_flop_partition_ge_1p7_n16384"] = (
+            None if sp is None else bool(sp >= 1.7)
+        )
+    return out
+
+
+def multihost_orchestrate(force_cpu: bool):
+    """--multihost: run both legs, persist docs/BENCH_multihost.json,
+    print the fragment."""
+    fragment = multihost_section(force_cpu)
+    path = os.path.join(REPO, "docs", "BENCH_multihost.json")
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(fragment, fh, indent=1, sort_keys=True)
+    os.replace(tmp, path)
+    print(json.dumps(fragment))
+    two = fragment.get("two_process", {})
+    sys.exit(2 if "error" in two else 0)
+
+
 def _synthetic_ragged_panel(T, N, r, dtype):
     """Factor + AR(1)-idio DGP with CONTIGUOUS per-series observation runs
     (ragged heads/tails, no interior gaps) — the mask class the
@@ -2991,6 +3241,14 @@ def run_tpu_remainder(force_cpu: bool = False):
     _persist_partial(partial)
     print(json.dumps(partial), file=sys.stderr, flush=True)
 
+    # multi-host smoke: the two-OS-process ("dcn", "ici") mesh leg at one
+    # small size — proves the process-spanning sharded step compiles and
+    # runs and the FLOP-partition accounting holds; the full N in
+    # {4k, 16k} grid is bench.py --multihost on a long window
+    partial["multihost_smoke"] = multihost_section(force_cpu, smoke=True)
+    _persist_partial(partial)
+    print(json.dumps(partial), file=sys.stderr, flush=True)
+
     # particle-filter scenario smoke: proves the SMC scan compiles and
     # runs on the live chip; the full P in {1k, 10k} sweep is
     # bench.py --scenarios-nl on a long window
@@ -3675,6 +3933,19 @@ def main():
                          "(large_n_section); prints one JSON line and "
                          "persists docs/BENCH_large_n.json")
     ap.add_argument("--run-multichip", action="store_true")
+    ap.add_argument("--multihost", action="store_true",
+                    help="multi-host scale-out accounting: 1proc x 8dev "
+                         "vs 2 real OS processes x 4dev on the process-"
+                         "spanning ('dcn','ici') mesh, per-process FLOP-"
+                         "partition speedup + cross-host collective bytes "
+                         "at N in {4k, 16k}; persists "
+                         "docs/BENCH_multihost.json (CPU legs carry "
+                         "flop_proxy labels)")
+    ap.add_argument("--run-multihost", action="store_true")
+    ap.add_argument("--run-multihost-worker", action="store_true")
+    ap.add_argument("--mh-pid", type=int, default=0)
+    ap.add_argument("--mh-nproc", type=int, default=2)
+    ap.add_argument("--mh-port", default="0")
     ap.add_argument("--composed", action="store_true",
                     help="composed transform-stack grid: N in {1k, 10k, "
                          "100k} x {sequential, collapsed, steady, "
@@ -3735,6 +4006,16 @@ def main():
         return
     if args.multichip:
         multichip_orchestrate(force_cpu=args.force_cpu)
+        return
+    if args.run_multihost:
+        run_multihost_single(force_cpu=args.force_cpu, smoke=args.smoke)
+        return
+    if args.run_multihost_worker:
+        run_multihost_worker(args.mh_nproc, args.mh_pid, args.mh_port,
+                             smoke=args.smoke)
+        return
+    if args.multihost:
+        multihost_orchestrate(force_cpu=args.force_cpu)
         return
     if args.run_compile_split:
         run_compile_split(args.cache_dir)

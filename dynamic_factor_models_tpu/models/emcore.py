@@ -631,27 +631,26 @@ def ar_steady_plan(params: SSMARParams, mask, min_tail: int = 8):
 # ======================= collapsed AR x data mesh ============================
 
 
-def _ar_params_spec():
+def _ar_params_spec(dax="data"):
     from ..parallel.mesh import P
 
     return SSMARParams(
-        lam=P("data", None), phi=P("data"), sigv2=P("data"), A=P(), Q=P()
+        lam=P(dax, None), phi=P(dax), sigv2=P(dax), A=P(), Q=P()
     )
 
 
-def _qd_stats_spec():
+def _qd_stats_spec(dax="data"):
     from ..parallel.mesh import P
 
     return QDStats(
-        m=P(None, "data"), first=P(None, "data"), interior=P(None, "data"),
-        x_prev=P(None, "data"), mT=P("data", None), firstT=P("data", None),
-        interiorT=P("data", None), xT=P("data", None),
-        x_prevT=P("data", None), n_int=P("data"), n_obs=P(),
+        m=P(None, dax), first=P(None, dax), interior=P(None, dax),
+        x_prev=P(None, dax), mT=P(dax, None), firstT=P(dax, None),
+        interiorT=P(dax, None), xT=P(dax, None),
+        x_prevT=P(dax, None), n_int=P(dax), n_obs=P(),
     )
 
 
-@lru_cache(maxsize=None)
-def _ar_sharded_step_for(n_shards: int):
+def _ar_sharded_step_for(n_shards: int, hosts: int = 0):
     """The collapsed-AR EM step sharded over the ``("data",)`` N-axis mesh
     — same (params, x, qd) -> (params, loglik) contract as
     `em_step_ar_qd`, N must be a shard multiple (`estimate_dfm_em_ar`
@@ -667,18 +666,47 @@ def _ar_sharded_step_for(n_shards: int):
     a padded series (lam = 0, phi = 0, sigv2 = 1, all-False mask) has
     Vinv = beta = z = 0, so it contributes exactly zero to every payload
     column, its Gram/rhs are zero (the minimum-norm solve returns
-    lam = 0), and has = n_int > 0 keeps its phi/sigv2 fixed."""
+    lam = 0), and has = n_int > 0 keeps its phi/sigv2 fixed.
+
+    `hosts=0` resolves to `jax.process_count()` (see
+    `ssm._sharded_step_for`): hosts<=1 keeps the flat single-host mesh
+    and program; hosts>1 runs the process-spanning ``("dcn", "ici")``
+    mesh with the hierarchical ICI-ring + DCN-psum reduction.  Plain
+    dispatcher over an lru_cached impl so `f(2)` and `f(2, hosts=0)`
+    return one object (resolve-identity pins)."""
+    from .ssm import _resolve_mesh_hosts
+
+    return _ar_sharded_step_impl(int(n_shards), _resolve_mesh_hosts(hosts))
+
+
+@lru_cache(maxsize=None)
+def _ar_sharded_step_impl(n_shards: int, hosts: int):
     from jax.experimental.shard_map import shard_map
 
-    from ..ops.pallas_gram import ring_allreduce
+    from ..ops.pallas_gram import hierarchical_allreduce, ring_allreduce
     from ..parallel.mesh import P, data_mesh
 
-    mesh = data_mesh(n_shards)
+    mesh = data_mesh(n_shards, hosts=hosts)
+    if hosts > 1:
+        dax = ("dcn", "ici")
+        n_ici = n_shards // hosts
+
+        def _reduce(payload):
+            return hierarchical_allreduce(payload, "ici", "dcn", n_ici)
+
+        name = f"em_step_ar_sharded_d{n_shards}_h{hosts}"
+    else:
+        dax = "data"
+
+        def _reduce(payload):
+            return ring_allreduce(payload, "data", n_shards)
+
+        name = f"em_step_ar_sharded_d{n_shards}"
 
     def step(params: SSMARParams, x, qd: QDStats):
         params = _guard_params_qd(params)
         payload = _collapse_obs_qd_partial(params, x, qd)
-        payload = ring_allreduce(payload, "data", n_shards)
+        payload = _reduce(payload)
         C, b, ld_V, xRx = _unpack_qd_collapsed(payload, params.r)
         means, covs, pmeans, pcovs, lls, pinvs = _qd_filter_from_collapsed(
             params, C, b, ld_V, xRx, qd.n_obs, want_pinv=True
@@ -689,15 +717,15 @@ def _ar_sharded_step_for(n_shards: int):
         )
         return _m_step_ar_qd(params, x, qd, s_sm, P_sm, lag1), lls.sum()
 
-    step.__name__ = step.__qualname__ = f"em_step_ar_sharded_d{n_shards}"
+    step.__name__ = step.__qualname__ = name
     step.__module__ = __name__
 
     return jax.jit(
         shard_map(
             step,
             mesh=mesh,
-            in_specs=(_ar_params_spec(), P(None, "data"), _qd_stats_spec()),
-            out_specs=(_ar_params_spec(), P()),
+            in_specs=(_ar_params_spec(dax), P(None, dax), _qd_stats_spec(dax)),
+            out_specs=(_ar_params_spec(dax), P()),
             check_rep=False,
         )
     )
@@ -708,28 +736,53 @@ def em_step_ar_sharded(params: SSMARParams, x, qd: QDStats, n_shards: int):
     return _ar_sharded_step_for(int(n_shards))(params, x, qd)
 
 
-@lru_cache(maxsize=None)
-def _ar_steady_sharded_step_for(t_star: int, block: int, n_shards: int):
+def _ar_steady_sharded_step_for(t_star: int, block: int, n_shards: int, hosts: int = 0):
     """All three composed axes on one panel: the quasi-differenced
     collapse (N-free scan), the steady tail (T-free tail), and the data
     mesh (shard-local pre-scan GEMMs).  The steady split's payload and
     constant vector are both series sums, so the shard transform applies
     unchanged: one ring all-reduce + one psum per iteration, then the
-    replicated steady core and the shard-local closed-form M-step."""
+    replicated steady core and the shard-local closed-form M-step.
+    `hosts` follows `_ar_sharded_step_for` (0 = process count; >1 =
+    hierarchical ICI+DCN reduction)."""
+    from .ssm import _resolve_mesh_hosts
+
+    return _ar_steady_sharded_step_impl(
+        int(t_star), int(block), int(n_shards), _resolve_mesh_hosts(hosts)
+    )
+
+
+@lru_cache(maxsize=None)
+def _ar_steady_sharded_step_impl(t_star: int, block: int, n_shards: int, hosts: int):
     from jax.experimental.shard_map import shard_map
 
-    from ..ops.pallas_gram import ring_allreduce
+    from ..ops.pallas_gram import hierarchical_allreduce, ring_allreduce
     from ..parallel.mesh import P, data_mesh
 
-    mesh = data_mesh(n_shards)
+    mesh = data_mesh(n_shards, hosts=hosts)
+    if hosts > 1:
+        dax = ("dcn", "ici")
+        n_ici = n_shards // hosts
+
+        def _reduce(payload):
+            return hierarchical_allreduce(payload, "ici", "dcn", n_ici)
+
+        name = f"em_step_ar_all_t{t_star}_b{block}_d{n_shards}_h{hosts}"
+    else:
+        dax = "data"
+
+        def _reduce(payload):
+            return ring_allreduce(payload, "data", n_shards)
+
+        name = f"em_step_ar_all_t{t_star}_b{block}_d{n_shards}"
 
     def step(state: ARSteadyState, x, qd: QDStats, tail: QDTailStats):
         params = _guard_params_qd(state.params)
         payload, const_vec = _qd_steady_collapse_partial(
             params, x, qd, t_star
         )
-        payload = ring_allreduce(payload, "data", n_shards)
-        const_vec = jax.lax.psum(const_vec, "data")
+        payload = _reduce(payload)
+        const_vec = jax.lax.psum(const_vec, dax)
         C_head, b, ld_h, xrx_h, C_inf, ld_inf, quad_tail = (
             _unpack_qd_steady(payload, const_vec, params.r, t_star)
         )
@@ -747,19 +800,19 @@ def _ar_steady_sharded_step_for(t_star: int, block: int, n_shards: int):
             ll,
         )
 
-    step.__name__ = step.__qualname__ = (
-        f"em_step_ar_all_t{t_star}_b{block}_d{n_shards}"
-    )
+    step.__name__ = step.__qualname__ = name
     step.__module__ = __name__
 
-    state_spec = ARSteadyState(params=_ar_params_spec(), Pp=P(), riccati_iters=P())
-    tail_spec = QDTailStats(sxx=P("data"), sxx1=P("data"), spp=P("data"))
+    state_spec = ARSteadyState(
+        params=_ar_params_spec(dax), Pp=P(), riccati_iters=P()
+    )
+    tail_spec = QDTailStats(sxx=P(dax), sxx1=P(dax), spp=P(dax))
     return jax.jit(
         shard_map(
             step,
             mesh=mesh,
             in_specs=(
-                state_spec, P(None, "data"), _qd_stats_spec(), tail_spec,
+                state_spec, P(None, dax), _qd_stats_spec(dax), tail_spec,
             ),
             out_specs=((state_spec, P())),
             check_rep=False,

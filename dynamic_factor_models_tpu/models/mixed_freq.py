@@ -22,6 +22,7 @@ are just blocks of E[s s'], so no extra smoother passes are needed.
 
 from __future__ import annotations
 
+from functools import lru_cache
 from typing import NamedTuple
 
 import jax
@@ -36,12 +37,14 @@ from .ssm import (
     _bf16_gemm,
     _collapse_obs,
     _collapse_obs_stats,
+    _collapse_obs_stats_partial,
     _companion,
     _info_filter_scan,
     _psd_floor,
     _rts_scan,
     _solve_loadings_and_R,
     _sym_pack_idx,
+    _unpack_collapsed,
     _var_moments,
     compute_panel_stats,
 )
@@ -50,6 +53,7 @@ __all__ = [
     "MixedFreqParams",
     "em_step_mf",
     "em_step_mf_stats",
+    "em_step_mf_sharded",
     "estimate_mixed_freq_dfm",
     "steady_gains",
     "MFResults",
@@ -306,6 +310,152 @@ def em_step_mf_stats_bulk(params: MixedFreqParams, x, mask, stats):
     )
 
 
+def _mf_sharded_step_for(n_shards: int, hosts: int = 0):
+    """The mixed-frequency EM step sharded over the cross-section —
+    same (params, x, mask, stats) -> (params, loglik) contract as
+    `em_step_mf_stats`, N must be a shard multiple
+    (`estimate_mixed_freq_dfm(n_shards=)` pads with inert series first).
+
+    Why this shards at all: each series' contribution to the E-step is an
+    independent sum term even through the Mariano-Murasawa aggregation
+    rows.  The aggregation row of a quarterly series couples that series
+    to 5 state LAGS — `_obs_matrix` makes its observation row dense over
+    the first q5 = 5r state dims — but never to another series, so the
+    collapsed statistics C_t = H5' R^-1 H5, b_t = H5' R^-1 x_t and the
+    M-step Grams all remain plain sums over series.  The per-shard half
+    is exactly `ssm._collapse_obs_stats_partial` with Hq = H5 (it is
+    generic in the observation block); the payload is all-reduced once
+    per iteration (flat ring on one host, hierarchical ICI-ring + DCN
+    psum across hosts), then the N-free O(k^3) filter/smoother scans and
+    the factor-VAR moments run replicated, and the per-series
+    loading/R solves — including the tiny agg-row einsums — stay
+    shard-local.
+
+    Inert-padding contract (the exact gap the old `NotImplementedError`
+    cited): a padded series carries lam = 0, R = 1, a monthly
+    aggregation row (1,0,0,0,0), and an all-False mask column, so its H5
+    row is zero and every payload column, Gram, rhs and log-det term it
+    contributes is exactly zero EVEN under the period-3 quarterly mask
+    cycle — the mask never resurrects a zero loading row.  Pinned in
+    tests/test_multihost.py (padded-aggregation-row inertness) and
+    sharded == sequential parity at 1e-10 in tests/test_sharding.py.
+
+    `hosts` follows `ssm._sharded_step_for` (0 = the runtime's process
+    count; dispatcher over an lru_cached impl so `f(2)` and
+    `f(2, hosts=0)` are one object)."""
+    from .ssm import _resolve_mesh_hosts
+
+    return _mf_sharded_step_impl(int(n_shards), _resolve_mesh_hosts(hosts))
+
+
+@lru_cache(maxsize=None)
+def _mf_sharded_step_impl(n_shards: int, hosts: int):
+    from jax.experimental.shard_map import shard_map
+
+    from ..ops.pallas_gram import hierarchical_allreduce, ring_allreduce
+    from ..parallel.mesh import P, data_mesh
+
+    mesh = data_mesh(n_shards, hosts=hosts)
+    if hosts > 1:
+        dax = ("dcn", "ici")
+        n_ici = n_shards // hosts
+
+        def _reduce(payload):
+            return hierarchical_allreduce(payload, "ici", "dcn", n_ici)
+
+        name = f"em_step_mf_sharded_d{n_shards}_h{hosts}"
+    else:
+        dax = "data"
+
+        def _reduce(payload):
+            return ring_allreduce(payload, "data", n_shards)
+
+        name = f"em_step_mf_sharded_d{n_shards}"
+
+    def step(params: MixedFreqParams, x, mask, stats):
+        del mask  # collapse statistics already carry the mask
+        r, p = params.r, params.p
+        q5 = _N_AGG * r
+        Tn = x.shape[0]
+        params = params._replace(
+            Q=_psd_floor(params.Q), R=jnp.maximum(params.R, 1e-8)
+        )
+        H5 = _obs_matrix(params)[:, :q5]
+        payload, llc = _collapse_obs_stats_partial(H5, params.R, x, stats)
+        payload = _reduce(payload)
+        llc = jax.lax.psum(llc, dax)
+        C, b, ld_R = _unpack_collapsed(payload, q5)
+
+        # replicated filter/smoother: `_filter_mf`'s scan assembly on the
+        # pre-reduced collapsed statistics (xRx is identically zero on the
+        # stats path — the quadratic is the ll_corr scalar)
+        Tm, Qs = _companion(_as_ssm(params))
+        k = Tm.shape[0]
+        dtype = x.dtype
+        s0 = jnp.zeros(k, dtype)
+        P0 = 1e2 * jnp.eye(k, dtype=dtype)
+        xRx = jnp.zeros(b.shape[0], dtype)
+
+        def obs_step(inp, sp):
+            Ct, bt, ld, xr, no = inp
+            g = sp[:q5]
+            Cf = jnp.zeros((k, k), dtype).at[:q5, :q5].set(Ct)
+            rhs = jnp.zeros(k, dtype).at[:q5].set(bt - Ct @ g)
+            quad0 = xr - 2.0 * (g @ bt) + g @ Ct @ g
+            return Cf, rhs, ld, quad0, no
+
+        means, covs, pmeans, pcovs, lls = _info_filter_scan(
+            Tm, Qs, (C, b, ld_R, xRx, stats.n_obs), obs_step, s0, P0
+        )
+        ll = lls.sum() + llc
+        s_sm, P_sm, lag1 = _rts_scan(Tm, means, covs, pmeans, pcovs)
+
+        # shard-local M-step on the local N-slice (see `_em_mf_impl`)
+        s5 = s_sm[:, :q5]
+        iu, iv, unpack = _sym_pack_idx(q5)
+        Ess_u = s5[:, iu] * s5[:, iv] + P_sm[:, iu, iv]
+        Zu = stats.mT @ Ess_u
+        Sxg5 = stats.xT @ s5
+        Z = Zu[:, unpack].reshape(-1, _N_AGG, r, _N_AGG, r)
+        Sgg = jnp.einsum("ij,ijrls,il->irs", params.agg, Z, params.agg)
+        Sxg = jnp.einsum("ij,ijr->ir", params.agg, Sxg5.reshape(-1, _N_AGG, r))
+        lam, R = _solve_loadings_and_R(Sgg, Sxg, stats.Sxx, stats.n_i)
+
+        S11, S00, S10, Tn_eff = _var_moments(s_sm, P_sm, lag1, r, Tn, stats.tw)
+        Ak = S10 @ jnp.linalg.pinv(S00, hermitian=True)
+        Q = _psd_floor((S11 - Ak @ S10.T) / (Tn_eff - 1))
+        A = jnp.stack([Ak[:, i * r : (i + 1) * r] for i in range(p)])
+        return MixedFreqParams(lam, R, A, Q, params.agg), ll
+
+    step.__name__ = step.__qualname__ = name
+    step.__module__ = __name__
+
+    params_spec = MixedFreqParams(
+        lam=P(dax, None), R=P(dax), A=P(), Q=P(), agg=P(dax, None)
+    )
+    from .ssm import PanelStats
+
+    stats_spec = PanelStats(
+        m=P(None, dax), xT=P(dax, None), mT=P(dax, None),
+        Sxx=P(dax), n_i=P(dax), n_obs=P(),
+        m16=None, x16=None, mT16=None, xT16=None, tw=P(),
+    )
+    return jax.jit(
+        shard_map(
+            step,
+            mesh=mesh,
+            in_specs=(params_spec, P(None, dax), P(None, dax), stats_spec),
+            out_specs=(params_spec, P()),
+            check_rep=False,
+        )
+    )
+
+
+def em_step_mf_sharded(params: MixedFreqParams, x, mask, stats, n_shards: int):
+    """One sharded mixed-frequency EM iteration (see `_mf_sharded_step_for`)."""
+    return _mf_sharded_step_for(int(n_shards))(params, x, mask, stats)
+
+
 class MFResults(NamedTuple):
     params: MixedFreqParams
     factors: jnp.ndarray  # (T, r) smoothed MONTHLY factors
@@ -365,8 +515,14 @@ def estimate_mixed_freq_dfm(
     periods are excluded from the factor-VAR moments via `PanelStats.tw`;
     one compiled MF executable then serves every panel in the bucket.
 
-    n_shards is accepted for API symmetry with `ssm.estimate_dfm_em` but
-    only n_shards in (None, 0, 1) is implemented here — see docs/sharding.md.
+    n_shards > 1 shards the cross-section over the data mesh
+    (`_mf_sharded_step_for`), exactly as `ssm.estimate_dfm_em`: the panel
+    is padded with inert series up to a shard multiple — zero loadings,
+    unit R, monthly aggregation rows, all-False mask, exactly inert under
+    the period-3 quarterly mask cycle — and in a `jax.distributed`
+    runtime the mesh spans processes with a hierarchical ICI+DCN
+    reduction.  Parity with the sequential run is pinned at 1e-10; see
+    docs/sharding.md.
     """
     from ..utils.compile import (
         bucket_shape,
@@ -387,18 +543,24 @@ def estimate_mixed_freq_dfm(
         )
     if gram_dtype is not None and checkpoint_path is not None:
         raise ValueError("gram_dtype is not combinable with checkpoint_path")
-    if n_shards is not None and int(n_shards) > 1:
-        # the single-frequency collapse shards cleanly because every
-        # series contributes an independent rank-one term; the mixed-freq
-        # observation matrix couples a quarterly series to 5 state lags
-        # through the aggregation row, which still sums over series — but
-        # the padded-agg inertness contract has no sharded test pin yet,
-        # so refuse loudly rather than return silently-unverified numbers
-        raise NotImplementedError(
-            "n_shards > 1 covers the single-frequency EM path "
-            "(ssm.estimate_dfm_em); mixed-frequency sharding is tracked in "
-            "ROADMAP item 2"
-        )
+    ns = int(n_shards) if n_shards is not None else 0
+    if ns > 1:
+        if gram_dtype is not None:
+            raise ValueError(
+                "n_shards is not combinable with gram_dtype: the bf16 "
+                "panel twins are not sharded"
+            )
+        if ns > jax.device_count():
+            raise ValueError(
+                f"n_shards={ns} exceeds the {jax.device_count()} visible "
+                "devices"
+            )
+        if jax.process_count() > 1 and ns % jax.process_count() != 0:
+            raise ValueError(
+                f"n_shards={ns} must be a multiple of "
+                f"jax.process_count()={jax.process_count()} so every host "
+                "owns the same number of local shards"
+            )
     from ..utils.telemetry import run_record
 
     with on_backend(backend), run_record(
@@ -454,12 +616,23 @@ def estimate_mixed_freq_dfm(
             "T": T0, "N": N0, "r": r, "p": p,
             "n_quarterly": int(is_q.sum()),
         })
-        if buckets is not None:
-            Tb, Nb = bucket_shape(T0, N0, *buckets)
-            rec.set(bucket=[Tb, Nb])
+        if buckets is not None or ns > 1:
+            # pad up to the bucket and/or a shard multiple (see
+            # ssm.estimate_dfm_em): padded series carry zero loadings,
+            # unit R, a monthly aggregation row and an all-False mask —
+            # inert in every moment, including under the period-3
+            # quarterly mask cycle (pinned in tests/test_multihost.py)
+            if buckets is not None:
+                Tb, Nb = bucket_shape(T0, N0, *buckets)
+            else:
+                Tb, Nb = T0, N0
+            if ns > 1:
+                from ..parallel.mesh import series_pad
+
+                Nb = series_pad(Nb, ns)
+            if buckets is not None:
+                rec.set(bucket=[Tb, Nb])
             xz, m_arr, tw = pad_panel(xz, m_arr, Tb, Nb)
-            # padded series: zero loadings, unit R, monthly aggregation
-            # row (fully masked, so any valid agg pattern is inert)
             agg_pad = jnp.zeros((Nb, _N_AGG), dtype).at[:N0].set(params.agg)
             agg_pad = agg_pad.at[N0:, 0].set(1.0)
             params = params._replace(
@@ -470,23 +643,44 @@ def estimate_mixed_freq_dfm(
             stats = compute_panel_stats(xz, m_arr)._replace(tw=tw)
         else:
             stats = compute_panel_stats(xz, m_arr)
-        # the mixed-frequency core is the one-entry stack (no step
-        # transforms are defined for it yet — aggregation rows couple
-        # series across shards); resolving keeps the selection in the one
-        # table models/transforms owns
+        # step selection stays in the one table models/transforms owns:
+        # the bare mixed-frequency core, or the shard transform over it
         from . import transforms as tfm
 
-        step = tfm.resolve(tfm.Stack("mf")).step
         fallback_step = None
         fallback_unwrap = None
+        if ns > 1:
+            # a tripped sharded run demotes to the exact sequential MF
+            # step: same (xz, mask, stats) args
+            res_t = tfm.resolve(tfm.Stack("mf", (tfm.shard(ns),)))
+            step, fallback_step = res_t.step, res_t.fallback_step
+            nproc = jax.process_count()
+            if nproc > 1:
+                # multi-process SPMD: hand the loop host (numpy) arrays —
+                # identical on every process by construction — so jit can
+                # shard them onto the global ("dcn", "ici") mesh (a
+                # committed single-device array cannot be resharded
+                # across processes)
+                to_host = lambda t: jax.tree.map(np.asarray, t)
+                xz, m_arr = np.asarray(xz), np.asarray(m_arr)
+                params, stats = to_host(params), to_host(stats)
+                rec.set(
+                    mesh_shape=[nproc, ns // nproc], sharded=True,
+                    process_count=nproc,
+                )
+            else:
+                rec.set(mesh_shape=[ns], sharded=True)
+        else:
+            step = tfm.resolve(tfm.Stack("mf")).step
         if accel == "squarem":
             from .emaccel import squarem, squarem_state, unwrap_state
 
-            step = squarem(em_step_mf_stats, _project_params_mf)
+            step = squarem(step, _project_params_mf)
             params = squarem_state(params)
             # recovery ladder's demote rung: peel the SquaremState and
             # continue on the exact sequential EM map
-            fallback_step = em_step_mf_stats
+            if fallback_step is None:
+                fallback_step = em_step_mf_stats
             fallback_unwrap = unwrap_state
 
         if gram_dtype is not None:
@@ -538,10 +732,23 @@ def estimate_mixed_freq_dfm(
                 final_health=HEALTH_NAMES[res.health],
             )
 
-        # bucketed path: smooth at the bucket shape, then slice the
-        # readout (and the params) back to the raw panel
+        if ns > 1 and jax.process_count() > 1:
+            # gather the mesh-sharded loop output to replicated host
+            # copies before the local smoother readout (fully-replicated
+            # arrays are locally addressable on every process)
+            from jax.sharding import NamedSharding
+
+            from ..parallel.mesh import P as _P, data_mesh
+
+            gmesh = data_mesh(ns, hosts=0)
+            gather = jax.jit(
+                lambda t: t, out_shardings=NamedSharding(gmesh, _P())
+            )
+            params = jax.tree.map(np.asarray, gather(params))
+        # bucketed/sharded path: smooth at the padded shape, then slice
+        # the readout (and the params) back to the raw panel
         s_sm, x_hat = _smooth_xhat_mf(params, xz, m_arr)
-        if buckets is not None:
+        if buckets is not None or ns > 1:
             params = params._replace(
                 lam=params.lam[:N0], R=params.R[:N0], agg=params.agg[:N0]
             )

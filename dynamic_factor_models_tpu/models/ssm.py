@@ -1427,39 +1427,83 @@ def em_step_steady(state, x, mask, stats: PanelStats, t_star: int, block: int = 
     return _steady_step_for(int(t_star), int(block))(state, x, mask, stats)
 
 
-@lru_cache(maxsize=None)
-def _sharded_step_for(n_shards: int):
-    """The cross-section-sharded EM step over an ``("data",)`` N-axis mesh
-    of `n_shards` devices — same (params, x, mask, stats) -> (params,
+def _resolve_mesh_hosts(hosts: int) -> int:
+    """Resolve the `hosts` knob of a sharded-step factory: 0/None means
+    "the runtime's process count" (1 in a plain single-process session,
+    >1 only after `parallel.distributed.initialize_distributed`), and
+    anything <= 1 collapses to the flat single-host mesh."""
+    if hosts is None or hosts == 0:
+        hosts = jax.process_count()
+    return max(int(hosts), 1)
+
+
+def _sharded_step_for(n_shards: int, hosts: int = 0):
+    """The cross-section-sharded EM step over an N-axis data mesh of
+    `n_shards` devices — same (params, x, mask, stats) -> (params,
     loglik) contract as `em_step_stats`, N must be a shard multiple
     (`estimate_dfm_em(n_shards=)` pads with inert series first).
 
+    `hosts=0` (default) resolves to `jax.process_count()`: a plain
+    single-process session gets the flat single-host ``("data",)`` mesh
+    (byte-identical program to pre-multi-host builds), while a
+    `jax.distributed`-initialized runtime transparently gets the
+    process-spanning ``("dcn", "ici")`` mesh with the hierarchical
+    reduction.  Pass `hosts` explicitly to force a topology (the tier-1
+    proxy runs hosts=2 on the single-process 8-device CPU mesh).
+
     Work split per iteration: the Jungbacker-Koopman collapse and the
     M-step panel GEMMs — everything O(N) — run on local shards; the packed
-    collapse payload is all-reduced once per iteration by the ring kernel
-    (`ops.pallas_gram.ring_allreduce`: Pallas remote-DMA ring on TPU,
-    `lax.psum` on the CPU mesh); the O(k^3) filter/smoother scans and the
-    factor-VAR moments are N-free and run replicated; the loading/R solves
-    are per-series and stay shard-local.  With the guarded while-loop
-    outside, a whole sharded EM run executes with ONE cross-device
-    reduction and ZERO host syncs per iteration.
+    collapse payload is all-reduced once per iteration (flat ring on one
+    host; ring-within-ICI then one cross-host DCN psum on many — see
+    `ops.pallas_gram.hierarchical_allreduce`); the O(k^3) filter/smoother
+    scans and the factor-VAR moments are N-free and run replicated; the
+    loading/R solves are per-series and stay shard-local.  With the
+    guarded while-loop outside, a whole sharded EM run executes with ONE
+    cross-device reduction and ZERO host syncs per iteration.
 
-    lru_cached and named per shard count so `run_em_loop`'s AOT-registry
-    statics key (utils.compile.aot_statics uses __module__ + __qualname__)
-    is stable across processes, like `_steady_step_for`."""
+    This dispatcher is a plain function so `f(2)`, `f(2, 0)` and
+    `f(2, hosts=0)` all hit ONE cache entry (functools.lru_cache keys
+    them differently, which would break the resolve-identity pins in
+    tests/test_transform_stack.py); the lru_cached impl is keyed on the
+    resolved (n_shards, hosts) pair."""
+    return _sharded_step_impl(int(n_shards), _resolve_mesh_hosts(hosts))
+
+
+@lru_cache(maxsize=None)
+def _sharded_step_impl(n_shards: int, hosts: int):
+    """lru_cached and named per (shard count, host count) so
+    `run_em_loop`'s AOT-registry statics key (utils.compile.aot_statics
+    uses __module__ + __qualname__) is stable across processes, like
+    `_steady_step_for`.  hosts<=1 keeps the exact pre-multi-host name
+    (`em_step_sharded_d{n}`) and program."""
     from jax.experimental.shard_map import shard_map
 
-    from ..ops.pallas_gram import ring_allreduce
+    from ..ops.pallas_gram import hierarchical_allreduce, ring_allreduce
     from ..parallel.mesh import P, data_mesh
 
-    mesh = data_mesh(n_shards)
+    mesh = data_mesh(n_shards, hosts=hosts)
+    if hosts > 1:
+        dax = ("dcn", "ici")
+        n_ici = n_shards // hosts
+
+        def _reduce(payload):
+            return hierarchical_allreduce(payload, "ici", "dcn", n_ici)
+
+        name = f"em_step_sharded_d{n_shards}_h{hosts}"
+    else:
+        dax = "data"
+
+        def _reduce(payload):
+            return ring_allreduce(payload, "data", n_shards)
+
+        name = f"em_step_sharded_d{n_shards}"
 
     def step(params: SSMParams, x, mask, stats: PanelStats):
         del mask  # collapse statistics already carry the mask
         params = params._replace(Q=_psd_floor(params.Q))
         payload, llc = _collapse_obs_stats_partial(params.lam, params.R, x, stats)
-        payload = ring_allreduce(payload, "data", n_shards)
-        llc = jax.lax.psum(llc, "data")
+        payload = _reduce(payload)
+        llc = jax.lax.psum(llc, dax)
         C, b, ld_R = _unpack_collapsed(payload, params.r)
         filt, pinvs = _filter_scan_collapsed_stats(
             params, C, b, ld_R, stats.n_obs, llc, want_pinv=True
@@ -1470,20 +1514,20 @@ def _sharded_step_for(n_shards: int):
             filt.loglik,
         )
 
-    step.__name__ = step.__qualname__ = f"em_step_sharded_d{n_shards}"
+    step.__name__ = step.__qualname__ = name
     step.__module__ = __name__
 
-    params_spec = SSMParams(lam=P("data", None), R=P("data"), A=P(), Q=P())
+    params_spec = SSMParams(lam=P(dax, None), R=P(dax), A=P(), Q=P())
     stats_spec = PanelStats(
-        m=P(None, "data"), xT=P("data", None), mT=P("data", None),
-        Sxx=P("data"), n_i=P("data"), n_obs=P(),
+        m=P(None, dax), xT=P(dax, None), mT=P(dax, None),
+        Sxx=P(dax), n_i=P(dax), n_obs=P(),
         m16=None, x16=None, mT16=None, xT16=None, tw=P(),
     )
     return jax.jit(
         shard_map(
             step,
             mesh=mesh,
-            in_specs=(params_spec, P(None, "data"), P(None, "data"), stats_spec),
+            in_specs=(params_spec, P(None, dax), P(None, dax), stats_spec),
             out_specs=(params_spec, P()),
             check_rep=False,
         )
@@ -1674,6 +1718,12 @@ def estimate_dfm_em(
                 f"n_shards={ns} exceeds the {jax.device_count()} visible "
                 "devices"
             )
+        if jax.process_count() > 1 and ns % jax.process_count() != 0:
+            raise ValueError(
+                f"n_shards={ns} must be a multiple of "
+                f"jax.process_count()={jax.process_count()} so every host "
+                "owns the same number of local shards"
+            )
     from ..utils.telemetry import run_record
 
     with on_backend(backend), run_record(
@@ -1738,7 +1788,22 @@ def estimate_dfm_em(
                 # sequential step: same (xz, mask, stats) args
                 res_t = tfm.resolve(tfm.Stack("ssm", (tfm.shard(ns),)))
                 step, fallback_step = res_t.step, res_t.fallback_step
-                rec.set(mesh_shape=[ns], sharded=True)
+                nproc = jax.process_count()
+                if nproc > 1:
+                    # multi-process SPMD: hand the loop host (numpy)
+                    # arrays — identical on every process by construction
+                    # — so jit can shard them onto the global
+                    # ("dcn", "ici") mesh (a committed single-device
+                    # array cannot be resharded across processes)
+                    xz, m_arr = np.asarray(xz), np.asarray(m_arr)
+                    params = jax.tree.map(np.asarray, params)
+                    stats = jax.tree.map(np.asarray, stats)
+                    rec.set(
+                        mesh_shape=[nproc, ns // nproc], sharded=True,
+                        process_count=nproc,
+                    )
+                else:
+                    rec.set(mesh_shape=[ns], sharded=True)
             args = (xz, m_arr, stats)
         elif method == "steady":
             stats = compute_panel_stats(xz, m_arr)
@@ -1850,6 +1915,19 @@ def estimate_dfm_em(
                 ladder_rung=res.ladder_rung,
                 final_health=HEALTH_NAMES[res.health],
             )
+        if ns > 1 and jax.process_count() > 1:
+            # gather the mesh-sharded loop output to replicated host
+            # copies before the local smoother readout (fully-replicated
+            # arrays are locally addressable on every process)
+            from jax.sharding import NamedSharding
+
+            from ..parallel.mesh import P as _P, data_mesh
+
+            gmesh = data_mesh(ns, hosts=0)
+            gather = jax.jit(
+                lambda t: t, out_shardings=NamedSharding(gmesh, _P())
+            )
+            params = jax.tree.map(np.asarray, gather(params))
         # on the bucketed path the smoother also runs at the bucket shape
         # (padded cells are NaN -> missing; trailing all-missing periods
         # add no information at real times), then the readout slices back

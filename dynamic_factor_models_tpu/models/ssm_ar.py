@@ -785,6 +785,12 @@ def estimate_dfm_em_ar(
                 f"n_shards={ns} exceeds the {jax.device_count()} visible "
                 "devices"
             )
+        if jax.process_count() > 1 and ns % jax.process_count() != 0:
+            raise ValueError(
+                f"n_shards={ns} must be a multiple of "
+                f"jax.process_count()={jax.process_count()} so every host "
+                "owns the same number of local shards"
+            )
     from ..utils.telemetry import run_record
 
     with on_backend(backend), run_record(
@@ -894,7 +900,14 @@ def estimate_dfm_em_ar(
                     [m_arr, jnp.zeros(zcols.shape, bool)], axis=1
                 )
                 params_em = emcore.pad_ar_params(params, Npad)
-            rec.set(mesh_shape=[ns], sharded=True, n_padded=Npad)
+            nproc = jax.process_count()
+            if nproc > 1:
+                rec.set(
+                    mesh_shape=[nproc, ns // nproc], sharded=True,
+                    n_padded=Npad, process_count=nproc,
+                )
+            else:
+                rec.set(mesh_shape=[ns], sharded=True, n_padded=Npad)
 
         res_t = tfm.resolve(tfm.Stack("ar", tuple(axes)))
         base_step = res_t.step
@@ -938,6 +951,16 @@ def estimate_dfm_em_ar(
             fallback_step = base_step
             fallback_unwrap = unwrap_state
             fallback_args = None
+        if ns > 1 and jax.process_count() > 1:
+            # multi-process SPMD: hand the loop host (numpy) arrays —
+            # identical on every process by construction — so jit can
+            # shard them onto the global ("dcn", "ici") mesh (a committed
+            # single-device array cannot be resharded across processes)
+            to_host = lambda t: jax.tree.map(np.asarray, t)
+            params_em = to_host(params_em)
+            em_args = to_host(em_args)
+            if fallback_args is not None:
+                fallback_args = to_host(fallback_args)
         res = run_em_loop(
             step, params_em, em_args, tol, max_em_iter,
             collect_path=collect_path,
@@ -954,6 +977,19 @@ def estimate_dfm_em_ar(
         if isinstance(params, emcore.ARSteadyState):
             rec.set(riccati_iters=int(params.riccati_iters))
             params = params.params
+        if ns > 1 and jax.process_count() > 1:
+            # gather the mesh-sharded loop output to replicated host
+            # copies before the local readout (fully-replicated arrays
+            # are locally addressable on every process)
+            from jax.sharding import NamedSharding
+
+            from ..parallel.mesh import P as _P, data_mesh
+
+            gmesh = data_mesh(ns, hosts=0)
+            gather = jax.jit(
+                lambda t: t, out_shardings=NamedSharding(gmesh, _P())
+            )
+            params = jax.tree.map(np.asarray, gather(params))
         if int(params.lam.shape[0]) != N_n:  # sharded padding
             params = emcore.unpad_ar_params(params, N_n)
         rec.set(

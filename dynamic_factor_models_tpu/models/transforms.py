@@ -127,12 +127,19 @@ def steady_tail(t_star: int, block: int = 0) -> Transform:
     return Transform("steady", (int(t_star), int(block)))
 
 
-def shard(n_shards: int) -> Transform:
+def shard(n_shards: int, hosts: int = 0) -> Transform:
     """Run the collapse's pre-scan (T, N) GEMMs shard-local over the
-    ``("data",)`` series mesh, all-reducing the packed payload with the
+    series data mesh, all-reducing the packed payload with the
     Pallas/psum ring; the N-free scan runs replicated, the per-series
-    M-step shard-local."""
-    return Transform("shard", (int(n_shards),))
+    M-step shard-local.
+
+    hosts=0 (the default) resolves to ``jax.process_count()`` at resolve
+    time: a single-process runtime gets the flat ``("data",)`` mesh, a
+    `jax.distributed`-initialized runtime the process-spanning
+    ``("dcn", "ici")`` mesh with the hierarchical ICI-ring + DCN-psum
+    reduction.  Pass hosts explicitly to force a topology (the tier-1
+    multi-host proxy runs hosts=2 on one process)."""
+    return Transform("shard", (int(n_shards), int(hosts)))
 
 
 def batch(B: int) -> Transform:
@@ -177,6 +184,9 @@ class Resolved(NamedTuple):
     donate     carry donation policy (None = env default)
     accel      acceleration name or None
     fallback_step  the exact step the guard ladder's demote rung targets
+    hosts      mesh host count as requested by shard() (0 = resolve to
+               jax.process_count(); >1 = process-spanning ("dcn", "ici")
+               mesh with the hierarchical reduction)
     """
 
     step: object
@@ -191,6 +201,7 @@ class Resolved(NamedTuple):
     donate: bool | None = None
     accel: str | None = None
     fallback_step: object = None
+    hosts: int = 0
 
 
 def _split(stack: Stack):
@@ -226,9 +237,12 @@ def resolve(stack: Stack) -> Resolved:
     t_star, block = (
         step_t["steady"].args if "steady" in step_t else (None, 0)
     )
-    n_shards = step_t["shard"].args[0] if "shard" in step_t else 0
+    sargs = step_t["shard"].args if "shard" in step_t else (0,)
+    n_shards = sargs[0]
+    hosts = sargs[1] if len(sargs) > 1 else 0
     kw = dict(
         n_shards=n_shards,
+        hosts=hosts,
         t_star=t_star,
         block=block,
         batch=loop_t["batch"].args[0] if "batch" in loop_t else 0,
@@ -260,8 +274,8 @@ def resolve(stack: Stack) -> Resolved:
             )
         if axes <= {"collapse", "shard"}:
             return Resolved(
-                ssm._sharded_step_for(n_shards), "ssm", "stats", "bare",
-                fallback_step=ssm.em_step_stats, **kw,
+                ssm._sharded_step_for(n_shards, hosts), "ssm", "stats",
+                "bare", fallback_step=ssm.em_step_stats, **kw,
             )
         raise ValueError(
             "the iid core has no steady x shard product yet; compose "
@@ -319,12 +333,12 @@ def resolve(stack: Stack) -> Resolved:
             )
         if axes == {"collapse", "shard"}:
             return Resolved(
-                emcore._ar_sharded_step_for(n_shards), "ar", "qd", "bare",
-                fallback_step=ssm_ar.em_step_ar_qd, **kw,
+                emcore._ar_sharded_step_for(n_shards, hosts), "ar", "qd",
+                "bare", fallback_step=ssm_ar.em_step_ar_qd, **kw,
             )
         # all three speed axes on one panel
         return Resolved(
-            emcore._ar_steady_sharded_step_for(t_star, block, n_shards),
+            emcore._ar_steady_sharded_step_for(t_star, block, n_shards, hosts),
             "ar", "qd_tail", "ar_steady",
             fallback_step=ssm_ar.em_step_ar_qd, **kw,
         )
@@ -332,11 +346,23 @@ def resolve(stack: Stack) -> Resolved:
     # stack.core == "mf"
     from . import mixed_freq
 
+    if axes == {"shard"}:
+        # the MF step collapses through H5 inside its own scan (collapse
+        # is implied), so shard is the one extra axis it composes with:
+        # per-series E-step terms stay independent sums even through the
+        # Mariano-Murasawa aggregation rows
+        return Resolved(
+            mixed_freq._mf_sharded_step_for(n_shards, hosts), "mf",
+            "stats", "bare", fallback_step=mixed_freq.em_step_mf_stats,
+            **kw,
+        )
     if axes:
         raise ValueError(
-            "the mixed-frequency core supports no step transforms yet "
-            "(aggregation rows couple series across shards; ROADMAP "
-            "item 5)"
+            "the mixed-frequency core supports no step transforms other "
+            "than 'shard': it already collapses through H5 inside its "
+            "scan (an explicit 'collapse' would be a no-op), and the "
+            "period-3 quarterly mask cycle has no single steady horizon "
+            "for 'steady' to split at"
         )
     return Resolved(
         mixed_freq.em_step_mf_stats, "mf", "stats", "bare", **kw
@@ -407,7 +433,11 @@ def enumerate_stacks(spec) -> list:
         if spec.t_star is not None
         else None
     )
-    sh = (shard(spec.n_shards),) if spec.n_shards > 1 else None
+    sh = (
+        (shard(spec.n_shards, getattr(spec, "mesh_hosts", 0)),)
+        if spec.n_shards > 1
+        else None
+    )
     entries: list[PlanEntry] = []
     add = entries.append
 
@@ -462,6 +492,8 @@ def enumerate_stacks(spec) -> list:
     if sh is not None:
         if "em_step_sharded" in ks:
             add(PlanEntry("em_step_sharded", Stack("ssm", sh)))
+        if "em_step_mf_sharded" in ks:
+            add(PlanEntry("em_step_mf_sharded", Stack("mf", sh)))
         if "em_loop_guarded@sharded" in ks:
             add(
                 PlanEntry(

@@ -55,6 +55,22 @@ def initialize_distributed(
     )
     if coordinator_address is None:
         return False  # single-process
+    # CPU cross-process collectives need an explicit implementation: the
+    # flag defaults to "none" and the TFRT CPU client then refuses ANY
+    # compile whose device assignment crosses a process boundary
+    # ("Multiprocess computations aren't implemented on the CPU backend").
+    # Pick Gloo before the backend instantiates; TPU/GPU ignore the flag.
+    try:
+        from jax._src import xla_bridge as _xb
+
+        current = _xb.CPU_COLLECTIVES_IMPLEMENTATION.value
+    except Exception:
+        current = None
+    if current in (None, "none"):
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:
+            pass  # newer jax: gloo is the default and the flag may be gone
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,

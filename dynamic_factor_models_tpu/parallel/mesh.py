@@ -64,12 +64,63 @@ def series_pad(n_series: int, n_shards: int) -> int:
     return ((n_series + n_shards - 1) // n_shards) * n_shards
 
 
-def data_mesh(n_shards: int | None = None) -> Mesh:
-    """1-D ``("data",)`` mesh over the first n_shards devices — the
-    cross-section (N axis) mesh used by the sharded EM step.  On TPU the
-    axis rides ICI; in CI the same program runs on the forced 8-device
-    CPU platform (tests/conftest.py)."""
-    return make_mesh(n_shards, axis_names=("data",))
+def data_mesh(n_shards: int | None = None, hosts: int = 1) -> Mesh:
+    """Cross-section (N axis) mesh used by the sharded EM step.
+
+    hosts <= 1 (the default, and the resolution of hosts=0/None in a
+    single-process runtime) builds the flat 1-D ``("data",)`` mesh over
+    the first n_shards devices — byte-identical to the pre-multi-host
+    construction, so the single-host HLO pins are preserved.
+
+    hosts > 1 builds the process-spanning 2-D ``("dcn", "ici")`` mesh:
+    the outer axis enumerates hosts (cross-process psum rides DCN), the
+    inner axis a host's local devices (Pallas ring rides ICI).  Sharded
+    arrays flatten both axes into one logical data axis via a tuple
+    PartitionSpec entry ``P(("dcn", "ici"), ...)``.  In a multi-process
+    runtime each host contributes its own first ``n_shards // hosts``
+    devices, relying on the process-major ordering of ``jax.devices()``;
+    single-process callers (the tier-1 8-device proxy) get the same
+    topology by reshaping the first n_shards local devices.
+
+    On TPU the inner axis rides ICI; in CI the same program runs on the
+    forced 8-device CPU platform (tests/conftest.py)."""
+    if hosts is None or hosts == 0:
+        hosts = jax.process_count()
+    hosts = max(int(hosts), 1)
+    if hosts <= 1:
+        return make_mesh(n_shards, axis_names=("data",))
+    devs = jax.devices()
+    if n_shards is None:
+        n_shards = len(devs)
+    if n_shards % hosts != 0:
+        raise ValueError(
+            f"n_shards={n_shards} must divide evenly over hosts={hosts} "
+            f"(each host owns n_shards // hosts local devices)"
+        )
+    local = n_shards // hosts
+    nproc = jax.process_count()
+    if nproc > 1:
+        if hosts != nproc:
+            raise ValueError(
+                f"hosts={hosts} must equal jax.process_count()={nproc} in a "
+                f"multi-process runtime (one DCN rank per OS process)"
+            )
+        per_proc = len(devs) // nproc
+        if local > per_proc:
+            raise ValueError(
+                f"n_shards={n_shards} over hosts={hosts} needs {local} devices "
+                f"per process but only {per_proc} are visible"
+            )
+        # Process-major: take each process's first `local` devices so the
+        # "ici" axis never crosses a process boundary.
+        picked = [devs[h * per_proc + j] for h in range(hosts) for j in range(local)]
+    else:
+        if n_shards > len(devs):
+            raise ValueError(
+                f"n_shards={n_shards} exceeds the {len(devs)} visible devices"
+            )
+        picked = list(devs[:n_shards])
+    return Mesh(np.array(picked).reshape(hosts, local), ("dcn", "ici"))
 
 
 def make_mesh(n_devices: int | None = None, axis_names=("rep",), shape=None) -> Mesh:

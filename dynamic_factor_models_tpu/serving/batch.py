@@ -311,7 +311,14 @@ def refit_sequential(
     padding — and the per-iteration program is the zero-host-sync sharded
     EM step.  Tenants too small to shard profitably still work; the knob
     exists so a serving node with a mesh can refit its largest panels
-    without a separate code path."""
+    without a separate code path.
+
+    In a `jax.distributed`-initialized runtime `_sharded_step_for`
+    resolves onto the process-spanning ``("dcn", "ici")`` mesh (PR 15)
+    with the hierarchical ICI+DCN reduction, so a multi-host serving
+    node refits across OS processes unmodified — n_shards must then be a
+    multiple of `jax.process_count()` and `jax.device_count()` counts
+    the GLOBAL mesh, so the guard below already sizes correctly."""
     ns = int(n_shards) if n_shards else 0
     if ns > 1:
         if step is not None:

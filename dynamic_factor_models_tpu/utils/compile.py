@@ -503,6 +503,13 @@ class CompileSpec:
     # the given axis names.  0 (default) skips the sharded kernels.
     n_shards: int = 0
     mesh_axes: tuple = ("data",)
+    # multi-host sharding (PR 15): mesh_hosts > 1 lowers the sharded
+    # kernels onto the process-spanning ("dcn", "ici") mesh via
+    # transforms.shard(n_shards, hosts) — the hierarchical-reduction
+    # program.  0 (default) resolves to jax.process_count() at resolve
+    # time, so existing single-process specs compile the same flat-mesh
+    # programs as before.
+    mesh_hosts: int = 0
 
     def padded_shape(self) -> tuple:
         if not self.bucket:
@@ -646,6 +653,33 @@ def _kernel_plan(spec: CompileSpec):
             from ..parallel.mesh import series_pad
 
             N = series_pad(Nb, res.n_shards)
+        if res.core == "mf":
+            # MixedFreqParams carries the extra (N, 5) aggregation-row
+            # leaf, so the SSM aval pytree below would mis-key the plan;
+            # build the MF pytree explicitly.  _obs_matrix silently
+            # truncates its lag slices when p < 5, so refuse early.
+            from ..models.mixed_freq import _N_AGG, MixedFreqParams
+
+            if p < _N_AGG:
+                raise ValueError(
+                    f"CompileSpec p={p} must be >= {_N_AGG} to plan "
+                    "mixed-frequency kernels (Mariano-Murasawa lags)"
+                )
+            _, xa_s, ma_s, st_s = _ssm_avals(N)
+            mf_s = MixedFreqParams(
+                _sds((N, r), dt), _sds((N,), dt), _sds((p, r, r), dt),
+                _sds((r, r), dt), _sds((N, _N_AGG), dt),
+            )
+
+            def mk_mf():
+                pa, x, mask, stats = em_inputs_at(N)
+                agg = jnp.zeros((N, _N_AGG), dt).at[:, 0].set(1.0)
+                return (
+                    MixedFreqParams(pa.lam, pa.R, pa.A, pa.Q, agg),
+                    x, mask, stats,
+                )
+
+            return mf_s, (xa_s, ma_s, st_s), mk_mf
         if res.arg_kind in ("stats", "panel"):
             pa_s, xa_s, ma_s, st_s = _ssm_avals(N)
             if res.arg_kind == "panel":

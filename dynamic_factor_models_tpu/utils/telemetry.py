@@ -880,10 +880,12 @@ class RunRecord:
             dev = jax.devices()[0]
             d.setdefault("device_kind", dev.device_kind)
             d.setdefault("n_devices", jax.device_count())
+            d.setdefault("process_count", jax.process_count())
         except Exception:
             d.setdefault("platform", "unknown")
             d.setdefault("device_kind", "unknown")
             d.setdefault("n_devices", 0)
+            d.setdefault("process_count", 1)
         # sharded runs set mesh_shape via rec.set(...) in the estimator;
         # single-device records carry the explicit defaults so summarize
         # can render "-" without guessing

@@ -3,13 +3,25 @@
 Each worker is one 'host': 4 virtual CPU devices, joined into one global
 8-device runtime via `jax.distributed.initialize` (coordination service +
 Gloo CPU collectives — the DCN analogue this environment can actually run).
-Run: python _dist_worker.py <process_id> <num_processes> <port>
+Run: python _dist_worker.py <process_id> <num_processes> <port> [mode]
+
+Modes:
+  favar (default)  the PR-13 drill: global-mesh psum + replication-sharded
+                   bootstrap.
+  em               the PR-15 drill: sharded EM (plain + collapsed-AR) with
+                   n_shards=8 over the process-spanning ("dcn", "ici")
+                   mesh; each worker ALSO runs the single-process reference
+                   locally and asserts <= 1e-10 parity in-process, then
+                   prints a bytes digest of the sharded results so the
+                   harness can pin bit-identical SPMD output across
+                   processes.
 """
 
 import os
 import sys
 
 pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+mode = sys.argv[4] if len(sys.argv) > 4 else "favar"
 os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
 os.environ["JAX_PLATFORMS"] = "cpu"
 
@@ -33,12 +45,92 @@ from dynamic_factor_models_tpu.parallel.distributed import (  # noqa: E402
 from dynamic_factor_models_tpu.parallel.timescan import shard_map  # noqa: E402
 
 
+def _digest(tree) -> str:
+    """Order-stable bytes digest of a pytree — bit-identity probe across
+    the SPMD processes (any divergence, even in the last ulp, changes it)."""
+    import hashlib
+
+    h = hashlib.sha256()
+    for leaf in jax.tree.leaves(tree):
+        h.update(np.ascontiguousarray(np.asarray(leaf)).tobytes())
+    return h.hexdigest()
+
+
+def em_mode():
+    """PR-15 drill: sharded EM over the process-spanning mesh.
+
+    Each worker runs the single-process (local, collective-free) reference
+    AND the n_shards=8 global-mesh run, asserts <= 1e-10 parity in-process,
+    and prints a digest of the sharded results for the cross-process
+    bit-identity check in the harness.
+    """
+    from dynamic_factor_models_tpu.models.dfm import DFMConfig
+    from dynamic_factor_models_tpu.models.ssm import estimate_dfm_em
+    from dynamic_factor_models_tpu.models.ssm_ar import estimate_dfm_em_ar
+
+    def max_leaf_diff(a, b):
+        return max(
+            float(np.max(np.abs(np.asarray(x) - np.asarray(y))))
+            if np.asarray(x).size
+            else 0.0
+            for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b))
+        )
+
+    rng = np.random.default_rng(15)
+    T, N, r = 60, 37, 2
+    f = rng.standard_normal((T, r))
+    lam = rng.standard_normal((N, r))
+    x = f @ lam.T + 0.5 * rng.standard_normal((T, N))
+    x[rng.random((T, N)) < 0.15 * (np.arange(N) >= r + 4)] = np.nan
+    cfg = DFMConfig(nfac_u=r, n_factorlag=1)
+
+    # plain EM: local reference vs global (dcn, ici) mesh
+    base = estimate_dfm_em(x, np.ones(N), 0, T - 1, cfg, max_em_iter=6)
+    shrd = estimate_dfm_em(
+        x, np.ones(N), 0, T - 1, cfg, max_em_iter=6, n_shards=8
+    )
+    d_em = max_leaf_diff(base.params, shrd.params)
+    n = min(base.n_iter, shrd.n_iter)
+    d_ll = float(
+        np.max(
+            np.abs(
+                np.asarray(shrd.loglik_path[:n])
+                - np.asarray(base.loglik_path[:n])
+            )
+        )
+    )
+    assert d_em <= 1e-10, f"plain-EM parity {d_em}"
+    assert d_ll <= 1e-10, f"plain-EM loglik parity {d_ll}"
+
+    # collapsed-AR EM: the production large-N path
+    base_ar = estimate_dfm_em_ar(
+        x, np.ones(N), 0, T - 1, cfg, max_em_iter=4, method="collapsed"
+    )
+    shrd_ar = estimate_dfm_em_ar(
+        x, np.ones(N), 0, T - 1, cfg, max_em_iter=4, method="collapsed",
+        n_shards=8,
+    )
+    d_ar = max_leaf_diff(base_ar.params, shrd_ar.params)
+    assert d_ar <= 1e-10, f"collapsed-AR parity {d_ar}"
+
+    dg = _digest((shrd.params, shrd.loglik_path, shrd_ar.params))
+    print(
+        f"RESULT pid={pid} emdiff={d_em:.3e} lldiff={d_ll:.3e} "
+        f"ardiff={d_ar:.3e} digest={dg}",
+        flush=True,
+    )
+
+
 def main():
     ok = initialize_distributed(f"127.0.0.1:{port}", nproc, pid)
     assert ok, "expected a distributed runtime"
     assert jax.process_count() == nproc
     assert jax.local_device_count() == 4
     assert jax.device_count() == 4 * nproc
+
+    if mode == "em":
+        em_mode()
+        return
 
     # 1. global mesh with the documented DCN-outer/ICI-inner factorization:
     #    outer axis strides across processes (device order is process-major)

@@ -63,6 +63,15 @@ def bench(tmp_path, monkeypatch):
         return _FakeChild('{"n_devices": 8, "tpu_unreachable": false}')
 
     monkeypatch.setattr(b, "_run_child", _fake_run_child)
+    # the multi-host leg spawns real OS-process workers — stub the whole
+    # section like the other named sections so the order test stays a
+    # plumbing test
+    monkeypatch.setattr(
+        b, "multihost_section",
+        lambda force_cpu, smoke=False: calls.append("multihost") or {
+            "smoke": smoke, "flop_proxy": True
+        },
+    )
 
     class _FakeDS:
         pass
@@ -78,7 +87,7 @@ def test_remainder_section_order_and_stores(bench, tmp_path, capsys):
     bench.run_tpu_remainder()
     assert bench._test_calls == [
         "pallas", "parity", "large", "refscale", "multichip", "composed",
-        "crossover"
+        "multihost", "crossover"
     ]
     out = capsys.readouterr().out.strip().splitlines()[-1]
     final = json.loads(out)
@@ -86,6 +95,7 @@ def test_remainder_section_order_and_stores(bench, tmp_path, capsys):
     assert final["pallas_gram_speedup_large_panel"] == 1.5
     assert final["multichip"]["n_devices"] == 8
     assert final["composed_smoke"]["smoke"] is True
+    assert final["multihost_smoke"]["smoke"] is True
     assert "crossover_markdown" in final
     # per-section persistence: the partial file holds the full accumulation
     partial = json.loads((tmp_path / "partial.json").read_text())

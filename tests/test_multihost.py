@@ -1,0 +1,376 @@
+"""Multi-host scale-out (PR-15): the process-spanning ``("dcn", "ici")``
+data mesh and the hierarchical ICI+DCN Gram reduction.
+
+A real multi-process runtime can't live inside the tier-1 pytest process
+(jax.distributed.initialize is once-per-process), so the coverage splits:
+
+* tier-1 proxy (this file, unmarked/`multidevice`): hosts=2 forced onto the
+  single-process 8-device CPU platform — the SAME 2-D mesh, tuple
+  PartitionSpec flattening, and hierarchical reduce as the real two-host
+  program, minus the OS-process boundary.  Pins hierarchical == flat ring
+  at 1e-12 and every step factory's hosts=2 output against its flat twin.
+* the real thing (`slow` + `multihost`): two OS processes joined by
+  `jax.distributed.initialize` run the sharded estimators end-to-end via
+  tests/_dist_worker.py mode "em" — <= 1e-10 parity vs the single-process
+  reference asserted in-worker, bit-identical SPMD results pinned across
+  processes by digest equality here.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dynamic_factor_models_tpu.models import emcore, mixed_freq, ssm, ssm_ar
+from dynamic_factor_models_tpu.models import transforms as tfm
+from dynamic_factor_models_tpu.models.mixed_freq import MixedFreqParams
+from dynamic_factor_models_tpu.models.ssm import compute_panel_stats
+from dynamic_factor_models_tpu.ops.pallas_gram import (
+    hierarchical_allreduce,
+    ring_allreduce,
+)
+from dynamic_factor_models_tpu.parallel.mesh import P, data_mesh
+
+from test_sharding import _max_leaf_diff, _mf_panel, _panel, _prep_padded
+
+PARITY_ATOL = 1e-10  # acceptance bar for step/estimator outputs
+REDUCE_ATOL = 1e-12  # acceptance bar for the raw reduction itself
+
+_WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)), "_dist_worker.py")
+
+
+# ---------------------------------------------------------------------------
+# 1. mesh construction
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.multidevice
+def test_data_mesh_hosts_topology():
+    flat = data_mesh(8)
+    assert flat.axis_names == ("data",)
+    assert flat.devices.shape == (8,)
+    two = data_mesh(8, hosts=2)
+    assert two.axis_names == ("dcn", "ici")
+    assert two.devices.shape == (2, 4)
+    # same device set, row-major: the "ici" rows partition the flat order
+    assert [d.id for d in two.devices.ravel()] == [d.id for d in flat.devices]
+    # hosts=0/None resolve to process_count() -> 1 here -> the flat mesh
+    assert data_mesh(8, hosts=0).axis_names == ("data",)
+    assert data_mesh(8, hosts=None).axis_names == ("data",)
+
+
+def test_data_mesh_hosts_validation():
+    with pytest.raises(ValueError, match="divide evenly"):
+        data_mesh(8, hosts=3)
+    with pytest.raises(ValueError, match="devices"):
+        data_mesh(2 * jax.device_count(), hosts=2)
+
+
+# ---------------------------------------------------------------------------
+# 2. the reduction itself: hierarchical (ICI ring + DCN psum) == flat ring
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.multidevice
+def test_hierarchical_reduce_matches_flat_ring():
+    """The tier-1 pin behind the two-host program: reducing within the
+    "ici" axis then once across "dcn" must equal the flat 8-way ring at
+    <= 1e-12 (reduction order differs, bitwise identity is not promised)."""
+    from jax.experimental.shard_map import shard_map
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((8, 33)))
+
+    flat = jax.jit(
+        shard_map(
+            lambda a: ring_allreduce(a, "data", 8),
+            mesh=data_mesh(8),
+            in_specs=P("data", None),
+            out_specs=P("data", None),
+            check_rep=False,
+        )
+    )(x)
+    hier = jax.jit(
+        shard_map(
+            lambda a: hierarchical_allreduce(a, "ici", "dcn", 4),
+            mesh=data_mesh(8, hosts=2),
+            in_specs=P(("dcn", "ici"), None),
+            out_specs=P(("dcn", "ici"), None),
+            check_rep=False,
+        )
+    )(x)
+    want = np.asarray(x).sum(0)
+    for got in (np.asarray(flat), np.asarray(hier)):
+        assert got.shape == (8, 33)  # every shard holds the full sum
+        np.testing.assert_allclose(got, np.broadcast_to(want, got.shape),
+                                   atol=REDUCE_ATOL, rtol=0)
+    np.testing.assert_allclose(np.asarray(flat), np.asarray(hier),
+                               atol=REDUCE_ATOL, rtol=0)
+
+
+# ---------------------------------------------------------------------------
+# 3. step factories: hosts=2 output == flat single-host output
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.multidevice
+def test_ssm_sharded_step_hosts2_matches_flat():
+    params, xz, mask, stats = _prep_padded(60, 37, 8, r=3, p=2, seed=3)
+    p1, ll1 = ssm._sharded_step_for(8)(params, xz, mask, stats)
+    p2, ll2 = ssm._sharded_step_for(8, hosts=2)(params, xz, mask, stats)
+    assert abs(float(ll1) - float(ll2)) <= PARITY_ATOL
+    assert _max_leaf_diff(p1, p2) <= PARITY_ATOL
+
+
+@pytest.mark.multidevice
+def test_ar_sharded_step_hosts2_matches_flat():
+    rng = np.random.default_rng(13)
+    T, N, r = 80, 24, 2  # N divides evenly: no padding in the way
+    phi_true = rng.uniform(-0.5, 0.7, N)
+    lam = rng.standard_normal((N, r))
+    f = np.zeros((T, r))
+    e = np.zeros((T, N))
+    for t in range(1, T):
+        f[t] = 0.6 * f[t - 1] + 0.5 * rng.standard_normal(r)
+        e[t] = phi_true * e[t - 1] + 0.4 * rng.standard_normal(N)
+    x = f @ lam.T + e
+    for i in range(6):  # contiguous-prefix missingness (the QD mask class)
+        x[: int(rng.integers(1, 6)), i] = np.nan
+    m = ~np.isnan(x)
+    xz = jnp.asarray(np.where(m, x, 0.0))
+    params = ssm_ar.SSMARParams(
+        lam=jnp.asarray(0.3 * rng.standard_normal((N, r))),
+        phi=jnp.zeros(N),
+        sigv2=jnp.ones(N),
+        A=0.5 * jnp.eye(r)[None],
+        Q=jnp.eye(r),
+    )
+    qd = ssm_ar.compute_qd_stats(xz, jnp.asarray(m))
+    p1, ll1 = emcore._ar_sharded_step_for(8)(params, xz, qd)
+    p2, ll2 = emcore._ar_sharded_step_for(8, hosts=2)(params, xz, qd)
+    assert abs(float(ll1) - float(ll2)) <= PARITY_ATOL
+    assert _max_leaf_diff(p1, p2) <= PARITY_ATOL
+
+
+def _mf_step_inputs(T=48, N=16, r=2, p=5, seed=21):
+    rng = np.random.default_rng(seed)
+    n_q = 4
+    is_q = np.zeros(N, bool)
+    is_q[-n_q:] = True
+    agg = np.zeros((N, 5))
+    agg[~is_q, 0] = 1.0
+    agg[is_q] = np.array([1.0, 2.0, 3.0, 2.0, 1.0]) / 3.0
+    x = rng.standard_normal((T, N))
+    x[rng.random((T, N)) < 0.2] = np.nan
+    for j in np.nonzero(is_q)[0]:
+        x[np.arange(T) % 3 != 2, j] = np.nan
+    A = np.concatenate(
+        [(0.6 * np.eye(r))[None], 0.05 * rng.standard_normal((p - 1, r, r))]
+    )
+    params = MixedFreqParams(
+        lam=jnp.asarray(rng.standard_normal((N, r))),
+        R=jnp.asarray(0.2 + rng.random(N)),
+        A=jnp.asarray(A),
+        Q=jnp.eye(r),
+        agg=jnp.asarray(agg),
+    )
+    m = ~np.isnan(x)
+    xz = jnp.asarray(np.nan_to_num(x))
+    mask = jnp.asarray(m)
+    return params, xz, mask, compute_panel_stats(xz, mask)
+
+
+@pytest.mark.multidevice
+def test_mf_sharded_step_hosts2_matches_flat():
+    params, xz, mask, stats = _mf_step_inputs()
+    p0, ll0 = mixed_freq.em_step_mf_stats(params, xz, mask, stats)
+    p1, ll1 = mixed_freq._mf_sharded_step_for(8)(params, xz, mask, stats)
+    p2, ll2 = mixed_freq._mf_sharded_step_for(8, hosts=2)(params, xz, mask, stats)
+    # flat sharded == sequential (the lifted-refusal exactness argument)
+    assert abs(float(ll0) - float(ll1)) <= PARITY_ATOL
+    assert _max_leaf_diff(p0, p1) <= PARITY_ATOL
+    # hierarchical == flat
+    assert abs(float(ll1) - float(ll2)) <= PARITY_ATOL
+    assert _max_leaf_diff(p1, p2) <= PARITY_ATOL
+
+
+# ---------------------------------------------------------------------------
+# 4. dispatcher identity, transform plumbing, telemetry rendering
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.multidevice
+def test_dispatcher_identity():
+    # one cache entry no matter how hosts=0 is spelled (the `is`-identity
+    # contract the transform-stack pins rely on)
+    assert ssm._sharded_step_for(2) is ssm._sharded_step_for(2, 0)
+    assert ssm._sharded_step_for(2) is ssm._sharded_step_for(2, hosts=0)
+    assert emcore._ar_sharded_step_for(2) is emcore._ar_sharded_step_for(2, 0)
+    assert (
+        mixed_freq._mf_sharded_step_for(2)
+        is mixed_freq._mf_sharded_step_for(2, hosts=0)
+    )
+    # hosts>1 is a DIFFERENT program and must never alias the flat cache
+    # entry (its AOT-registry name carries the _h suffix)
+    assert ssm._sharded_step_for(8, 2) is not ssm._sharded_step_for(8)
+    assert ssm._sharded_step_for(8, 2) is ssm._sharded_step_for(8, hosts=2)
+
+
+@pytest.mark.multidevice
+def test_transform_stack_carries_hosts():
+    assert tfm.shard(8).args == (8, 0)
+    assert tfm.shard(8, 2).args == (8, 2)
+    res = tfm.resolve(tfm.Stack("ssm", (tfm.shard(8, 2),)))
+    assert res.step is ssm._sharded_step_for(8, 2)
+    assert res.hosts == 2 and res.n_shards == 8
+    res_ar = tfm.resolve(tfm.Stack("ar", (tfm.collapse(), tfm.shard(8, 2))))
+    assert res_ar.step is emcore._ar_sharded_step_for(8, 2)
+    res_mf = tfm.resolve(tfm.Stack("mf", (tfm.shard(8),)))
+    assert res_mf.step is mixed_freq._mf_sharded_step_for(8)
+    assert res_mf.fallback_step is mixed_freq.em_step_mf_stats
+    # hosts=0 resolution leaves the single-host identity intact
+    assert (
+        tfm.resolve(tfm.Stack("ssm", (tfm.shard(8),))).step
+        is ssm._sharded_step_for(8)
+    )
+
+
+def test_dev_str_renders_process_mesh():
+    from dynamic_factor_models_tpu.utils import telemetry
+
+    assert telemetry._dev_str({"sharded": True, "mesh_shape": [2, 4]}) == "2x4"
+    assert telemetry._dev_str({"sharded": True, "mesh_shape": [8]}) == "8"
+    assert telemetry._dev_str({}) == "-"
+
+
+# ---------------------------------------------------------------------------
+# 5. mixed-frequency padding: aggregation rows exactly inert
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.multidevice
+def test_mf_padded_aggregation_rows_inert():
+    """Padding a mixed-frequency panel appends monthly rows (agg = e0,
+    zero loadings, all-False mask): their H5 block-rows are identically
+    zero, so through three EM steps the padded run's real slice matches
+    the unpadded run and the padded loadings stay exactly dark — even
+    under the period-3 quarterly mask cycle of the real series."""
+    T, N, Np = 48, 10, 16
+    x, is_q = _mf_panel(T, N, n_quarterly=4)
+    r = 2
+    rng = np.random.default_rng(31)
+    m = ~np.isnan(x)
+    xz = jnp.asarray(np.where(m, x, 0.0))
+    mask = jnp.asarray(m)
+    agg = np.zeros((N, 5))
+    agg[~is_q, 0] = 1.0
+    agg[is_q] = np.array([1.0, 2.0, 3.0, 2.0, 1.0]) / 3.0
+    lam = 0.3 * rng.standard_normal((N, r))
+    A = np.concatenate([(0.5 * np.eye(r))[None], np.zeros((4, r, r))])
+    params = MixedFreqParams(
+        lam=jnp.asarray(lam), R=jnp.ones(N), A=jnp.asarray(A),
+        Q=jnp.eye(r), agg=jnp.asarray(agg),
+    )
+    # the estimator's inert-padding recipe, applied by hand
+    pad = Np - N
+    xz_p = jnp.concatenate([xz, jnp.zeros((T, pad))], axis=1)
+    mask_p = jnp.concatenate([mask, jnp.zeros((T, pad), bool)], axis=1)
+    agg_p = np.zeros((Np, 5))
+    agg_p[:N] = agg
+    agg_p[N:, 0] = 1.0  # padded rows are monthly: plain e0 aggregation
+    params_p = MixedFreqParams(
+        lam=jnp.concatenate([params.lam, jnp.zeros((pad, r))]),
+        R=jnp.concatenate([params.R, jnp.ones(pad)]),
+        A=params.A, Q=params.Q, agg=jnp.asarray(agg_p),
+    )
+    stats = compute_panel_stats(xz, mask)
+    stats_p = compute_panel_stats(xz_p, mask_p)
+    # all-False rows weigh zero: the padded panel's total obs count is
+    # unchanged, so the M-step denominators agree exactly
+    assert float(stats.n_obs.sum()) == float(stats_p.n_obs.sum())
+    p1, p2 = params, params_p
+    for _ in range(3):
+        p1, ll1 = mixed_freq.em_step_mf_stats(p1, xz, mask, stats)
+        p2, ll2 = mixed_freq.em_step_mf_stats(p2, xz_p, mask_p, stats_p)
+        np.testing.assert_array_equal(np.asarray(p2.lam[N:]), 0.0)
+        assert abs(float(ll1) - float(ll2)) <= PARITY_ATOL
+    assert _max_leaf_diff(
+        (p1.lam, p1.R, p1.A, p1.Q), (p2.lam[:N], p2.R[:N], p2.A, p2.Q)
+    ) <= PARITY_ATOL
+
+
+# ---------------------------------------------------------------------------
+# 6. the real thing: two OS processes, one global mesh
+# ---------------------------------------------------------------------------
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.slow
+@pytest.mark.multihost
+def test_two_process_sharded_em_parity(tmp_path):
+    """Two workers (4 virtual CPU devices each) join one 8-device runtime
+    and run estimate_dfm_em / estimate_dfm_em_ar(method="collapsed") with
+    n_shards=8 over the ("dcn", "ici") mesh.  Each worker asserts <= 1e-10
+    parity against its local single-process reference; here we assert both
+    exited clean and reported BIT-IDENTICAL results (SPMD digest)."""
+    port, nproc = _free_port(), 2
+    env = {
+        k: v
+        for k, v in os.environ.items()
+        if k not in ("XLA_FLAGS", "JAX_PLATFORMS")
+    }
+    logs = [(tmp_path / f"w{i}.out", tmp_path / f"w{i}.err") for i in range(nproc)]
+    procs = []
+    try:
+        for i in range(nproc):
+            with open(logs[i][0], "w") as out, open(logs[i][1], "w") as err:
+                procs.append(
+                    subprocess.Popen(
+                        [sys.executable, _WORKER, str(i), str(nproc),
+                         str(port), "em"],
+                        stdout=out,
+                        stderr=err,
+                        env=env,
+                    )
+                )
+        deadline = time.monotonic() + 600  # hard timeout for the drill
+        while any(p.poll() is None for p in procs):
+            if any(p.poll() not in (None, 0) for p in procs):
+                break  # a dead worker strands the other at the barrier
+            if time.monotonic() > deadline:
+                break
+            time.sleep(0.5)
+    finally:
+        # orphan cleanup: never leak a worker past the test, pass or fail
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+
+    dumps = [
+        f"worker {i}: rc={p.returncode}\n{logs[i][0].read_text()}"
+        f"\n{logs[i][1].read_text()[-2000:]}"
+        for i, p in enumerate(procs)
+    ]
+    assert all(p.returncode == 0 for p in procs), "\n\n".join(dumps)
+    results = sorted(
+        line
+        for o, _ in logs
+        for line in o.read_text().splitlines()
+        if line.startswith("RESULT")
+    )
+    assert len(results) == nproc, "\n\n".join(dumps)
+    payloads = {r.split("pid=")[1].split(" ", 1)[1] for r in results}
+    assert len(payloads) == 1, f"processes disagree: {results}"
+    assert "digest=" in results[0]

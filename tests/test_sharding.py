@@ -214,14 +214,58 @@ def test_n_shards_validation():
         )
 
 
-def test_mixed_freq_n_shards_refuses_loudly():
+def _mf_panel(T, N, n_quarterly, seed=8):
+    """Monthly panel whose last n_quarterly columns are quarterly: observed
+    only in quarter-end months (t % 3 == 2), NaN elsewhere."""
+    x = np.array(_panel(T, N, seed=seed))
+    is_q = np.zeros(N, bool)
+    is_q[N - n_quarterly :] = True
+    not_qend = (np.arange(T) % 3) != 2
+    x[np.ix_(not_qend, np.nonzero(is_q)[0])] = np.nan
+    return x, is_q
+
+
+@pytest.mark.multidevice
+def test_mixed_freq_sharded_matches_sequential():
+    """The lifted refusal: estimate_mixed_freq_dfm(n_shards=8) must match
+    the sequential run at 1e-10 — N=10 pads to 16 over the 8-device mesh,
+    so this also exercises the inert quarterly/monthly series padding
+    under the period-3 mask cycle."""
     from dynamic_factor_models_tpu.models.mixed_freq import (
         estimate_mixed_freq_dfm,
     )
 
-    x = _panel(36, 6, seed=8)
-    with pytest.raises(NotImplementedError, match="single-frequency"):
-        estimate_mixed_freq_dfm(x, np.zeros(6, bool), r=1, n_shards=8)
+    T, N = 48, 10
+    x, is_q = _mf_panel(T, N, n_quarterly=4)
+    base = estimate_mixed_freq_dfm(x, is_q, r=2, max_em_iter=6)
+    shrd = estimate_mixed_freq_dfm(x, is_q, r=2, max_em_iter=6, n_shards=8)
+    assert shrd.params.lam.shape == base.params.lam.shape  # unpadded
+    assert _max_leaf_diff(base.params, shrd.params) < PARITY_ATOL
+    n = min(len(base.loglik_path), len(shrd.loglik_path))
+    assert n >= 1
+    np.testing.assert_allclose(
+        np.asarray(shrd.loglik_path[:n]), np.asarray(base.loglik_path[:n]),
+        atol=PARITY_ATOL, rtol=0,
+    )
+    np.testing.assert_allclose(
+        np.asarray(shrd.factors), np.asarray(base.factors), atol=1e-8
+    )
+
+
+def test_mixed_freq_n_shards_validation():
+    from dynamic_factor_models_tpu.models.mixed_freq import (
+        estimate_mixed_freq_dfm,
+    )
+
+    x, is_q = _mf_panel(36, 6, n_quarterly=2)
+    with pytest.raises(ValueError, match="gram_dtype"):
+        estimate_mixed_freq_dfm(
+            x, is_q, r=1, gram_dtype="bfloat16", n_shards=8
+        )
+    with pytest.raises(ValueError, match="devices|device"):
+        estimate_mixed_freq_dfm(
+            x, is_q, r=1, n_shards=jax.device_count() + 1
+        )
 
 
 @pytest.mark.multidevice
