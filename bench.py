@@ -2779,6 +2779,260 @@ def composed_orchestrate(force_cpu: bool):
     sys.exit(pr.returncode)
 
 
+def run_time_parallel(force_cpu: bool = False, smoke: bool = False):
+    """--run-time-parallel (child of --time-parallel): does the
+    parallel-in-time EM family earn its keep?
+
+    Four legs, all on the forced 8-device platform:
+
+      * refscale — the real-panel dims (T=222, N=92, r=4, p=4): iters/sec
+        + cost-model FLOPs of the sequential collapsed step, the RETIRED
+        unfused associative step (elements from the N-dim observation
+        model), and the fused collapsed-element step.  Acceptance: fused
+        beats unfused on wall clock (the regression the fused elements
+        fix).
+      * scaling — T in {1e4, 1e5, 1e6} at small N (16, r=2, p=1):
+        sequential vs fused-assoc vs the blocked-slab step
+        (emtime.em_step_tp_for(8)) with per-T ips and FLOPs.  On CPU the
+        8 virtual devices share one socket and every ppermute is an
+        emulated rendezvous, so the slab step's wall clock is NOT the
+        story here — its per-device FLOPs are ("flop_proxy").
+      * slab_partition — the scan itself at the largest T: per-device
+        FLOPs of `sharded_scan(local="sequential")` (1x combine work
+        split over 8 slabs, O(k^2) boundary exchange) vs the one-device
+        `lax.associative_scan` (~2x combine work, log-depth).
+        Acceptance: >= 3x FLOP reduction at T=1e6.
+      * crossover — smallest T (small-N dims) where the fused associative
+        step's wall clock catches the sequential scan: at T=222 the
+        sequential recursion wins (dispatch-light), by T~1e4 the
+        log-depth form's vectorized combines win even on CPU.
+
+    Prints one JSON line; the parent persists
+    docs/BENCH_time_parallel.json."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    if force_cpu:
+        from dynamic_factor_models_tpu.utils.backend import fall_back_to_cpu
+
+        fall_back_to_cpu("time-parallel forced CPU", caller="bench")
+
+    from dynamic_factor_models_tpu.models import emtime
+    from dynamic_factor_models_tpu.models import pkalman as pk
+    from dynamic_factor_models_tpu.models.ssm import (
+        SSMParams,
+        _collapse_obs_stats,
+        _psd_floor,
+        compute_panel_stats,
+        em_step_assoc,
+        em_step_assoc_fused,
+        em_step_stats,
+    )
+    from dynamic_factor_models_tpu.parallel.mesh import data_mesh
+    from dynamic_factor_models_tpu.parallel.timescan import sharded_scan
+
+    dev = jax.devices()[0]
+    n_dev = jax.device_count()
+    tb = min(8, n_dev)
+    out = {
+        "device": str(dev), "time_parallel": True, "smoke": smoke,
+        "n_devices": n_dev, "t_blocks": tb,
+        "flop_proxy": not _is_tpu_platform(dev.platform),
+    }
+
+    def _ips(ex, *args, n_timing_runs=3):
+        jax.block_until_ready(ex(*args))  # warm outside the clock
+        t = _time_fixed_iters(
+            lambda: jax.block_until_ready(ex(*args)), n_timing_runs
+        )
+        return round(1.0 / t, 2)
+
+    def _panel(T, N, r, p, seed=0):
+        rng = np.random.default_rng(seed)
+        f = np.zeros((T, r))
+        e = rng.standard_normal((T, r))
+        for t in range(1, T):
+            f[t] = 0.7 * f[t - 1] + e[t]
+        lam = 0.5 * rng.standard_normal((N, r))
+        x = jnp.asarray(f @ lam.T + rng.standard_normal((T, N)))
+        m = jnp.ones((T, N))
+        A = jnp.zeros((p, r, r)).at[0].set(0.5 * jnp.eye(r))
+        params = SSMParams(
+            lam=jnp.asarray(lam), R=jnp.ones(N), A=A, Q=jnp.eye(r)
+        )
+        return params, x, m, compute_panel_stats(x, m)
+
+    # -- refscale: the dims of the real panel, where the unfused
+    #    associative variant measurably LOST to the sequential scan
+    T0, N0, r0, p0 = (96, 24, 2, 1) if smoke else (222, 92, 4, 4)
+    params, x, m, stats = _panel(T0, N0, r0, p0)
+    ex_seq = jax.jit(em_step_stats).lower(params, x, m, stats).compile()
+    ex_unf = jax.jit(em_step_assoc).lower(params, x, m).compile()
+    ex_fus = jax.jit(em_step_assoc_fused).lower(params, x, m).compile()
+    ref = {
+        "T": T0, "N": N0, "r": r0, "p": p0,
+        "seq_iters_per_sec": _ips(ex_seq, params, x, m, stats),
+        "assoc_unfused_iters_per_sec": _ips(ex_unf, params, x, m),
+        "assoc_fused_iters_per_sec": _ips(ex_fus, params, x, m),
+        "seq_flops": _compiled_flops(ex_seq),
+        "assoc_unfused_flops": _compiled_flops(ex_unf),
+        "assoc_fused_flops": _compiled_flops(ex_fus),
+    }
+    if ref["assoc_unfused_iters_per_sec"]:
+        ref["fused_vs_unfused_speedup"] = round(
+            ref["assoc_fused_iters_per_sec"]
+            / ref["assoc_unfused_iters_per_sec"], 2
+        )
+    if ref["assoc_unfused_flops"] and ref["assoc_fused_flops"]:
+        ref["fused_vs_unfused_flop_reduction"] = round(
+            ref["assoc_unfused_flops"] / ref["assoc_fused_flops"], 2
+        )
+    if ref["seq_iters_per_sec"]:
+        # the honest refscale verdict: at T=222 the sequential recursion
+        # still wins one-device wall clock — parallel-in-time is a
+        # long-T tool (see the crossover leg)
+        ref["fused_over_seq_wallclock"] = round(
+            ref["assoc_fused_iters_per_sec"] / ref["seq_iters_per_sec"], 3
+        )
+    if n_dev > 1:
+        # per-device FLOP share of the blocked-slab step at refscale
+        # (flops only: on the CPU container its wall clock is emulated-
+        # collective rendezvous, not compute)
+        ex_tp = (
+            emtime.em_step_tp_for(tb).lower(params, x, m, stats).compile()
+        )
+        ref["tp_flops_per_device"] = _compiled_flops(ex_tp)
+        if ref["seq_flops"] and ref["tp_flops_per_device"]:
+            ref["tp_per_device_over_seq_flops"] = round(
+                ref["tp_flops_per_device"] / ref["seq_flops"], 2
+            )
+    out["refscale"] = ref
+    print(json.dumps({"refscale": ref}), file=sys.stderr, flush=True)
+
+    # -- scaling in T at small N: the regime the time mesh exists for
+    Ns, rs, ps = 16, 2, 1
+    Ts = (1_000, 10_000) if smoke else (10_000, 100_000, 1_000_000)
+    step_tp = emtime.em_step_tp_for(tb) if tb > 1 else None
+    rows = []
+    for T in Ts:
+        params, x, m, stats = _panel(T, Ns, rs, ps, seed=1)
+        nt = 1 if T >= 100_000 else 3
+        ex_s = jax.jit(em_step_stats).lower(params, x, m, stats).compile()
+        ex_f = jax.jit(em_step_assoc_fused).lower(params, x, m).compile()
+        row = {
+            "T": T,
+            "seq_iters_per_sec": _ips(ex_s, params, x, m, stats,
+                                      n_timing_runs=nt),
+            "fused_iters_per_sec": _ips(ex_f, params, x, m,
+                                        n_timing_runs=nt),
+            "seq_flops": _compiled_flops(ex_s),
+            "fused_flops": _compiled_flops(ex_f),
+        }
+        if step_tp is not None:
+            ex_t = step_tp.lower(params, x, m, stats).compile()
+            row["tp_iters_per_sec"] = _ips(ex_t, params, x, m, stats,
+                                           n_timing_runs=nt)
+            # SPMD cost analysis counts ONE device's program, so this is
+            # the per-device share of the blocked-slab step
+            row["tp_flops_per_device"] = _compiled_flops(ex_t)
+            if row["fused_flops"] and row["tp_flops_per_device"]:
+                row["tp_step_flop_partition"] = round(
+                    row["fused_flops"] / row["tp_flops_per_device"], 2
+                )
+        rows.append(row)
+        print(json.dumps({"scaling_row": row}), file=sys.stderr, flush=True)
+    out["scaling"] = rows
+    out["scaling_dims"] = {"N": Ns, "r": rs, "p": ps}
+
+    # -- the slab partition itself: the scan is the thing the time axis
+    #    shards, so its per-device FLOP share is the acceptance quantity
+    #    (the step-level ratio above also carries the replicated collapse
+    #    + element build + M-step; see models/emtime.py)
+    T_big = Ts[-1]
+    params, x, m, stats = _panel(T_big, Ns, rs, ps, seed=1)
+    params = params._replace(Q=_psd_floor(params.Q))
+    C, b, ld_R, xRx, n_obs, llc = _collapse_obs_stats(
+        params.lam, params.R, x, stats
+    )
+    elems = pk.filter_elements_collapsed(params, C, b)
+    ex_a = jax.jit(
+        lambda e: jax.lax.associative_scan(pk.combine_filter, e)
+    ).lower(elems).compile()
+    slab = {"T": T_big, "assoc_scan_flops": _compiled_flops(ex_a)}
+    if tb > 1:
+        mesh = data_mesh(1, hosts=1, t_blocks=tb)
+        ex_b = jax.jit(
+            lambda e: sharded_scan(
+                pk.combine_filter, e, mesh, local="sequential"
+            )
+        ).lower(elems).compile()
+        slab["slab_scan_flops_per_device"] = _compiled_flops(ex_b)
+        if slab["assoc_scan_flops"] and slab["slab_scan_flops_per_device"]:
+            slab["slab_partition_flop_ratio"] = round(
+                slab["assoc_scan_flops"]
+                / slab["slab_scan_flops_per_device"], 2
+            )
+    out["slab_partition"] = slab
+    print(json.dumps({"slab_partition": slab}), file=sys.stderr, flush=True)
+
+    # -- wall-clock crossover in T: sequential wins small T (one cheap
+    #    combine per step), the log-depth fused form wins large T
+    Tx = (250, 1_000) if smoke else (250, 1_000, 4_000, 16_000)
+    xrows, crossover_T = [], None
+    for T in Tx:
+        params, x, m, stats = _panel(T, Ns, rs, ps, seed=2)
+        ex_s = jax.jit(em_step_stats).lower(params, x, m, stats).compile()
+        ex_f = jax.jit(em_step_assoc_fused).lower(params, x, m).compile()
+        ips_s = _ips(ex_s, params, x, m, stats)
+        ips_f = _ips(ex_f, params, x, m)
+        ratio = round(ips_f / ips_s, 3) if ips_s else None
+        xrows.append({"T": T, "seq_iters_per_sec": ips_s,
+                      "fused_iters_per_sec": ips_f,
+                      "fused_over_seq": ratio})
+        if crossover_T is None and ratio is not None and ratio >= 1.0:
+            crossover_T = T
+    out["crossover"] = {"rows": xrows, "crossover_T": crossover_T}
+    print(json.dumps({"crossover": out["crossover"]}), file=sys.stderr,
+          flush=True)
+
+    # acceptance summary (None when the contributing leg was gated)
+    fu = ref.get("fused_vs_unfused_speedup")
+    out["accept_fused_beats_unfused_refscale"] = (
+        None if fu is None else bool(fu >= 1.0)
+    )
+    sr = slab.get("slab_partition_flop_ratio")
+    out["accept_slab_partition_3x"] = None if sr is None else bool(sr >= 3.0)
+    out["accept_assoc_seq_crossover"] = bool(crossover_T is not None)
+    print(json.dumps(out), flush=True)
+
+
+def time_parallel_orchestrate(force_cpu: bool):
+    """--time-parallel: run the parallel-in-time EM legs in a child with
+    the forced 8-device flag set BEFORE jax initializes (same reason
+    --multichip and --composed are children), then persist
+    docs/BENCH_time_parallel.json."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        flags = (flags + " --xla_force_host_platform_device_count=8").strip()
+    child_args = ["--run-time-parallel"]
+    if force_cpu or os.environ.get("DFM_BENCH_FORCE_CPU") == "1":
+        child_args.append("--force-cpu")
+    pr = _run_child(child_args, env_extra={"XLA_FLAGS": flags},
+                    timeout_s=7200)
+    fragment = _parse_fragment(pr)
+    if fragment is None:
+        print("bench: time-parallel child produced no JSON", file=sys.stderr)
+        sys.exit(2)
+    path = os.path.join(REPO, "docs", "BENCH_time_parallel.json")
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(fragment, fh, indent=1, sort_keys=True)
+    os.replace(tmp, path)
+    print(json.dumps(fragment))
+    sys.exit(pr.returncode)
+
+
 def crossover_table():
     """Manual mode: Pallas-vs-XLA crossover sweep on the live chip; prints a
     markdown table for ops/pallas_gram.py and docs/PARITY.md."""
@@ -3237,6 +3491,22 @@ def run_tpu_remainder(force_cpu: bool = False):
     partial["composed_smoke"] = (
         cp if cp is not None
         else {"error": "composed child produced no JSON"}
+    )
+    _persist_partial(partial)
+    print(json.dumps(partial), file=sys.stderr, flush=True)
+
+    # parallel-in-time smoke: same 8-device child pattern — the full
+    # T-scaling grid is bench.py --time-parallel; the smoke proves the
+    # fused collapsed elements and the blocked-slab scan compile and run
+    # on the live chip inside a short window
+    tp_args = ["--run-time-parallel", "--smoke"]
+    if force_cpu:
+        tp_args.append("--force-cpu")
+    tp_pr = _run_child(tp_args, env_extra={"XLA_FLAGS": mc_flags})
+    tp = _parse_fragment(tp_pr)
+    partial["time_parallel_smoke"] = (
+        tp if tp is not None
+        else {"error": "time-parallel child produced no JSON"}
     )
     _persist_partial(partial)
     print(json.dumps(partial), file=sys.stderr, flush=True)
@@ -3955,6 +4225,15 @@ def main():
                          "runs in an 8-device child and persists "
                          "docs/BENCH_composed.json")
     ap.add_argument("--run-composed", action="store_true")
+    ap.add_argument("--time-parallel", action="store_true",
+                    help="parallel-in-time EM legs: refscale fused-vs-"
+                         "unfused associative steps, T in {1e4, 1e5, 1e6} "
+                         "seq/fused/blocked-slab scaling, the slab-scan "
+                         "per-device FLOP partition (>= 3x acceptance at "
+                         "T=1e6), and the assoc-vs-sequential wall-clock "
+                         "crossover in T; runs in an 8-device child and "
+                         "persists docs/BENCH_time_parallel.json")
+    ap.add_argument("--run-time-parallel", action="store_true")
     ap.add_argument("--smoke", action="store_true",
                     help="with --run-composed: tiny grid (T=96, N=768) "
                          "proving the composed kernels compile and run; "
@@ -4000,6 +4279,12 @@ def main():
         return
     if args.run_composed:
         run_composed(force_cpu=args.force_cpu, smoke=args.smoke)
+        return
+    if args.time_parallel:
+        time_parallel_orchestrate(force_cpu=args.force_cpu)
+        return
+    if args.run_time_parallel:
+        run_time_parallel(force_cpu=args.force_cpu, smoke=args.smoke)
         return
     if args.run_multichip:
         run_multichip(force_cpu=args.force_cpu)

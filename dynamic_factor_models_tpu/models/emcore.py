@@ -681,9 +681,8 @@ def _ar_sharded_step_for(n_shards: int, hosts: int = 0):
 
 @lru_cache(maxsize=None)
 def _ar_sharded_step_impl(n_shards: int, hosts: int):
-    from jax.experimental.shard_map import shard_map
-
     from ..ops.pallas_gram import hierarchical_allreduce, ring_allreduce
+    from ..parallel import shard_map_nocheck
     from ..parallel.mesh import P, data_mesh
 
     mesh = data_mesh(n_shards, hosts=hosts)
@@ -721,12 +720,11 @@ def _ar_sharded_step_impl(n_shards: int, hosts: int):
     step.__module__ = __name__
 
     return jax.jit(
-        shard_map(
+        shard_map_nocheck(
             step,
             mesh=mesh,
             in_specs=(_ar_params_spec(dax), P(None, dax), _qd_stats_spec(dax)),
             out_specs=(_ar_params_spec(dax), P()),
-            check_rep=False,
         )
     )
 
@@ -754,9 +752,8 @@ def _ar_steady_sharded_step_for(t_star: int, block: int, n_shards: int, hosts: i
 
 @lru_cache(maxsize=None)
 def _ar_steady_sharded_step_impl(t_star: int, block: int, n_shards: int, hosts: int):
-    from jax.experimental.shard_map import shard_map
-
     from ..ops.pallas_gram import hierarchical_allreduce, ring_allreduce
+    from ..parallel import shard_map_nocheck
     from ..parallel.mesh import P, data_mesh
 
     mesh = data_mesh(n_shards, hosts=hosts)
@@ -808,14 +805,13 @@ def _ar_steady_sharded_step_impl(t_star: int, block: int, n_shards: int, hosts: 
     )
     tail_spec = QDTailStats(sxx=P(dax), sxx1=P(dax), spp=P(dax))
     return jax.jit(
-        shard_map(
+        shard_map_nocheck(
             step,
             mesh=mesh,
             in_specs=(
                 state_spec, P(None, dax), _qd_stats_spec(dax), tail_spec,
             ),
             out_specs=((state_spec, P())),
-            check_rep=False,
         )
     )
 

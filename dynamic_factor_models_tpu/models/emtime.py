@@ -1,0 +1,203 @@
+"""Parallel-in-time EM steps: blocked-slab fused smoothers on a time mesh.
+
+The associative-scan EM variant (`ssm.em_step_assoc`) parallelizes the
+state recursion over T but used to LOSE to the sequential collapsed path:
+its elements were built from the full N-dim observation model (O(N r) per
+element) and the scan ran on one device.  This module is the production
+time-parallel path that fixes both:
+
+  * elements come from the COLLAPSED per-step payload (C, b, ld_R) —
+    `pkalman.filter_elements_collapsed` — so element construction is
+    O(r^3) per step, never O(N r);
+  * the scan runs BLOCKED over the mesh "time" axis
+    (`parallel.timescan.sharded_scan` with ``local="sequential"``): each
+    device owns a contiguous slab and runs the cheap sequential combine
+    recursion (~1x combine work vs the associative form's ~2x), and only
+    the O(k^2) slab-boundary elements cross devices in the log-depth
+    exclusive-prefix exchange.
+
+Step factories are lru_cached and NAMED (`em_step_tp_b{b}`,
+`em_step_tp_b{b}_d{n}[_h{h}]`, `em_step_ar_tp_b{b}`) so the AOT registry
+statics key (utils.compile.aot_statics uses __module__ + __qualname__) is
+stable across processes, exactly like `ssm._sharded_step_impl`.  The
+composed time x shard step splits work over the 3-D
+``("dcn", "time", "ici")`` mesh (`parallel.mesh.data_mesh`): the
+Jungbacker-Koopman collapse runs shard-local over the series axes with
+one psum, the blocked slab scans ride the "time" axis, and the M-step
+(N-free solves plus the per-series regressions on the replicated smoothed
+moments) runs replicated — correctness-first; the per-series M-step GEMMs
+could be re-sharded later without changing this module's contract.
+
+Padded/boundary time steps are exactly inert: `sharded_scan` pads ragged
+T at the END with repeats of the last element, which an inclusive causal
+scan never reads back into real positions (pinned at 1e-10 EM parity in
+tests/test_timeparallel.py).
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+
+from ..parallel import shard_map_nocheck
+from ..parallel.mesh import P, data_mesh
+from ..parallel.timescan import sharded_scan
+from . import pkalman as pk
+from .ssm import (
+    PanelStats,
+    SSMParams,
+    _collapse_obs_stats,
+    _collapse_obs_stats_partial,
+    _em_m_step,
+    _psd_floor,
+    _resolve_mesh_hosts,
+    _unpack_collapsed,
+)
+
+__all__ = ["em_step_tp_for", "em_step_ar_tp_for"]
+
+
+def _time_scan(mesh):
+    """The injected scan: blocked slabs over the mesh "time" axis with the
+    sequential within-slab recursion (the production choice — within one
+    device depth is free, so the ~1x-combine-work form wins on FLOPs)."""
+    return lambda comb, elems: sharded_scan(
+        comb, elems, mesh, axis="time", local="sequential"
+    )
+
+
+def em_step_tp_for(t_blocks: int, n_shards: int = 0, hosts: int = 0):
+    """The parallel-in-time iid-core EM step over `t_blocks` time slabs —
+    same (params, x, mask, stats) -> (params, loglik) contract as
+    `ssm.em_step_stats`, any T (ragged slabs pad inertly inside the scan).
+
+    n_shards > 1 composes with the cross-section sharding into the 3-D
+    ``("dcn", "time", "ici")`` mesh; `hosts` resolves exactly as in
+    `ssm._sharded_step_for` (0 -> jax.process_count()).  Plain-function
+    dispatcher over the lru_cached impls so every call spelling hits one
+    cache entry (the resolve-identity contract of models/transforms)."""
+    if t_blocks <= 1:
+        raise ValueError(f"t_blocks must be > 1, got {t_blocks}")
+    ns = int(n_shards)
+    if ns > 1:
+        return _tp_sharded_step_impl(
+            int(t_blocks), ns, _resolve_mesh_hosts(hosts)
+        )
+    return _tp_step_impl(int(t_blocks))
+
+
+def em_step_ar_tp_for(t_blocks: int):
+    """The parallel-in-time AR-idiosyncratic (kappa = 0) EM step — same
+    (params, x, qd) -> (params, loglik) contract as
+    `ssm_ar.em_step_ar_qd`, with the quasi-differenced collapsed payload
+    (q = 2r active state coordinates) feeding the same fused blocked-slab
+    smoother."""
+    if t_blocks <= 1:
+        raise ValueError(f"t_blocks must be > 1, got {t_blocks}")
+    return _tp_ar_step_impl(int(t_blocks))
+
+
+@lru_cache(maxsize=None)
+def _tp_step_impl(t_blocks: int):
+    mesh = data_mesh(1, hosts=1, t_blocks=t_blocks)
+    scan = _time_scan(mesh)
+
+    def step(params: SSMParams, x, mask, stats: PanelStats):
+        del mask  # collapse statistics already carry the mask
+        params = params._replace(Q=_psd_floor(params.Q))
+        C, b, ld_R, xRx, n_obs, llc = _collapse_obs_stats(
+            params.lam, params.R, x, stats
+        )
+        s_sm, P_sm, ll, lag1 = pk.kalman_smoother_associative_collapsed(
+            params, C, b, ld_R, xRx, n_obs, ll_corr=llc, scan=scan
+        )
+        return (
+            _em_m_step(params, x, stats.m, s_sm, P_sm, lag1, stats=stats),
+            ll,
+        )
+
+    step.__name__ = step.__qualname__ = f"em_step_tp_b{t_blocks}"
+    step.__module__ = __name__
+    return jax.jit(step)
+
+
+@lru_cache(maxsize=None)
+def _tp_sharded_step_impl(t_blocks: int, n_shards: int, hosts: int):
+    mesh = data_mesh(n_shards, hosts=hosts, t_blocks=t_blocks)
+    scan = _time_scan(mesh)
+    dax = ("dcn", "ici")
+
+    params_spec = SSMParams(lam=P(dax, None), R=P(dax), A=P(), Q=P())
+    stats_spec = PanelStats(
+        m=P(None, dax), xT=P(dax, None), mT=P(dax, None),
+        Sxx=P(dax), n_i=P(dax), n_obs=P(),
+        m16=None, x16=None, mT16=None, xT16=None, tw=P(),
+    )
+
+    def _collapse(params: SSMParams, x, stats: PanelStats):
+        payload, llc = _collapse_obs_stats_partial(
+            params.lam, params.R, x, stats
+        )
+        # every collapsed statistic is a sum over series: one psum over
+        # the series axes reduces shard partials exactly; the "time" axis
+        # carries identical replicas, so the output is fully replicated
+        return jax.lax.psum(payload, dax), jax.lax.psum(llc, dax)
+
+    collapse = shard_map_nocheck(
+        _collapse,
+        mesh=mesh,
+        in_specs=(params_spec, P(None, dax), stats_spec),
+        out_specs=(P(), P()),
+    )
+
+    def step(params: SSMParams, x, mask, stats: PanelStats):
+        del mask
+        params = params._replace(Q=_psd_floor(params.Q))
+        payload, llc = collapse(params, x, stats)
+        C, b, ld_R = _unpack_collapsed(payload, params.r)
+        xRx = jnp.zeros(b.shape[0], b.dtype)
+        s_sm, P_sm, ll, lag1 = pk.kalman_smoother_associative_collapsed(
+            params, C, b, ld_R, xRx, stats.n_obs, ll_corr=llc, scan=scan
+        )
+        return (
+            _em_m_step(params, x, stats.m, s_sm, P_sm, lag1, stats=stats),
+            ll,
+        )
+
+    name = f"em_step_tp_b{t_blocks}_d{n_shards}"
+    if hosts > 1:
+        name += f"_h{hosts}"
+    step.__name__ = step.__qualname__ = name
+    step.__module__ = __name__
+    return jax.jit(step)
+
+
+@lru_cache(maxsize=None)
+def _tp_ar_step_impl(t_blocks: int):
+    from .ssm_ar import (
+        _collapse_obs_qd,
+        _guard_params_qd,
+        _m_step_ar_qd,
+        _qd_companion,
+    )
+
+    mesh = data_mesh(1, hosts=1, t_blocks=t_blocks)
+    scan = _time_scan(mesh)
+
+    def step(params, x, qd):
+        params = _guard_params_qd(params)
+        Tm, Qs = _qd_companion(params)
+        k = Tm.shape[0]
+        s0 = jnp.zeros(k, x.dtype)
+        P0 = 1e2 * jnp.eye(k, dtype=x.dtype)
+        C, b, ld_V, xRx, n_obs = _collapse_obs_qd(params, x, qd)
+        s_sm, P_sm, ll, lag1 = pk._assoc_smooth_collapsed(
+            Tm, Qs, s0, P0, C, b, ld_V, xRx, n_obs, 0.0, scan=scan
+        )
+        return _m_step_ar_qd(params, x, qd, s_sm, P_sm, lag1), ll
+
+    step.__name__ = step.__qualname__ = f"em_step_ar_tp_b{t_blocks}"
+    step.__module__ = __name__
+    return jax.jit(step)

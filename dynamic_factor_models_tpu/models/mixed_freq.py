@@ -350,9 +350,8 @@ def _mf_sharded_step_for(n_shards: int, hosts: int = 0):
 
 @lru_cache(maxsize=None)
 def _mf_sharded_step_impl(n_shards: int, hosts: int):
-    from jax.experimental.shard_map import shard_map
-
     from ..ops.pallas_gram import hierarchical_allreduce, ring_allreduce
+    from ..parallel import shard_map_nocheck
     from ..parallel.mesh import P, data_mesh
 
     mesh = data_mesh(n_shards, hosts=hosts)
@@ -441,12 +440,11 @@ def _mf_sharded_step_impl(n_shards: int, hosts: int):
         m16=None, x16=None, mT16=None, xT16=None, tw=P(),
     )
     return jax.jit(
-        shard_map(
+        shard_map_nocheck(
             step,
             mesh=mesh,
             in_specs=(params_spec, P(None, dax), P(None, dax), stats_spec),
             out_specs=(params_spec, P()),
-            check_rep=False,
         )
     )
 

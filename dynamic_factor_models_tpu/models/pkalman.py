@@ -38,10 +38,13 @@ __all__ = [
     "FilterElement",
     "SmootherElement",
     "filter_elements",
+    "filter_elements_collapsed",
     "combine_filter",
     "combine_smoother",
     "kalman_filter_associative",
+    "kalman_filter_associative_collapsed",
     "kalman_smoother_associative",
+    "kalman_smoother_associative_collapsed",
 ]
 
 
@@ -141,6 +144,113 @@ def _generic_elements(params: SSMParams, x, m):
     return jax.vmap(one)(x, m)
 
 
+def _generic_elements_collapsed(Tm, Qs, C, b):
+    """Elements for t >= 2 built from COLLAPSED per-step statistics — the
+    fused form that retires the unfused O(N r)-per-element construction.
+
+    C[t] = H_a' diag(m_t/R) H_a (q, q) and b[t] = H_a' (m_t/R * z_t) (q,)
+    are the Jungbacker-Koopman collapse of a model whose observation loads
+    only the leading q state coordinates (q = r for the iid core, 2r for
+    the quasi-differenced AR core); they come out of TWO (T, N) panel
+    GEMMs (ssm._collapse_obs / ssm_ar._collapse_obs_qd), so element
+    construction here is O(q^3) per step with NO N-sized operand — the
+    reason the shipped `ssm.assoc` kernel lost to the sequential scan
+    (BENCH_r05: 92 vs 157 EM it/s) and this one does not.  The Woodbury
+    algebra is `_generic_elements`' own, written against the active block:
+        H_a' S^{-1} H_a = (I + C Q_a)^{-1} C,
+        H_a' S^{-1} z   = (I + C Q_a)^{-1} b,
+    with Q_a the active block of the transition noise (singular Q_a is
+    fine — the identity is rational in Q_a)."""
+    k = Tm.shape[0]
+    q = b.shape[-1]
+    dtype = b.dtype
+    eye_q = jnp.eye(q, dtype=dtype)
+    Qa = Qs[:q, :q]
+    Qcols = Qs[:, :q]  # (k, q); only these columns of Qs meet the obs map
+    Tma = Tm[:q, :]  # (q, k) rows of Tm feeding the active block
+
+    def one(Ct, bt):
+        IZQ = eye_q + Ct @ Qa
+        SinvZ = jnp.linalg.solve(IZQ, Ct)  # H_a'S^{-1}H_a
+        Sinvw = jnp.linalg.solve(IZQ, bt)  # H_a'S^{-1}z
+        KH = jnp.zeros((k, k), dtype).at[:, :q].set(Qcols @ SinvZ)
+        A = Tm - KH @ Tm
+        b_el = Qcols @ Sinvw
+        C_el = Qs - (Qcols @ SinvZ) @ Qs[:q, :]
+        eta = Tma.T @ Sinvw
+        J = Tma.T @ SinvZ @ Tma
+        return FilterElement(
+            A, b_el, 0.5 * (C_el + C_el.T), eta, 0.5 * (J + J.T)
+        )
+
+    return jax.vmap(one)(C, b)
+
+
+def _first_element_collapsed(Tm, Qs, s0, P0, C0, b0):
+    """t = 1 element from collapsed statistics: full-state posterior from
+    the prior (A=0, b=m_{1|1}, C=P_{1|1}) — `_first_element` with
+    C0 = H_a'diag(m/R)H_a and b0 = H_a'(m/R * z_0) supplied instead of
+    rebuilt from the (N, q) loadings."""
+    k = Tm.shape[0]
+    q = b0.shape[0]
+    dtype = b0.dtype
+    sp = Tm @ s0
+    Pp = Tm @ P0 @ Tm.T + Qs
+    Z = jnp.zeros((k, k), dtype).at[:q, :q].set(C0)
+    rhs = jnp.zeros(k, dtype).at[:q].set(b0 - C0 @ sp[:q])
+    Pu = jnp.linalg.pinv(jnp.linalg.pinv(Pp, hermitian=True) + Z, hermitian=True)
+    su = sp + Pu @ rhs
+    zk = jnp.zeros(k, dtype)
+    zkk = jnp.zeros((k, k), dtype)
+    return FilterElement(zkk, su, 0.5 * (Pu + Pu.T), zk, zkk)
+
+
+def _filter_elements_from_collapsed(Tm, Qs, s0, P0, C, b) -> FilterElement:
+    # The generic build runs over ALL T rows and row 0 is then overwritten,
+    # instead of concatenate([first[None], generic(C[1:], b[1:])]): a
+    # 1 + (T-1) concatenate along a mesh-sharded time axis miscompiles in
+    # the XLA SPMD partitioner (uneven-operand padding), while a static
+    # row-0 update partitions cleanly.  One wasted q^3 solve per call.
+    first = _first_element_collapsed(Tm, Qs, s0, P0, C[0], b[0])
+    full = _generic_elements_collapsed(Tm, Qs, C, b)
+    return jax.tree.map(lambda f, a: a.at[0].set(f), first, full)
+
+
+def _loglik_from_filtered_collapsed(
+    Tm, Qs, s0, P0, C, b, ld_R, xRx, n_obs, means, covs
+):
+    """`_loglik_from_filtered` on collapsed statistics: the observation
+    quadratic (x - H sp)'R^{-1}(x - H sp) expands to
+    xRx_t - 2 f'b_t + f'C_t f (f the active predicted state), so no
+    N-sized operand enters.  On the PanelStats path xRx is identically
+    zero and the caller adds the scalar ll_corr = -1/2 sum_i Sxx_i/R_i
+    (ssm._collapse_obs_stats convention)."""
+    k = Tm.shape[0]
+    q = b.shape[-1]
+    dtype = b.dtype
+    log2pi = jnp.asarray(np.log(2.0 * np.pi), dtype)
+
+    # roll + row-0 update, not concatenate([x0[None], x[:-1]]): the uneven
+    # concatenate miscompiles under the SPMD partitioner on a time-sharded
+    # mesh (see _filter_elements_from_collapsed); roll lowers to a clean
+    # collective permute.
+    prev_means = jnp.roll(means, 1, axis=0).at[0].set(s0)
+    prev_covs = jnp.roll(covs, 1, axis=0).at[0].set(P0)
+    pred_means = prev_means @ Tm.T
+    pred_covs = jnp.einsum("ij,tjl,kl->tik", Tm, prev_covs, Tm) + Qs[None]
+
+    def one(Ct, bt, ld, xr, no, sp, Pp, Pu):
+        f = sp[:q]
+        rhs = jnp.zeros(k, dtype).at[:q].set(bt - Ct @ f)
+        _, ld_pp = jnp.linalg.slogdet(Pp)
+        _, ld_pu = jnp.linalg.slogdet(Pu)
+        quad = xr - 2.0 * (f @ bt) + f @ Ct @ f - rhs @ Pu @ rhs
+        return -0.5 * (no * log2pi + ld + ld_pp - ld_pu + quad)
+
+    lls = jax.vmap(one)(C, b, ld_R, xRx, n_obs, pred_means, pred_covs, covs)
+    return lls.sum(), pred_means, pred_covs
+
+
 def _first_element(params: SSMParams, x0, m0):
     """t = 1 element: full-state posterior from the diffuse prior
     (A=0, b=m_{1|1}, C=P_{1|1}; eta/J never read for the earliest block)."""
@@ -229,9 +339,10 @@ def kalman_filter_associative(
     return KalmanResult(ll, means, covs, pred_means, pred_covs)
 
 
-def smoother_elements(params: SSMParams, filt: KalmanResult) -> SmootherElement:
-    """Backward elements from the filtered path, batched over time."""
-    Tm, Qs = _companion(params)
+def _smoother_elements_generic(Tm, Qs, means, covs) -> SmootherElement:
+    """Backward elements from a filtered path, batched over time — already
+    N-free (only the k-dim posterior enters), shared by the panel-built
+    and collapsed-built forward passes."""
     k = Tm.shape[0]
 
     def one(su, Pu):
@@ -241,15 +352,21 @@ def smoother_elements(params: SSMParams, filt: KalmanResult) -> SmootherElement:
         L = Pu - E @ Tm @ Pu
         return SmootherElement(E, g, 0.5 * (L + L.T))
 
-    rest = jax.vmap(one)(filt.means[:-1], filt.covs[:-1])
+    # Vmapped over ALL T rows with the terminal row overwritten in place —
+    # the (T-1) + 1 concatenate along time miscompiles under the SPMD
+    # partitioner on a time-sharded mesh (see
+    # _filter_elements_from_collapsed); a static last-row update is clean.
+    full = jax.vmap(one)(means, covs)
     last = SmootherElement(
-        jnp.zeros((k, k), filt.means.dtype),
-        filt.means[-1],
-        filt.covs[-1],
+        jnp.zeros((k, k), means.dtype), means[-1], covs[-1]
     )
-    return jax.tree.map(
-        lambda a, b: jnp.concatenate([a, b[None]], axis=0), rest, last
-    )
+    return jax.tree.map(lambda a, b: a.at[-1].set(b), full, last)
+
+
+def smoother_elements(params: SSMParams, filt: KalmanResult) -> SmootherElement:
+    """Backward elements from the filtered path, batched over time."""
+    Tm, Qs = _companion(params)
+    return _smoother_elements_generic(Tm, Qs, filt.means, filt.covs)
 
 
 def kalman_smoother_associative(params: SSMParams, x, mask, scan=None):
@@ -276,3 +393,82 @@ def kalman_smoother_associative(params: SSMParams, x, mask, scan=None):
     # lag-one smoothed covariance: P_{t+1|T} E_t'
     lag1 = jnp.einsum("tij,tkj->tik", covs[1:], elems.E[:-1])
     return means, covs, filt.loglik, lag1
+
+
+# ------------------- collapsed (fused) parallel smoother --------------------
+
+
+def filter_elements_collapsed(params: SSMParams, C, b) -> FilterElement:
+    """Per-step elements from the iid core's collapsed statistics
+    (`ssm._collapse_obs` / `_collapse_obs_stats` C and b); element t=0
+    folds in the diffuse prior.  O(r^3) per element — never O(N r)."""
+    Tm, Qs = _companion(params)
+    s0, P0 = _init_state(params)
+    return _filter_elements_from_collapsed(Tm, Qs, s0, P0, C, b)
+
+
+def _assoc_smooth_collapsed(
+    Tm, Qs, s0, P0, C, b, ld_R, xRx, n_obs, ll_corr, scan=None
+):
+    """Model-agnostic fused parallel filter + RTS smoother on collapsed
+    statistics: (Tm, Qs, s0, P0) define the linear-Gaussian state model,
+    (C, b, ld_R, xRx, n_obs) its collapsed per-step observations over the
+    leading q = b.shape[1] state coordinates.  Returns
+    (s_sm, P_sm, loglik + ll_corr, lag1).  `scan` swaps the scan
+    implementation (default ``jax.lax.associative_scan``; pass
+    `parallel.timescan.sharded_scan`'s bound form to run time-sharded —
+    its end-padding repeats the LAST element, which an inclusive causal
+    scan never reads back into real positions, so padded/boundary steps
+    are exactly inert)."""
+    run = (
+        (lambda comb, e: jax.lax.associative_scan(comb, e))
+        if scan is None
+        else scan
+    )
+    elems = _filter_elements_from_collapsed(Tm, Qs, s0, P0, C, b)
+    scanned = run(combine_filter, elems)
+    means, covs = scanned.b, scanned.C
+    ll, _, _ = _loglik_from_filtered_collapsed(
+        Tm, Qs, s0, P0, C, b, ld_R, xRx, n_obs, means, covs
+    )
+    sm_elems = _smoother_elements_generic(Tm, Qs, means, covs)
+    rev = jax.tree.map(lambda a: jnp.flip(a, 0), sm_elems)
+    swapped = lambda a, b_: combine_smoother(b_, a)
+    sm = run(swapped, rev)
+    sm = jax.tree.map(lambda a: jnp.flip(a, 0), sm)
+    s_sm, P_sm = sm.g, sm.L
+    lag1 = jnp.einsum("tij,tkj->tik", P_sm[1:], sm_elems.E[:-1])
+    return s_sm, P_sm, ll + ll_corr, lag1
+
+
+def kalman_filter_associative_collapsed(
+    params: SSMParams, C, b, ld_R, xRx, n_obs, ll_corr=0.0, scan=None
+) -> KalmanResult:
+    """Fused parallel filter on the iid core's collapsed statistics."""
+    Tm, Qs = _companion(params)
+    s0, P0 = _init_state(params)
+    elems = _filter_elements_from_collapsed(Tm, Qs, s0, P0, C, b)
+    scanned = (
+        jax.lax.associative_scan(combine_filter, elems)
+        if scan is None
+        else scan(combine_filter, elems)
+    )
+    means, covs = scanned.b, scanned.C
+    ll, pred_means, pred_covs = _loglik_from_filtered_collapsed(
+        Tm, Qs, s0, P0, C, b, ld_R, xRx, n_obs, means, covs
+    )
+    return KalmanResult(ll + ll_corr, means, covs, pred_means, pred_covs)
+
+
+def kalman_smoother_associative_collapsed(
+    params: SSMParams, C, b, ld_R, xRx, n_obs, ll_corr=0.0, scan=None
+):
+    """Fused parallel filter + smoother on the iid core's collapsed
+    statistics: returns (s_sm, P_sm, loglik, lag1) — the E-step quartet
+    `ssm._em_m_step` consumes, built without any O(N r) per-element
+    work."""
+    Tm, Qs = _companion(params)
+    s0, P0 = _init_state(params)
+    return _assoc_smooth_collapsed(
+        Tm, Qs, s0, P0, C, b, ld_R, xRx, n_obs, ll_corr, scan=scan
+    )

@@ -1281,11 +1281,47 @@ def em_step_sqrt_collapsed(params: SSMParams, x, mask):
 
 
 @jax.jit
+def em_step_assoc_fused(params: SSMParams, x, mask):
+    """`em_step_assoc` with FUSED collapsed elements: the N-dim panel is
+    collapsed ONCE per step into the O(r^2) payload (C, b, ld_R, xRx)
+    and the scan elements are built from it at O(r^3) per step — element
+    construction never touches N again, so the associative variant's
+    per-element cost matches the sequential collapsed path instead of
+    paying O(N r) per element (the regression that made `em_step_assoc`
+    LOSE to sequential on wide panels)."""
+    from .pkalman import kalman_smoother_associative_collapsed
+
+    m = mask.astype(x.dtype)
+    params = params._replace(Q=_psd_floor(params.Q))
+    C, b, ld_R, xRx, n_obs = _collapse_obs(params.lam, params.R, x, m)
+    s_sm, P_sm, ll, lag1 = kalman_smoother_associative_collapsed(
+        params, C, b, ld_R, xRx, n_obs
+    )
+    return _em_m_step(params, x, m, s_sm, P_sm, lag1), ll
+
+
+@jax.jit
 def em_step_assoc(params: SSMParams, x, mask):
     """`em_step` with the parallel-in-time (associative-scan) E-step
     (models.pkalman): log-depth instead of T-depth recursions — the
     TPU-friendly shape when the sequential scan's per-step latency
-    dominates."""
+    dominates.
+
+    Panels wider than `LARGE_N_THRESHOLD` auto-dispatch (static shape,
+    resolved at trace time) to `em_step_assoc_fused`, whose elements are
+    built from the collapsed O(r^2) payload instead of the N-dim
+    observation model — same public name, same results to fp tolerance,
+    no O(N r) per-element work."""
+    if x.shape[1] > LARGE_N_THRESHOLD:
+        from .pkalman import kalman_smoother_associative_collapsed
+
+        m = mask.astype(x.dtype)
+        params = params._replace(Q=_psd_floor(params.Q))
+        C, b, ld_R, xRx, n_obs = _collapse_obs(params.lam, params.R, x, m)
+        s_sm, P_sm, ll, lag1 = kalman_smoother_associative_collapsed(
+            params, C, b, ld_R, xRx, n_obs
+        )
+        return _em_m_step(params, x, m, s_sm, P_sm, lag1), ll
     from .pkalman import kalman_smoother_associative
 
     m = mask.astype(x.dtype)
@@ -1476,9 +1512,8 @@ def _sharded_step_impl(n_shards: int, hosts: int):
     uses __module__ + __qualname__) is stable across processes, like
     `_steady_step_for`.  hosts<=1 keeps the exact pre-multi-host name
     (`em_step_sharded_d{n}`) and program."""
-    from jax.experimental.shard_map import shard_map
-
     from ..ops.pallas_gram import hierarchical_allreduce, ring_allreduce
+    from ..parallel import shard_map_nocheck
     from ..parallel.mesh import P, data_mesh
 
     mesh = data_mesh(n_shards, hosts=hosts)
@@ -1524,12 +1559,11 @@ def _sharded_step_impl(n_shards: int, hosts: int):
         m16=None, x16=None, mT16=None, xT16=None, tw=P(),
     )
     return jax.jit(
-        shard_map(
+        shard_map_nocheck(
             step,
             mesh=mesh,
             in_specs=(params_spec, P(None, dax), P(None, dax), stats_spec),
             out_specs=(params_spec, P()),
-            check_rep=False,
         )
     )
 
@@ -1619,6 +1653,7 @@ def estimate_dfm_em(
     gram_dtype: str | None = None,
     bucket=None,
     n_shards: int | None = None,
+    t_blocks: int | None = None,
 ) -> EMResults:
     """State-space DFM via EM on the standardized included panel
     (BASELINE.json config 2: `State-space DFM via EM + Kalman smoother`).
@@ -1667,6 +1702,15 @@ def estimate_dfm_em(
     all-reduce per iteration, and the recovery ladder demotes a tripped
     sharded run to the exact single-device sequential step.  Parity with
     the unsharded run is pinned at 1e-10 in tests/test_sharding.py.
+
+    t_blocks > 1 (sequential method only) runs the E-step PARALLEL IN
+    TIME on the collapsed statistics (models/emtime): each device owns a
+    contiguous time slab running the cheap sequential combine recursion,
+    and only O(r^2) slab-boundary elements cross devices
+    (`parallel.timescan.sharded_scan`).  Composes with n_shards into the
+    3-D hosts x time x series mesh (`parallel.mesh.data_mesh`); parity
+    with the sequential run is pinned at 1e-10 in
+    tests/test_timeparallel.py.
     """
     from ..utils.compile import (
         bucket_shape,
@@ -1723,6 +1767,23 @@ def estimate_dfm_em(
                 f"n_shards={ns} must be a multiple of "
                 f"jax.process_count()={jax.process_count()} so every host "
                 "owns the same number of local shards"
+            )
+    tb = int(t_blocks) if t_blocks is not None else 0
+    if tb > 1:
+        if method != "sequential":
+            raise ValueError(
+                "t_blocks requires method='sequential' (the collapsed "
+                "stats path feeds the time-sharded fused smoother)"
+            )
+        if gram_dtype is not None:
+            raise ValueError(
+                "t_blocks is not combinable with gram_dtype: the bf16 "
+                "bulk phase is not time-sharded"
+            )
+        if tb * max(ns, 1) > jax.device_count():
+            raise ValueError(
+                f"t_blocks={tb} x n_shards={max(ns, 1)} exceeds the "
+                f"{jax.device_count()} visible devices"
             )
     from ..utils.telemetry import run_record
 
@@ -1783,10 +1844,16 @@ def estimate_dfm_em(
                 xz, m_arr = xz_b, m_b
             else:
                 stats = compute_panel_stats(xz, m_arr)
-            if ns > 1:
-                # a tripped sharded run demotes to the exact single-device
-                # sequential step: same (xz, mask, stats) args
-                res_t = tfm.resolve(tfm.Stack("ssm", (tfm.shard(ns),)))
+            if ns > 1 or tb > 1:
+                # a tripped sharded / time-sharded run demotes to the
+                # exact single-device sequential step: same
+                # (xz, mask, stats) args
+                axes = []
+                if tb > 1:
+                    axes.append(tfm.time_shard(tb))
+                if ns > 1:
+                    axes.append(tfm.shard(ns))
+                res_t = tfm.resolve(tfm.Stack("ssm", tuple(axes)))
                 step, fallback_step = res_t.step, res_t.fallback_step
                 nproc = jax.process_count()
                 if nproc > 1:
@@ -1798,12 +1865,19 @@ def estimate_dfm_em(
                     xz, m_arr = np.asarray(xz), np.asarray(m_arr)
                     params = jax.tree.map(np.asarray, params)
                     stats = jax.tree.map(np.asarray, stats)
+                    shape = [nproc]
+                    if tb > 1:
+                        shape.append(tb)
+                    shape.append(max(ns, nproc) // nproc)
                     rec.set(
-                        mesh_shape=[nproc, ns // nproc], sharded=True,
+                        mesh_shape=shape, sharded=ns > 1,
                         process_count=nproc,
                     )
                 else:
-                    rec.set(mesh_shape=[ns], sharded=True)
+                    shape = ([1, tb, max(ns, 1)] if tb > 1 else [ns])
+                    rec.set(mesh_shape=shape, sharded=ns > 1)
+                if tb > 1:
+                    rec.set(t_blocks=tb)
             args = (xz, m_arr, stats)
         elif method == "steady":
             stats = compute_panel_stats(xz, m_arr)

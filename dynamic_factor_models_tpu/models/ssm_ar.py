@@ -713,6 +713,7 @@ def estimate_dfm_em_ar(
     method: str = "dense",
     steady: bool = False,
     n_shards: int | None = None,
+    t_blocks: int | None = None,
 ) -> EMARResults:
     """Full Banbura-Modugno EM: factors + AR(1) idiosyncratic states.
 
@@ -747,6 +748,14 @@ def estimate_dfm_em_ar(
     panel is padded with inert series to a shard multiple.  Composes with
     steady=True (`emcore._ar_steady_sharded_step_for`): all three speed
     axes — collapsed x steady x sharded — on one panel.
+
+    t_blocks > 1 (collapsed only; exclusive with steady/n_shards on this
+    core) runs the E-step scans parallel in time over that many
+    contiguous per-device slabs (`emtime.em_step_ar_tp_for`): the
+    quasi-differenced collapsed payload feeds fused O(k^3) scan elements
+    and only O(k^2) slab boundaries cross devices
+    (`parallel.timescan.sharded_scan`).  Parity with the sequential
+    collapsed run is pinned at 1e-10 in tests/test_timeparallel.py.
 
     The step for any combination is resolved from a transform stack
     (models/transforms), not hand-picked: `Stack("ar", (collapse(),
@@ -791,6 +800,24 @@ def estimate_dfm_em_ar(
                 f"jax.process_count()={jax.process_count()} so every host "
                 "owns the same number of local shards"
             )
+    tb = int(t_blocks) if t_blocks is not None else 0
+    if tb > 1:
+        if method != "collapsed":
+            raise ValueError(
+                "t_blocks requires method='collapsed' (only the "
+                "quasi-differenced payload feeds the fused slab scan)"
+            )
+        if steady or ns > 1:
+            raise ValueError(
+                "t_blocks is exclusive with steady/n_shards on the AR "
+                "core: the time axis composes with 'collapse' only "
+                "(models/transforms refuses the other products)"
+            )
+        if tb > jax.device_count():
+            raise ValueError(
+                f"t_blocks={tb} exceeds the {jax.device_count()} visible "
+                "devices"
+            )
     from ..utils.telemetry import run_record
 
     with on_backend(backend), run_record(
@@ -798,7 +825,7 @@ def estimate_dfm_em_ar(
         config={
             "accel": accel, "tol": tol, "max_em_iter": max_em_iter,
             "checkpointed": checkpoint_path is not None, "method": method,
-            "steady": steady, "n_shards": ns,
+            "steady": steady, "n_shards": ns, "t_blocks": tb,
         },
     ) as rec:
         data = jnp.asarray(data)
@@ -881,8 +908,11 @@ def estimate_dfm_em_ar(
                         steady_frac=float(T_n - t_star) / float(T_n),
                         riccati_rho=float(rho),
                     )
-        elif steady or ns > 1:
-            rec.set(steady_gated=steady, shard_gated=ns > 1)
+            if tb > 1:
+                axes.append(tfm.time_shard(tb))
+                rec.set(t_blocks=tb, mesh_shape=[1, tb, 1])
+        elif steady or ns > 1 or tb > 1:
+            rec.set(steady_gated=steady, shard_gated=ns > 1, tp_gated=tb > 1)
 
         xz_em, m_em, params_em = xz, m_arr, params
         if use_collapsed and ns > 1:
@@ -934,9 +964,10 @@ def estimate_dfm_em_ar(
                 fallback_step = res_t.fallback_step
                 fallback_unwrap = unwrap_state
                 fallback_args = (xz_em, qd)
-            elif ns > 1:
-                # a tripped sharded run demotes to the exact single-device
-                # collapsed step: same (x, qd) args, padding stays inert
+            elif ns > 1 or tb > 1:
+                # a tripped sharded / time-sharded run demotes to the
+                # exact single-device collapsed step: same (x, qd) args,
+                # padding stays inert
                 fallback_step = res_t.fallback_step
         else:
             em_args = (xz_em, m_em)

@@ -76,11 +76,12 @@ __all__ = [
     "Resolved",
     "shard",
     "steady_tail",
+    "time_shard",
     "unwrap_params",
     "wrap_params",
 ]
 
-_STEP_KINDS = ("collapse", "steady", "shard")
+_STEP_KINDS = ("collapse", "steady", "shard", "time")
 _LOOP_KINDS = ("guard", "batch", "donate", "accel")
 
 CORES = (
@@ -142,6 +143,17 @@ def shard(n_shards: int, hosts: int = 0) -> Transform:
     return Transform("shard", (int(n_shards), int(hosts)))
 
 
+def time_shard(t_blocks: int) -> Transform:
+    """Run the E-step scans PARALLEL IN TIME over `t_blocks` contiguous
+    per-device time slabs (models/emtime): the collapsed per-step payload
+    feeds fused O(r^3) scan elements (pkalman.filter_elements_collapsed),
+    each slab runs the cheap sequential combine recursion locally, and
+    only O(k^2) slab-boundary elements cross devices in the log-depth
+    exclusive-prefix exchange (parallel/timescan.sharded_scan).  Composes
+    with `shard` into the 3-D ("dcn", "time", "ici") mesh."""
+    return Transform("time", (int(t_blocks),))
+
+
 def batch(B: int) -> Transform:
     """vmap the step over B same-shape panels inside one device loop,
     with per-lane convergence scalars and health flags in the carry
@@ -187,6 +199,8 @@ class Resolved(NamedTuple):
     hosts      mesh host count as requested by shard() (0 = resolve to
                jax.process_count(); >1 = process-spanning ("dcn", "ici")
                mesh with the hierarchical reduction)
+    t_blocks   parallel-in-time slab count (0 = sequential scans; > 1 =
+               blocked slabs over the mesh "time" axis, models/emtime)
     """
 
     step: object
@@ -202,6 +216,7 @@ class Resolved(NamedTuple):
     accel: str | None = None
     fallback_step: object = None
     hosts: int = 0
+    t_blocks: int = 0
 
 
 def _split(stack: Stack):
@@ -240,6 +255,7 @@ def resolve(stack: Stack) -> Resolved:
     sargs = step_t["shard"].args if "shard" in step_t else (0,)
     n_shards = sargs[0]
     hosts = sargs[1] if len(sargs) > 1 else 0
+    t_blocks = step_t["time"].args[0] if "time" in step_t else 0
     kw = dict(
         n_shards=n_shards,
         hosts=hosts,
@@ -249,7 +265,25 @@ def resolve(stack: Stack) -> Resolved:
         guard=loop_t["guard"].args[0] if "guard" in loop_t else None,
         donate=True if "donate" in loop_t else None,
         accel=loop_t["accel"].args[0] if "accel" in loop_t else None,
+        t_blocks=t_blocks,
     )
+    if t_blocks:
+        if t_blocks <= 1:
+            raise ValueError(
+                f"time_shard needs t_blocks > 1, got {t_blocks}"
+            )
+        if kw["batch"] > 0:
+            raise ValueError(
+                "time_shard x batch is not composable: each vmapped lane "
+                "would need its own time mesh — run batched panels with "
+                "sequential scans, or one panel time-sharded"
+            )
+        if t_star is not None:
+            raise ValueError(
+                "time_shard x steady_tail is not composable: the "
+                "constant-gain tail is already O(1) in T, so there is "
+                "nothing left for the slab scan to split — pick one"
+            )
 
     if stack.core == "ssm":
         from . import ssm
@@ -276,6 +310,20 @@ def resolve(stack: Stack) -> Resolved:
             return Resolved(
                 ssm._sharded_step_for(n_shards, hosts), "ssm", "stats",
                 "bare", fallback_step=ssm.em_step_stats, **kw,
+            )
+        if axes <= {"collapse", "time"}:
+            from . import emtime
+
+            return Resolved(
+                emtime.em_step_tp_for(t_blocks), "ssm", "stats", "bare",
+                fallback_step=ssm.em_step_stats, **kw,
+            )
+        if axes <= {"collapse", "time", "shard"}:
+            from . import emtime
+
+            return Resolved(
+                emtime.em_step_tp_for(t_blocks, n_shards, hosts), "ssm",
+                "stats", "bare", fallback_step=ssm.em_step_stats, **kw,
             )
         raise ValueError(
             "the iid core has no steady x shard product yet; compose "
@@ -315,8 +363,8 @@ def resolve(stack: Stack) -> Resolved:
         if "collapse" not in axes:
             raise ValueError(
                 "the dense AR step has no collapsed statistics to split "
-                "or shard; 'steady'/'shard' on the 'ar' core require "
-                "'collapse' first"
+                "or shard; 'steady'/'shard'/'time' on the 'ar' core "
+                "require 'collapse' first"
             )
         from . import emcore
 
@@ -324,6 +372,20 @@ def resolve(stack: Stack) -> Resolved:
             return Resolved(
                 ssm_ar.em_step_ar_qd, "ar", "qd", "bare",
                 fallback_step=ssm_ar.em_step_ar, **kw,
+            )
+        if axes == {"collapse", "time"}:
+            from . import emtime
+
+            return Resolved(
+                emtime.em_step_ar_tp_for(t_blocks), "ar", "qd", "bare",
+                fallback_step=ssm_ar.em_step_ar_qd, **kw,
+            )
+        if "time" in axes:
+            raise ValueError(
+                "the AR core's time_shard composes with 'collapse' only: "
+                "its per-series M-step GEMMs are not sharded, so "
+                "time x shard has no AR product yet — shard the iid core "
+                "instead, or drop one axis"
             )
         if axes == {"collapse", "steady"}:
             return Resolved(
@@ -438,6 +500,11 @@ def enumerate_stacks(spec) -> list:
         if spec.n_shards > 1
         else None
     )
+    tp = (
+        (time_shard(spec.t_blocks),)
+        if getattr(spec, "t_blocks", 0) > 1
+        else None
+    )
     entries: list[PlanEntry] = []
     add = entries.append
 
@@ -500,6 +567,15 @@ def enumerate_stacks(spec) -> list:
                     "em_loop_guarded@sharded", Stack("ssm", sh), "guarded"
                 )
             )
+    if tp is not None:
+        # parallel-in-time entries are opt-in by name, like the composed
+        # emcore kernels, so existing specs compile the same set as before
+        if "em_step_tp" in ks:
+            add(PlanEntry("em_step_tp", Stack("ssm", tp)))
+        if "em_step_ar_tp" in ks:
+            add(PlanEntry("em_step_ar_tp", Stack("ar", (collapse(),) + tp)))
+        if sh is not None and "em_step_tp_sharded" in ks:
+            add(PlanEntry("em_step_tp_sharded", Stack("ssm", tp + sh)))
     if spec.em_batch > 0:
         add(
             PlanEntry(

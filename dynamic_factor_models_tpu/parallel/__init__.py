@@ -1,3 +1,43 @@
-from .mesh import Mesh, NamedSharding, P, make_mesh, replicate, shard_over
+"""Parallelism package: meshes, collectives, and sequence parallelism.
+
+Also holds the single copy of the `shard_map` compatibility shim: the
+function moved from ``jax.experimental.shard_map`` to ``jax.shard_map``
+and its replication-check kwarg was renamed ``check_rep`` ->
+``check_vma`` on a DIFFERENT version boundary than the import move, so
+every call site used to re-sniff both.  `shard_map_nocheck` resolves
+both once, here — defined before the submodule imports below so
+``from . import shard_map_nocheck`` inside them cannot recurse.
+"""
+
+import inspect as _inspect
+
+try:  # jax >= 0.4.35
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+SHARD_MAP_NOCHECK_KW = (
+    {"check_vma": False}
+    if "check_vma" in _inspect.signature(_shard_map).parameters
+    else {"check_rep": False}
+)
+del _inspect
+
+
+def shard_map_nocheck(f, mesh, in_specs, out_specs):
+    """``shard_map`` with the replication check disabled, version-proof.
+
+    Every shard_map in this codebase runs with the static replication
+    check off (the collapse payload reductions and prefix exchanges
+    produce replicated outputs the checker cannot prove), so the kwarg
+    sniffing lives here once instead of inline at each call site.
+    """
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        **SHARD_MAP_NOCHECK_KW,
+    )
+
+
+from .mesh import Mesh, NamedSharding, P, data_mesh, make_mesh, replicate, shard_over
 from .distributed import global_mesh, initialize_distributed
 from .timescan import sharded_scan, time_sharding

@@ -64,7 +64,9 @@ def series_pad(n_series: int, n_shards: int) -> int:
     return ((n_series + n_shards - 1) // n_shards) * n_shards
 
 
-def data_mesh(n_shards: int | None = None, hosts: int = 1) -> Mesh:
+def data_mesh(
+    n_shards: int | None = None, hosts: int = 1, t_blocks: int = 0
+) -> Mesh:
     """Cross-section (N axis) mesh used by the sharded EM step.
 
     hosts <= 1 (the default, and the resolution of hosts=0/None in a
@@ -82,22 +84,34 @@ def data_mesh(n_shards: int | None = None, hosts: int = 1) -> Mesh:
     single-process callers (the tier-1 8-device proxy) get the same
     topology by reshaping the first n_shards local devices.
 
+    t_blocks > 1 inserts a THIRD axis between them — the 3-D
+    ``("dcn", "time", "ici")`` mesh of the parallel-in-time EM path
+    (parallel/timescan, models/emtime): each host owns t_blocks
+    contiguous time slabs, each slab an ICI group of ``n_shards // hosts``
+    series shards, so the O(k^2) slab-boundary exchange stays on-host
+    (ICI/shared memory) while only the hierarchical payload reduction
+    crosses DCN.  Device order stays process-major; ``t_blocks <= 1``
+    returns exactly the flat/2-D mesh above (byte-identity guarantee —
+    pinned in tests/test_multihost.py).
+
     On TPU the inner axis rides ICI; in CI the same program runs on the
     forced 8-device CPU platform (tests/conftest.py)."""
     if hosts is None or hosts == 0:
         hosts = jax.process_count()
     hosts = max(int(hosts), 1)
-    if hosts <= 1:
+    t_blocks = max(int(t_blocks), 0)
+    if t_blocks <= 1 and hosts <= 1:
         return make_mesh(n_shards, axis_names=("data",))
     devs = jax.devices()
     if n_shards is None:
-        n_shards = len(devs)
+        n_shards = len(devs) if t_blocks <= 1 else len(devs) // max(t_blocks, 1)
     if n_shards % hosts != 0:
         raise ValueError(
             f"n_shards={n_shards} must divide evenly over hosts={hosts} "
             f"(each host owns n_shards // hosts local devices)"
         )
     local = n_shards // hosts
+    per_host = local * max(t_blocks, 1)  # devices one host contributes
     nproc = jax.process_count()
     if nproc > 1:
         if hosts != nproc:
@@ -106,21 +120,30 @@ def data_mesh(n_shards: int | None = None, hosts: int = 1) -> Mesh:
                 f"multi-process runtime (one DCN rank per OS process)"
             )
         per_proc = len(devs) // nproc
-        if local > per_proc:
+        if per_host > per_proc:
             raise ValueError(
-                f"n_shards={n_shards} over hosts={hosts} needs {local} devices "
-                f"per process but only {per_proc} are visible"
+                f"n_shards={n_shards} x t_blocks={t_blocks} over "
+                f"hosts={hosts} needs {per_host} devices per process but "
+                f"only {per_proc} are visible"
             )
-        # Process-major: take each process's first `local` devices so the
-        # "ici" axis never crosses a process boundary.
-        picked = [devs[h * per_proc + j] for h in range(hosts) for j in range(local)]
+        # Process-major: take each process's first `per_host` devices so
+        # neither the "time" nor the "ici" axis crosses a process boundary.
+        picked = [
+            devs[h * per_proc + j] for h in range(hosts) for j in range(per_host)
+        ]
     else:
-        if n_shards > len(devs):
+        if hosts * per_host > len(devs):
             raise ValueError(
-                f"n_shards={n_shards} exceeds the {len(devs)} visible devices"
+                f"n_shards={n_shards} x t_blocks={max(t_blocks, 1)} exceeds "
+                f"the {len(devs)} visible devices"
             )
-        picked = list(devs[:n_shards])
-    return Mesh(np.array(picked).reshape(hosts, local), ("dcn", "ici"))
+        picked = list(devs[: hosts * per_host])
+    if t_blocks <= 1:
+        return Mesh(np.array(picked).reshape(hosts, local), ("dcn", "ici"))
+    return Mesh(
+        np.array(picked).reshape(hosts, t_blocks, local),
+        ("dcn", "time", "ici"),
+    )
 
 
 def make_mesh(n_devices: int | None = None, axis_names=("rep",), shape=None) -> Mesh:

@@ -6,42 +6,39 @@ associative — the parallel Kalman filter/smoother elements
 (models/pkalman.py), cumulative products of companion matrices for IRFs,
 prefix log-likelihoods — runs time-block-sharded across devices:
 
-    1. each device runs a local ``lax.associative_scan`` on its block;
-    2. ONE ``all_gather`` over the mesh axis exchanges the per-block totals
-       (the classic Blelchoch block-scan exchange; O(n_dev * elem) bytes on
-       ICI, independent of T);
-    3. each device folds the gathered prefixes (n_dev tiny combines) and
-       applies its exclusive block-prefix to the local results.
+    1. each device owns one contiguous time slab and runs a LOCAL inclusive
+       scan on it — either ``lax.associative_scan`` (log-depth, ~2x combine
+       work) or, with ``local="sequential"``, a plain ``lax.scan`` of the
+       combine (~1x work; the blocked-slab production choice, since within a
+       device depth costs nothing);
+    2. the per-slab totals take part in a Hillis-Steele exclusive-prefix
+       exchange over the mesh axis: ceil(log2(n_dev)) + 1 non-wrapping
+       ``ppermute`` rounds, each moving ONE boundary element (O(k^2) bytes)
+       per device — never an all-gather of all n_dev totals;
+    3. each device folds its exclusive block prefix into its local results
+       (one vmapped combine).
 
-Implemented with ``shard_map`` so the collective is explicit and rides the
+Ragged time lengths are handled by padding the element pytree AT THE END
+with repeats of the last element: an inclusive forward scan is causal, so
+positions [:T] are unaffected and the padded outputs are sliced off —
+boundary/padded steps are exactly inert (pinned in tests/test_pkalman.py).
+
+Implemented with ``shard_map`` so the collectives are explicit and ride the
 mesh axis; everything composes with jit.  The reference has no distributed
 code of any kind (SURVEY.md section 2.6) — this is new TPU-native design.
 """
 
 from __future__ import annotations
 
-from functools import partial
-
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-try:  # jax >= 0.4.35
-    from jax import shard_map
-except ImportError:  # pragma: no cover - older jax
-    from jax.experimental.shard_map import shard_map
-
-# the replication-check kwarg was renamed check_rep -> check_vma on a
-# different jax version boundary than the import move, so pick by signature
-import inspect as _inspect
-
-_params = _inspect.signature(shard_map).parameters
-_SHARD_MAP_KW = (
-    {"check_vma": False} if "check_vma" in _params else {"check_rep": False}
-)
-del _inspect, _params
+from . import shard_map_nocheck
 
 __all__ = ["sharded_scan", "time_sharding"]
+
+_LOCAL_KINDS = ("associative", "sequential")
 
 
 def time_sharding(mesh: Mesh, axis: str = "time"):
@@ -50,51 +47,144 @@ def time_sharding(mesh: Mesh, axis: str = "time"):
     return NamedSharding(mesh, P(axis))
 
 
-def sharded_scan(combine, elems, mesh: Mesh, axis: str = "time"):
-    """Inclusive associative scan over the leading axis of an elements pytree,
-    sharded over `mesh[axis]`.
+def _local_inclusive_scan(combine, elems, kind: str):
+    """Within-slab inclusive scan: log-depth associative form, or the
+    cheap sequential ``lax.scan`` of the combine (~1x combine evaluations
+    per element vs the up/down-sweep's ~2x — within one device the extra
+    depth of the sequential recursion is free, so it wins on FLOPs)."""
+    if kind == "associative":
+        return jax.lax.associative_scan(combine, elems)
+    first = jax.tree.map(lambda a: a[0], elems)
+    rest = jax.tree.map(lambda a: a[1:], elems)
+
+    def step(carry, e):
+        c = combine(carry, e)
+        return c, c
+
+    _, out = jax.lax.scan(step, first, rest)
+    return jax.tree.map(
+        lambda f, o: jnp.concatenate([f[None], o], axis=0), first, out
+    )
+
+
+def block_scan_body(combine, local_elems, axis: str, n_blocks: int,
+                    local: str = "associative"):
+    """The slab-scan body, callable inside ANY shard_map that carries mesh
+    axis `axis` with one time slab per device: local inclusive scan, then a
+    Hillis-Steele exclusive-prefix exchange of the O(1)-per-device slab
+    totals, then one vmapped fold of the prefix into the local results.
+
+    ppermute fills non-receiving devices with zeros, which must never flow
+    through an arbitrary combine as DATA — every round therefore masks the
+    folded value back to the unfolded one on devices that received nothing
+    (`jnp.where` on the block index is free; the combine on garbage operands
+    is still well-defined arithmetic, merely discarded)."""
+    scanned = _local_inclusive_scan(combine, local_elems, local)
+    if n_blocks == 1:
+        return scanned
+    idx = jax.lax.axis_index(axis)
+    cur = jax.tree.map(lambda a: a[-1], scanned)
+    shift = 1
+    while shift < n_blocks:
+        perm = [(s, s + shift) for s in range(n_blocks - shift)]
+        recv = jax.tree.map(
+            lambda a: jax.lax.ppermute(a, axis, perm), cur
+        )
+        folded = combine(recv, cur)
+        cur = jax.tree.map(
+            lambda f, c: jnp.where(idx >= shift, f, c), folded, cur
+        )
+        shift *= 2
+    # cur now holds the INCLUSIVE prefix of slab totals; one more shift
+    # converts it to the exclusive prefix this slab must fold in front
+    perm1 = [(s, s + 1) for s in range(n_blocks - 1)]
+    prefix = jax.tree.map(lambda a: jax.lax.ppermute(a, axis, perm1), cur)
+    with_prefix = jax.vmap(lambda e: combine(prefix, e))(scanned)
+    # slab 0 has no predecessor: its local scan IS the global prefix
+    return jax.tree.map(
+        lambda a, b: jnp.where(idx == 0, a, b), scanned, with_prefix
+    )
+
+
+def sharded_scan(combine, elems, mesh: Mesh, axis: str = "time",
+                 local: str = "associative"):
+    """Inclusive associative scan over the leading axis of an elements
+    pytree, sharded over `mesh[axis]` in contiguous per-device time slabs.
 
     `combine(earlier, later)` must be associative (not necessarily
-    commutative).  The leading dimension must divide evenly by the mesh-axis
-    size.  Returns the same pytree, scanned, with the same sharding.
+    commutative).  Any time length is accepted: a `T` that does not divide
+    the mesh-axis size is padded at the end with repeats of the last
+    element (causally inert for an inclusive forward scan) and the padded
+    outputs are sliced off.  `local` picks the within-slab recursion:
+    "associative" (log-depth) or "sequential" (`lax.scan` of the combine;
+    ~half the combine work — the blocked-slab default for EM).  Returns
+    the same pytree, scanned, with the same sharding.
     """
+    if local not in _LOCAL_KINDS:
+        raise ValueError(
+            f"local must be one of {_LOCAL_KINDS}, got {local!r}"
+        )
     n_dev = mesh.shape[axis]
     T = jax.tree.leaves(elems)[0].shape[0]
-    if T % n_dev:
-        raise ValueError(f"time length {T} not divisible by mesh axis size {n_dev}")
+    if n_dev <= 1:
+        # single-block degeneracy: no collective, no padding
+        return _local_inclusive_scan(combine, elems, local)
+    slab = -(-T // n_dev)
+    T_pad = slab * n_dev
+    if T_pad != T:
+        # Pad via a static front update + where-mask, NOT
+        # concatenate([a, repeats]): an uneven concatenate along the
+        # to-be-time-sharded axis miscompiles in the XLA SPMD partitioner
+        # when this runs under jit on the mesh (the same hazard documented
+        # in models/pkalman._filter_elements_from_collapsed).
+        def _pad_with_last(a):
+            base = jnp.zeros((T_pad,) + a.shape[1:], a.dtype).at[:T].set(a)
+            keep = (jnp.arange(T_pad) < T).reshape(
+                (-1,) + (1,) * (a.ndim - 1)
+            )
+            return jnp.where(keep, base, a[-1])
+
+        elems = jax.tree.map(_pad_with_last, elems)
+
+    # Partitioner firewall: pin the element pytree REPLICATED at the
+    # boundary of the manual region.  Without this, GSPMD is free to
+    # shard the caller's upstream glue (flips, shifted concatenations,
+    # padding) along the time dim, and the XLA SPMD partitioner
+    # miscompiles several such ops when the per-device extent is
+    # uneven/padded (observed: uneven concatenate, reverse).  All
+    # time-axis slicing then happens exclusively inside shard_map, where
+    # the blocks are explicit.
+    repl = NamedSharding(mesh, P())
+    elems = jax.tree.map(
+        lambda a: (
+            jax.lax.with_sharding_constraint(a, repl)
+            if isinstance(a, jax.core.Tracer)
+            else a
+        ),
+        elems,
+    )
 
     spec = P(axis)
-
-    @partial(
-        shard_map,
+    block_scan = shard_map_nocheck(
+        lambda e: block_scan_body(combine, e, axis, n_dev, local),
         mesh=mesh,
         in_specs=(spec,),
         out_specs=spec,
-        **_SHARD_MAP_KW,
     )
-    def block_scan(local):
-        # 1. local inclusive scan on this device's time block
-        scanned = jax.lax.associative_scan(combine, local)
-        # 2. exchange block totals: (n_dev, ...) on every device
-        total = jax.tree.map(lambda a: a[-1], scanned)
-        gathered = jax.tree.map(
-            lambda a: jax.lax.all_gather(a, axis_name=axis), total
-        )
-        # 3. exclusive prefix of the gathered totals for this device's block
-        idx = jax.lax.axis_index(axis)
-
-        def fold(i, carry):
-            nxt = jax.tree.map(lambda a: a[i], gathered)
-            return jax.lax.cond(
-                i < idx, lambda: combine(carry, nxt), lambda: carry
-            )
-
-        first = jax.tree.map(lambda a: a[0], gathered)
-        prefix = jax.lax.fori_loop(1, n_dev, fold, first)
-        # apply: block 0 keeps its local scan; others fold the prefix in front
-        with_prefix = jax.vmap(lambda e: combine(prefix, e))(scanned)
-        return jax.tree.map(
-            lambda a, b: jnp.where(idx == 0, a, b), scanned, with_prefix
-        )
-
-    return block_scan(elems)
+    out = block_scan(elems)
+    # Same firewall on the way out: the scan's result leaves shard_map
+    # committed to P(axis), and caller-side glue on that layout (the
+    # smoother's flips, the un-padding slice, lag-one shifts) hits the
+    # identical partitioner hazards.  Pinning the result replicated makes
+    # the manual region the ONLY place the time axis is ever sharded.
+    out = jax.tree.map(
+        lambda a: (
+            jax.lax.with_sharding_constraint(a, repl)
+            if isinstance(a, jax.core.Tracer)
+            else a
+        ),
+        out,
+    )
+    if T_pad != T:
+        out = jax.tree.map(lambda a: a[:T], out)
+    return out

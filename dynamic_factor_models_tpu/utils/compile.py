@@ -510,6 +510,12 @@ class CompileSpec:
     # time, so existing single-process specs compile the same flat-mesh
     # programs as before.
     mesh_hosts: int = 0
+    # parallel-in-time slabs (models/emtime via transforms.time_shard):
+    # t_blocks > 1 registers the opt-in time-parallel EM steps
+    # ("em_step_tp", "em_step_ar_tp", and "em_step_tp_sharded" when
+    # n_shards > 1 too) over the blocked-slab time mesh.  0 (default)
+    # skips them, so existing specs compile the same set as before.
+    t_blocks: int = 0
 
     def padded_shape(self) -> tuple:
         if not self.bucket:

@@ -891,6 +891,7 @@ class RunRecord:
         # can render "-" without guessing
         d.setdefault("mesh_shape", None)
         d.setdefault("sharded", False)
+        d.setdefault("t_blocks", 0)
         d.setdefault("x64", bool(jax.config.jax_enable_x64))
         try:
             d.setdefault("donate", donation_enabled())
@@ -987,11 +988,14 @@ def _n_series_str(rec: dict) -> str:
 
 
 def _dev_str(rec: dict) -> str:
-    """Devices column: '-' for single-device records, 'NxM' for a sharded
-    mesh (its shape), else the raw device count when a record ran
-    multi-device without sharding (e.g. vmapped tenant batches)."""
+    """Devices column: '-' for single-device records, the 'x'-joined mesh
+    shape at ANY rank for a run that recorded one — '8' (flat data mesh),
+    '2x4' (dcn x ici), '1x4x2' (dcn x time x ici) — else the raw device
+    count when a record ran multi-device without sharding (e.g. vmapped
+    tenant batches).  Rendering no longer requires the `sharded` flag:
+    time-only parallel runs carry a mesh but shard no series axis."""
     mesh = rec.get("mesh_shape")
-    if rec.get("sharded") and mesh:
+    if mesh:
         return "x".join(str(int(m)) for m in mesh)
     n = rec.get("n_devices")
     if isinstance(n, (int, float)) and n > 1 and rec.get("sharded"):

@@ -56,6 +56,9 @@ def bench(tmp_path, monkeypatch):
             self.stdout = stdout
 
     def _fake_run_child(args, env_extra=None, timeout_s=3600):
+        if "--run-time-parallel" in args:
+            calls.append("timeparallel")
+            return _FakeChild('{"time_parallel": true, "smoke": true}')
         if "--run-composed" in args:
             calls.append("composed")
             return _FakeChild('{"composed": true, "smoke": true}')
@@ -87,7 +90,7 @@ def test_remainder_section_order_and_stores(bench, tmp_path, capsys):
     bench.run_tpu_remainder()
     assert bench._test_calls == [
         "pallas", "parity", "large", "refscale", "multichip", "composed",
-        "multihost", "crossover"
+        "timeparallel", "multihost", "crossover"
     ]
     out = capsys.readouterr().out.strip().splitlines()[-1]
     final = json.loads(out)
@@ -95,6 +98,7 @@ def test_remainder_section_order_and_stores(bench, tmp_path, capsys):
     assert final["pallas_gram_speedup_large_panel"] == 1.5
     assert final["multichip"]["n_devices"] == 8
     assert final["composed_smoke"]["smoke"] is True
+    assert final["time_parallel_smoke"]["smoke"] is True
     assert final["multihost_smoke"]["smoke"] is True
     assert "crossover_markdown" in final
     # per-section persistence: the partial file holds the full accumulation

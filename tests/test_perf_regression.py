@@ -490,6 +490,25 @@ def test_derived_kernel_registry_size_is_pinned():
     )
     assert len(cc._kernel_plan(full_c)) == 20
 
+    # t_blocks opts the parallel-in-time family in BY NAME: the three tp
+    # kernels add exactly three entries on top of the maximal spec (the
+    # time x shard product needs n_shards too), nothing else moves
+    full_tp = cc.CompileSpec(
+        T=60, N=12, r=2, p=1, dtype=str(_np.dtype(float)), max_em_iter=4,
+        t_star=16, n_shards=2, em_batch=2, t_blocks=4,
+        kernels=full.kernels
+        + ("em_step_tp", "em_step_ar_tp", "em_step_tp_sharded"),
+    )
+    assert len(tfm.enumerate_stacks(full_tp)) == 17
+    assert len(cc._kernel_plan(full_tp)) == 19
+    # t_blocks without the kernel names is inert — same set as `full`
+    silent_tp = cc.CompileSpec(
+        T=60, N=12, r=2, p=1, dtype=str(_np.dtype(float)), max_em_iter=4,
+        t_star=16, n_shards=2, em_batch=2, t_blocks=4,
+        kernels=full.kernels,
+    )
+    assert len(tfm.enumerate_stacks(silent_tp)) == 14
+
     # particle_count opts the SMC family in: exactly one plan per
     # AOT-able particle model (tvp is excluded — its aux carries a
     # panel-length factor path, which would key the executable on data

@@ -124,11 +124,16 @@ def test_sequence_parallel_smoother_on_mesh(problem):
     np.testing.assert_allclose(np.asarray(lag1), np.asarray(lag1_seq), atol=1e-9)
 
 
-def test_sharded_scan_rejects_ragged_blocks(problem):
+def test_sharded_scan_pads_ragged_blocks(problem):
+    """T % n_dev != 0 no longer rejects: the element pytree is padded at
+    the end with repeats of the last element (causally inert for an
+    inclusive forward scan) and the padded outputs are sliced off."""
     params, x = problem
     from dynamic_factor_models_tpu.parallel.timescan import sharded_scan
 
     mesh = Mesh(np.array(jax.devices()[:8]), ("time",))
     elems = filter_elements(params, fillz(x)[:63], mask_of(x)[:63])
-    with pytest.raises(ValueError, match="not divisible"):
-        sharded_scan(combine_filter, elems, mesh)
+    ref = jax.lax.associative_scan(combine_filter, elems)
+    shd = sharded_scan(combine_filter, elems, mesh)
+    np.testing.assert_allclose(np.asarray(shd.b), np.asarray(ref.b), atol=1e-12)
+    np.testing.assert_allclose(np.asarray(shd.C), np.asarray(ref.C), atol=1e-12)

@@ -1,0 +1,576 @@
+"""Parallel-in-time EM (PR-16): fused collapsed filter/smoother elements,
+blocked time slabs over the mesh "time" axis, and the 3-D
+hosts x time x series mesh.
+
+Coverage map:
+
+* fused element construction — `em_step_assoc_fused` built from the
+  collapsed per-step payload (O(r^3) per element, no N-sized operand)
+  matches both the unfused associative step and the sequential stats
+  step, and the public ``"ssm.assoc"`` alias auto-dispatches to the
+  fused body above `LARGE_N_THRESHOLD`;
+* `parallel.timescan.sharded_scan` edge cases on the forced-8-device CPU
+  platform — non-power-of-two T, T % n_dev != 0 (end-padding with inert
+  repeats), and single-block degeneracy — at 1e-12 against
+  ``jax.lax.associative_scan``, for both local recursion kinds;
+* the time-parallel EM steps resolved through the transform stack
+  (`em_step_tp_b*`, `em_step_tp_b*_d*`, `em_step_ar_tp_b*`) at 1e-10
+  against the sequential references, including the full estimators
+  `estimate_dfm_em(t_blocks=...)` / `estimate_dfm_em_ar(t_blocks=...)`
+  (params, loglik path, E-step moments via the factor paths);
+* stack refusals (time x steady, time x batch, AR time x shard, AR time
+  without collapse), the derived AOT plan entries, warm-process registry
+  hits, and the telemetry device-column rendering for 3-D meshes.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dynamic_factor_models_tpu.models import ssm, transforms as tfm
+from dynamic_factor_models_tpu.models import pkalman as pk
+from dynamic_factor_models_tpu.models.dfm import DFMConfig
+from dynamic_factor_models_tpu.models.ssm import (
+    SSMParams,
+    _collapse_obs,
+    compute_panel_stats,
+    em_step_assoc,
+    em_step_assoc_fused,
+    em_step_stats,
+    estimate_dfm_em,
+)
+from dynamic_factor_models_tpu.models.ssm_ar import (
+    SSMARParams,
+    compute_qd_stats,
+    em_step_ar_qd,
+    estimate_dfm_em_ar,
+)
+from dynamic_factor_models_tpu.parallel import data_mesh, sharded_scan
+from dynamic_factor_models_tpu.utils import compile as cc
+from dynamic_factor_models_tpu.utils.telemetry import _dev_str
+
+PARITY_ATOL = 1e-10  # acceptance bar vs the sequential reference
+SCAN_ATOL = 1e-12  # acceptance bar for the raw scan itself
+
+
+def _panel(T=67, N=12, r=3, p=2, miss=0.15, seed=0):
+    rng = np.random.default_rng(seed)
+    lam = jnp.asarray(0.5 * rng.standard_normal((N, r)))
+    A = jnp.zeros((p, r, r)).at[0].set(0.3 * jnp.eye(r))
+    params = SSMParams(lam, jnp.ones(N) * 0.7, A, jnp.eye(r))
+    x = jnp.asarray(rng.standard_normal((T, N)))
+    mask = jnp.asarray(rng.random((T, N)) > miss)
+    return params, jnp.where(mask, x, 0.0), mask.astype(x.dtype)
+
+
+@pytest.fixture(scope="module")
+def problem():
+    return _panel()
+
+
+def _assert_leaves_close(a, b, atol=PARITY_ATOL):
+    for u, v in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_allclose(
+            np.asarray(u), np.asarray(v), atol=atol
+        )
+
+
+# ---------------------------------------------------------------------------
+# 1. 3-D mesh topology
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.timeparallel
+@pytest.mark.multidevice
+def test_data_mesh_time_axis_topology():
+    m = data_mesh(2, hosts=1, t_blocks=4)
+    assert m.axis_names == ("dcn", "time", "ici")
+    assert m.devices.shape == (1, 4, 2)
+    # flat-mesh byte-identity when no time axis is requested
+    flat = data_mesh(8)
+    assert data_mesh(8, t_blocks=0).axis_names == flat.axis_names
+    assert [d.id for d in data_mesh(8, t_blocks=1).devices.ravel()] == [
+        d.id for d in flat.devices.ravel()
+    ]
+    # same device set, process-major order
+    assert sorted(d.id for d in m.devices.ravel()) == [
+        d.id for d in flat.devices.ravel()
+    ]
+
+
+@pytest.mark.timeparallel
+def test_data_mesh_time_axis_validation():
+    with pytest.raises(ValueError):
+        data_mesh(jax.device_count(), t_blocks=3)  # does not divide
+    with pytest.raises(ValueError):
+        data_mesh(jax.device_count() * 2, t_blocks=2)  # too many devices
+
+
+# ---------------------------------------------------------------------------
+# 2. fused collapsed elements (the retired unfused ssm.assoc)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.timeparallel
+def test_fused_elements_match_unfused_assoc(problem):
+    params, x, mask = problem
+    p1, ll1 = em_step_assoc(params, x, mask)
+    p2, ll2 = em_step_assoc_fused(params, x, mask)
+    np.testing.assert_allclose(float(ll1), float(ll2), rtol=1e-10)
+    _assert_leaves_close(p1, p2)
+
+
+@pytest.mark.timeparallel
+def test_fused_elements_match_sequential(problem):
+    params, x, mask = problem
+    stats = compute_panel_stats(x, mask)
+    p0, ll0 = em_step_stats(params, x, mask, stats)
+    p2, ll2 = em_step_assoc_fused(params, x, mask)
+    np.testing.assert_allclose(float(ll0), float(ll2), rtol=1e-10)
+    _assert_leaves_close(p0, p2)
+
+
+@pytest.mark.timeparallel
+def test_assoc_alias_dispatches_fused_above_threshold(problem, monkeypatch):
+    """The public "ssm.assoc" name keeps ONE entry point: the step
+    dispatches the fused element builder whenever N clears
+    LARGE_N_THRESHOLD (static shape test, free inside jit).  Lowering
+    the threshold under the panel width forces the fused branch through
+    the SAME alias and must not move the answer."""
+    params, x, mask = problem
+    res = tfm.resolve(tfm.Stack("ssm.assoc"))
+    p_small, ll_small = res.step(params, x, mask)
+    monkeypatch.setattr(ssm, "LARGE_N_THRESHOLD", 4)
+    jax.clear_caches()  # drop the traced branch, force a re-trace
+    try:
+        p_big, ll_big = res.step(params, x, mask)
+    finally:
+        jax.clear_caches()
+    np.testing.assert_allclose(float(ll_small), float(ll_big), rtol=1e-10)
+    _assert_leaves_close(p_small, p_big)
+
+
+# ---------------------------------------------------------------------------
+# 3. sharded_scan edge cases (forced 8-device CPU)
+# ---------------------------------------------------------------------------
+
+
+def _scan_problem(T, seed=2):
+    rng = np.random.default_rng(seed)
+    elems = (
+        jnp.asarray(rng.standard_normal((T, 3, 3))) * 0.1,
+        jnp.asarray(rng.standard_normal((T, 3))),
+    )
+
+    def comb(a, b):
+        return (
+            jnp.einsum("...ij,...jk->...ik", b[0], a[0]),
+            jnp.einsum("...ij,...j->...i", b[0], a[1]) + b[1],
+        )
+
+    return comb, elems
+
+
+@pytest.mark.timeparallel
+@pytest.mark.multidevice
+@pytest.mark.parametrize("T", [67, 63, 96])
+@pytest.mark.parametrize("local", ["associative", "sequential"])
+def test_sharded_scan_edge_lengths(T, local):
+    """Non-power-of-two T and T % n_dev != 0: end-padding with repeats of
+    the last element is causally inert for an inclusive forward scan, so
+    positions [:T] match the unsharded scan at 1e-12 — eager AND jitted
+    (the jit path exercises the partitioner firewall)."""
+    comb, elems = _scan_problem(T)
+    mesh = data_mesh(1, hosts=1, t_blocks=8)
+    ref = jax.lax.associative_scan(comb, elems)
+    out = sharded_scan(comb, elems, mesh, local=local)
+    _assert_leaves_close(out, ref, atol=SCAN_ATOL)
+    jout = jax.jit(
+        lambda e: sharded_scan(comb, e, mesh, local=local)
+    )(elems)
+    _assert_leaves_close(jout, ref, atol=SCAN_ATOL)
+
+
+@pytest.mark.timeparallel
+def test_sharded_scan_single_block_degeneracy():
+    """A size-1 time axis must fall through to the plain local scan —
+    no collective, no padding, any T.  (data_mesh(t_blocks=1) returns
+    the FLAT mesh by byte-identity design, so the size-1 axis is built
+    explicitly here.)"""
+    from jax.sharding import Mesh
+
+    comb, elems = _scan_problem(61)
+    mesh = Mesh(
+        np.array(jax.devices()[:1]).reshape(1, 1, 1),
+        ("dcn", "time", "ici"),
+    )
+    ref = jax.lax.associative_scan(comb, elems)
+    for local in ("associative", "sequential"):
+        out = sharded_scan(comb, elems, mesh, local=local)
+        _assert_leaves_close(out, ref, atol=SCAN_ATOL)
+
+
+@pytest.mark.timeparallel
+def test_sharded_scan_rejects_unknown_local_kind():
+    from jax.sharding import Mesh
+
+    comb, elems = _scan_problem(8)
+    mesh = Mesh(
+        np.array(jax.devices()[:1]).reshape(1, 1, 1),
+        ("dcn", "time", "ici"),
+    )
+    with pytest.raises(ValueError, match="local"):
+        sharded_scan(comb, elems, mesh, local="recursive")
+
+
+@pytest.mark.timeparallel
+@pytest.mark.multidevice
+def test_sharded_scan_on_filter_elements(problem):
+    """The production combine (combine_filter on collapsed-built
+    FilterElements) through the blocked-slab exchange, ragged T."""
+    params, x, mask = problem
+    C, b, *_ = _collapse_obs(params.lam, params.R, x, mask)
+    elems = pk.filter_elements_collapsed(params, C, b)
+    ref = jax.lax.associative_scan(pk.combine_filter, elems)
+    mesh = data_mesh(1, hosts=1, t_blocks=8)
+    out = sharded_scan(
+        pk.combine_filter, elems, mesh, local="sequential"
+    )
+    _assert_leaves_close(out, ref, atol=SCAN_ATOL)
+
+
+# ---------------------------------------------------------------------------
+# 4. time-parallel EM steps through the transform stack
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.timeparallel
+@pytest.mark.multidevice
+def test_em_step_tp_matches_sequential(problem):
+    params, x, mask = problem
+    stats = compute_panel_stats(x, mask)
+    p0, ll0 = em_step_stats(params, x, mask, stats)
+    res = tfm.resolve(tfm.Stack("ssm", (tfm.time_shard(8),)))
+    assert res.t_blocks == 8
+    p1, ll1 = res.step(params, x, mask, stats)
+    np.testing.assert_allclose(float(ll0), float(ll1), rtol=1e-10)
+    _assert_leaves_close(p0, p1)
+
+
+@pytest.mark.timeparallel
+@pytest.mark.multidevice
+def test_em_step_tp_sharded_matches_sequential(problem):
+    """time x shard on the 3-D mesh: 4 time blocks x 2 series shards."""
+    from dynamic_factor_models_tpu.parallel.mesh import series_pad
+    from dynamic_factor_models_tpu.utils.compile import (
+        pad_panel,
+        pad_ssm_params,
+        unpad_ssm_params,
+    )
+
+    params, x, mask = problem
+    T, N = x.shape
+    stats = compute_panel_stats(x, mask)
+    p0, ll0 = em_step_stats(params, x, mask, stats)
+
+    Npad = series_pad(N, 2)
+    xb, mb, tw = pad_panel(x, mask, T, Npad)
+    stats_b = compute_panel_stats(xb, mb)._replace(tw=tw)
+    res = tfm.resolve(
+        tfm.Stack("ssm", (tfm.time_shard(4), tfm.shard(2)))
+    )
+    p1, ll1 = res.step(pad_ssm_params(params, Npad), xb, mb, stats_b)
+    np.testing.assert_allclose(float(ll0), float(ll1), rtol=1e-10)
+    p1u = unpad_ssm_params(jax.tree.map(np.asarray, p1), N)
+    _assert_leaves_close(p0, p1u)
+
+
+@pytest.mark.timeparallel
+@pytest.mark.multidevice
+def test_em_step_ar_tp_matches_sequential(problem):
+    params, x, mask = problem
+    N = x.shape[1]
+    arp = SSMARParams(
+        params.lam, jnp.zeros(N), jnp.ones(N) * 0.5, params.A, params.Q
+    )
+    qd = compute_qd_stats(x, mask)
+    p0, ll0 = em_step_ar_qd(arp, x, qd)
+    res = tfm.resolve(
+        tfm.Stack("ar", (tfm.collapse(), tfm.time_shard(8)))
+    )
+    p1, ll1 = res.step(arp, x, qd)
+    np.testing.assert_allclose(float(ll0), float(ll1), rtol=1e-10)
+    _assert_leaves_close(p0, p1)
+
+
+# ---------------------------------------------------------------------------
+# 5. stack refusals and step naming
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.timeparallel
+def test_time_shard_refusals():
+    cases = [
+        (tfm.Stack("ssm", (tfm.time_shard(4), tfm.steady_tail(16))),
+         "steady"),
+        (tfm.Stack("ssm", (tfm.time_shard(4), tfm.batch(2))), "batch"),
+        (tfm.Stack(
+            "ar", (tfm.collapse(), tfm.time_shard(4), tfm.shard(2))
+        ), "collapse"),
+        (tfm.Stack("ar", (tfm.time_shard(4),)), "collapsed"),
+    ]
+    for stack, frag in cases:
+        with pytest.raises(ValueError, match=frag):
+            tfm.resolve(stack)
+    with pytest.raises(ValueError, match="t_blocks > 1"):
+        tfm.resolve(tfm.Stack("ssm", (tfm.time_shard(1),)))
+
+
+@pytest.mark.timeparallel
+@pytest.mark.multidevice
+def test_tp_step_names_are_aot_stable():
+    """The lru_cached factories name their steps by (blocks, shards) so
+    the AOT registry's statics key is process-stable."""
+    from dynamic_factor_models_tpu.models import emtime
+
+    assert emtime.em_step_tp_for(8).__wrapped__.__name__ == "em_step_tp_b8"
+    assert (
+        emtime.em_step_tp_for(4, 2).__wrapped__.__name__
+        == "em_step_tp_b4_d2"
+    )
+    assert (
+        emtime.em_step_ar_tp_for(8).__wrapped__.__name__
+        == "em_step_ar_tp_b8"
+    )
+    # same (blocks, shards) -> the SAME jitted callable (cache hit)
+    assert emtime.em_step_tp_for(8) is emtime.em_step_tp_for(8)
+    with pytest.raises(ValueError):
+        emtime.em_step_tp_for(1)
+
+
+# ---------------------------------------------------------------------------
+# 6. full estimators
+# ---------------------------------------------------------------------------
+
+
+def _estimation_panel(T=90, N=12, r=2, seed=0):
+    rng = np.random.default_rng(seed)
+    f = np.zeros((T, r))
+    for t in range(1, T):
+        f[t] = 0.6 * f[t - 1] + rng.standard_normal(r)
+    lam = rng.standard_normal((N, r))
+    x = f @ lam.T + 0.6 * rng.standard_normal((T, N))
+    miss = rng.random((T, N)) < 0.1
+    miss[:, N // 2:] = False  # keep PCA-initializable series
+    x[miss] = np.nan
+    return x
+
+
+@pytest.mark.timeparallel
+@pytest.mark.multidevice
+def test_estimate_dfm_em_t_blocks_parity():
+    x = _estimation_panel()
+    T, N = x.shape
+    cfg = DFMConfig(nfac_u=2, tol=0.0, max_iter=300)
+    base = estimate_dfm_em(x, np.ones(N), 0, T - 1, cfg, max_em_iter=8)
+    tp = estimate_dfm_em(
+        x, np.ones(N), 0, T - 1, cfg, max_em_iter=8, t_blocks=4
+    )
+    np.testing.assert_allclose(
+        np.asarray(tp.loglik_path), np.asarray(base.loglik_path),
+        atol=PARITY_ATOL,
+    )
+    np.testing.assert_allclose(
+        np.asarray(tp.factors), np.asarray(base.factors),
+        atol=PARITY_ATOL,
+    )
+    # E-step moments: the smoothed factor covariances ride the result
+    np.testing.assert_allclose(
+        np.asarray(tp.factor_covs), np.asarray(base.factor_covs),
+        atol=PARITY_ATOL,
+    )
+    _assert_leaves_close(tp.params, base.params)
+
+
+@pytest.mark.timeparallel
+@pytest.mark.multidevice
+def test_estimate_dfm_em_t_blocks_times_shards_parity():
+    x = _estimation_panel(seed=1)
+    T, N = x.shape
+    cfg = DFMConfig(nfac_u=2, tol=0.0, max_iter=300)
+    base = estimate_dfm_em(x, np.ones(N), 0, T - 1, cfg, max_em_iter=6)
+    tp = estimate_dfm_em(
+        x, np.ones(N), 0, T - 1, cfg, max_em_iter=6,
+        t_blocks=4, n_shards=2,
+    )
+    np.testing.assert_allclose(
+        np.asarray(tp.loglik_path), np.asarray(base.loglik_path),
+        atol=PARITY_ATOL,
+    )
+    np.testing.assert_allclose(
+        np.asarray(tp.factors), np.asarray(base.factors),
+        atol=PARITY_ATOL,
+    )
+
+
+@pytest.mark.timeparallel
+def test_estimate_dfm_em_t_blocks_validation():
+    x = _estimation_panel()
+    T, N = x.shape
+    cfg = DFMConfig(nfac_u=2, tol=0.0, max_iter=300)
+    with pytest.raises(ValueError, match="method"):
+        estimate_dfm_em(
+            x, np.ones(N), 0, T - 1, cfg, max_em_iter=2,
+            t_blocks=4, method="associative",
+        )
+    with pytest.raises(ValueError, match="gram_dtype"):
+        estimate_dfm_em(
+            x, np.ones(N), 0, T - 1, cfg, max_em_iter=2,
+            t_blocks=4, gram_dtype="bfloat16",
+        )
+    with pytest.raises(ValueError, match="device"):
+        estimate_dfm_em(
+            x, np.ones(N), 0, T - 1, cfg, max_em_iter=2,
+            t_blocks=jax.device_count() * 2,
+        )
+
+
+@pytest.mark.timeparallel
+@pytest.mark.multidevice
+def test_estimate_dfm_em_ar_t_blocks_parity():
+    # complete panel: the collapsed AR path's exact mask class
+    x = _estimation_panel(seed=2)
+    x = np.nan_to_num(x)
+    T, N = x.shape
+    cfg = DFMConfig(nfac_u=2, tol=0.0, max_iter=300)
+    base = estimate_dfm_em_ar(
+        x, np.ones(N), 0, T - 1, cfg, max_em_iter=8, method="collapsed"
+    )
+    tp = estimate_dfm_em_ar(
+        x, np.ones(N), 0, T - 1, cfg, max_em_iter=8,
+        method="collapsed", t_blocks=8,
+    )
+    np.testing.assert_allclose(
+        np.asarray(tp.loglik_path), np.asarray(base.loglik_path),
+        atol=PARITY_ATOL,
+    )
+    np.testing.assert_allclose(
+        np.asarray(tp.factors), np.asarray(base.factors),
+        atol=PARITY_ATOL,
+    )
+
+
+@pytest.mark.timeparallel
+def test_estimate_dfm_em_ar_t_blocks_validation():
+    x = np.nan_to_num(_estimation_panel())
+    T, N = x.shape
+    cfg = DFMConfig(nfac_u=2, tol=0.0, max_iter=300)
+    with pytest.raises(ValueError, match="collapsed"):
+        estimate_dfm_em_ar(
+            x, np.ones(N), 0, T - 1, cfg, max_em_iter=2,
+            method="dense", t_blocks=4,
+        )
+
+
+# ---------------------------------------------------------------------------
+# 7. derived AOT plan + warm-process registry hits
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.timeparallel
+def test_enumerate_stacks_time_entries():
+    base = cc.CompileSpec(
+        T=60, N=12, r=2, p=1, dtype=str(np.dtype(float)), max_em_iter=4
+    )
+    n0 = len(tfm.enumerate_stacks(base))
+    # t_blocks alone adds nothing: the tp kernels are opt-in by name
+    silent = cc.CompileSpec(
+        T=60, N=12, r=2, p=1, dtype=str(np.dtype(float)),
+        max_em_iter=4, t_blocks=4,
+    )
+    assert len(tfm.enumerate_stacks(silent)) == n0
+    tp = cc.CompileSpec(
+        T=60, N=12, r=2, p=1, dtype=str(np.dtype(float)),
+        max_em_iter=4, t_blocks=4, n_shards=2,
+        kernels=cc.CompileSpec.kernels
+        + ("em_step_tp", "em_step_ar_tp", "em_step_tp_sharded"),
+    )
+    keys = [e.key for e in tfm.enumerate_stacks(tp)]
+    assert keys.count("em_step_tp") == 1
+    assert keys.count("em_step_ar_tp") == 1
+    assert keys.count("em_step_tp_sharded") == 1
+    # ... and without n_shards the time x shard product is NOT derived
+    tp_only = cc.CompileSpec(
+        T=60, N=12, r=2, p=1, dtype=str(np.dtype(float)),
+        max_em_iter=4, t_blocks=4,
+        kernels=cc.CompileSpec.kernels
+        + ("em_step_tp", "em_step_ar_tp", "em_step_tp_sharded"),
+    )
+    keys = [e.key for e in tfm.enumerate_stacks(tp_only)]
+    assert "em_step_tp_sharded" not in keys
+
+
+@pytest.mark.timeparallel
+@pytest.mark.multidevice
+def test_em_step_tp_precompile_warm_hit():
+    """The derived plan compiles em_step_tp ahead of time; a second
+    precompile of the identical spec is served entirely from the
+    in-process registry (zero XLA work) — the warm-process acceptance
+    pin for the time-parallel kernels."""
+    cc.reset_counters()
+    spec = cc.CompileSpec(
+        T=90, N=12, r=2, p=1, dtype=str(np.dtype(float)),
+        max_em_iter=4, t_blocks=4, kernels=("em_step_tp",),
+    )
+    r1 = cc.precompile(spec)
+    assert not r1["kernels"]["em_step_tp"]["aot_cached"]
+    assert cc.counters()["em_step_tp"]["compiles"] == 1
+    r2 = cc.precompile(spec)
+    assert r2["kernels"]["em_step_tp"]["aot_cached"]
+    assert r2["compile_s_total"] == 0.0
+    c = cc.counters()["em_step_tp"]
+    assert c["compiles"] == 1 and c["aot_hits"] == 1
+
+
+# ---------------------------------------------------------------------------
+# 8. telemetry rendering
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.timeparallel
+@pytest.mark.telemetry
+def test_dev_str_renders_any_mesh_rank():
+    assert _dev_str({"mesh_shape": [8], "sharded": True}) == "8"
+    assert _dev_str({"mesh_shape": [2, 4], "sharded": True}) == "2x4"
+    # 3-D time mesh: renders WITHOUT the sharded flag (time-only runs
+    # shard no series axis)
+    assert _dev_str({"mesh_shape": [1, 4, 2]}) == "1x4x2"
+    assert _dev_str({"mesh_shape": [1, 8, 1], "sharded": False}) == "1x8x1"
+    assert _dev_str({"n_devices": 8, "sharded": True}) == "8"
+    assert _dev_str({"n_devices": 8}) == "-"
+    assert _dev_str({}) == "-"
+
+
+@pytest.mark.timeparallel
+@pytest.mark.telemetry
+def test_run_record_defaults_t_blocks(tmp_path, monkeypatch):
+    import json
+
+    from dynamic_factor_models_tpu.utils import telemetry as T
+
+    path = tmp_path / "runs.jsonl"
+    monkeypatch.setenv("DFM_TELEMETRY", str(path))
+    monkeypatch.setattr(T, "_explicit_enabled", None)
+    monkeypatch.setattr(T, "_explicit_sink", None)
+
+    with T.RunRecord("em_tp_test", {}) as rec:
+        rec.set(T=10, N=4)
+    rec_d = json.loads(path.read_text().strip().splitlines()[-1])
+    assert rec_d["t_blocks"] == 0  # sequential default, field present
+
+    with T.RunRecord("em_tp_test", {}) as rec:
+        rec.set(t_blocks=4, mesh_shape=[1, 4, 1])
+    rec_d = json.loads(path.read_text().strip().splitlines()[-1])
+    assert rec_d["t_blocks"] == 4
+    assert _dev_str(rec_d) == "1x4x1"
