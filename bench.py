@@ -116,8 +116,12 @@ PARITY_THRESHOLDS = {
 # v5e single-chip peak: 197 TFLOP/s bf16 on the MXU.  The float32 programs
 # below run at a fraction of that peak by construction; MFU against the
 # bf16 ceiling is the honest, hardware-anchored denominator (it cannot
-# flatter the result).
-PEAK_FLOPS_V5E_BF16 = 1.97e14
+# flatter the result).  Aliased from the runtime roofline ledger
+# (utils/roofline) so bench and the live MFU gauges share ONE denominator
+# and one provenance vocabulary (mfu_peak_source / flop_proxy).
+from dynamic_factor_models_tpu.utils.roofline import (  # noqa: E402
+    PEAK_FLOPS_V5E_BF16,
+)
 
 # large-panel regime (the scale ops/pallas_gram.py's docstring targets,
 # beyond the reference's 224x233 panel)
@@ -517,6 +521,14 @@ def large_panel_section(tpu_ok, persist=None):
         if persist is not None:
             persist(dict(out))
 
+    # provenance labels first (ROADMAP item 5 honesty contract, enforced
+    # by tools/check_bench_honesty.py): the *_flops_per_sec fields below
+    # divide the documented FLOPs model by wall-clock — a proxy off-TPU —
+    # and every *_mfu_* field is normalized by the v5e bf16 datasheet peak
+    _emit({
+        "flop_proxy": not tpu_ok,
+        "mfu_peak_source": "v5e_bf16_datasheet",
+    })
     als_t = run_als(None) / n_als
     als_flops = als_iter_flops(T, N, r) / als_t
     fields = {
@@ -1900,28 +1912,14 @@ def _measured_gemm_peak():
     normalized by what the backend's own GEMM actually sustains (best of
     five 10-deep on-device matmul loops).  docs/EVIDENCE.md records why the
     two denominators are not comparable: the TPU number is a datasheet
-    bf16 peak, this one is a measured f32 peak."""
-    import jax
-    import jax.numpy as jnp
-    import numpy as np
-    from jax import lax
+    bf16 peak, this one is a measured f32 peak.
 
-    n = 1024
-    a = jnp.asarray(
-        np.random.default_rng(3).standard_normal((n, n)) / n, jnp.float32
-    )
+    Delegates to utils/roofline.measured_gemm_peak (the same probe the
+    runtime ledger uses), which also caches the result so the live MFU
+    gauges adopt the measured denominator from here on."""
+    from dynamic_factor_models_tpu.utils.roofline import measured_gemm_peak
 
-    @jax.jit
-    def loop(a):
-        return lax.fori_loop(0, 10, lambda i, acc: acc @ a, a)
-
-    loop(a).block_until_ready()
-    best = float("inf")
-    for _ in range(5):
-        t0 = time.perf_counter()
-        loop(a).block_until_ready()
-        best = min(best, time.perf_counter() - t0)
-    return 10 * 2.0 * n**3 / best
+    return measured_gemm_peak(reps=5)
 
 
 def _compiled_flops(compiled):
@@ -3398,6 +3396,83 @@ def _is_tpu_platform(platform: str) -> bool:
     return platform in ("tpu", "axon")
 
 
+def obs_overhead_section(smoke: bool = True):
+    """Observability-overhead leg: the SAME small EM estimate timed with
+    telemetry disabled and enabled (RunRecord + roofline ledger + flight
+    ring armed-but-idle), plus the ledger's own cumulative snapshot —
+    the live check that the PR 17 instrumentation stays inside the
+    telemetry budget on the estimation path.  Returns the fields dict
+    (the remainder folds it in; --obs-overhead prints it)."""
+    import tempfile
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dynamic_factor_models_tpu.models.dfm import DFMConfig
+    from dynamic_factor_models_tpu.models.ssm import estimate_dfm_em
+    from dynamic_factor_models_tpu.utils import compile as cc
+    from dynamic_factor_models_tpu.utils import roofline, telemetry
+
+    T, N = (96, 32) if smoke else (224, 128)
+    n_iter = 10
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((T, N)).astype(np.float32)
+    cfg = DFMConfig(nfac_u=2)
+    # ledger costs are captured at AOT registration — precompile the
+    # guarded-loop executable the runs below dispatch
+    cc.precompile(
+        cc.CompileSpec(
+            T=T, N=N, r=2, p=cfg.n_factorlag,
+            dtype=str(jnp.asarray(0.0).dtype),  # f64 iff x64 is on
+            kernels=("em_loop_guarded",), max_em_iter=n_iter,
+        ),
+        warmup=False,
+    )
+
+    def run():
+        estimate_dfm_em(
+            x, np.ones(N), 0, T - 1, cfg, max_em_iter=n_iter, tol=0.0,
+            bucket=True,
+        )
+
+    # remember the caller's telemetry state: disable() is sticky (it
+    # shadows DFM_TELEMETRY), and the remainder's later sections must
+    # keep recording into the live-window sink
+    prev_enabled = telemetry._explicit_enabled
+    prev_sink = telemetry._explicit_sink
+    telemetry.disable()
+    run()  # compile any remaining misses outside both timings
+    t_off = _time_fixed_iters(run)
+    with tempfile.TemporaryDirectory() as d:
+        telemetry.enable(sink=os.path.join(d, "obs.jsonl"))
+        try:
+            run()  # warm the enabled path (hist registration etc.)
+            t_on = _time_fixed_iters(run)
+            snap = roofline.publish_gauges()
+        finally:
+            telemetry.disable()
+            telemetry._explicit_enabled = prev_enabled
+            telemetry._explicit_sink = prev_sink
+    out = {
+        "obs_em_wall_s_off": round(t_off, 4),
+        "obs_em_wall_s_on": round(t_on, 4),
+        "obs_overhead_pct": round(100.0 * (t_on - t_off) / t_off, 2),
+        "obs_ledger_flops_total": round(snap["flops_total"], 0),
+        "obs_ledger_bytes_total": round(snap["bytes_total"], 0),
+        "obs_ledger_kernels": len(snap["per_kernel"]),
+        "obs_comm_axes": sorted(snap["comm"]["per_axis"]),
+        "mfu_peak_source": snap["mfu_peak_source"],
+        "flop_proxy": snap["flop_proxy"],
+    }
+    if "mfu_pct" in snap:
+        out["obs_mfu_pct"] = snap["mfu_pct"]
+    if "intensity_flops_per_byte" in snap:
+        out["obs_intensity_flops_per_byte"] = snap[
+            "intensity_flops_per_byte"
+        ]
+    return out
+
+
 def run_tpu_remainder(force_cpu: bool = False):
     """Child mode for short tunnel windows: ONLY the TPU sections the
     2026-07-31 salvaged live record is missing, cheapest compile surface
@@ -3536,6 +3611,13 @@ def run_tpu_remainder(force_cpu: bool = False):
     with redirect_stdout(buf):
         cs = chaos_serving_section()
     partial.update(cs)
+    _persist_partial(partial)
+    print(json.dumps(partial), file=sys.stderr, flush=True)
+
+    # observability-overhead smoke: proves the roofline ledger + flight
+    # ring keep the estimation path inside the telemetry budget on the
+    # live chip (and records the on-device ledger MFU fields)
+    partial["obs_overhead"] = obs_overhead_section(smoke=True)
     _persist_partial(partial)
     print(json.dumps(partial), file=sys.stderr, flush=True)
 
@@ -4241,6 +4323,11 @@ def main():
     ap.add_argument("--run-compile-split", action="store_true")
     ap.add_argument("--cache-dir")
     ap.add_argument("--warm-cache", action="store_true")
+    ap.add_argument("--obs-overhead", action="store_true",
+                    help="observability-overhead smoke: time a small EM "
+                         "estimate with telemetry off vs on and report "
+                         "the roofline-ledger snapshot (--smoke shrinks "
+                         "the panel)")
     ap.add_argument("--telemetry", metavar="PATH",
                     help="record a RunRecord JSONL for every estimation "
                          "call (sets DFM_TELEMETRY; inherited by bench "
@@ -4267,6 +4354,9 @@ def main():
         return
     if args.chaos_preempt_drill:
         chaos_preempt_drill()
+        return
+    if args.obs_overhead:
+        print(json.dumps(obs_overhead_section(smoke=args.smoke)))
         return
     if args.load:
         load_section(smoke=args.smoke)

@@ -779,6 +779,15 @@ def _ar_steady_sharded_step_impl(t_star: int, block: int, n_shards: int, hosts: 
             params, x, qd, t_star
         )
         payload = _reduce(payload)
+        # comm accounting (PR 17): the steady split's second collective —
+        # one psum of the O(r^2) constant vector over the full series
+        # axis — recorded host-side at trace time like the payload reduce
+        from ..utils.roofline import record_collective, tensor_nbytes
+
+        record_collective(
+            "emcore.steady_const_vec", dax, tensor_nbytes(const_vec),
+            hops=1, collective="psum", dtype=str(const_vec.dtype),
+        )
         const_vec = jax.lax.psum(const_vec, dax)
         C_head, b, ld_h, xrx_h, C_inf, ld_inf, quad_tail = (
             _unpack_qd_steady(payload, const_vec, params.r, t_star)

@@ -36,6 +36,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..utils import faults as _faults
+from ..utils import flight as _flight
 from ..utils import guards as _guards
 from ..utils.profiling import ConvergenceTrace
 from ..utils.telemetry import (
@@ -1040,6 +1041,21 @@ def _run_device_guarded(
                     faults_detected += new_trips
                     inc("em_guard.faults_detected", new_trips)
                     trips_seen = trips
+                    # flight recorder: every sentinel trip is a
+                    # pre-mortem moment, even one the traced jitter
+                    # rungs recover in-loop — ring event + one
+                    # (throttled) bundle dump with the preceding
+                    # injections and the kernel-ledger snapshot
+                    _flight.record(
+                        "em_guard.trip",
+                        health=_guards.HEALTH_NAMES[health],
+                        iter=int(carry[4]), trips=trips_seen,
+                        rungs_used=list(rungs_used),
+                    )
+                    _flight.dump(
+                        "guard_trip",
+                        health=_guards.HEALTH_NAMES[health],
+                    )
                 n_traced = min(int(carry[7]), _guards.N_TRACED_RUNGS)
                 for i in range(traced_recorded, n_traced):
                     rungs_used.append(_guards.LADDER_RUNGS[i])
@@ -1072,6 +1088,16 @@ def _run_device_guarded(
                 if rung is None:
                     final_health = health  # ladder exhausted: return last-good
                     inc("em_guard.exhausted")
+                    _flight.record(
+                        "em_guard.exhausted",
+                        health=_guards.HEALTH_NAMES[health],
+                        rungs_used=list(rungs_used),
+                        rung_skips=list(rung_skips),
+                    )
+                    _flight.dump(
+                        "ladder_exhausted",
+                        health=_guards.HEALTH_NAMES[health],
+                    )
                     break
                 # the device loop already rolled back: carry[0] is last-good
                 last_good, it = carry[0], int(carry[4])
@@ -1098,6 +1124,9 @@ def _run_device_guarded(
                     inj = (0, 0)
                 rungs_used.append(rung)
                 inc("em_guard.rung." + rung)
+                _flight.record(
+                    "em_guard.rung", severity="info", rung=rung,
+                )
                 carry = (
                     new_params,
                     jax.tree.map(jnp.copy, new_params),

@@ -232,7 +232,19 @@ def ring_allreduce(x: jnp.ndarray, axis_name: str, n_dev: int) -> jnp.ndarray:
     same mathematical reduction over the same ring order, so parity tests
     on the virtual CPU mesh validate the sharded numerics while the
     kernel path stays TPU-only.
+
+    Comm accounting (PR 17): the payload bytes are a static property of
+    the traced program, so they are recorded host-side HERE, at trace
+    time — a ring moves the full per-device payload across n_dev - 1
+    links per call (utils/roofline.py tags the entry with the mesh
+    axis).  Nothing is added to the compiled computation.
     """
+    from ..utils.roofline import record_collective, tensor_nbytes
+
+    record_collective(
+        "pallas_gram.ring_allreduce", axis_name, tensor_nbytes(x),
+        hops=max(1, n_dev - 1), collective="ring", dtype=str(x.dtype),
+    )
     if _context_platform() in _TPU_PLATFORMS and n_dev > 1:
         return _ring_allreduce_pallas(x, axis_name, n_dev)
     return jax.lax.psum(x, axis_name)
@@ -257,8 +269,20 @@ def hierarchical_allreduce(
     over the flattened ``(dcn, ici)`` axis tuple up to summation order;
     the tier-1 proxy pins hierarchical == flat at 1e-12 on the virtual
     CPU mesh (tests/test_multihost.py).
+
+    The DCN stage's payload bytes are recorded at trace time (one psum
+    of the already-reduced payload per call) — this is the measured
+    counterpart of the hand-derived bench field
+    ``dcn_payload_bytes_per_iter``, pinned equal on the 2-process proxy
+    in tests/test_obs.py.
     """
+    from ..utils.roofline import record_collective, tensor_nbytes
+
     x = ring_allreduce(x, ici_axis, n_ici)
+    record_collective(
+        "pallas_gram.hierarchical_allreduce.dcn", dcn_axis,
+        tensor_nbytes(x), hops=1, collective="psum", dtype=str(x.dtype),
+    )
     return jax.lax.psum(x, dcn_axis)
 
 

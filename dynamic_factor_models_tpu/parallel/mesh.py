@@ -101,7 +101,7 @@ def data_mesh(
     hosts = max(int(hosts), 1)
     t_blocks = max(int(t_blocks), 0)
     if t_blocks <= 1 and hosts <= 1:
-        return make_mesh(n_shards, axis_names=("data",))
+        return _publish_axes(make_mesh(n_shards, axis_names=("data",)))
     devs = jax.devices()
     if n_shards is None:
         n_shards = len(devs) if t_blocks <= 1 else len(devs) // max(t_blocks, 1)
@@ -139,11 +139,32 @@ def data_mesh(
             )
         picked = list(devs[: hosts * per_host])
     if t_blocks <= 1:
-        return Mesh(np.array(picked).reshape(hosts, local), ("dcn", "ici"))
-    return Mesh(
-        np.array(picked).reshape(hosts, t_blocks, local),
-        ("dcn", "time", "ici"),
+        return _publish_axes(
+            Mesh(np.array(picked).reshape(hosts, local), ("dcn", "ici"))
+        )
+    return _publish_axes(
+        Mesh(
+            np.array(picked).reshape(hosts, t_blocks, local),
+            ("dcn", "time", "ici"),
+        )
     )
+
+
+def _publish_axes(mesh: Mesh) -> Mesh:
+    """Publish the mesh topology as inline-labeled telemetry gauges
+    (``mesh.axis_size{axis="dcn"}`` etc.) so the comm-bytes ledger
+    (utils/roofline.comm_summary) can be read against the axis sizes it
+    is attributed over.  gauge_set is ungated and the data_mesh call
+    sites are lru-cached, so this fires once per topology."""
+    try:
+        from ..utils.telemetry import gauge_set
+
+        for name, size in mesh.shape.items():
+            gauge_set(f'mesh.axis_size{{axis="{name}"}}', int(size))
+        gauge_set("mesh.n_devices", int(mesh.devices.size))
+    except Exception:
+        pass
+    return mesh
 
 
 def make_mesh(n_devices: int | None = None, axis_names=("rep",), shape=None) -> Mesh:

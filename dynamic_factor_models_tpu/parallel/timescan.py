@@ -84,6 +84,20 @@ def block_scan_body(combine, local_elems, axis: str, n_blocks: int,
         return scanned
     idx = jax.lax.axis_index(axis)
     cur = jax.tree.map(lambda a: a[-1], scanned)
+    # comm accounting (PR 17): the exchange moves ONE boundary pytree
+    # per device per round, ceil(log2(n_blocks)) rounds plus the final
+    # exclusive shift — a static property of the traced program,
+    # recorded host-side at trace time (utils/roofline.py)
+    from ..utils.roofline import record_collective, tensor_nbytes
+
+    boundary_bytes = sum(
+        tensor_nbytes(a) for a in jax.tree.leaves(cur)
+    )
+    n_rounds = 1 + max(1, (n_blocks - 1)).bit_length()
+    record_collective(
+        "timescan.block_scan_boundary", axis, boundary_bytes,
+        hops=n_rounds, collective="ppermute",
+    )
     shift = 1
     while shift < n_blocks:
         perm = [(s, s + shift) for s in range(n_blocks - shift)]

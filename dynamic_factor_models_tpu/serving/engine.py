@@ -60,6 +60,7 @@ import numpy as np
 
 from ..models import ssm as _ssm
 from ..utils import faults as _faults
+from ..utils import flight as _flight
 from ..utils.compile import bucket_shape
 from ..utils.guards import host_finite
 from ..utils.telemetry import (
@@ -276,6 +277,19 @@ class ServingEngine:
         # (kind, outcome) -> LatencyHistogram, held directly so the hot
         # path never takes the registry lock (register_hist once per key)
         self._lat_hists: dict = {}
+        # serving-loop occupancy (PR 17): per-phase wall-clock split of
+        # each round — journal-fsync / device-dispatch / commit /
+        # envelope — the measurement baseline ROADMAP item 1's
+        # pipelining speedup is claimed against.  `_obs_live` caches the
+        # per-request run_record() enabled() probe so the phase timers
+        # cost NOTHING when telemetry is off (the <5% envelope bar);
+        # accumulated seconds reach the gauge registry only inside
+        # flush_metrics, never per tick.
+        self._obs_live = False
+        self._occ_s: dict = {}       # phase -> cumulative seconds
+        self._occ_req = 0.0          # phase seconds inside this request
+        self._phase_hists: dict = {}  # phase -> LatencyHistogram
+        self._slo_alerting = False   # edge-triggers the SLO-page dump
 
     # -- registration ----------------------------------------------------
 
@@ -570,6 +584,7 @@ class ServingEngine:
         reqno = self._requests
         if _faults.site_hits("engine_crash", reqno):
             _faults.fault_fired("engine_crash")
+            _flight.dump("engine_crash", force=True, reqno=reqno)
             raise _faults.SimulatedCrash(
                 f"injected engine_crash at request {reqno}"
             )
@@ -586,6 +601,11 @@ class ServingEngine:
         rec_cm = run_record(
             "serving", kind=rkind, config={"tenant": tenant_id}
         )
+        # occupancy attribution rides the SAME probe: phase timers in
+        # _tick/_flush_round fire only while this flag is up, so the
+        # disabled path adds one attribute store and nothing else
+        self._obs_live = rec_cm is not _NULL_RECORD
+        self._occ_req = 0.0
         if rec_cm is _NULL_RECORD:
             tr_cm = _NULL_TRACE
         else:
@@ -630,10 +650,32 @@ class ServingEngine:
                     breaker_state=resp.breaker_state,
                     latency_s=round(latency_s, 9),
                 )
+        if self._obs_live:
+            # envelope = request wall-clock not attributed to a device
+            # dispatch / journal append / memory commit phase — the
+            # host-side overhead the 5%-of-a-tick budget bounds
+            self._occ_add(
+                "envelope", max(0.0, latency_s - self._occ_req)
+            )
         self._observe(rkind, outcome, latency_s, resp.ok)
         if (reqno & 1023) == 0 and rec is not _NULL_RECORD:
             self.flush_metrics()
         return resp
+
+    def _occ_add(self, phase: str, dt: float) -> None:
+        """Accumulate one occupancy phase sample: cumulative seconds
+        (gauges pushed by `flush_metrics`, never per tick) plus the
+        per-phase HDR histogram.  Callers gate on `_obs_live`."""
+        self._occ_s[phase] = self._occ_s.get(phase, 0.0) + dt
+        self._occ_req += dt
+        try:
+            h = self._phase_hists[phase]
+        except KeyError:
+            h = register_hist(
+                "serving.phase.latency", entry="serving", phase=phase,
+            )
+            self._phase_hists[phase] = h
+        h.record(dt)
 
     def _observe(self, kind, outcome, latency_s, ok) -> None:
         """O(1) host-side per-request accounting: one histogram bucket
@@ -660,9 +702,22 @@ class ServingEngine:
         sink (when one is active).  Called every 1024th request
         automatically; call explicitly at the end of a run to flush the
         tail."""
+        alerting = False
         for slo in self.slos:
             for name, val in slo.gauges().items():
                 gauge_set(name, val)
+            try:
+                alerting = alerting or bool(slo.status().get("alerting"))
+            except Exception:
+                pass
+        # SLO page: edge-triggered flight dump (one bundle per alert
+        # transition, not one per flush while the page stays up)
+        if alerting and not self._slo_alerting:
+            _flight.record("serving.slo_page")
+            _flight.dump("slo_page")
+        self._slo_alerting = alerting
+        for phase, s in self._occ_s.items():
+            gauge_set(f"serving.occupancy.{phase}_s", round(s, 9))
         self._resident_gauges()
         emit_metrics()
         return emit_histograms()
@@ -744,8 +799,22 @@ class ServingEngine:
         and (unless `count_fault=False`, e.g. a fast-fail against an
         already-open breaker) counts one fault toward the breaker."""
         if count_fault:
+            was_open = ten.breaker.state == BREAKER_OPEN
             ten.breaker.record_fault()
+            if not was_open and ten.breaker.state == BREAKER_OPEN:
+                _flight.record(
+                    "serving.breaker_open", tenant=tenant_id, code=err.code,
+                )
         inc("serving.faults." + err.code)
+        if err.category == SYSTEM_FAULT:
+            # typed system fault: ring event + (throttled) bundle dump —
+            # the pre-mortem for "the engine started answering
+            # system_fault envelopes at 3am"
+            _flight.record(
+                "serving.system_fault", kind=kind, tenant=tenant_id,
+                code=err.code,
+            )
+            _flight.dump("system_fault", code=err.code)
         return Response(
             ok=False, kind=kind, tenant=tenant_id, error=err,
             degraded=bool(ten.replay), ticks_behind=len(ten.replay),
@@ -844,6 +913,8 @@ class ServingEngine:
             )
 
         self._ticks += 1
+        obs = self._obs_live
+        t_ph = time.perf_counter() if obs else 0.0
         new_state = online_tick(ten.model, ten.state, row[0], row[1])
         if _faults.site_hits("tick_nan", self._ticks):
             _faults.fault_fired("tick_nan")
@@ -877,6 +948,8 @@ class ServingEngine:
                 ),
                 recovered=recovered,
             )
+        if obs:  # device dispatch + (sampled) deep check
+            self._occ_add("dispatch", time.perf_counter() - t_ph)
         if deadline.exceeded():  # final probe before the commit point
             ten.replay.append(row)
             return self._fault_resp(
@@ -895,6 +968,7 @@ class ServingEngine:
             if journal is None:
                 journal = ten.journal = self.store.journal(tenant_id)
             t_idx = int(ten.state.t)
+            t_ph = time.perf_counter() if obs else 0.0
             try:
                 with trace_span("tick.journal_append", t=t_idx):
                     _, retries = call_with_retries(
@@ -914,7 +988,10 @@ class ServingEngine:
                     retries=self.retry_policy.max_retries,
                     recovered=recovered,
                 )
+            if obs:  # write-ahead append incl. fsync and retries
+                self._occ_add("journal", time.perf_counter() - t_ph)
 
+        t_ph = time.perf_counter() if obs else 0.0
         ten.state = new_state
         ten.dirty += 1  # this tick lives in the journal, not the snapshot
         if deep:
@@ -922,6 +999,8 @@ class ServingEngine:
         if ten.hist is not None:
             ten.hist.append(row[0], row[1])
         ten.breaker.record_success()
+        if obs:
+            self._occ_add("commit", time.perf_counter() - t_ph)
         return Response(
             ok=True, kind="tick", tenant=tenant_id, result=new_state,
             retries=retries, breaker_state=ten.breaker.state,
@@ -1169,6 +1248,7 @@ class ServingEngine:
         reqno = self._requests
         if _faults.site_hits("engine_crash", reqno):
             _faults.fault_fired("engine_crash")
+            _flight.dump("engine_crash", force=True, reqno=reqno)
             raise _faults.SimulatedCrash(
                 f"injected engine_crash at request {reqno}"
             )
@@ -1207,6 +1287,9 @@ class ServingEngine:
             "serving", kind="tick_flush",
             config={"n_lanes": len(entries)},
         ) as rec:
+            self._obs_live = rec is not _NULL_RECORD
+            self._occ_req = 0.0
+            t_period = time.perf_counter() if self._obs_live else 0.0
             pending = list(range(len(entries)))
             rounds = 0
             while pending:
@@ -1226,6 +1309,14 @@ class ServingEngine:
                 self._flush_round(entries, now_round, responses)
                 pending = later
             inc("serving.batch.flushes")
+            if self._obs_live:
+                # envelope = period wall-clock beyond the attributed
+                # dispatch/journal/commit phases (admission, batching
+                # glue, response assembly)
+                self._occ_add("envelope", max(
+                    0.0,
+                    (time.perf_counter() - t_period) - self._occ_req,
+                ))
             ok_n = sum(1 for r in responses if r is not None and r.ok)
             if rec is not _NULL_RECORD:
                 rec.set(
@@ -1356,15 +1447,20 @@ class ServingEngine:
             if hit:
                 _faults.fault_fired("tick_nan")
             poisoned.append(hit)
+        obs = self._obs_live
+        t_ph = time.perf_counter() if obs else 0.0
         new_states = batched_tick_dispatch(
             [(ten.model, ten.state, row[0], row[1])
              for _qi, _tid, ten, row, _dl, _rc in lanes]
         )
+        if obs:  # one vmapped device dispatch for the whole round
+            self._occ_add("dispatch", time.perf_counter() - t_ph)
 
         # per-lane isolation: batched serving always deep-checks (the
         # states just materialized on host) and journal-appends; a
         # failed lane buffers its row and freezes only that tenant
         commits = []
+        t_ph = time.perf_counter() if obs else 0.0
         for (qi, tenant_id, ten, row, deadline, recovered), st, poi in zip(
             lanes, new_states, poisoned
         ):
@@ -1411,7 +1507,10 @@ class ServingEngine:
                     )
                     continue
             commits.append((qi, tenant_id, ten, row, st, recovered, retries))
+        if obs:  # per-lane deep checks + write-ahead appends (fsync)
+            self._occ_add("journal", time.perf_counter() - t_ph)
         # memory commits only after EVERY lane's append has settled
+        t_ph = time.perf_counter() if obs else 0.0
         for qi, tenant_id, ten, row, st, recovered, retries in commits:
             ten.state = st
             ten.suspect = False
@@ -1425,6 +1524,8 @@ class ServingEngine:
                 retries=retries, breaker_state=ten.breaker.state,
                 recovered=recovered,
             )
+        if obs:
+            self._occ_add("commit", time.perf_counter() - t_ph)
 
     # -- persistence -----------------------------------------------------
 

@@ -260,11 +260,15 @@ def plan_check(plan: FaultPlan) -> FaultPlan:
 
 
 def fault_fired(kind: str) -> None:
-    """Count one injected fault in the telemetry registry (and per kind)."""
+    """Count one injected fault in the telemetry registry (and per kind),
+    and drop a breadcrumb into the flight-recorder ring so a later dump
+    shows the injections that preceded the trigger."""
+    from .flight import record
     from .telemetry import inc
 
     inc("faults_injected")
     inc("faults_injected." + kind)
+    record("fault_injected", severity="info", fault=kind)
 
 
 def corrupt_file(path: str, mode: str = "truncate") -> None:

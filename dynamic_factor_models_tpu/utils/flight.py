@@ -1,0 +1,266 @@
+"""Fault-dump flight recorder: a bounded in-memory ring of recent
+guard / ladder / breaker / fault events, dumped as ONE timestamped JSON
+bundle when something goes wrong.
+
+The JSONL sink answers "what happened over the run"; what it cannot
+answer at 3am is "what were the last 200 things that happened BEFORE the
+guard tripped, and what had the device been doing" — by the time someone
+attaches, the interesting tail is interleaved with a million healthy
+lines.  The flight recorder keeps that tail pre-assembled:
+
+- ``record(kind, **fields)`` appends one event to a bounded ring
+  (``collections.deque(maxlen=...)``).  Gated on ``telemetry.enabled()``
+  — the ring is allocated LAZILY on the first recorded event, so a
+  clean disabled-telemetry run performs zero allocations here (pinned
+  in tests/test_obs.py).
+- ``dump(trigger, ...)`` writes ``flight-<utc>-<trigger>.json`` —
+  trigger event, ring contents, the telemetry record tail, the roofline
+  kernel-ledger snapshot (``utils/roofline.py``), and the counter/gauge
+  registry — via tmp+rename+fsync so a crash mid-dump can't leave a
+  truncated bundle.  Dumps are throttled (one per
+  ``DFM_FLIGHT_MIN_INTERVAL_S``, default 5s) unless forced, so a fault
+  storm produces a bundle per episode, not per envelope.
+
+Triggers wired in this PR: EM guard trips / ladder exhaustion
+(models/emloop.py), serving typed ``system_fault`` envelopes, breaker
+opens and injected ``engine_crash`` kills (serving/engine.py), SLO pages
+(engine.flush_metrics), injected faults (utils/faults.fault_fired), and
+SIGTERM/atexit (installed on the first *event*-severity record; the exit
+dump fires only when an armed event is still undumped).  Drills ride the
+existing ``DFM_FAULTS`` grammar — ``DFM_FAULTS=nan_estep@3`` produces a
+bundle with no bespoke test plumbing.
+
+Dump directory: ``DFM_FLIGHT_DIR``, else the telemetry sink's directory,
+else ``build/flight``.
+"""
+
+from __future__ import annotations
+
+import atexit
+import collections
+import json
+import os
+import signal
+import threading
+import time
+
+__all__ = [
+    "armed",
+    "dump",
+    "dump_dir",
+    "install",
+    "last_dump_path",
+    "record",
+    "reset",
+    "ring",
+    "ring_len",
+]
+
+_lock = threading.RLock()
+
+# the ring: None until the first enabled record — the disabled clean
+# path must allocate NOTHING (acceptance-pinned)
+_ring: collections.deque | None = None
+_seq = 0
+_armed = False          # an event-severity record awaits a dump
+_installed = False      # atexit/SIGTERM hooks registered
+_last_dump_t = 0.0
+_last_dump_path: str | None = None
+
+
+def _ring_maxlen() -> int:
+    raw = os.environ.get("DFM_FLIGHT_RING", "256") or "256"
+    try:
+        return max(8, int(raw))
+    except ValueError:
+        return 256
+
+
+def _min_interval_s() -> float:
+    raw = os.environ.get("DFM_FLIGHT_MIN_INTERVAL_S", "5") or "5"
+    try:
+        return max(0.0, float(raw))
+    except ValueError:
+        return 5.0
+
+
+def dump_dir() -> str:
+    d = os.environ.get("DFM_FLIGHT_DIR")
+    if d:
+        return d
+    from . import telemetry as T
+
+    sink = T.sink_path()
+    if sink:
+        parent = os.path.dirname(sink)
+        if parent:
+            return os.path.join(parent, "flight")
+    return os.path.join("build", "flight")
+
+
+def record(event: str, severity: str = "event", **fields) -> bool:
+    """Append one event to the ring (its type lands under the ring key
+    ``kind``); returns True when recorded.
+
+    No-op (False) while telemetry is disabled.  ``severity="event"``
+    arms the exit-time dump and installs the SIGTERM/atexit hooks on
+    first use; ``severity="info"`` is breadcrumb context (spans, metric
+    deltas) that never arms anything.  The parameter is named `event`
+    so callers can attach a ``kind=...`` payload field (serving request
+    kinds do)."""
+    from . import telemetry as T
+
+    if not T.enabled():
+        return False
+    global _ring, _seq, _armed
+    ev = {
+        "seq": 0,
+        "time_unix": round(time.time(), 6),
+        "kind": str(event),
+        "severity": severity,
+    }
+    for k, v in fields.items():
+        try:
+            json.dumps(v)
+            ev[k] = v
+        except (TypeError, ValueError):
+            ev[k] = repr(v)
+    with _lock:
+        if _ring is None:
+            _ring = collections.deque(maxlen=_ring_maxlen())
+        _seq += 1
+        ev["seq"] = _seq
+        _ring.append(ev)
+        if severity == "event":
+            _armed = True
+    if severity == "event":
+        install()
+    return True
+
+
+def ring() -> list[dict]:
+    with _lock:
+        return list(_ring) if _ring is not None else []
+
+
+def ring_len() -> int:
+    with _lock:
+        return len(_ring) if _ring is not None else 0
+
+
+def armed() -> bool:
+    with _lock:
+        return _armed
+
+
+def last_dump_path() -> str | None:
+    with _lock:
+        return _last_dump_path
+
+
+def dump(trigger: str, force: bool = False, **fields) -> str | None:
+    """Write the flight bundle; returns its path, or None when skipped
+    (telemetry disabled, or inside the dump throttle window and not
+    forced).  Never raises — a broken disk must not turn a pre-mortem
+    into the mortem."""
+    from . import telemetry as T
+
+    if not T.enabled():
+        return None
+    global _last_dump_t, _last_dump_path, _armed
+    now = time.time()
+    with _lock:
+        if not force and (now - _last_dump_t) < _min_interval_s():
+            return None
+        _last_dump_t = now
+    try:
+        from . import roofline
+
+        trig = {"trigger": str(trigger), "time_unix": round(now, 6)}
+        for k, v in fields.items():
+            try:
+                json.dumps(v)
+                trig[k] = v
+            except (TypeError, ValueError):
+                trig[k] = repr(v)
+        snap = T.snapshot()
+        bundle = {
+            "version": 1,
+            "time_unix": round(now, 6),
+            "trigger": trig,
+            "ring": ring(),
+            "records_tail": T.records()[-32:],
+            "kernel_ledger": roofline.ledger_snapshot(),
+            "counters": snap["counters"],
+            "gauges": snap["gauges"],
+        }
+        d = dump_dir()
+        os.makedirs(d, exist_ok=True)
+        ts = time.strftime("%Y%m%dT%H%M%S", time.gmtime(now))
+        safe = "".join(
+            ch if ch.isalnum() or ch in "-_." else "_"
+            for ch in str(trigger)
+        )
+        path = os.path.join(d, f"flight-{ts}-{os.getpid()}-{safe}.json")
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(bundle, f, indent=1, default=repr)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        with _lock:
+            _last_dump_path = path
+            _armed = False
+        T.inc("flight.dumps")
+        return path
+    except Exception:
+        return None
+
+
+# ---------------------------------------------------------------------------
+# process-exit triggers
+# ---------------------------------------------------------------------------
+
+
+def _exit_dump() -> None:
+    if armed():
+        dump("atexit", force=True)
+
+
+def _sigterm(signum, frame) -> None:
+    dump("sigterm", force=True)
+    signal.signal(signal.SIGTERM, signal.SIG_DFL)
+    os.kill(os.getpid(), signal.SIGTERM)
+
+
+def install() -> None:
+    """Register the atexit hook (always) and a SIGTERM handler (only
+    when no application handler is present and we are on the main
+    thread).  Idempotent; called automatically on the first
+    event-severity `record`."""
+    global _installed
+    with _lock:
+        if _installed:
+            return
+        _installed = True
+    atexit.register(_exit_dump)
+    try:
+        if (
+            threading.current_thread() is threading.main_thread()
+            and signal.getsignal(signal.SIGTERM) == signal.SIG_DFL
+        ):
+            signal.signal(signal.SIGTERM, _sigterm)
+    except (ValueError, OSError):
+        pass  # non-main thread / restricted env: atexit still covers us
+
+
+def reset() -> None:
+    """Drop the ring and disarm (tests).  Installed process hooks stay
+    — they are idempotent no-ops while disarmed."""
+    global _ring, _seq, _armed, _last_dump_t, _last_dump_path
+    with _lock:
+        _ring = None
+        _seq = 0
+        _armed = False
+        _last_dump_t = 0.0
+        _last_dump_path = None

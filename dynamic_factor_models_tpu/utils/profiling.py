@@ -1,9 +1,19 @@
 """Profiling/tracing hooks (SURVEY.md section 5.1).
 
 Thin wrappers over jax.profiler so estimation loops can annotate their hot
-regions; traces are viewable in TensorBoard/Perfetto.  The convergence-trace
-recorder replaces the reference's commented-out `println("diff = ...")`
-debugging (dfm_functions.ipynb cell 20:42) with structured data.
+regions; traces are viewable in TensorBoard/Perfetto.  Since PR 17
+``annotate`` also opens a telemetry trace span with the SAME region name
+whenever telemetry is enabled, so the Perfetto timeline and the JSONL
+span trees (utils/telemetry.trace_span) agree on what a region is called
+— one vocabulary across both viewers.
+
+The convergence-trace recorder replaces the reference's commented-out
+`println("diff = ...")` debugging (dfm_functions.ipynb cell 20:42) with
+structured data.  Its wall-clock fields (`times`, `iters_per_sec`) are
+DEPRECATED as a timing source: RunRecord's ``wall_s`` / ``phase_s`` and
+the compile-layer run counters are the canonical clocks (one timebase,
+visible in `telemetry summarize`); keep using ConvergenceTrace for the
+objective-value sequence itself.
 """
 
 from __future__ import annotations
@@ -16,8 +26,44 @@ import jax
 __all__ = ["annotate", "trace_to", "ConvergenceTrace"]
 
 
+class _AnnotatedSpan:
+    """``jax.profiler.TraceAnnotation`` + ``telemetry.trace_span`` opened
+    and closed together under one region name."""
+
+    __slots__ = ("_name", "_ann", "_span")
+
+    def __init__(self, name: str):
+        self._name = name
+
+    def __enter__(self):
+        from . import telemetry as T
+
+        self._ann = jax.profiler.TraceAnnotation(self._name)
+        self._ann.__enter__()
+        # enabled() was probed once in annotate(); the _on variant skips
+        # the repeat (the same idiom the serving engine uses)
+        self._span = T.trace_span_on(self._name)
+        self._span.__enter__()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        try:
+            self._span.__exit__(exc_type, exc, tb)
+        finally:
+            return self._ann.__exit__(exc_type, exc, tb)
+
+
 def annotate(name: str):
-    """Named region for profiler traces: `with annotate("als_step"): ...`"""
+    """Named region for profiler traces: `with annotate("als_step"): ...`
+
+    With telemetry enabled the same name also becomes a telemetry trace
+    span (child of whatever request/run span is active), so span trees
+    and Perfetto annotations line up; the disabled path returns the bare
+    ``TraceAnnotation`` exactly as before."""
+    from . import telemetry as T
+
+    if T.enabled():
+        return _AnnotatedSpan(name)
     return jax.profiler.TraceAnnotation(name)
 
 
@@ -27,7 +73,14 @@ trace_to = jax.profiler.trace
 
 @dataclass
 class ConvergenceTrace:
-    """Records per-iteration objective values + wall time of an ALS/EM loop."""
+    """Records per-iteration objective values + wall time of an ALS/EM
+    loop.
+
+    .. deprecated:: PR 17
+        The wall-clock side (`times`, `iters_per_sec`) duplicates the
+        RunRecord phase/wall seconds on a second timebase — prefer
+        ``run_record(...)`` fields for timing.  The objective-value
+        sequence (`values`) remains first-class."""
 
     name: str = "loop"
     values: list = field(default_factory=list)

@@ -1,0 +1,415 @@
+"""Per-kernel roofline ledger + mesh comm accounting (compute-scale
+observability).
+
+PR 12 made *request*-scale time observable (spans, HDR histograms, SLO
+burn rates); this module makes *compute*-scale work observable — what the
+device actually did, continuously, in the production entry points instead
+of a one-off ``cost_analysis()`` probe inside ``bench.py --multichip``:
+
+kernel ledger
+    ``utils/compile.py::precompile`` registers every AOT executable here
+    (``record_kernel``) with the static per-call cost XLA reports —
+    ``cost_analysis()`` flops and bytes accessed.  Cost is a property of
+    the COMPILED PROGRAM, so it is captured exactly once per kernel x
+    bucket at registration time; at runtime ``ledger_snapshot()``
+    multiplies it by the existing per-kernel invocation counters
+    (``compile.counters()`` runs / run_s — the hot path pays nothing new)
+    to expose cumulative device FLOPs, bytes, arithmetic intensity,
+    achieved FLOP/s, and MFU against the measured/datasheet peak.
+
+comm accounting
+    The collectives (``ops.pallas_gram`` rings, ``parallel.timescan``
+    slab-boundary ppermutes, the cross-host psum combines) call
+    ``record_collective`` AT TRACE TIME — inside shard_map the payload
+    shapes are static, so bytes-per-call is a compile-time fact exactly
+    like kernel flops, and the hand-derived bench field
+    ``dcn_payload_bytes_per_iter`` becomes a measured registry entry
+    tagged by mesh axis (``dcn`` / ``time`` / ``ici`` / ``data``).
+
+MFU peak machinery (shared with bench.py)
+    ``PEAK_FLOPS_V5E_BF16`` + ``measured_gemm_peak()`` +
+    ``mfu_peak()`` — the datasheet peak on TPU, a measured f32 GEMM peak
+    elsewhere, always labeled with ``mfu_peak_source`` and
+    ``flop_proxy`` (ROADMAP item 5's honesty contract, enforced by
+    tools/check_bench_honesty.py).  The measured peak costs ~a second,
+    so ``mfu_peak()`` NEVER measures implicitly: off-TPU it returns no
+    peak until ``measured_gemm_peak()`` has been called explicitly
+    (bench legs do; a RunRecord exit must stay cheap).
+
+Registries are tiny per-process dicts guarded by one lock, recorded
+unconditionally like ``compile.counters()`` (registration/trace-time
+only — never per execution); gauge publication (``publish_gauges``) is
+what telemetry enablement gates.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+__all__ = [
+    "PEAK_FLOPS_V5E_BF16",
+    "comm_summary",
+    "compiled_cost",
+    "kernel_ledger",
+    "ledger_snapshot",
+    "measured_gemm_peak",
+    "mfu_peak",
+    "publish_gauges",
+    "record_collective",
+    "record_kernel",
+    "reset",
+    "run_fields",
+    "tensor_nbytes",
+]
+
+# TPU v5e bf16 datasheet peak (matmul); the one chip this project's live
+# windows target.  bench.py aliases this constant.
+PEAK_FLOPS_V5E_BF16 = 1.97e14
+
+_TPU_PLATFORMS = ("tpu", "axon")
+
+_lock = threading.RLock()
+
+# kernel registry: reg name (the `compile.counters()` key) ->
+#   {"flops_per_call", "bytes_per_call", "buckets": {plan name: {...}}}
+# A kernel registered at several buckets keeps the LATEST registration as
+# its representative per-call cost (bucket count is reported so readers
+# can see when attribution is approximate — the invocation counters are
+# per registry name, not per bucket).
+_kernels: dict[str, dict] = {}
+
+# comm registry: (site, axis) -> {"collective", "bytes_per_call",
+#   "hops", "dtype", "traces"}.  Bytes are PER DEVICE PER CALL of the
+# traced program; `hops` scales a ring's per-link traffic.
+_collectives: dict[tuple, dict] = {}
+
+# measured-GEMM peak cache: {"peak_flops": float, "measured_at": float}
+_measured: dict = {}
+
+
+# ---------------------------------------------------------------------------
+# cost capture
+# ---------------------------------------------------------------------------
+
+
+def compiled_cost(compiled) -> tuple[float | None, float | None]:
+    """(flops, bytes accessed) per call of a Compiled, defensively parsed
+    — ``cost_analysis()`` returns a list on some JAX versions, a dict on
+    others, and CPU backends may omit either field.  None = unreported."""
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:
+        return None, None
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    if not isinstance(ca, dict):
+        return None, None
+    try:
+        flops = float(ca.get("flops", 0.0) or 0.0)
+    except (TypeError, ValueError):
+        flops = 0.0
+    try:
+        byts = float(ca.get("bytes accessed", 0.0) or 0.0)
+    except (TypeError, ValueError):
+        byts = 0.0
+    return (flops if flops > 0 else None, byts if byts > 0 else None)
+
+
+def record_kernel(reg: str, name: str, compiled) -> None:
+    """Register one AOT executable's static per-call cost under its
+    counter registry name `reg` (plan names may carry an ``@variant``
+    suffix — `name` keeps it for the bucket table).  Called by
+    ``compile.precompile``; never raises."""
+    flops, byts = compiled_cost(compiled)
+    if flops is None and byts is None:
+        return
+    with _lock:
+        k = _kernels.setdefault(reg, {"buckets": {}})
+        k["buckets"][name] = {
+            "flops": flops or 0.0, "bytes": byts or 0.0,
+        }
+        k["flops_per_call"] = flops or 0.0
+        k["bytes_per_call"] = byts or 0.0
+
+
+def kernel_ledger() -> dict:
+    """Static per-call cost table: reg name -> flops/bytes per call plus
+    the per-bucket breakdown."""
+    with _lock:
+        return {
+            reg: {
+                "flops_per_call": k.get("flops_per_call", 0.0),
+                "bytes_per_call": k.get("bytes_per_call", 0.0),
+                "buckets": {b: dict(v) for b, v in k["buckets"].items()},
+            }
+            for reg, k in _kernels.items()
+        }
+
+
+# ---------------------------------------------------------------------------
+# comm accounting
+# ---------------------------------------------------------------------------
+
+
+def tensor_nbytes(x) -> int:
+    """Per-device payload bytes of an array/tracer from its static
+    aval — valid inside shard_map tracing where `x.shape` is the block
+    shape."""
+    try:
+        return int(np.prod(x.shape, dtype=np.int64)) * int(
+            np.dtype(x.dtype).itemsize
+        )
+    except Exception:
+        return 0
+
+
+def record_collective(
+    site: str, axis, nbytes: int, hops: int = 1, collective: str = "psum",
+    dtype: str | None = None,
+) -> None:
+    """Record one collective call site at trace time.
+
+    `axis` is the mesh axis name (or tuple) the payload crosses;
+    `nbytes` the per-device payload bytes of ONE traced call; `hops`
+    the number of per-link transfers a single call performs (ring:
+    n_dev - 1; ppermute ladder: rounds).  Re-tracing the same site
+    overwrites in place (cost is a static property of the traced
+    program, exactly like kernel flops) and bumps `traces`."""
+    ax = (
+        "+".join(str(a) for a in axis)
+        if isinstance(axis, (tuple, list)) else str(axis)
+    )
+    with _lock:
+        e = _collectives.setdefault(
+            (str(site), ax),
+            {"collective": collective, "bytes_per_call": 0, "hops": 1,
+             "dtype": dtype, "traces": 0},
+        )
+        e["collective"] = collective
+        e["bytes_per_call"] = int(nbytes)
+        e["hops"] = int(hops)
+        if dtype is not None:
+            e["dtype"] = dtype
+        e["traces"] += 1
+
+
+def comm_summary() -> dict:
+    """Comm registry snapshot: per-site rows plus per-axis payload-byte
+    totals (``bytes_per_call`` summed over the sites crossing that axis
+    — for the EM estimators one traced call IS one iteration, so the
+    per-axis total is directly comparable to the bench field
+    ``dcn_payload_bytes_per_iter``)."""
+    with _lock:
+        sites = [
+            {"site": site, "axis": ax, **dict(e)}
+            for (site, ax), e in sorted(_collectives.items())
+        ]
+    per_axis: dict[str, dict] = {}
+    for s in sites:
+        a = per_axis.setdefault(
+            s["axis"], {"bytes_per_call": 0, "link_bytes_per_call": 0,
+                        "sites": 0},
+        )
+        a["bytes_per_call"] += s["bytes_per_call"]
+        a["link_bytes_per_call"] += s["bytes_per_call"] * s["hops"]
+        a["sites"] += 1
+    return {"sites": sites, "per_axis": per_axis}
+
+
+# ---------------------------------------------------------------------------
+# MFU peak machinery (bench.py aliases these)
+# ---------------------------------------------------------------------------
+
+
+def _platform() -> str:
+    try:
+        import jax
+
+        return jax.default_backend()
+    except Exception:
+        return "unknown"
+
+
+def measured_gemm_peak(reps: int = 3, n: int = 1024, depth: int = 10) -> float:
+    """Measured f32 GEMM peak FLOP/s (best of `reps` timed chains of
+    `depth` n^3 matmuls) — the honest MFU denominator on platforms with
+    no datasheet number.  ~a second of work; the result is cached so
+    `mfu_peak()` can use it without ever re-measuring implicitly."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def chain(a, b):
+        for _ in range(depth):
+            a = a @ b
+        return a
+
+    key = jax.random.PRNGKey(0)
+    a = jax.random.normal(key, (n, n), jnp.float32)
+    b = jax.random.normal(key, (n, n), jnp.float32)
+    jax.block_until_ready(chain(a, b))  # compile outside the timing
+    best = float("inf")
+    for _ in range(max(1, reps)):
+        t0 = time.perf_counter()
+        jax.block_until_ready(chain(a, b))
+        best = min(best, time.perf_counter() - t0)
+    peak = depth * 2.0 * float(n) ** 3 / best
+    with _lock:
+        _measured["peak_flops"] = peak
+        _measured["measured_at"] = time.time()
+    return peak
+
+
+def mfu_peak(platform: str | None = None) -> dict:
+    """The MFU denominator + its provenance labels:
+    ``{"peak_flops", "mfu_peak_source", "flop_proxy"}``.
+
+    TPU platforms get the v5e bf16 datasheet peak; everywhere else the
+    cached ``measured_gemm_peak()`` result (``peak_flops`` is None until
+    someone has measured — never measured implicitly here) and
+    ``flop_proxy=True``, because off-TPU a FLOP/s figure divides XLA's
+    flop model by wall-clock rather than profiling the chip."""
+    p = platform if platform is not None else _platform()
+    if p in _TPU_PLATFORMS:
+        return {
+            "peak_flops": PEAK_FLOPS_V5E_BF16,
+            "mfu_peak_source": "v5e_bf16_datasheet",
+            "flop_proxy": False,
+        }
+    with _lock:
+        peak = _measured.get("peak_flops")
+    return {
+        "peak_flops": peak,
+        "mfu_peak_source": "measured_f32_gemm" if peak else "unmeasured",
+        "flop_proxy": True,
+    }
+
+
+# ---------------------------------------------------------------------------
+# runtime snapshots
+# ---------------------------------------------------------------------------
+
+
+def _totals(counts: dict) -> dict:
+    """Cumulative device work implied by a `compile.counters()`-shaped
+    dict: per-kernel per-call cost x that kernel's run count."""
+    per_kernel = {}
+    flops = byts = run_s = 0.0
+    runs = 0
+    with _lock:
+        kern = {
+            reg: (k.get("flops_per_call", 0.0), k.get("bytes_per_call", 0.0),
+                  len(k["buckets"]))
+            for reg, k in _kernels.items()
+        }
+    for reg, (f_pc, b_pc, n_buckets) in kern.items():
+        c = counts.get(reg)
+        if not c or not c.get("runs"):
+            continue
+        n = int(c["runs"])
+        kf, kb, ks = f_pc * n, b_pc * n, float(c.get("run_s", 0.0))
+        per_kernel[reg] = {
+            "runs": n, "flops": kf, "bytes": kb, "run_s": round(ks, 6),
+            "buckets": n_buckets,
+        }
+        flops += kf
+        byts += kb
+        run_s += ks
+        runs += n
+    return {
+        "flops_total": flops, "bytes_total": byts,
+        "run_s_total": round(run_s, 6), "runs_total": runs,
+        "per_kernel": per_kernel,
+    }
+
+
+def _derived(t: dict) -> dict:
+    out = dict(t)
+    if t["bytes_total"] > 0:
+        out["intensity_flops_per_byte"] = round(
+            t["flops_total"] / t["bytes_total"], 3
+        )
+    if t["run_s_total"] > 0 and t["flops_total"] > 0:
+        out["flops_per_sec"] = t["flops_total"] / t["run_s_total"]
+    peak = mfu_peak()
+    out["mfu_peak_source"] = peak["mfu_peak_source"]
+    out["flop_proxy"] = peak["flop_proxy"]
+    if peak["peak_flops"] and out.get("flops_per_sec"):
+        out["mfu_pct"] = round(
+            100.0 * out["flops_per_sec"] / peak["peak_flops"], 4
+        )
+    return out
+
+
+def ledger_snapshot() -> dict:
+    """Cumulative roofline snapshot: the kernel ledger multiplied by the
+    live invocation counters, with derived intensity / achieved FLOP/s /
+    MFU (labeled), plus the comm registry."""
+    from .compile import counters
+
+    snap = _derived(_totals(counters()))
+    snap["comm"] = comm_summary()
+    return snap
+
+
+def run_fields(counters_delta: dict, wall_s: float | None = None) -> dict:
+    """Roofline fields for ONE run from its RunRecord `counters_delta`
+    — device FLOPs/bytes this run dispatched, intensity, achieved
+    FLOP/s over the measured in-run device seconds (falling back to
+    `wall_s` when the run used kernels outside `aot_call` timing), and
+    labeled MFU.  Empty dict when no ledgered kernel ran."""
+    t = _totals(counters_delta)
+    if not t["per_kernel"]:
+        return {}
+    if t["run_s_total"] <= 0 and wall_s and wall_s > 0:
+        t["run_s_total"] = round(float(wall_s), 6)
+        t["run_s_source"] = "wall"
+    out = _derived(t)
+    out.pop("per_kernel", None)
+    return out
+
+
+def publish_gauges() -> dict:
+    """Push the cumulative ledger into the telemetry gauge registry
+    (flows into ``export_openmetrics`` / ``dump_metrics`` /
+    ``emit_metrics`` untouched) and return the snapshot.  Inline-labeled
+    comm gauges ride the existing ``name{k="v"}`` convention."""
+    from . import telemetry as T
+
+    snap = ledger_snapshot()
+    T.gauge_set("roofline.device_flops_total", snap["flops_total"])
+    T.gauge_set("roofline.device_bytes_total", snap["bytes_total"])
+    T.gauge_set("roofline.device_run_s_total", snap["run_s_total"])
+    if "intensity_flops_per_byte" in snap:
+        T.gauge_set(
+            "roofline.intensity_flops_per_byte",
+            snap["intensity_flops_per_byte"],
+        )
+    if "flops_per_sec" in snap:
+        T.gauge_set("roofline.flops_per_sec", snap["flops_per_sec"])
+    if "mfu_pct" in snap:
+        T.gauge_set("roofline.mfu_pct", snap["mfu_pct"])
+    T.gauge_set(
+        "roofline.flop_proxy", 1.0 if snap["flop_proxy"] else 0.0
+    )
+    for ax, a in snap["comm"]["per_axis"].items():
+        T.gauge_set(
+            f'comm.bytes_per_call{{axis="{ax}"}}', a["bytes_per_call"]
+        )
+        T.gauge_set(
+            f'comm.link_bytes_per_call{{axis="{ax}"}}',
+            a["link_bytes_per_call"],
+        )
+    return snap
+
+
+def reset() -> None:
+    """Clear the kernel + comm registries (tests).  The measured-GEMM
+    peak cache survives — it is a property of the machine, not the
+    workload."""
+    with _lock:
+        _kernels.clear()
+        _collectives.clear()

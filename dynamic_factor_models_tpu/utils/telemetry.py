@@ -622,6 +622,12 @@ def dump_metrics(path: str) -> None:
     tmp = f"{path}.tmp.{os.getpid()}"
     with open(tmp, "w") as f:
         json.dump(data, f, indent=1)
+        # durability, not just atomicity: os.replace alone leaves the
+        # rename pointing at unflushed pages — fsync before the swap
+        # (the utils/checkpoint.py crash-safety pattern) so a crash
+        # mid-dump can't surface a truncated or empty export
+        f.flush()
+        os.fsync(f.fileno())
     os.replace(tmp, path)
 
 
@@ -898,6 +904,18 @@ class RunRecord:
         except Exception:
             d.setdefault("donate", False)
         d["memory"] = device_memory_stats()
+        # roofline fields (PR 17): device FLOPs/bytes this run dispatched
+        # — the ledger's static per-call costs x this run's counter
+        # deltas; absent when no ledgered kernel ran (summarize renders
+        # "-", the standing mixed-vintage contract)
+        try:
+            from .roofline import run_fields
+
+            rf = run_fields(d.get("counters_delta") or {}, wall)
+            if rf:
+                d["roofline"] = rf
+        except Exception:
+            pass
         if exc_type is not None:
             d["error"] = f"{exc_type.__name__}: {exc}"
         _emit(d)
@@ -1082,13 +1100,35 @@ def _count_str(n: int) -> str:
     return str(n)
 
 
+def _roofline_cols(rec: dict) -> tuple[str, str]:
+    """Per-run GFLOP / MFU%% columns from the PR 17 roofline stamp;
+    "-" for records written before the ledger existed (the standing
+    mixed-vintage fallback contract) or runs that used no ledgered
+    kernel."""
+    rf = rec.get("roofline") or {}
+    fl = rf.get("flops_total")
+    g = _gflop_str(fl) if isinstance(fl, (int, float)) and fl > 0 else "-"
+    m = rf.get("mfu_pct")
+    return g, f"{m:.2f}" if isinstance(m, (int, float)) else "-"
+
+
+def _gflop_str(flops: float) -> str:
+    """GFLOP column: fixed-point at real-workload scale, scientific for
+    the tiny CI panels (0.00 would hide them)."""
+    g = flops / 1e9
+    return f"{g:.2f}" if g >= 0.01 else f"{g:.2g}"
+
+
 def summarize(path: str, entry: str | None = None) -> str:
     """Per-run and per-entry aggregate tables of a RunRecord JSONL file,
     plus (when the file carries ``entry="hist"`` snapshot lines) a
     per-request-kind latency table sourced from the HDR histograms.
     Files written before the histogram layer simply lack the extra
-    table and show "-" in the aggregate p50/p99 columns."""
-    recs = _load_jsonl(path)
+    table and show "-" in the aggregate p50/p99 columns.  A rotated
+    predecessor (``<path>.1``, written by the size-capped sink) is read
+    first so one invocation covers the whole retained window."""
+    recs = _load_jsonl(path + ".1") if os.path.exists(path + ".1") else []
+    recs += _load_jsonl(path)
     hists = _latest_hists(recs)
     n_traces = sum(1 for r in recs if r.get("entry") == "trace")
     # metrics snapshots are cumulative: the last line per file wins;
@@ -1136,6 +1176,7 @@ def summarize(path: str, entry: str | None = None) -> str:
                     if isinstance(v, (int, float)) and v:
                         it = f"{int(v)}{suffix}"
                         break
+        gflop, mfu = _roofline_cols(r)
         rows.append([
             ts,
             str(r.get("entry", "?")),
@@ -1149,13 +1190,16 @@ def summarize(path: str, entry: str | None = None) -> str:
             f"{ll:.5g}" if isinstance(ll, (int, float)) else "-",
             f"{r.get('wall_s') or 0.0:.3f}",
             _mem_mb(r),
+            gflop,
+            mfu,
             f"{h}/{m}",
             _health_str(r),
             "ERR" if r.get("error") else "",
         ])
     per_run = _fmt_table(
         ["time", "entry", "kind", "plat", "dev", "shape", "N", "iters",
-         "conv", "loglik", "wall_s", "peak_MB", "aot h/m", "faults", ""],
+         "conv", "loglik", "wall_s", "peak_MB", "GFLOP", "MFU%",
+         "aot h/m", "faults", ""],
         rows,
     )
 
@@ -1166,7 +1210,12 @@ def summarize(path: str, entry: str | None = None) -> str:
             "conv": 0, "compile_s": 0.0, "hits": 0, "misses": 0,
             "faults": 0, "recovered": 0, "unhealthy": 0,
             "outcomes": 0, "answered": 0, "ess_min": None,
+            "gflops": 0.0, "roofline_runs": 0,
         })
+        rf = r.get("roofline") or {}
+        if isinstance(rf.get("flops_total"), (int, float)):
+            a["gflops"] += rf["flops_total"] / 1e9
+            a["roofline_runs"] += 1
         a["runs"] += 1
         a["errors"] += 1 if r.get("error") else 0
         # availability: serving envelopes stamp `outcome` per request —
@@ -1231,6 +1280,23 @@ def summarize(path: str, entry: str | None = None) -> str:
             str(int(c.get("serving.fault_ins", 0))),
         )
 
+    # occupancy column (PR 17): the serving row shows the last metrics
+    # snapshot's phase-seconds split — dispatch/journal/commit/envelope
+    # as percentages of accounted time; other entries, and sinks written
+    # before the occupancy gauges existed, show "-"
+    def _occ_col(e):
+        if metrics is None or e != "serving":
+            return "-"
+        g = metrics.get("gauges") or {}
+        vals = [
+            float(g.get(f"serving.occupancy.{p}_s") or 0.0)
+            for p in ("dispatch", "journal", "commit", "envelope")
+        ]
+        tot = sum(vals)
+        if tot <= 0:
+            return "-"
+        return "/".join(f"{100.0 * v / tot:.0f}" for v in vals)
+
     arows = []
     for e, a in sorted(agg.items()):
         p50, p99 = _lat(e)
@@ -1255,13 +1321,16 @@ def summarize(path: str, entry: str | None = None) -> str:
             res,
             evd,
             fin,
+            (_gflop_str(a["gflops"] * 1e9) if a["roofline_runs"] else "-"),
+            _occ_col(e),
             p50,
             p99,
         ])
     aggregate = _fmt_table(
         ["entry", "runs", "err", "wall_s", "mean_s", "mean_iters",
          "conv%", "compile_s", "aot h/m", "faults", "ess_min", "avail",
-         "resident", "evict", "fault_in", "p50_ms", "p99_ms"],
+         "resident", "evict", "fault_in", "GFLOP", "occ d/j/c/e",
+         "p50_ms", "p99_ms"],
         arows,
     )
     out = (

@@ -1,0 +1,82 @@
+"""Bench-record honesty labels (ROADMAP item 5 via PR 17):
+`tools/check_bench_honesty.py` audits every committed
+``docs/BENCH_*.json`` for the `flop_proxy` / `mfu_peak_source`
+provenance labels — off-TPU FLOP/s figures divide a flop *model* by
+wall-clock, and an MFU% is meaningless without naming its peak."""
+
+import glob
+import importlib.util
+import os
+
+import pytest
+
+pytestmark = pytest.mark.obs
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _checker():
+    spec = importlib.util.spec_from_file_location(
+        "check_bench_honesty",
+        os.path.join(_REPO, "tools", "check_bench_honesty.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_committed_bench_records_are_labeled(capsys):
+    chk = _checker()
+    paths = sorted(
+        glob.glob(os.path.join(_REPO, "docs", "BENCH_*.json"))
+    )
+    assert paths, "no committed BENCH records found"
+    assert chk.main(paths) == 0, capsys.readouterr().out
+
+
+def test_default_glob_finds_committed_records():
+    assert _checker().main([]) == 0
+
+
+def test_unlabeled_flop_value_is_a_violation():
+    chk = _checker()
+    bad = chk.audit_obj({"gram_flops_per_sec": 1.0e12})
+    assert bad and "flop_proxy" in bad[0][1]
+    bad = chk.audit_obj({"als_mfu_pct": 3.2})
+    assert bad and "mfu_peak_source" in bad[0][1]
+
+
+def test_ancestor_scope_labels_nested_fragments():
+    chk = _checker()
+    rec = {
+        "flop_proxy": True,
+        "mfu_peak_source": "measured_f32_gemm",
+        "legs": [
+            {"gram_flops_per_sec": 1.0e12, "als_mfu_pct": 3.2},
+            {"nested": {"flop_reduction_ratio": 12.0}},
+        ],
+    }
+    assert chk.audit_obj(rec) == []
+    # the labels themselves and the peak value are not flop VALUES
+    assert chk.audit_obj(
+        {"flop_proxy": True, "mfu_peak_source": "x",
+         "mfu_peak_flops": 1.97e14}
+    ) == []
+
+
+def test_sibling_scope_does_not_leak():
+    chk = _checker()
+    rec = {
+        "labeled": {"flop_proxy": True, "a_flops_measured": 1.0},
+        "unlabeled": {"b_flops_measured": 2.0},
+    }
+    bad = chk.audit_obj(rec)
+    assert len(bad) == 1 and bad[0][0] == "$.unlabeled"
+
+
+def test_unreadable_input_exits_2(tmp_path, capsys):
+    chk = _checker()
+    p = tmp_path / "BENCH_broken.json"
+    p.write_text("{not json")
+    assert chk.main([str(p)]) == 2
+    assert chk.main([str(tmp_path / "missing.json")]) == 2

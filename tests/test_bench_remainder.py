@@ -75,6 +75,15 @@ def bench(tmp_path, monkeypatch):
             "smoke": smoke, "flop_proxy": True
         },
     )
+    # the obs-overhead leg runs a real (small) EM estimate — stub it so
+    # the order test stays a plumbing test
+    monkeypatch.setattr(
+        b, "obs_overhead_section",
+        lambda smoke=True: calls.append("obs") or {
+            "obs_overhead_pct": 1.0, "flop_proxy": True,
+            "mfu_peak_source": "unmeasured",
+        },
+    )
 
     class _FakeDS:
         pass
@@ -90,7 +99,7 @@ def test_remainder_section_order_and_stores(bench, tmp_path, capsys):
     bench.run_tpu_remainder()
     assert bench._test_calls == [
         "pallas", "parity", "large", "refscale", "multichip", "composed",
-        "timeparallel", "multihost", "crossover"
+        "timeparallel", "multihost", "obs", "crossover"
     ]
     out = capsys.readouterr().out.strip().splitlines()[-1]
     final = json.loads(out)
@@ -100,6 +109,7 @@ def test_remainder_section_order_and_stores(bench, tmp_path, capsys):
     assert final["composed_smoke"]["smoke"] is True
     assert final["time_parallel_smoke"]["smoke"] is True
     assert final["multihost_smoke"]["smoke"] is True
+    assert final["obs_overhead"]["obs_overhead_pct"] == 1.0
     assert "crossover_markdown" in final
     # per-section persistence: the partial file holds the full accumulation
     partial = json.loads((tmp_path / "partial.json").read_text())

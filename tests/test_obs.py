@@ -1,0 +1,483 @@
+"""Compute-observability layer (PR 17): per-kernel roofline ledger,
+mesh comm accounting, serving occupancy attribution, and the fault-dump
+flight recorder.
+
+Acceptance pins:
+
+1. ledger cumulative FLOPs for a reference-scale `estimate_dfm_em`
+   match the direct `compiled.cost_analysis()` sum within 1%;
+2. ledger gauges flow into the OpenMetrics export and `summarize`
+   renders the GFLOP/MFU%/occupancy columns with "-" fallbacks for
+   pre-PR-17 (mixed-vintage) sink lines, including a rotated
+   ``<path>.1`` predecessor;
+3. the comm registry reproduces PR 15's hand-derived
+   ``dcn_payload_bytes_per_iter = 15360`` on the 2-process proxy
+   (T=256, q=4, f32) as a measured trace-time entry;
+4. ``DFM_FAULTS=nan_estep@3`` and a serving ``engine_crash@n`` drill
+   each produce a flight bundle (trigger event, preceding ring, kernel
+   ledger snapshot); a clean disabled-telemetry run allocates NO ring
+   and writes NO bundle.
+"""
+
+import glob
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dynamic_factor_models_tpu.models.dfm import DFMConfig
+from dynamic_factor_models_tpu.models.ssm import estimate_dfm_em
+from dynamic_factor_models_tpu.utils import compile as cc
+from dynamic_factor_models_tpu.utils import faults, flight, roofline, telemetry
+
+pytestmark = pytest.mark.obs
+
+
+@pytest.fixture(autouse=True)
+def _obs_clean():
+    """Telemetry/flight/ledger state is process-global: start and leave
+    every test clean so drills cannot bleed into other modules.
+    `_explicit_enabled` goes back to None (not the sticky False that
+    `disable()` sets) so later env-driven tests still see DFM_TELEMETRY."""
+    telemetry.disable()
+    flight.reset()
+    roofline.reset()
+    yield
+    telemetry.disable()
+    telemetry._explicit_enabled = None
+    flight.reset()
+    roofline.reset()
+
+
+def _panel(T, N, seed=0, dtype=float):
+    rng = np.random.default_rng(seed)
+    f = rng.standard_normal((T, 4)).cumsum(0) * 0.1
+    lam = rng.standard_normal((N, 4))
+    return (f @ lam.T + 0.5 * rng.standard_normal((T, N))).astype(dtype)
+
+
+# ---------------------------------------------------------------------------
+# 1. roofline ledger
+# ---------------------------------------------------------------------------
+
+
+def test_ledger_flops_match_cost_analysis_within_1pct():
+    """Acceptance pin 1: kernel ledger x run counters == the direct
+    cost_analysis sum over the executables the run dispatched."""
+    cc.reset_counters()
+    spec = cc.CompileSpec(
+        T=224, N=139, dtype=str(np.dtype(float)),
+        kernels=("em_loop_guarded",), max_em_iter=8,
+    )
+    cc.precompile(spec, warmup=False)
+    assert "em_loop_guarded" in roofline.kernel_ledger()
+    cc.reset_counters()  # run counts must come from the estimate only
+
+    T, N = 224, 139
+    x = _panel(T, N, seed=1)
+    cfg = DFMConfig(nfac_u=4, tol=1e-5, max_iter=300)
+    estimate_dfm_em(x, np.ones(N), 0, T - 1, cfg, max_em_iter=8,
+                    bucket=True)
+    counts = cc.counters()
+    assert counts["em_loop_guarded"]["runs"] >= 1
+    assert counts["em_loop_guarded"]["aot_hits"] >= 1
+
+    snap = roofline.ledger_snapshot()
+    assert snap["flops_total"] > 0 and snap["bytes_total"] > 0
+    direct = 0.0
+    for (reg, _statics, _sig), compiled in cc._AOT.items():
+        runs = counts.get(reg, {}).get("runs", 0)
+        if runs:
+            flops, _ = roofline.compiled_cost(compiled)
+            direct += (flops or 0.0) * runs
+    assert direct > 0
+    assert abs(snap["flops_total"] - direct) <= 0.01 * direct
+    # derived fields are present and provenance-labeled
+    assert snap["intensity_flops_per_byte"] > 0
+    assert snap["mfu_peak_source"] in (
+        "unmeasured", "measured_f32_gemm", "v5e_bf16_datasheet"
+    )
+    assert isinstance(snap["flop_proxy"], bool)
+
+
+def test_run_record_carries_roofline_fields(tmp_path):
+    """RunRecord exit stamps per-run roofline fields derived from its
+    own counters_delta (no extra device work)."""
+    cc.reset_counters()
+    spec = cc.CompileSpec(
+        T=64, N=12, r=2, p=1, dtype=str(np.dtype(float)), bucket=False,
+        kernels=("em_loop_guarded",), max_em_iter=6,
+    )
+    cc.precompile(spec, warmup=False)
+    sink = str(tmp_path / "t.jsonl")
+    telemetry.enable(sink=sink)
+    try:
+        x = _panel(64, 12, seed=2)
+        estimate_dfm_em(x, np.ones(12), 0, 63, DFMConfig(nfac_u=2),
+                        max_em_iter=6, tol=0.0, bucket=False)
+    finally:
+        telemetry.disable()
+    recs = [json.loads(l) for l in open(sink) if l.strip()]
+    run = [r for r in recs if r.get("entry") == "estimate_dfm_em"][-1]
+    rf = run["roofline"]
+    assert rf["flops_total"] > 0
+    assert rf["runs_total"] >= 1
+    assert "mfu_peak_source" in rf and "flop_proxy" in rf
+    # per_kernel is ledger detail, not per-run payload
+    assert "per_kernel" not in rf
+
+
+def test_run_fields_wall_fallback_and_empty():
+    class _Fake:
+        def cost_analysis(self):
+            return [{"flops": 100.0, "bytes accessed": 50.0}]
+
+    roofline.record_kernel("k1", "k1", _Fake())
+    out = roofline.run_fields(
+        {"k1": {"runs": 2, "run_s": 0.0}}, wall_s=0.5
+    )
+    assert out["flops_total"] == 200.0
+    assert out["run_s_total"] == 0.5 and out["run_s_source"] == "wall"
+    # no ledgered kernel ran -> no roofline stamp at all
+    assert roofline.run_fields({}, 1.0) == {}
+    assert roofline.run_fields({"other": {"runs": 3}}, 1.0) == {}
+
+
+def test_ledger_gauges_reach_openmetrics():
+    class _Fake:
+        def cost_analysis(self):
+            return [{"flops": 1.0e9, "bytes accessed": 2.0e8}]
+
+    roofline.record_kernel("em_loop_guarded", "em_loop_guarded", _Fake())
+    roofline.record_collective("site.a", "dcn", 15360, hops=1)
+    roofline.publish_gauges()
+    om = telemetry.export_openmetrics()
+    assert "roofline_device_flops_total" in om
+    assert "roofline_device_bytes_total" in om
+    assert "roofline_flop_proxy" in om
+    assert 'comm_bytes_per_call{axis="dcn"} 15360' in om
+
+
+# ---------------------------------------------------------------------------
+# 2. comm accounting
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.multidevice
+def test_comm_registry_pins_dcn_payload_15360():
+    """Acceptance pin 3: PR 15's hand-derived bench field
+    `dcn_payload_bytes_per_iter` (T=256, q=r=4: T x (q(q+1)/2 + 1 + q)
+    x 4B = 15360) becomes a measured comm-registry entry when the
+    hosts=2 sharded step traces."""
+    if jax.device_count() < 8:
+        pytest.skip("needs the forced 8-device platform")
+    from dynamic_factor_models_tpu.models import ssm
+    from dynamic_factor_models_tpu.ops.linalg import standardize_data
+    from dynamic_factor_models_tpu.ops.masking import fillz, mask_of
+
+    T, N, r = 256, 32, 4
+    x = _panel(T, N, seed=3, dtype=np.float32)
+    # the PR 15 pin is an f32 payload (x64 test mode would double it)
+    xstd = standardize_data(jnp.asarray(x))[0].astype(jnp.float32)
+    xz, m = fillz(xstd), mask_of(xstd).astype(xstd.dtype)
+    params = ssm.SSMParams(
+        lam=jnp.zeros((N, r), xz.dtype).at[:, 0].set(1.0),
+        R=jnp.ones(N, xz.dtype),
+        A=0.5 * jnp.eye(r, dtype=xz.dtype)[None],
+        Q=jnp.eye(r, dtype=xz.dtype),
+    )
+    stats = ssm.compute_panel_stats(xz, m)._replace(
+        tw=jnp.ones(T, xz.dtype)
+    )
+    ssm._sharded_step_for(8, hosts=2)(params, xz, m, stats)
+
+    comm = roofline.comm_summary()
+    assert comm["per_axis"]["dcn"]["bytes_per_call"] == 15360
+    dcn = [s for s in comm["sites"] if s["axis"] == "dcn"]
+    assert dcn and dcn[0]["collective"] == "psum"
+    assert dcn[0]["dtype"] == "float32"
+    # the ICI ring carries the same payload over n_ici - 1 = 3 hops
+    ici = [s for s in comm["sites"] if s["axis"] == "ici"]
+    assert ici and ici[0]["hops"] == 3
+    assert (
+        comm["per_axis"]["ici"]["link_bytes_per_call"] == 3 * 15360
+    )
+
+
+@pytest.mark.multidevice
+def test_mesh_topology_gauges_published():
+    if jax.device_count() < 8:
+        pytest.skip("needs the forced 8-device platform")
+    from dynamic_factor_models_tpu.parallel.mesh import data_mesh
+
+    data_mesh(8, hosts=2)
+    g = telemetry.snapshot()["gauges"]
+    assert g['mesh.axis_size{axis="dcn"}'] == 2
+    assert g['mesh.axis_size{axis="ici"}'] == 4
+    assert g["mesh.n_devices"] == 8
+
+
+@pytest.mark.multidevice
+@pytest.mark.timeparallel
+def test_timescan_boundary_collective_recorded():
+    """The slab-boundary ppermute ladder records its per-call boundary
+    bytes and its ceil(log2)+1 round count on the "time" axis."""
+    if jax.device_count() < 8:
+        pytest.skip("needs the forced 8-device platform")
+
+    T, N, r = 64, 12, 2
+    x = _panel(T, N, seed=4)
+    cfg = DFMConfig(nfac_u=r)
+    estimate_dfm_em(x, np.ones(N), 0, T - 1, cfg, max_em_iter=3,
+                    tol=0.0, t_blocks=4)
+    rows = [
+        s for s in roofline.comm_summary()["sites"]
+        if s["site"] == "timescan.block_scan_boundary"
+    ]
+    assert rows, roofline.comm_summary()["sites"]
+    row = rows[0]
+    assert row["axis"] == "time"
+    assert row["collective"] == "ppermute"
+    assert row["bytes_per_call"] > 0
+    # n_blocks=4 -> 1 + bit_length(3) = 3 exchange rounds
+    assert row["hops"] == 3
+
+
+# ---------------------------------------------------------------------------
+# 3. flight recorder drills
+# ---------------------------------------------------------------------------
+
+
+def _flight_files(d):
+    return sorted(glob.glob(os.path.join(str(d), "flight-*.json")))
+
+
+def test_flight_dump_on_guard_trip_drill(tmp_path, monkeypatch):
+    """Acceptance pin 4a: DFM_FAULTS=nan_estep@3 under an enabled sink
+    produces ONE bundle carrying the trigger, the preceding ring (with
+    the injection breadcrumb), and the kernel-ledger snapshot."""
+    fdir = tmp_path / "flight"
+    monkeypatch.setenv("DFM_FLIGHT_DIR", str(fdir))
+    telemetry.enable(sink=str(tmp_path / "t.jsonl"))
+    try:
+        x = _panel(64, 12, seed=5)
+        with faults.inject("nan_estep@3"):
+            estimate_dfm_em(x, np.ones(12), 0, 63, DFMConfig(nfac_u=2),
+                            max_em_iter=10, tol=0.0)
+    finally:
+        telemetry.disable()
+    files = _flight_files(fdir)
+    assert len(files) == 1, files
+    assert "guard_trip" in os.path.basename(files[0])
+    bundle = json.load(open(files[0]))
+    assert bundle["trigger"]["trigger"] == "guard_trip"
+    kinds = [e["kind"] for e in bundle["ring"]]
+    assert "fault_injected" in kinds  # the injection preceded the trip
+    assert "em_guard.trip" in kinds
+    assert "kernel_ledger" in bundle and "counters" in bundle
+    assert bundle["counters"].get("faults_injected", 0) >= 1
+    assert flight.last_dump_path() == files[0]
+
+
+def test_flight_dump_on_engine_crash_drill(tmp_path, monkeypatch):
+    """Acceptance pin 4b: the serving engine_crash@n kill dumps a
+    bundle (forced — a kill must never be throttled away)."""
+    from dynamic_factor_models_tpu.serving.engine import ServingEngine
+    from dynamic_factor_models_tpu.serving.resilience import RetryPolicy
+
+    fdir = tmp_path / "flight"
+    monkeypatch.setenv("DFM_FLIGHT_DIR", str(fdir))
+    telemetry.enable(sink=str(tmp_path / "t.jsonl"))
+    try:
+        rng = np.random.default_rng(6)
+        eng = ServingEngine(
+            retry_policy=RetryPolicy(max_retries=2, backoff_base_s=0.0),
+            max_em_iter=5,
+        )
+        eng.register("a", _panel(48, 6, seed=6))
+        with faults.inject("engine_crash@2"), \
+                pytest.raises(faults.SimulatedCrash):
+            for _ in range(3):
+                eng.handle(
+                    {"kind": "tick", "tenant": "a",
+                     "x": rng.standard_normal(6)}
+                )
+    finally:
+        telemetry.disable()
+    files = _flight_files(fdir)
+    assert len(files) == 1 and "engine_crash" in os.path.basename(files[0])
+    bundle = json.load(open(files[0]))
+    assert bundle["trigger"]["trigger"] == "engine_crash"
+    assert bundle["trigger"]["reqno"] == 2
+    assert "fault_injected" in [e["kind"] for e in bundle["ring"]]
+
+
+def test_clean_disabled_run_allocates_no_ring_and_no_dump(
+    tmp_path, monkeypatch
+):
+    """Acceptance pin 4c: with telemetry disabled the clean path makes
+    ZERO flight allocations and writes nothing."""
+    fdir = tmp_path / "flight"
+    monkeypatch.setenv("DFM_FLIGHT_DIR", str(fdir))
+    x = _panel(64, 12, seed=7)
+    estimate_dfm_em(x, np.ones(12), 0, 63, DFMConfig(nfac_u=2),
+                    max_em_iter=4, tol=0.0)
+    assert flight._ring is None
+    assert not flight.armed()
+    assert _flight_files(fdir) == []
+    # even an explicit fault drill stays silent while disabled
+    with faults.inject("nan_estep@2"):
+        estimate_dfm_em(x, np.ones(12), 0, 63, DFMConfig(nfac_u=2),
+                        max_em_iter=4, tol=0.0)
+    assert flight._ring is None and _flight_files(fdir) == []
+
+
+def test_flight_dump_throttled_unless_forced(tmp_path, monkeypatch):
+    fdir = tmp_path / "flight"
+    monkeypatch.setenv("DFM_FLIGHT_DIR", str(fdir))
+    telemetry.enable(sink=str(tmp_path / "t.jsonl"))
+    try:
+        flight.record("ev1", severity="info")
+        p1 = flight.dump("first")
+        assert p1 and os.path.exists(p1)
+        # inside the 5s window: skipped...
+        assert flight.dump("second") is None
+        # ...unless forced
+        p3 = flight.dump("third", force=True)
+        assert p3 and p3 != p1
+    finally:
+        telemetry.disable()
+
+
+# ---------------------------------------------------------------------------
+# 4. serving occupancy
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.serving
+def test_serving_occupancy_gauges_and_phase_hists(tmp_path):
+    from dynamic_factor_models_tpu.serving.engine import ServingEngine
+    from dynamic_factor_models_tpu.serving.resilience import RetryPolicy
+
+    telemetry.enable(sink=str(tmp_path / "t.jsonl"))
+    try:
+        rng = np.random.default_rng(8)
+        eng = ServingEngine(
+            retry_policy=RetryPolicy(max_retries=2, backoff_base_s=0.0),
+            max_em_iter=5,
+        )
+        eng.register("a", _panel(48, 6, seed=8))
+        for _ in range(5):
+            assert eng.handle(
+                {"kind": "tick", "tenant": "a",
+                 "x": rng.standard_normal(6)}
+            ).ok
+        eng.flush_metrics()
+        g = telemetry.snapshot()["gauges"]
+        assert g.get("serving.occupancy.dispatch_s", 0) > 0
+        assert g.get("serving.occupancy.commit_s", 0) > 0
+        assert g.get("serving.occupancy.envelope_s", 0) > 0
+        om = telemetry.export_openmetrics()
+        assert 'phase="dispatch"' in om
+        assert "serving_phase_latency_seconds" in om
+    finally:
+        telemetry.disable()
+
+
+@pytest.mark.serving
+def test_serving_occupancy_off_when_disabled():
+    from dynamic_factor_models_tpu.serving.engine import ServingEngine
+    from dynamic_factor_models_tpu.serving.resilience import RetryPolicy
+
+    rng = np.random.default_rng(9)
+    eng = ServingEngine(
+        retry_policy=RetryPolicy(max_retries=2, backoff_base_s=0.0),
+        max_em_iter=5,
+    )
+    eng.register("a", _panel(48, 6, seed=9))
+    for _ in range(3):
+        assert eng.handle(
+            {"kind": "tick", "tenant": "a", "x": rng.standard_normal(6)}
+        ).ok
+    assert eng._occ_s == {}  # the disabled path never touches a timer
+    assert eng._phase_hists == {}
+
+
+# ---------------------------------------------------------------------------
+# 5. summarize: mixed vintage + rotation
+# ---------------------------------------------------------------------------
+
+
+_OLD_LINE = {
+    "run_id": "old1", "entry": "estimate_dfm_em", "time_unix": 1.0,
+    "n_iter": 5, "wall_s": 0.5, "counters_delta": {},
+}
+_NEW_LINE = {
+    "run_id": "new1", "entry": "estimate_dfm_em", "time_unix": 2.0,
+    "n_iter": 5, "wall_s": 0.5, "counters_delta": {},
+    "roofline": {
+        "flops_total": 5.0e9, "bytes_total": 1.0e9, "runs_total": 1,
+        "run_s_total": 0.4, "mfu_pct": 12.34,
+        "mfu_peak_source": "measured_f32_gemm", "flop_proxy": True,
+    },
+}
+
+
+def test_summarize_mixed_vintage_roofline_columns(tmp_path):
+    sink = str(tmp_path / "t.jsonl")
+    with open(sink, "w") as f:
+        f.write(json.dumps(_OLD_LINE) + "\n")
+        f.write(json.dumps(_NEW_LINE) + "\n")
+    out = telemetry.summarize(sink)
+    assert "GFLOP" in out and "MFU%" in out
+    rows = [
+        l for l in out.splitlines()
+        if "estimate_dfm_em" in l and not l.startswith("estimate")
+    ]
+    assert len(rows) == 2
+    new_row = [l for l in rows if "12.34" in l][0]
+    old_row = [l for l in rows if "12.34" not in l][0]
+    assert "5.00" in new_row
+    # pre-PR-17 line: the new columns degrade to "-", nothing crashes
+    assert "5.00" not in old_row and " - " in old_row
+
+
+def test_summarize_occupancy_column_and_rotated_sink(tmp_path):
+    sink = str(tmp_path / "t.jsonl")
+    # rotated predecessor: one pre-PR-17 run
+    with open(sink + ".1", "w") as f:
+        f.write(json.dumps(_OLD_LINE) + "\n")
+    serving_line = {
+        "run_id": "s1", "entry": "serving", "time_unix": 3.0,
+        "wall_s": 0.01, "kind": "tick", "outcome": "ok",
+    }
+    metrics_line = {
+        "entry": "metrics", "time_unix": 4.0, "counters": {},
+        "gauges": {
+            "serving.occupancy.dispatch_s": 0.6,
+            "serving.occupancy.journal_s": 0.2,
+            "serving.occupancy.commit_s": 0.1,
+            "serving.occupancy.envelope_s": 0.1,
+        },
+    }
+    with open(sink, "w") as f:
+        f.write(json.dumps(serving_line) + "\n")
+        f.write(json.dumps(metrics_line) + "\n")
+    out = telemetry.summarize(sink)
+    # both files were read: the rotated old run + the live serving run
+    assert "2 record(s)" in out
+    assert "occ d/j/c/e" in out
+    srow = [
+        l for l in out.splitlines()
+        if l.startswith("serving") and "60/20/10/10" in l
+    ]
+    assert srow, out
+    # the old entry's aggregate row renders "-" in the occupancy column
+    erow = [
+        l for l in out.splitlines() if l.startswith("estimate_dfm_em")
+    ]
+    assert erow and "60/20/10/10" not in erow[0]

@@ -410,7 +410,10 @@ def test_engine_populates_histograms_and_slos(sink):
     assert s["n_fast"] == 21 and s["green"]
 
     n_lines = eng.flush_metrics()
-    assert n_lines == len(by_key)
+    # one snapshot line per non-empty histogram: the request-kind
+    # latency series plus the PR 17 per-phase occupancy histograms
+    assert n_lines == sum(1 for _, _, h in T.histograms() if h.n)
+    assert n_lines > len(by_key)  # the phase histograms are in there
     hist_recs = [r for r in _recs(sink) if r["entry"] == "hist"]
     assert len(hist_recs) == n_lines
     assert T.snapshot()["gauges"]["slo.tick_avail.green"] == 1.0

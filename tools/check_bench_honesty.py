@@ -1,0 +1,112 @@
+#!/usr/bin/env python
+"""Audit docs/BENCH_*.json perf records for MFU/FLOP provenance labels.
+
+ROADMAP item 5's honesty contract: a FLOP/s figure computed off-TPU
+divides a FLOPs *model* by wall-clock (a proxy, not a hardware counter),
+and an MFU percentage is meaningless without naming the peak it is
+normalized by.  Every benchmark record that carries flop-derived values
+must therefore say so explicitly:
+
+* any JSON object with a flop-derived value key (``*_flops_per_sec``,
+  ``*_flops_measured``, ``*_flop_reduction_*``, ``flop_partition_*``,
+  ...) must carry ``flop_proxy`` in SELF-OR-ANCESTOR scope — a record
+  may label once at the root for all of its nested fragments
+  (BENCH_time_parallel.json does);
+* any object with an MFU value key (``*_mfu_*``) must carry
+  ``mfu_peak_source`` in self-or-ancestor scope.
+
+Run with no arguments from anywhere in the repo (globs docs/BENCH_*.json
+next to this file's parent), or pass explicit paths.  Exit 0 clean,
+1 on violations, 2 on unreadable input.  tests/test_bench_honesty.py
+runs this over the committed records in tier-1.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+
+__all__ = ["audit_obj", "audit_file", "main"]
+
+_LABELS = ("flop_proxy", "mfu_peak_source")
+
+
+def _is_flop_value_key(key: str) -> bool:
+    k = key.lower()
+    if k in _LABELS or k == "mfu_peak_flops":
+        return False
+    return "flops" in k or "flop_" in k
+
+
+def _is_mfu_value_key(key: str) -> bool:
+    k = key.lower()
+    return "mfu" in k and k != "mfu_peak_source"
+
+
+def audit_obj(obj, path: str = "$", scope: frozenset = frozenset()) -> list:
+    """Violations in one parsed JSON value: ``(json_path, message)``
+    rows.  `scope` carries the label keys visible from ancestors."""
+    out = []
+    if isinstance(obj, dict):
+        here = scope | {lbl for lbl in _LABELS if lbl in obj}
+        flop_keys = sorted(k for k in obj if _is_flop_value_key(k))
+        mfu_keys = sorted(k for k in obj if _is_mfu_value_key(k))
+        if flop_keys and "flop_proxy" not in here:
+            out.append((
+                path,
+                "flop-derived fields %s lack a flop_proxy label in "
+                "self-or-ancestor scope" % flop_keys,
+            ))
+        if mfu_keys and "mfu_peak_source" not in here:
+            out.append((
+                path,
+                "MFU fields %s lack an mfu_peak_source label in "
+                "self-or-ancestor scope" % mfu_keys,
+            ))
+        for k, v in obj.items():
+            out.extend(audit_obj(v, f"{path}.{k}", here))
+    elif isinstance(obj, list):
+        for i, v in enumerate(obj):
+            out.extend(audit_obj(v, f"{path}[{i}]", scope))
+    return out
+
+
+def audit_file(path: str) -> list:
+    with open(path) as fh:
+        return audit_obj(json.load(fh))
+
+
+def main(argv=None) -> int:
+    args = list(sys.argv[1:] if argv is None else argv)
+    if not args:
+        docs = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "docs",
+        )
+        args = sorted(glob.glob(os.path.join(docs, "BENCH_*.json")))
+    if not args:
+        print("check_bench_honesty: no BENCH_*.json records found",
+              file=sys.stderr)
+        return 2
+    bad = 0
+    for path in args:
+        try:
+            violations = audit_file(path)
+        except (OSError, ValueError) as e:
+            print(f"check_bench_honesty: cannot read {path}: {e}",
+                  file=sys.stderr)
+            return 2
+        for where, msg in violations:
+            print(f"{path}: {where}: {msg}")
+            bad += 1
+    if bad:
+        print(f"check_bench_honesty: {bad} violation(s)", file=sys.stderr)
+        return 1
+    print(f"check_bench_honesty: {len(args)} record(s) clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
