@@ -1101,6 +1101,24 @@ def load_section(smoke: bool = False):
     (HDR histogram + a fault_in SLO), and whole-process `recover()`
     timing, all nested under ``eviction`` in docs/BENCH_load.json.
 
+    The PIPELINE leg (async pipelined serving) adds three fields:
+
+    - load_pipeline_vs_sequential_x: store-backed tick throughput with
+      `ServingPipeline` (thread backstage, round-coalesced fsync
+      overlapping the next round's admit+dispatch) over the sequential
+      per-request handle() path at saturation (bar: >= 3);
+    - load_pipeline_slo_green_at_seq_capacity: the tick SLO judged
+      open-loop with the pipeline offered the OFF path's measured
+      capacity rate (bar: true — overlap must not trade latency at the
+      previous capacity point);
+    - load_sharded_m2_x: `TenantRouter` OS-process workers, M=2 over
+      M=1 on identical traffic (bar: >= 1.7 on multi-core; a
+      single-core container reports the honest ratio).
+
+    Stage-occupancy splits for pipeline-off (batched flush_period) vs
+    pipeline-on runs, plus the sharded rows and a `flop_proxy` label on
+    CPU, nest under ``pipeline`` in docs/BENCH_load.json.
+
     Persists docs/BENCH_load.json; prints one JSON line and returns the
     headline dict.
     """
@@ -1113,6 +1131,9 @@ def load_section(smoke: bool = False):
         "load_envelope_overhead_frac": None,
         "load_eviction_resident_frac": None,
         "load_eviction_batched_vs_sequential_x": None,
+        "load_pipeline_vs_sequential_x": None,
+        "load_pipeline_slo_green_at_seq_capacity": None,
+        "load_sharded_m2_x": None,
     }
     out = {"smoke": bool(smoke)}
     try:
@@ -1376,6 +1397,109 @@ def load_section(smoke: bool = False):
         finally:
             shutil.rmtree(ev_dir, ignore_errors=True)
 
+        # -- pipeline on/off A/B leg (async pipelined serving) ----------
+        # Runs in a CHILD process (the same idiom as --multihost /
+        # --composed): the legs above leave up-to-100k-tenant object
+        # graphs and a large program cache behind, which drags the
+        # allocation-heavy pipelined path and would understate the A/B.
+        # The child (`--run-pipeline-ab`) measures sequential handle()
+        # vs `ServingPipeline` at saturation, captures the before/after
+        # occupancy splits, and judges the tick SLO with the pipeline
+        # offered the sequential path's capacity rate.
+        from dynamic_factor_models_tpu.serving.router import (
+            TenantRouter,
+            worker_of,
+        )
+
+        pipe_lanes = 64
+        ab_args = ["--run-pipeline-ab"] + (["--smoke"] if smoke else [])
+        frag = _parse_fragment(
+            _run_child(ab_args, timeout_s=600 if smoke else 1800)
+        )
+        if frag is None:
+            out["pipeline"] = {
+                "error": "pipeline-ab child produced no JSON"
+            }
+        else:
+            fields["load_pipeline_vs_sequential_x"] = round(
+                frag["pipelined_rps"] / frag["sequential_rps"], 3
+            )
+            fields["load_pipeline_slo_green_at_seq_capacity"] = bool(
+                frag["slo_at_seq_capacity"]["green"]
+            )
+            out["pipeline"] = {
+                "flop_proxy": not _is_tpu_platform(
+                    jax.devices()[0].platform
+                ),
+                **frag,
+            }
+
+        pipe_dir = tempfile.mkdtemp(prefix="dfm-bench-pipe-")
+        try:
+            # -- tenant-sharded workers: M=1 vs M=2 OS processes --------
+            # spawn workers re-import jax, so this is the slow part of
+            # the leg; on a single-core container the M=2 ratio is an
+            # honest CPU proxy (reported, labeled, not inflated)
+            n_sh = 64 if smoke else 256      # sharded-leg tenants
+            n_sr = 256 if smoke else 2048    # sharded-leg requests
+            sh_rows = {}
+            for m in (1, 2):
+                with TenantRouter(
+                    m, store_dir=os.path.join(pipe_dir, f"m{m}"),
+                    backend="process", pipelined=True,
+                    engine_kwargs={"max_em_iter": 5},
+                    pipeline_kwargs={"backstage": "thread",
+                                     "max_round_lanes": pipe_lanes},
+                ) as rt:
+                    rt.register_seed("s0", panel)
+                    for i in range(1, n_sh):
+                        rt.register_shared(f"s{i}", "s0")
+                    rs2 = np.random.default_rng(41)
+                    # route-aware bucket warm: for every worker and
+                    # every lane bucket it can form (rounds hold
+                    # DISTINCT tenants, so max round size = owned
+                    # count), send exactly b owned tenants so the
+                    # bucket-b executable compiles before the timed
+                    # region — a cold bucket mid-measurement costs an
+                    # XLA compile and swings the ratio 3-4x
+                    owned = {
+                        w: [t for t in range(n_sh)
+                            if worker_of(f"s{t}", m) == w]
+                        for w in range(m)
+                    }
+                    b = 1
+                    while b <= pipe_lanes:
+                        rt.submit([
+                            {"kind": "tick", "tenant": f"s{t}",
+                             "x": rs2.standard_normal(N)}
+                            for w in range(m)
+                            for t in owned[w][:b]
+                        ])
+                        rt.flush_all()
+                        b *= 2
+                    reqs = [
+                        {"kind": "tick", "tenant": f"s{j}",
+                         "x": rs2.standard_normal(N)}
+                        for j in rs2.integers(0, n_sh, size=n_sr)
+                    ]
+                    t0 = time.perf_counter()
+                    for i in range(0, n_sr, pipe_lanes * m):
+                        rt.submit(reqs[i:i + pipe_lanes * m])
+                        rt.flush_all()
+                    sh_rows[m] = n_sr / (time.perf_counter() - t0)
+            fields["load_sharded_m2_x"] = round(
+                sh_rows[2] / sh_rows[1], 3
+            )
+            out["pipeline"]["sharded"] = {
+                "cpu_count": os.cpu_count(),
+                "n_tenants": n_sh,
+                "n_requests": n_sr,
+                "m1_rps": round(sh_rows[1], 1),
+                "m2_rps": round(sh_rows[2], 1),
+            }
+        finally:
+            shutil.rmtree(pipe_dir, ignore_errors=True)
+
         fields["load_scales"] = [s["n_tenants"] for s in scale_rows]
         fields["load_slo_green_at_low_load"] = bool(green_low)
         fields["load_envelope_us"] = round(1e6 * wall_env / n_bench, 1)
@@ -1397,6 +1521,210 @@ def load_section(smoke: bool = False):
         fields["load_error"] = f"{type(e).__name__}: {e}"
     print(json.dumps(fields), flush=True)
     return fields
+
+
+def run_pipeline_ab(smoke: bool = False):
+    """Child leg for the pipeline on/off A/B (``--run-pipeline-ab``).
+
+    Runs in its own fresh interpreter (spawned by load_section through
+    `_run_child`) so the measurement is not dragged by the 100k-tenant
+    object graphs and program caches the earlier load legs leave in the
+    parent.  Tick-only store-backed traffic at saturation: OFF is the
+    per-request handle() path (one journal fsync per tick), ON is
+    `ServingPipeline` with the thread backstage (round-coalesced fsync
+    overlapping the next round's admit+dispatch).  The occupancy splits
+    re-run shorter with telemetry enabled so the before/after stage
+    attribution lands in the json; wall-clock numbers come from the
+    untelemetered runs.  Prints ONE json line:
+
+        {sequential_rps, pipelined_rps, pipelined_availability,
+         occupancy_s: {off, on}, slo_at_seq_capacity, n_tenants,
+         n_requests, round_lanes}
+    """
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from dynamic_factor_models_tpu.serving.engine import ServingEngine
+    from dynamic_factor_models_tpu.serving.pipeline import ServingPipeline
+    from dynamic_factor_models_tpu.utils import telemetry as _tel
+    from dynamic_factor_models_tpu.utils.slo import SLO
+
+    T, N = 64, 16
+    rng = np.random.default_rng(23)
+    f = rng.standard_normal((T, 4)).cumsum(0) * 0.1
+    lam = rng.standard_normal((N, 4))
+    panel = f @ lam.T + 0.5 * rng.standard_normal((T, N))
+    slo_thresh_s, slo_obj = 0.25, 0.95
+
+    n_pt = 64 if smoke else 512          # pipeline-leg tenants
+    n_pr = 512 if smoke else 4096        # pipeline-leg requests
+    pipe_lanes = 64
+    pipe_dir = tempfile.mkdtemp(prefix="dfm-bench-pipe-ab-")
+    try:
+        def _pipe_engine(sub):
+            e = ServingEngine(
+                max_em_iter=5,
+                store_dir=os.path.join(pipe_dir, sub),
+            )
+            e.register("p0", panel)
+            for i in range(1, n_pt):
+                e.register_shared(f"p{i}", "p0")
+            return e
+
+        rs = np.random.default_rng(31)
+
+        def pipe_stream(n):
+            ids = rs.integers(0, n_pt, size=n)
+            return [
+                {"kind": "tick", "tenant": f"p{j}",
+                 "x": rs.standard_normal(N)}
+                for j in ids
+            ]
+
+        def run_sequential(eng, reqs):
+            t0 = time.perf_counter()
+            for req in reqs:
+                eng.handle(req)
+            return len(reqs) / (time.perf_counter() - t0)
+
+        def warm_buckets(submit, flush):
+            # Compile every lane bucket the round former can produce
+            # BEFORE the timed region: per-tenant dedup and drain tails
+            # make round sizes data-dependent, so any cold bucket means
+            # an XLA compile lands mid-measurement (observed as a 3-4x
+            # rps swing between otherwise identical runs).  b distinct
+            # tenants -> one round padded to exactly bucket b.
+            b = 1
+            while b <= pipe_lanes:
+                for j in range(min(b, n_pt)):
+                    submit({"kind": "tick", "tenant": f"p{j}",
+                            "x": rs.standard_normal(N)})
+                flush()
+                b *= 2
+
+        def run_pipelined(eng, reqs, warm=True):
+            with ServingPipeline(
+                eng, backstage="thread", max_round_lanes=pipe_lanes,
+            ) as pipe:
+                if warm:
+                    warm_buckets(pipe.submit,
+                                 lambda: (pipe.pump(), pipe.drain()))
+                t0 = time.perf_counter()
+                for i, req in enumerate(reqs):
+                    pipe.submit(req)
+                    if (i + 1) % pipe_lanes == 0:
+                        pipe.pump()
+                out_r = pipe.drain()
+                wall = time.perf_counter() - t0
+            n_ok = sum(bool(r.ok) for r in out_r)
+            return len(reqs) / wall, n_ok / max(1, len(out_r))
+
+        seq_eng = _pipe_engine("seq")
+        for req in pipe_stream(32):  # warm tick + journal programs
+            seq_eng.handle(req)
+        seq_rps = run_sequential(seq_eng, pipe_stream(n_pr))
+
+        on_eng = _pipe_engine("on")
+        pipe_rps, pipe_avail = run_pipelined(on_eng, pipe_stream(n_pr))
+
+        # occupancy splits, telemetry on, shorter run: "off" is the
+        # batched submit/flush_period attribution (the pre-pipeline
+        # serving path), "on" is the pipelined round attribution with
+        # its admit phase and envelope overlap
+        def occ_split(run):
+            # warm (and reset the attribution) before enabling
+            # telemetry so the splits describe steady-state rounds, not
+            # bucket compiles
+            eng = _pipe_engine(f"occ-{run}")
+            occ_sink = os.path.join(pipe_dir, f"occ-{run}.jsonl")
+            reqs = pipe_stream(max(pipe_lanes, n_pr // 4))
+            if run == "on":
+                with ServingPipeline(
+                    eng, backstage="thread",
+                    max_round_lanes=pipe_lanes,
+                ) as pipe:
+                    warm_buckets(pipe.submit,
+                                 lambda: (pipe.pump(), pipe.drain()))
+                    eng._occ_s.clear()
+                    _tel.enable(sink=occ_sink)
+                    try:
+                        for i, req in enumerate(reqs):
+                            pipe.submit(req)
+                            if (i + 1) % pipe_lanes == 0:
+                                pipe.pump()
+                        pipe.drain()
+                    finally:
+                        _tel.disable()
+            else:
+                warm_buckets(eng.submit, eng.flush_period)
+                eng._occ_s.clear()
+                _tel.enable(sink=occ_sink)
+                try:
+                    for i, req in enumerate(reqs):
+                        eng.submit(req)
+                        if (i + 1) % pipe_lanes == 0:
+                            eng.flush_period()
+                    eng.flush_period()
+                finally:
+                    _tel.disable()
+            return {
+                k: round(v, 6)
+                for k, v in sorted(eng._occ_s.items())
+            }
+
+        occ_off = occ_split("off")
+        occ_on = occ_split("on")
+
+        # SLO at the previous capacity point: offer the pipelined
+        # engine the OFF path's measured saturation rate open-loop;
+        # the acceptance bar is the tick SLO staying green there
+        slo_eng = _pipe_engine("slo")
+        pipe_slo = SLO("tick_p95_250ms", kind="tick",
+                       threshold_s=slo_thresh_s, objective=slo_obj)
+        with ServingPipeline(
+            slo_eng, backstage="thread", max_round_lanes=pipe_lanes,
+        ) as pipe:
+            warm_buckets(pipe.submit,
+                         lambda: (pipe.pump(), pipe.drain()))
+            reqs = pipe_stream(n_pr // 2)
+            # pump eagerly (quarter-rounds): at the offered rate a
+            # full 64-lane round takes ~lanes/rate to even FORM —
+            # latency at fixed capacity is round depth, so the
+            # latency-sensitive point trades bucket size for it
+            slo_chunk = max(8, pipe_lanes // 4)
+            sched = {}
+            t0 = time.perf_counter()
+            for i, req in enumerate(reqs):
+                at = t0 + i / seq_rps
+                now = time.perf_counter()
+                if now < at:
+                    time.sleep(at - now)
+                sched[pipe.submit(req)] = at
+                if (i + 1) % slo_chunk == 0:
+                    pipe.pump()
+                    now = time.perf_counter()
+                    for r in pipe.poll():
+                        pipe_slo.observe(now - sched.pop(min(sched)),
+                                         r.ok)
+            out_r = pipe.drain()
+            now = time.perf_counter()
+            for r in out_r:
+                pipe_slo.observe(now - sched.pop(min(sched)), r.ok)
+
+        print(json.dumps({
+            "n_tenants": n_pt,
+            "n_requests": n_pr,
+            "round_lanes": pipe_lanes,
+            "sequential_rps": round(seq_rps, 1),
+            "pipelined_rps": round(pipe_rps, 1),
+            "pipelined_availability": round(pipe_avail, 4),
+            "occupancy_s": {"off": occ_off, "on": occ_on},
+            "slo_at_seq_capacity": pipe_slo.status(),
+        }), flush=True)
+    finally:
+        shutil.rmtree(pipe_dir, ignore_errors=True)
 
 
 def scenarios_section():
@@ -4295,6 +4623,7 @@ def main():
                          "flop_proxy labels)")
     ap.add_argument("--run-multihost", action="store_true")
     ap.add_argument("--run-multihost-worker", action="store_true")
+    ap.add_argument("--run-pipeline-ab", action="store_true")
     ap.add_argument("--mh-pid", type=int, default=0)
     ap.add_argument("--mh-nproc", type=int, default=2)
     ap.add_argument("--mh-port", default="0")
@@ -4360,6 +4689,9 @@ def main():
         return
     if args.load:
         load_section(smoke=args.smoke)
+        return
+    if args.run_pipeline_ab:
+        run_pipeline_ab(smoke=args.smoke)
         return
     if args.large_n:
         large_n_section(force_cpu=args.force_cpu)

@@ -15,12 +15,21 @@ foundation:
 * `engine` — the synchronous request-loop driver routing tick / nowcast
   / refit requests, each bracketed in a telemetry RunRecord; exposed as
   ``python -m dynamic_factor_models_tpu.serve``.
+* `pipeline` — double-buffered round pipeline over one engine: a
+  bounded async admission queue feeds rounds whose journal/commit back
+  half overlaps the next round's admit/dispatch (two-slot ring, FIFO
+  commits, per-tenant exclusion).
+* `router` — tenant-sharded serving: M engine workers (in-process or
+  OS processes), each owning a hash slice of tenants with its own
+  store partition; refits gang-schedule through one batched EM.
 
 See docs/serving.md for the request types and state-store layout.
 """
 
 from .batch import RefitResult, refit_batch, refit_sequential
 from .engine import ServingEngine
+from .pipeline import ServingPipeline
+from .router import TenantRouter
 from .online import (
     FilterState,
     ServingModel,
@@ -44,4 +53,6 @@ __all__ = [
     "TenantState",
     "TenantStore",
     "ServingEngine",
+    "ServingPipeline",
+    "TenantRouter",
 ]

@@ -52,6 +52,7 @@ import json
 import os
 import re as _re
 import sys
+import threading
 import time
 
 import jax
@@ -290,6 +291,18 @@ class ServingEngine:
         self._occ_req = 0.0          # phase seconds inside this request
         self._phase_hists: dict = {}  # phase -> LatencyHistogram
         self._slo_alerting = False   # edge-triggers the SLO-page dump
+        # occupancy accumulation must be race-free once a pipeline's
+        # backstage thread journals/commits round k while the main
+        # thread admits round k+1; taken only while telemetry is live
+        self._occ_lock = threading.Lock()
+        # pipelined serving (serving/pipeline.py): the attached
+        # ServingPipeline (None = plain sequential engine), the
+        # deferred metrics-flush flag it drains on its commit stage,
+        # and the committed-round counter behind the stall_commit@n
+        # fault site
+        self._pipeline = None
+        self._metrics_due = False
+        self._rounds_committed = 0
 
     # -- registration ----------------------------------------------------
 
@@ -659,23 +672,32 @@ class ServingEngine:
             )
         self._observe(rkind, outcome, latency_s, resp.ok)
         if (reqno & 1023) == 0 and rec is not _NULL_RECORD:
-            self.flush_metrics()
+            # with a pipeline attached the request path never blocks on
+            # telemetry I/O: the flush is deferred onto the pipeline's
+            # commit stage (drained in _commit_lanes); the bare
+            # sequential engine keeps the inline flush
+            if self._pipeline is not None:
+                self._metrics_due = True
+            else:
+                self.flush_metrics()
         return resp
 
     def _occ_add(self, phase: str, dt: float) -> None:
         """Accumulate one occupancy phase sample: cumulative seconds
         (gauges pushed by `flush_metrics`, never per tick) plus the
-        per-phase HDR histogram.  Callers gate on `_obs_live`."""
-        self._occ_s[phase] = self._occ_s.get(phase, 0.0) + dt
-        self._occ_req += dt
-        try:
-            h = self._phase_hists[phase]
-        except KeyError:
-            h = register_hist(
-                "serving.phase.latency", entry="serving", phase=phase,
-            )
-            self._phase_hists[phase] = h
-        h.record(dt)
+        per-phase HDR histogram.  Callers gate on `_obs_live`, so the
+        lock is never taken on the disabled clean path."""
+        with self._occ_lock:
+            self._occ_s[phase] = self._occ_s.get(phase, 0.0) + dt
+            self._occ_req += dt
+            try:
+                h = self._phase_hists[phase]
+            except KeyError:
+                h = register_hist(
+                    "serving.phase.latency", entry="serving", phase=phase,
+                )
+                self._phase_hists[phase] = h
+            h.record(dt)
 
     def _observe(self, kind, outcome, latency_s, ok) -> None:
         """O(1) host-side per-request accounting: one histogram bucket
@@ -716,7 +738,9 @@ class ServingEngine:
             _flight.record("serving.slo_page")
             _flight.dump("slo_page")
         self._slo_alerting = alerting
-        for phase, s in self._occ_s.items():
+        with self._occ_lock:
+            occ = dict(self._occ_s)
+        for phase, s in occ.items():
             gauge_set(f"serving.occupancy.{phase}_s", round(s, 9))
         self._resident_gauges()
         emit_metrics()
@@ -1351,6 +1375,27 @@ class ServingEngine:
             self._enforce_budget()
 
     def _flush_round_pinned(self, entries, idxs, responses, lanes) -> None:
+        """One round = the four pipeline stages run back-to-back on the
+        caller thread.  serving/pipeline.py calls the same four helpers
+        with round k's journal/commit overlapping round k+1's
+        admit/dispatch — the stage split IS the pipeline's stage
+        structure, so sequential and pipelined rounds cannot drift."""
+        obs = self._obs_live
+        self._admit_lanes(entries, idxs, responses, lanes, obs=obs)
+        staged = self._dispatch_lanes(lanes, obs=obs)
+        commits = self._journal_lanes(staged, responses, obs=obs)
+        self._commit_lanes(commits, responses, obs=obs)
+
+    # -- round stages (shared by flush_period and ServingPipeline) -------
+
+    def _admit_lanes(self, entries, idxs, responses, lanes, obs=None) -> None:
+        """ADMIT stage: validate, look up (faulting in evicted
+        tenants), reconcile replay buffers, and deadline-check each
+        entry in admission order; survivors land in `lanes` as
+        ``(qi, tenant_id, ten, row, deadline, recovered)``."""
+        if obs is None:
+            obs = self._obs_live
+        t_ph = time.perf_counter() if obs else 0.0
         for qi in idxs:
             req, deadline, _t_sub = entries[qi]
             if not isinstance(req, dict):
@@ -1434,12 +1479,19 @@ class ServingEngine:
                 )
                 continue
             lanes.append((qi, tenant_id, ten, row, deadline, recovered))
-        if not lanes:
-            return
+        if obs:  # validation + fault-in + reconcile, the round's front door
+            self._occ_add("admit", time.perf_counter() - t_ph)
 
-        # compute: the tick counter advances per lane in admission
-        # order, so the tick_nan site fires on exactly the tick index it
-        # would have under sequential serving
+    def _dispatch_lanes(self, lanes, obs=None) -> list:
+        """DISPATCH stage: one vmapped device dispatch for the whole
+        round.  Returns ``[(lane, new_state, poisoned)]`` in admission
+        order; the tick counter advances per lane in admission order,
+        so the tick_nan site fires on exactly the tick index it would
+        have under sequential serving."""
+        if obs is None:
+            obs = self._obs_live
+        if not lanes:
+            return []
         poisoned = []
         for _lane in lanes:
             self._ticks += 1
@@ -1447,7 +1499,6 @@ class ServingEngine:
             if hit:
                 _faults.fault_fired("tick_nan")
             poisoned.append(hit)
-        obs = self._obs_live
         t_ph = time.perf_counter() if obs else 0.0
         new_states = batched_tick_dispatch(
             [(ten.model, ten.state, row[0], row[1])
@@ -1455,15 +1506,25 @@ class ServingEngine:
         )
         if obs:  # one vmapped device dispatch for the whole round
             self._occ_add("dispatch", time.perf_counter() - t_ph)
+        return list(zip(lanes, new_states, poisoned))
 
-        # per-lane isolation: batched serving always deep-checks (the
-        # states just materialized on host) and journal-appends; a
-        # failed lane buffers its row and freezes only that tenant
-        commits = []
+    def _journal_lanes(self, staged, responses, obs=None) -> list:
+        """JOURNAL stage: deep-check every lane's freshly materialized
+        state, then write-ahead the round COALESCED — one buffered
+        write per touched journal file (all lanes' records), then one
+        fsync sweep.  Every append is durable before this returns, so
+        the stage boundary after it IS the round's acked⇔durable line.
+        A failed lane buffers its row and freezes only that tenant.
+        Returns the commit list for `_commit_lanes`."""
+        if obs is None:
+            obs = self._obs_live
+        if not staged:
+            return []
         t_ph = time.perf_counter() if obs else 0.0
-        for (qi, tenant_id, ten, row, deadline, recovered), st, poi in zip(
-            lanes, new_states, poisoned
-        ):
+        # per-lane isolation: batched serving always deep-checks (the
+        # states just materialized on host)
+        alive = []
+        for (qi, tenant_id, ten, row, deadline, recovered), st, poi in staged:
             if poi:
                 st = FilterState(s=st.s * np.nan, t=st.t)
             if not host_finite(st.s):
@@ -1478,40 +1539,127 @@ class ServingEngine:
                     recovered=recovered,
                 )
                 continue
-            retries = 0
-            if self.store is not None:
+            alive.append((qi, tenant_id, ten, row, st, recovered, deadline))
+        commits = []
+        if self.store is None:
+            commits = [
+                (qi, tid, ten, row, st, rc, 0, dl)
+                for qi, tid, ten, row, st, rc, dl in alive
+            ]
+        else:
+            # phase A: one buffered write per tenant journal (grouped
+            # in admission order; round formation admits one lane per
+            # tenant, so a group is almost always a single record)
+            groups: dict = {}
+            order = []
+            for lane in alive:
+                tid = lane[1]
+                if tid not in groups:
+                    groups[tid] = []
+                    order.append(tid)
+                groups[tid].append(lane)
+            pending = []
+            for tid in order:
+                group = groups[tid]
+                ten = group[0][2]
+                deadline = group[0][6]
                 journal = ten.journal
                 if journal is None:
-                    journal = ten.journal = self.store.journal(tenant_id)
+                    journal = ten.journal = self.store.journal(tid)
                 t_idx = int(ten.state.t)
+                rows = [(t_idx, lane[3][0], lane[3][1]) for lane in group]
+                holder = {}
+
+                def _write(j=journal, r=rows, h=holder):
+                    h["p"] = j.append_many(r, sync=False)
+
                 try:
-                    with trace_span("tick.journal_append", t=t_idx):
+                    with trace_span(
+                        "tick.journal_append", t=t_idx, n=len(rows)
+                    ):
                         _, retries = call_with_retries(
-                            lambda j=journal, t=t_idx, r=row: j.append(
-                                t, r[0], r[1]
-                            ),
+                            _write,
                             self.retry_policy,
-                            key=f"{tenant_id}:tick:{t_idx}",
+                            key=f"{tid}:tick:{t_idx}",
                             deadline=deadline,
                         )
                 except OSError as e:
-                    ten.replay.append(row)
-                    responses[qi] = self._fault_resp(
-                        "tick", tenant_id, ten,
+                    self._fail_lanes(
+                        group, responses,
                         ErrorInfo(
                             SYSTEM_FAULT, "store_io",
                             f"tick journal append failed: {e}",
                         ),
                         retries=self.retry_policy.max_retries,
-                        recovered=recovered,
                     )
                     continue
-            commits.append((qi, tenant_id, ten, row, st, recovered, retries))
-        if obs:  # per-lane deep checks + write-ahead appends (fsync)
+                pending.append((group, holder.get("p"), retries))
+            # phase B: the fsync sweep — ALL writes before ANY sync
+            # completed, all syncs before any commit (write-ahead)
+            for group, pend, retries in pending:
+                try:
+                    if pend is not None:
+                        pend.sync()
+                except OSError as e:
+                    self._fail_lanes(
+                        group, responses,
+                        ErrorInfo(
+                            SYSTEM_FAULT, "store_io",
+                            f"tick journal fsync failed: {e}",
+                        ),
+                        retries=retries,
+                    )
+                    continue
+                for qi, tid, ten, row, st, rc, dl in group:
+                    commits.append((qi, tid, ten, row, st, rc, retries, dl))
+        if obs:  # deep checks + coalesced write-ahead appends (fsync)
             self._occ_add("journal", time.perf_counter() - t_ph)
-        # memory commits only after EVERY lane's append has settled
+        return commits
+
+    def _fail_lanes(self, group, responses, err, retries=0) -> None:
+        """Fail every lane of one journal group: rows to the replay
+        buffer (admission order), typed fault envelopes out."""
+        for qi, tid, ten, row, _st, recovered, _dl in group:
+            ten.replay.append(row)
+            responses[qi] = self._fault_resp(
+                "tick", tid, ten, err,
+                retries=retries, recovered=recovered,
+            )
+
+    def _commit_lanes(self, commits, responses, obs=None) -> None:
+        """COMMIT stage: apply every journaled lane's state in
+        admission order — memory commits strictly after EVERY lane's
+        append has settled.  Hosts the ``stall_commit@n`` fault site
+        (the n-th committing round sleeps past its deadline budget —
+        the lanes are already durable, so the stall delays acks without
+        touching exactness) and drains the deferred metrics flush the
+        request path parked here."""
+        if obs is None:
+            obs = self._obs_live
+        if not commits:
+            if self._metrics_due and obs:
+                self._metrics_due = False
+                self.flush_metrics()
+            return
         t_ph = time.perf_counter() if obs else 0.0
-        for qi, tenant_id, ten, row, st, recovered, retries in commits:
+        if commits:
+            self._rounds_committed += 1
+            rc = self._rounds_committed
+            if _faults.site_hits("stall_commit", rc):
+                _faults.fault_fired("stall_commit")
+                budget = max(
+                    (c[7].budget_s or 0.0 for c in commits
+                     if c[7] is not None and c[7].budget_s is not None),
+                    default=0.0,
+                )
+                stall_s = budget + 0.02
+                time.sleep(stall_s)
+                _flight.record(
+                    "serving.stall_commit", round=rc,
+                    stalled_s=round(stall_s, 6), n_lanes=len(commits),
+                )
+                _flight.dump("stall_commit", round=rc)
+        for qi, tenant_id, ten, row, st, recovered, retries, _dl in commits:
             ten.state = st
             ten.suspect = False
             ten.dirty += 1
@@ -1526,6 +1674,9 @@ class ServingEngine:
             )
         if obs:
             self._occ_add("commit", time.perf_counter() - t_ph)
+        if self._metrics_due and obs:
+            self._metrics_due = False
+            self.flush_metrics()
 
     # -- persistence -----------------------------------------------------
 

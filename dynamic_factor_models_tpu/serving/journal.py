@@ -45,7 +45,7 @@ import numpy as np
 
 from ..utils.telemetry import inc
 
-__all__ = ["TickJournal", "JOURNAL_MAGIC"]
+__all__ = ["TickJournal", "PendingSync", "JOURNAL_MAGIC"]
 
 JOURNAL_MAGIC = "dfm-tick-journal"
 _VERSION = 1
@@ -59,6 +59,39 @@ def _header_sha(base_t: int) -> str:
 def _record_sha(t: int, dtype: str, x_b64: str, mask_b64: str) -> str:
     payload = f"{int(t)}|{dtype}|{x_b64}|{mask_b64}".encode()
     return hashlib.sha256(payload).hexdigest()
+
+
+class PendingSync:
+    """A coalesced journal append whose bytes are WRITTEN (buffered
+    through the OS) but not yet DURABLE: `sync()` fsyncs and closes.
+
+    The write-ahead contract for a batched round: every lane's
+    `append_many(..., sync=False)` write lands first, then ALL pending
+    syncs complete, and only then may any lane commit in memory — the
+    fsync sweep is the round's acked⇔durable line.  Dropping a
+    PendingSync without `sync()` leaves a possibly-torn tail that
+    replay quarantines, exactly like a crash between write and fsync.
+    """
+
+    __slots__ = ("_f",)
+
+    def __init__(self, f):
+        self._f = f
+
+    def sync(self) -> None:
+        f, self._f = self._f, None
+        if f is None:
+            return
+        try:
+            os.fsync(f.fileno())
+        finally:
+            f.close()
+
+    def close(self) -> None:
+        """Abandon without fsync (error paths only)."""
+        f, self._f = self._f, None
+        if f is not None:
+            f.close()
 
 
 class TickJournal:
@@ -101,32 +134,69 @@ class TickJournal:
         pre-increment clock), so lazy header creation is equivalent to
         an eager `reset` at snapshot time — and lets a million-tenant
         registration skip a million empty journal files."""
-        x = np.ascontiguousarray(x)
-        mask = np.ascontiguousarray(mask, dtype=np.uint8)
-        x_b64 = base64.b64encode(x.tobytes()).decode()
-        mask_b64 = base64.b64encode(mask.tobytes()).decode()
-        rec = {
-            "t": int(t),
-            "dtype": x.dtype.str,
-            "x": x_b64,
-            "mask": mask_b64,
-            "sha": _record_sha(t, x.dtype.str, x_b64, mask_b64),
-        }
+        self.append_many([(t, x, mask)])
+
+    def append_many(self, rows, sync: bool = True):
+        """Coalesced write-ahead: encode every ``(t, x, mask)`` row,
+        ONE buffered write of all lines, one fsync — bytes on disk
+        identical to the same rows appended one `append()` at a time
+        (pinned in tests/test_eviction.py), at one write+fsync instead
+        of k.
+
+        ``sync=False`` defers durability: the bytes are written and
+        flushed to the OS but NOT fsynced; the returned `PendingSync`'s
+        ``sync()`` completes the append.  The batched engine round uses
+        this to write every lane's records first and then run one fsync
+        sweep — all appends become durable before any lane commits, so
+        the write-ahead ordering is preserved per lane.  Returns None
+        when ``sync=True`` (or `rows` is empty).
+
+        The store's fault probe (``store_io@n`` / ``crash_io@n``) fires
+        ONCE per call, before any byte is written: a coalesced append
+        is one store op, atomic under the injected-crash model the
+        kill-matrix drills enumerate."""
+        rows = list(rows)
+        if not rows:
+            return None
+        encoded = []
+        for t, x, mask in rows:
+            x = np.ascontiguousarray(x)
+            mask = np.ascontiguousarray(mask, dtype=np.uint8)
+            x_b64 = base64.b64encode(x.tobytes()).decode()
+            mask_b64 = base64.b64encode(mask.tobytes()).decode()
+            encoded.append(json.dumps({
+                "t": int(t),
+                "dtype": x.dtype.str,
+                "x": x_b64,
+                "mask": mask_b64,
+                "sha": _record_sha(t, x.dtype.str, x_b64, mask_b64),
+            }))
         self._probe()
         lines = []
         if not os.path.exists(self.path):
+            t0 = int(rows[0][0])
             lines.append(json.dumps({
                 "magic": JOURNAL_MAGIC,
                 "version": _VERSION,
-                "base_t": int(t),
-                "sha": _header_sha(t),
+                "base_t": t0,
+                "sha": _header_sha(t0),
             }))
-        lines.append(json.dumps(rec))
-        with open(self.path, "a") as f:
+        lines.extend(encoded)
+        f = open(self.path, "a")
+        try:
             f.write("\n".join(lines) + "\n")
             f.flush()
+        except BaseException:
+            f.close()
+            raise
+        inc("serving.journal.appends", len(rows))
+        if not sync:
+            return PendingSync(f)
+        try:
             os.fsync(f.fileno())
-        inc("serving.journal.appends")
+        finally:
+            f.close()
+        return None
 
     # -- reads -----------------------------------------------------------
 

@@ -1,0 +1,582 @@
+"""Pipelined serving runtime: double-buffered rounds behind an async
+admission front.
+
+`flush_period()` runs a round's four stages strictly in sequence —
+admit → dispatch → journal → commit — so the host sits idle during the
+device dispatch and the device sits idle during the journal fsyncs;
+that serialization is why batched admission beat sequential serving by
+only 1.353x (docs/BENCH_load.json) and why ROADMAP item 1 calls for
+overlap.  This module overlaps them with an EXPLICIT stage-handoff
+structure instead of ad-hoc threading:
+
+* **Rounds** are first-class (`_Round`): each owns its entries, lanes,
+  staged states, responses, and a back-half step list.  The stage
+  functions are the engine's own `_admit_lanes` / `_dispatch_lanes` /
+  `_journal_lanes` / `_commit_lanes` — the exact code path
+  `flush_period()` runs, so sequential and pipelined rounds cannot
+  drift.
+* **Two-slot ring**: at most `slots` (default 2) rounds are in flight.
+  `pump()` forms round k+1 and runs its FRONT half (admit + dispatch)
+  on the caller thread while round k's BACK half (journal fsync +
+  commit) runs on the backstage; when the ring is full, the caller
+  blocks on the oldest round — bounded buffering, not an unbounded
+  task soup.
+* **Commit ordering**: the backstage executes back halves strictly
+  FIFO by round index (a single worker, a single queue), so round k's
+  commit always precedes round k+1's — the acked⇔durable-per-round
+  invariant needs no cross-round reasoning.
+* **Per-tenant exclusion**: round formation skips any tenant already
+  in flight (its queued ticks wait for the next round), so a tenant's
+  lane never dispatches from a speculative state and the crash
+  analysis stays per-round: a tenant has AT MOST ONE un-acked
+  journaled tick at any kill point (`acked ≤ recovered ≤ acked+1` per
+  tenant, tests/test_pipeline.py).  In-flight tenants are also pinned
+  against budget eviction via the engine's `_admission_pin`.
+
+The **admission front** is a bounded queue with typed shedding: a full
+queue answers `queue_full` (system fault, flight-recorded) instead of
+buffering unboundedly, and entries whose deadline expired while queued
+are shed at round formation without ever dispatching.  Queue depth and
+shed counters ride the telemetry registry
+(``serving.admission.depth`` / ``serving.admission.shed.*``), and each
+stage feeds the PR 17 occupancy split — including the new ``admit``
+phase — so `bench.py --load` can show the before/after overlap.
+
+Backstages (the threading doctrine, docs/ARCHITECTURE.md):
+
+* ``thread`` — one daemon worker owns every journal fsync and memory
+  commit; real overlap.  Exceptions (including the injected
+  SimulatedCrash kills) are captured per round and re-raised on the
+  caller thread at the next pump/drain — the pipeline is dead after.
+* ``serial`` — back halves run inline on the caller thread at
+  hand-off: identical stage structure and ordering, zero concurrency;
+  what the crash drills use so kills surface synchronously.
+* ``manual`` — back halves advance only via `step_back()`, one stage
+  at a time; with `interleavings()` this makes every legal stage
+  ordering ENUMERABLE instead of timing-dependent, which is how the
+  kill-at-every-stage-boundary matrix is driven.
+
+Results come back via `poll()` / `drain()` in SUBMISSION order (a
+shed request still yields exactly one typed Response), mirroring
+`flush_period()`'s one-response-per-entry contract.
+"""
+
+from __future__ import annotations
+
+import collections
+import queue as _queue_mod
+import threading
+import time
+
+from ..utils import faults as _faults
+from ..utils import flight as _flight
+from ..utils.telemetry import _NULL_RECORD, gauge_set, inc, run_record
+from .resilience import SYSTEM_FAULT, Deadline, ErrorInfo, Response
+
+__all__ = ["ServingPipeline", "interleavings", "BACK_STAGES"]
+
+BACK_STAGES = ("journal", "commit")
+_BACKSTAGES = ("thread", "serial", "manual")
+
+
+class _Round:
+    """One in-flight round: entries, staged artifacts, and back-half
+    progress.  Stage data flows admit→lanes→staged→commits→responses;
+    `done` flips once the commit stage (or a captured exception) ends
+    the round's life on the backstage."""
+
+    __slots__ = (
+        "idx", "entries", "seqs", "tenants", "responses", "lanes",
+        "staged", "commits", "obs", "t_form", "stage_wall", "back_steps",
+        "done", "exc",
+    )
+
+    def __init__(self, idx, entries, seqs, tenants, obs):
+        self.idx = idx
+        self.entries = entries      # [(req, Deadline, t_submit)]
+        self.seqs = seqs            # submission seq per entry
+        self.tenants = tenants      # frozenset of tenant ids in-round
+        self.responses = [None] * len(entries)
+        self.lanes = []
+        self.staged = None
+        self.commits = None
+        self.obs = obs
+        self.t_form = time.perf_counter()
+        self.stage_wall = 0.0       # attributed stage seconds (envelope)
+        self.back_steps = collections.deque(BACK_STAGES)
+        self.done = threading.Event()
+        self.exc = None
+
+
+class ServingPipeline:
+    """Double-buffered round pipeline over one `ServingEngine`.
+
+    ``submit()`` admits tick requests into the bounded queue (typed
+    sheds, never an exception); ``pump()`` forms and advances one
+    round; ``drain()`` runs the pipeline dry and returns every
+    releasable Response in submission order; ``close()`` stops the
+    backstage worker.  Attaching a pipeline moves the engine's
+    every-1024-requests metrics flush onto the commit stage."""
+
+    def __init__(
+        self,
+        engine,
+        max_queue: int = 4096,
+        slots: int = 2,
+        max_round_lanes: int = 1024,
+        backstage: str = "thread",
+        boundary_hook=None,
+    ):
+        if backstage not in _BACKSTAGES:
+            raise ValueError(
+                f"backstage must be one of {_BACKSTAGES}, got {backstage!r}"
+            )
+        if slots < 1:
+            raise ValueError("slots must be >= 1")
+        if max_queue < 1:
+            raise ValueError("max_queue must be >= 1")
+        self.engine = engine
+        self.max_queue = int(max_queue)
+        self.slots = int(slots)
+        self.max_round_lanes = int(max_round_lanes)
+        self.backstage = backstage
+        # test hook: called as hook(stage, round) AFTER each completed
+        # stage — the kill-matrix injects SimulatedCrash here to model
+        # a death at every stage boundary
+        self.boundary_hook = boundary_hook
+        self._queue: collections.deque = collections.deque()
+        self._inflight: collections.deque = collections.deque()
+        self._completed: dict = {}   # seq -> Response
+        self._next_seq = 0
+        self._next_out = 0
+        self._submits = 0            # queue_full fault-site counter
+        self._rounds_formed = 0
+        self._shed_queue_full = 0
+        self._shed_deadline = 0
+        self._max_inflight = 0       # high-water mark (ring-bound pin)
+        self._fatal = None
+        self._closed = False
+        self._work_q = None
+        self._worker = None
+        engine._pipeline = self
+        if backstage == "thread":
+            self._work_q = _queue_mod.SimpleQueue()
+            self._worker = threading.Thread(
+                target=self._worker_main,
+                name="dfm-pipeline-backstage",
+                daemon=True,
+            )
+            self._worker.start()
+
+    # -- admission front -------------------------------------------------
+
+    def submit(self, req) -> int:
+        """Admit one request into the bounded queue; returns its
+        submission sequence number.  Shares the engine's admission
+        fault sites (``engine_crash`` / ``slow_req`` fire against the
+        same request counter as `handle()`/`submit()`); a full queue —
+        or an injected ``queue_full@n`` — sheds the request with a
+        typed system fault delivered through `poll()` like any other
+        response, so callers always get one Response per submission."""
+        self._reraise()
+        eng = self.engine
+        seq = self._next_seq
+        self._next_seq += 1
+        self._submits += 1
+        eng._requests += 1
+        reqno = eng._requests
+        if _faults.site_hits("engine_crash", reqno):
+            _faults.fault_fired("engine_crash")
+            _flight.dump("engine_crash", force=True, reqno=reqno)
+            raise _faults.SimulatedCrash(
+                f"injected engine_crash at request {reqno}"
+            )
+        if (reqno & 1023) == 0:
+            # deferred onto the commit stage (engine._commit_lanes):
+            # the admission front never blocks on telemetry I/O
+            eng._metrics_due = True
+        budget = (
+            req.get("deadline_s", eng.deadline_s)
+            if isinstance(req, dict) else eng.deadline_s
+        )
+        deadline = Deadline(budget)
+        if _faults.site_hits("slow_req", reqno):
+            _faults.fault_fired("slow_req")
+            deadline.expire()
+        tid = req.get("tenant") if isinstance(req, dict) else None
+        if not isinstance(tid, str):
+            tid = None
+        forced = _faults.site_hits("queue_full", self._submits)
+        if forced or len(self._queue) >= self.max_queue:
+            if forced:
+                _faults.fault_fired("queue_full")
+            self._shed_queue_full += 1
+            inc("serving.admission.shed.queue_full")
+            _flight.record(
+                "serving.queue_full", tenant=tid, depth=len(self._queue),
+            )
+            _flight.dump("queue_full", depth=len(self._queue))
+            resp = Response(
+                ok=False, kind="tick", tenant=tid,
+                error=ErrorInfo(
+                    SYSTEM_FAULT, "queue_full",
+                    f"admission queue at capacity ({self.max_queue}); "
+                    "request shed",
+                ),
+            )
+            eng._observe("tick", SYSTEM_FAULT, 0.0, False)
+            self._completed[seq] = resp
+            return seq
+        inc("serving.admission.submitted")
+        self._queue.append((seq, req, deadline, time.perf_counter()))
+        return seq
+
+    def depth(self) -> int:
+        """Current admission-queue depth (excludes in-flight rounds)."""
+        return len(self._queue)
+
+    # -- the pipeline ----------------------------------------------------
+
+    def pump(self) -> int:
+        """Advance the pipeline one step: retire finished rounds, then
+        form at most one new round from the queue and run its front
+        half (admit + dispatch) on this thread, handing the back half
+        (journal + commit) to the backstage.  Returns the number of
+        lanes admitted into the new round (0 = nothing formed)."""
+        self._reraise()
+        if self.backstage == "manual":
+            self._collect_finished()
+            if len(self._inflight) >= self.slots:
+                raise RuntimeError(
+                    "pipeline ring full: run step_back() before pump()"
+                )
+        else:
+            while len(self._inflight) >= self.slots:
+                self._retire_oldest(block=True)
+            self._collect_finished()
+        entries, seqs, tenants = self._form_round()
+        if not entries:
+            # nothing admissible now: let the backstage make progress
+            # so excluded tenants free up (thread/serial only — manual
+            # stepping stays under the test scheduler's control)
+            if self._inflight and self.backstage != "manual":
+                self._retire_oldest(block=True)
+            return 0
+        eng = self.engine
+        idx = self._rounds_formed
+        self._rounds_formed += 1
+        with run_record(
+            "serving", kind="tick_round",
+            config={"n_lanes": len(entries), "round": idx},
+        ) as rec:
+            obs = rec is not _NULL_RECORD
+            eng._obs_live = obs
+            rnd = _Round(idx, entries, seqs, frozenset(tenants), obs)
+            # pin BEFORE admit: faulting in lane k must not evict a
+            # tenant of any in-flight round (or this round's lane j)
+            eng._admission_pin = eng._admission_pin | rnd.tenants
+            t0 = time.perf_counter()
+            try:
+                eng._admit_lanes(
+                    entries, list(range(len(entries))),
+                    rnd.responses, rnd.lanes, obs=obs,
+                )
+                self._hook("admit", rnd)
+                rnd.staged = eng._dispatch_lanes(rnd.lanes, obs=obs)
+                self._hook("dispatch", rnd)
+            finally:
+                rnd.stage_wall += time.perf_counter() - t0
+            if obs:
+                gauge_set("serving.admission.depth", len(self._queue))
+                rec.set(
+                    outcome="ok", n_lanes=len(entries),
+                    n_ok=sum(1 for r in rnd.responses if r is None),
+                    breaker_state="closed",
+                )
+        self._inflight.append(rnd)
+        self._max_inflight = max(self._max_inflight, len(self._inflight))
+        if self.backstage == "thread":
+            self._work_q.put(rnd)
+        elif self.backstage == "serial":
+            self._run_back(rnd)
+            self._retire_oldest(block=True)  # re-raises a captured kill
+        # manual: back_steps pending, advanced by step_back()
+        return len(rnd.lanes)
+
+    def _form_round(self):
+        """Pop the next round's entries off the queue: FIFO, at most
+        one lane per tenant not already in flight (skipped entries keep
+        their place at the head), deadline-shedding entries whose
+        budget burned down while queued."""
+        entries, seqs, tenants, skipped = [], [], set(), []
+        busy = set()
+        for rnd in self._inflight:
+            busy |= rnd.tenants
+        eng = self.engine
+        while self._queue and len(entries) < self.max_round_lanes:
+            seq, req, deadline, t_sub = self._queue.popleft()
+            tid = req.get("tenant") if isinstance(req, dict) else None
+            if not isinstance(tid, str):
+                tid = None
+            if tid is not None and (tid in busy or tid in tenants):
+                skipped.append((seq, req, deadline, t_sub))
+                continue
+            if deadline.exceeded():
+                self._shed_deadline += 1
+                inc("serving.admission.shed.deadline")
+                ten = eng._tenants.get(tid) if tid is not None else None
+                resp = Response(
+                    ok=False, kind="tick", tenant=tid,
+                    error=ErrorInfo(
+                        SYSTEM_FAULT, "deadline_exceeded",
+                        f"deadline of {deadline.budget_s}s exceeded in "
+                        "the admission queue",
+                    ),
+                    degraded=bool(ten.replay) if ten else False,
+                    ticks_behind=len(ten.replay) if ten else 0,
+                    breaker_state=ten.breaker.state if ten else "closed",
+                )
+                eng._observe(
+                    "tick", SYSTEM_FAULT,
+                    time.perf_counter() - t_sub, False,
+                )
+                self._completed[seq] = resp
+                continue
+            if tid is not None:
+                tenants.add(tid)
+            entries.append((req, deadline, t_sub))
+            seqs.append(seq)
+        # skipped entries go back to the HEAD, order preserved
+        self._queue.extendleft(reversed(skipped))
+        return entries, seqs, tenants
+
+    # -- back half -------------------------------------------------------
+
+    def _stage_back(self, rnd, stage) -> None:
+        eng = self.engine
+        t0 = time.perf_counter()
+        try:
+            if stage == "journal":
+                rnd.commits = eng._journal_lanes(
+                    rnd.staged, rnd.responses, obs=rnd.obs,
+                )
+            elif stage == "commit":
+                eng._commit_lanes(rnd.commits, rnd.responses, obs=rnd.obs)
+            else:  # pragma: no cover - internal invariant
+                raise AssertionError(f"unknown back stage {stage!r}")
+            self._hook(stage, rnd)
+        finally:
+            rnd.stage_wall += time.perf_counter() - t0
+
+    def _run_back(self, rnd) -> None:
+        """Run the round's remaining back stages in order, capturing
+        any exception (including injected kills) on the round."""
+        try:
+            while rnd.back_steps:
+                self._stage_back(rnd, rnd.back_steps.popleft())
+        except BaseException as e:
+            rnd.exc = e
+        finally:
+            rnd.done.set()
+
+    def _worker_main(self) -> None:
+        while True:
+            rnd = self._work_q.get()
+            if rnd is None:
+                return
+            self._run_back(rnd)
+
+    def _step_round(self, rnd) -> str:
+        """Advance one round by exactly one back stage.  Sets `done`
+        when the last stage completes (WITHOUT retiring the round — the
+        caller owns the `_inflight` deque) and on failure records the
+        exception on the round before re-raising."""
+        stage = rnd.back_steps.popleft()
+        try:
+            self._stage_back(rnd, stage)
+        except BaseException as e:
+            rnd.exc = e
+            rnd.done.set()
+            raise
+        if not rnd.back_steps:
+            rnd.done.set()
+        return stage
+
+    def step_back(self):
+        """Manual backstage only: run the OLDEST in-flight round's next
+        back stage (strict FIFO — the single-writer commit ordering).
+        Returns ``(round_idx, stage)``; raises the stage's exception
+        synchronously.  A fully stepped round retires immediately, so
+        its responses become pollable."""
+        if self.backstage != "manual":
+            raise RuntimeError("step_back() requires backstage='manual'")
+        self._reraise()
+        self._collect_finished()
+        if not self._inflight:
+            raise RuntimeError("step_back(): no round in flight")
+        rnd = self._inflight[0]
+        try:
+            stage = self._step_round(rnd)
+        except BaseException as e:
+            self._fatal = e
+            raise
+        if rnd.done.is_set():
+            self._collect_finished()
+        return rnd.idx, stage
+
+    # -- retire / deliver ------------------------------------------------
+
+    def _collect_finished(self) -> None:
+        while self._inflight and self._inflight[0].done.is_set():
+            self._retire_oldest(block=False)
+
+    def _retire_oldest(self, block: bool) -> bool:
+        if not self._inflight:
+            return False
+        rnd = self._inflight[0]
+        if not rnd.done.is_set():
+            if not block:
+                return False
+            if self.backstage == "manual":
+                while not rnd.done.is_set():
+                    try:
+                        self._step_round(rnd)
+                    except BaseException:
+                        break  # rnd.exc carries it; re-raised below
+            else:
+                rnd.done.wait()
+        self._inflight.popleft()
+        eng = self.engine
+        # unpin and re-enforce the budget: exclusion keeps in-flight
+        # tenant sets disjoint, so subtraction is exact
+        eng._admission_pin = eng._admission_pin - rnd.tenants
+        if rnd.exc is not None:
+            self._fatal = rnd.exc
+            raise rnd.exc
+        eng._enforce_budget()
+        now = time.perf_counter()
+        if rnd.obs:
+            # envelope = round wall-clock beyond the attributed stage
+            # walls: queue handoff, ring waits, response delivery
+            eng._occ_add(
+                "envelope", max(0.0, (now - rnd.t_form) - rnd.stage_wall)
+            )
+        for (req, _dl, t_sub), resp, seq in zip(
+            rnd.entries, rnd.responses, rnd.seqs
+        ):
+            outcome = (
+                ("degraded" if resp.degraded else "ok")
+                if resp.ok else resp.error.category
+            )
+            eng._observe("tick", outcome, now - t_sub, resp.ok)
+            self._completed[seq] = resp
+        inc("serving.pipeline.rounds")
+        return True
+
+    def poll(self) -> list:
+        """Responses releasable so far, in submission order (stops at
+        the first still-pending seq so ordering is never violated)."""
+        out = []
+        while self._next_out in self._completed:
+            out.append(self._completed.pop(self._next_out))
+            self._next_out += 1
+        return out
+
+    def drain(self) -> list:
+        """Pump until the queue is empty and every in-flight round has
+        retired, then return all releasable responses in submission
+        order.  The pipelined analogue of `flush_period()`."""
+        self._reraise()
+        while self._queue or self._inflight:
+            if self._queue:
+                if len(self._inflight) >= self.slots:
+                    self._retire_oldest(block=True)
+                if self.pump() == 0 and self._queue and self._inflight:
+                    # all queued tenants are in flight: make backstage
+                    # progress so exclusion frees them up
+                    self._retire_oldest(block=True)
+            else:
+                self._retire_oldest(block=True)
+        if self.engine._obs_live:
+            gauge_set("serving.admission.depth", len(self._queue))
+        return self.poll()
+
+    # -- lifecycle -------------------------------------------------------
+
+    def stats(self) -> dict:
+        """Host-side pipeline counters (tests and bench)."""
+        return {
+            "submitted": self._submits,
+            "rounds": self._rounds_formed,
+            "shed_queue_full": self._shed_queue_full,
+            "shed_deadline": self._shed_deadline,
+            "queue_depth": len(self._queue),
+            "inflight": len(self._inflight),
+            "max_inflight": self._max_inflight,
+        }
+
+    def close(self) -> None:
+        """Stop the backstage worker and detach from the engine (the
+        engine reverts to inline metrics flushes).  Idempotent; does
+        NOT drain — call `drain()` first if responses matter."""
+        if self._closed:
+            return
+        self._closed = True
+        if self._worker is not None:
+            self._work_q.put(None)
+            self._worker.join(timeout=10.0)
+            self._worker = None
+        if self.engine._pipeline is self:
+            self.engine._pipeline = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    # -- internals -------------------------------------------------------
+
+    def _hook(self, stage, rnd) -> None:
+        if self.boundary_hook is not None:
+            self.boundary_hook(stage, rnd.idx)
+
+    def _reraise(self) -> None:
+        if self._fatal is not None:
+            raise self._fatal
+
+
+def interleavings(n_rounds: int = 2, slots: int = 2):
+    """Enumerate every legal stage interleaving of `n_rounds` pipelined
+    rounds — the deterministic scheduler behind the interleaving tests.
+
+    Yields token sequences; each token is ``("pump", k)`` (round k's
+    front half: admit + dispatch) or ``("back", k, stage)`` (round k's
+    next back stage).  The constraints encoded are exactly the
+    pipeline's: rounds form in order; a round's stages run in order;
+    back halves are globally FIFO by round (single-writer commit
+    ordering); at most `slots` rounds are in flight at once.  Feed each
+    sequence to a ``backstage="manual"`` pipeline — `pump()` for pump
+    tokens, `step_back()` for back tokens — and every schedule must
+    produce bit-identical end states (tests/test_pipeline.py)."""
+    n_back = len(BACK_STAGES)
+
+    def gen(pumped, backed, acc):
+        # backed = total back stages completed, globally FIFO: round
+        # b = backed // n_back is the round whose back half is next
+        if pumped == n_rounds and backed == n_rounds * n_back:
+            yield list(acc)
+            return
+        b_round, b_stage = divmod(backed, n_back)
+        inflight = pumped - b_round  # formed, not fully committed
+        if pumped < n_rounds and inflight < slots:
+            acc.append(("pump", pumped))
+            yield from gen(pumped + 1, backed, acc)
+            acc.pop()
+        if b_round < pumped:
+            acc.append(("back", b_round, BACK_STAGES[b_stage]))
+            yield from gen(pumped, backed + 1, acc)
+            acc.pop()
+
+    yield from gen(0, 0, [])

@@ -1,0 +1,459 @@
+"""Tenant-sharded serving: M engine workers behind one router.
+
+One `ServingEngine` is single-threaded by construction — its journal
+fsyncs, LRU bookkeeping, and breaker state all assume one writer.  To
+scale past one process WITHOUT revisiting any of that, the router
+shards the TENANT SPACE instead of the engine: each of `n_workers`
+workers owns a stable hash slice of tenant ids, with its OWN store
+partition (`store.worker_partition` — disjoint snapshot + journal
+trees) and its own admission pipeline.  Every per-tenant invariant —
+write-ahead ordering, acked ⇔ durable, breaker and eviction accounting
+— is therefore a per-worker fact; the router adds routing, fan-out, and
+gang-scheduled refits, never shared mutable state.
+
+Backends:
+
+* ``inproc`` — workers are in-process `ServingEngine`s.  Zero IPC;
+  what the fast tests drive, and the degenerate M=1 case is exactly a
+  plain engine behind one hash lookup.
+* ``process`` — workers are OS processes (spawn), one duplex pipe
+  each.  Requests pickle over the pipe; responses are sanitized to
+  numpy leaves first (a device buffer must not cross a process
+  boundary).  Fan-out calls (`flush_all`, `stats`, `close`) send to
+  EVERY worker before receiving from any, so workers overlap.
+
+Refits GANG-SCHEDULE: workers only queue refit requests
+(`engine._queue_refit`); `flush_refits()` pulls every worker's queue,
+runs ONE `refit_batch` in the router process — inside
+`parallel.distributed.global_mesh` when the process-spanning init (PR
+15) is active, so a multi-host mesh sees one batched EM across all
+shards — and installs the fitted params back into the owning workers.
+`init_spec="module:function"` runs an arbitrary initializer in each
+worker at startup (e.g. `parallel.distributed.initialize_distributed`
+wired from env) for deployments where workers join the mesh
+themselves.
+
+Per-worker isolation is the failure story: one worker's eviction
+budget, circuit breakers, and fault drills never touch another's
+tenants, and a crashed worker loses only its slice — `recover()` on a
+fresh router replays each partition independently.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import importlib
+import multiprocessing as mp
+import os
+
+import numpy as np
+
+from ..utils.telemetry import inc
+from .store import worker_partition
+
+__all__ = ["TenantRouter", "worker_of"]
+
+_BACKENDS = ("inproc", "process")
+
+
+def worker_of(tenant_id: str, n_workers: int) -> int:
+    """Stable tenant → worker shard map: sha256 of the id, mod M.
+    Independent of registration order and identical across processes
+    and restarts — the partition layout on disk IS the routing table."""
+    h = hashlib.sha256(tenant_id.encode()).hexdigest()[:8]
+    return int(h, 16) % int(n_workers)
+
+
+def _sanitize(obj):
+    """Replace device arrays with host numpy in a response pytree so it
+    pickles across a process boundary without dragging jax buffers."""
+    import jax
+
+    def leaf(x):
+        if hasattr(x, "__array__") and not isinstance(x, np.ndarray):
+            return np.asarray(x)
+        return x
+
+    return jax.tree.map(leaf, obj)
+
+
+def _run_init_spec(init_spec: str | None) -> None:
+    if not init_spec:
+        return
+    mod, _, fn = init_spec.partition(":")
+    getattr(importlib.import_module(mod), fn or "main")()
+
+
+def _make_engine(store_dir, worker_id, engine_kwargs):
+    from .engine import ServingEngine
+
+    kw = dict(engine_kwargs or {})
+    sd = worker_partition(store_dir, worker_id) if store_dir else None
+    return ServingEngine(store_dir=sd, **kw)
+
+
+def _worker_main(conn, worker_id, store_dir, engine_kwargs,
+                 pipelined, pipeline_kwargs, init_spec) -> None:
+    """Engine-worker process body: one engine (plus optional pipeline)
+    serving ops off the pipe until ``close``.  Never raises across the
+    pipe — errors return as ``("err", repr)`` so one bad request
+    cannot wedge the router's recv."""
+    _run_init_spec(init_spec)
+    eng = _make_engine(store_dir, worker_id, engine_kwargs)
+    pipe = None
+    if pipelined:
+        from .pipeline import ServingPipeline
+
+        pipe = ServingPipeline(eng, **(pipeline_kwargs or {}))
+    while True:
+        try:
+            op, payload = conn.recv()
+        except EOFError:
+            break
+        try:
+            if op == "close":
+                if pipe is not None:
+                    pipe.close()
+                conn.send(("ok", None))
+                break
+            conn.send(("ok", _worker_op(eng, pipe, op, payload)))
+        except Exception as e:  # typed errors stay envelopes; this is
+            conn.send(("err", f"{type(e).__name__}: {e}"))  # the backstop
+    conn.close()
+
+
+def _worker_op(eng, pipe, op, payload):
+    """Shared op table: the process worker loop and the inproc backend
+    dispatch through the SAME function, so both backends are one code
+    path up to pickling."""
+    if op == "register":
+        tid, x, mask, params = payload
+        eng.register(tid, x, mask=mask, params=params)
+        return None
+    if op == "register_shared":
+        tid, like = payload
+        eng.register_shared(tid, like)
+        return None
+    if op == "handle":
+        return _sanitize(eng.handle(payload))
+    if op == "submit":
+        if pipe is not None:
+            for req in payload:
+                pipe.submit(req)
+            return None
+        for req in payload:
+            eng.submit(req)
+        return None
+    if op == "flush":
+        if pipe is not None:
+            out = pipe.drain()
+        else:
+            out = eng.flush_period()
+        return _sanitize(out)
+    if op == "pump":
+        if pipe is not None:
+            pipe.pump()
+            return _sanitize(pipe.poll())
+        return _sanitize(eng.flush_period())
+    if op == "refit_pull":
+        # gang scheduling: hand the queued refits (panel + params) to
+        # the router; the queue empties here, exactly like flush_refits
+        queue, eng._refit_queue = eng._refit_queue, []
+        out = []
+        for tid in queue:
+            ten = eng._tenants.get(tid)
+            if ten is None or ten.hist is None:
+                continue
+            out.append((
+                tid,
+                np.asarray(ten.hist.x), np.asarray(ten.hist.mask),
+                _sanitize(ten.params),
+            ))
+        return out
+    if op == "refit_install":
+        installed = 0
+        for tid, params in payload:
+            ten = eng._tenants.get(tid)
+            if ten is None or ten.hist is None:
+                continue
+            eng._install(tid, ten.hist.x, ten.hist.mask, params)
+            installed += 1
+        return installed
+    if op == "recover":
+        return eng.recover(prewarm=payload)
+    if op == "flush_metrics":
+        return eng.flush_metrics()
+    if op == "stats":
+        st = {
+            "resident": len(eng._tenants),
+            "requests": eng._requests,
+            "ticks": eng._ticks,
+        }
+        if pipe is not None:
+            st["pipeline"] = pipe.stats()
+        return st
+    if op == "tenant_ids":
+        return eng.tenant_ids()
+    raise ValueError(f"unknown worker op {op!r}")
+
+
+class TenantRouter:
+    """Shard tenants across M engine workers; route by stable hash.
+
+    The router is the single client-facing object: `register` /
+    `handle` / `submit` / `flush_all` mirror the engine API and fan
+    out (or route point-wise) to the owning worker.  Per-worker
+    eviction budgets and breakers come from `engine_kwargs` — applied
+    to EVERY worker, so M workers give M× the configured budget, each
+    enforced locally."""
+
+    def __init__(
+        self,
+        n_workers: int,
+        store_dir: str | None = None,
+        backend: str = "inproc",
+        pipelined: bool = False,
+        engine_kwargs: dict | None = None,
+        pipeline_kwargs: dict | None = None,
+        init_spec: str | None = None,
+    ):
+        if backend not in _BACKENDS:
+            raise ValueError(
+                f"backend must be one of {_BACKENDS}, got {backend!r}"
+            )
+        if n_workers < 1:
+            raise ValueError("n_workers must be >= 1")
+        self.n_workers = int(n_workers)
+        self.store_dir = store_dir
+        self.backend = backend
+        self.pipelined = bool(pipelined)
+        self.engine_kwargs = dict(engine_kwargs or {})
+        self.pipeline_kwargs = dict(pipeline_kwargs or {})
+        self._closed = False
+        self._engines = None
+        self._pipes = None
+        self._conns = None
+        self._procs = None
+        if backend == "inproc":
+            _run_init_spec(init_spec)
+            self._engines = [
+                _make_engine(store_dir, i, self.engine_kwargs)
+                for i in range(self.n_workers)
+            ]
+            self._pipes = [None] * self.n_workers
+            if self.pipelined:
+                from .pipeline import ServingPipeline
+
+                self._pipes = [
+                    ServingPipeline(eng, **self.pipeline_kwargs)
+                    for eng in self._engines
+                ]
+        else:
+            ctx = mp.get_context("spawn")
+            self._conns, self._procs = [], []
+            for i in range(self.n_workers):
+                parent, child = ctx.Pipe()
+                proc = ctx.Process(
+                    target=_worker_main,
+                    args=(child, i, store_dir, self.engine_kwargs,
+                          self.pipelined, self.pipeline_kwargs, init_spec),
+                    daemon=True,
+                )
+                proc.start()
+                child.close()
+                self._conns.append(parent)
+                self._procs.append(proc)
+
+    # -- shard addressing ------------------------------------------------
+
+    def worker_of(self, tenant_id: str) -> int:
+        return worker_of(tenant_id, self.n_workers)
+
+    def _call(self, w: int, op, payload=None):
+        if self._engines is not None:
+            return _worker_op(self._engines[w], self._pipes[w], op, payload)
+        self._conns[w].send((op, payload))
+        status, out = self._conns[w].recv()
+        if status == "err":
+            raise RuntimeError(f"worker {w}: {out}")
+        return out
+
+    def _fanout(self, op, payload=None) -> list:
+        """Send `op` to every worker, THEN collect: with process
+        workers the M operations overlap — this is where M× shows up."""
+        if self._engines is not None:
+            return [
+                self._call(w, op, payload) for w in range(self.n_workers)
+            ]
+        for conn in self._conns:
+            conn.send((op, payload))
+        out = []
+        for w, conn in enumerate(self._conns):
+            status, val = conn.recv()
+            if status == "err":
+                raise RuntimeError(f"worker {w}: {val}")
+            out.append(val)
+        return out
+
+    # -- engine API, sharded ---------------------------------------------
+
+    def register(self, tenant_id, x, mask=None, params=None) -> int:
+        w = self.worker_of(tenant_id)
+        self._call(w, "register", (
+            tenant_id, np.asarray(x, float),
+            None if mask is None else np.asarray(mask, bool),
+            None if params is None else _sanitize(params),
+        ))
+        return w
+
+    def register_seed(self, tenant_id, x, mask=None, params=None) -> None:
+        """Install a SEED tenant on EVERY worker so `register_shared`
+        can clone it locally regardless of which shard the clone hashes
+        to — the sharded analogue of the engine's shared-fit mass
+        registration (register once, clone O(1) everywhere)."""
+        payload = (
+            tenant_id, np.asarray(x, float),
+            None if mask is None else np.asarray(mask, bool),
+            None if params is None else _sanitize(params),
+        )
+        self._fanout("register", payload)
+
+    def register_shared(self, tenant_id, like) -> int:
+        w = self.worker_of(tenant_id)
+        self._call(w, "register_shared", (tenant_id, like))
+        return w
+
+    def handle(self, req):
+        tid = req.get("tenant") if isinstance(req, dict) else None
+        w = self.worker_of(tid) if isinstance(tid, str) else 0
+        return self._call(w, "handle", req)
+
+    def submit(self, reqs) -> None:
+        """Batch-submit tick requests, bucketed per owning worker (one
+        pipe message per worker, not per request)."""
+        if isinstance(reqs, dict):
+            reqs = [reqs]
+        buckets: list = [[] for _ in range(self.n_workers)]
+        for req in reqs:
+            tid = req.get("tenant") if isinstance(req, dict) else None
+            w = self.worker_of(tid) if isinstance(tid, str) else 0
+            buckets[w].append(req)
+        for w, bucket in enumerate(buckets):
+            if bucket:
+                self._call(w, "submit", bucket)
+
+    def flush_all(self) -> list:
+        """Flush every worker's queue/pipeline; responses concatenated
+        in worker order (per-worker submission order preserved)."""
+        out = []
+        for part in self._fanout("flush"):
+            out.extend(part)
+        inc("serving.router.flushes")
+        return out
+
+    def flush_refits(self):
+        """Gang-scheduled refit flush: pull every worker's queued
+        refits, run ONE batched EM in the router process — under the
+        process-spanning mesh when `parallel.distributed` is initialized
+        — then install results back into the owning workers.  Returns
+        ``{"n_requests", "installed", "failed"}``."""
+        import jax.numpy as jnp
+
+        from .batch import RefitRequest, refit_batch
+        from ..parallel import distributed as _dist
+
+        pulls = self._fanout("refit_pull")
+        reqs, owner = [], {}
+        for w, part in enumerate(pulls):
+            for tid, x, mask, params in part:
+                reqs.append(RefitRequest(
+                    tenant_id=tid, x=jnp.asarray(x),
+                    mask=jnp.asarray(mask), params=params,
+                ))
+                owner[tid] = w
+        if not reqs:
+            return {"n_requests": 0, "installed": 0, "failed": []}
+        import jax
+
+        eng_kw = self.engine_kwargs
+
+        def _run():
+            return refit_batch(
+                reqs, isolate_errors=True, tol=eng_kw.get("tol", 1e-6),
+                max_em_iter=eng_kw.get("max_em_iter", 200),
+            )
+
+        if jax.process_count() > 1:
+            # process-spanning init active (PR 15): one batched EM over
+            # the global mesh gang-schedules the refit across hosts
+            with _dist.global_mesh():
+                results = _run()
+        else:
+            results = _run()
+        installs: list = [[] for _ in range(self.n_workers)]
+        failed = []
+        for res in results:
+            if res.health == 0:
+                installs[owner[res.tenant_id]].append(
+                    (res.tenant_id, _sanitize(res.params))
+                )
+            else:
+                failed.append(res.tenant_id)
+        installed = 0
+        for w, batch in enumerate(installs):
+            if batch:
+                installed += self._call(w, "refit_install", batch)
+        inc("serving.router.gang_refits")
+        return {
+            "n_requests": len(reqs), "installed": installed,
+            "failed": failed,
+        }
+
+    def recover(self, prewarm=None) -> list:
+        return self._fanout("recover", prewarm)
+
+    def flush_metrics(self) -> list:
+        return self._fanout("flush_metrics")
+
+    def stats(self) -> list:
+        return self._fanout("stats")
+
+    def tenant_ids(self) -> list:
+        out = []
+        for part in self._fanout("tenant_ids"):
+            out.extend(part)
+        return sorted(out)
+
+    # -- lifecycle -------------------------------------------------------
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        if self._engines is not None:
+            for pipe in self._pipes:
+                if pipe is not None:
+                    pipe.close()
+            return
+        for conn in self._conns:
+            try:
+                conn.send(("close", None))
+            except (BrokenPipeError, OSError):
+                pass
+        for conn in self._conns:
+            try:
+                conn.recv()
+            except (EOFError, OSError):
+                pass
+            conn.close()
+        for proc in self._procs:
+            proc.join(timeout=30.0)
+            if proc.is_alive():
+                proc.terminate()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

@@ -33,9 +33,20 @@ from ..utils.checkpoint import (
 from ..utils.telemetry import inc
 from .journal import TickJournal
 
-__all__ = ["TenantState", "TenantStore", "template_state"]
+__all__ = [
+    "TenantState", "TenantStore", "template_state", "worker_partition",
+]
 
 _ID_RE = re.compile(r"^[A-Za-z0-9_\-]+$")
+
+
+def worker_partition(directory: str, worker: int) -> str:
+    """Store-partition path for one sharded engine worker
+    (serving/router.py): each worker owns a DISJOINT subdirectory of
+    the store, so snapshots and journals of different workers never
+    share a file and the per-tenant crash analysis is per-partition.
+    Pure path arithmetic — `TenantStore` creates the directory."""
+    return os.path.join(directory, f"worker{int(worker):03d}")
 
 
 class TenantState(NamedTuple):

@@ -51,6 +51,16 @@ chaos-serving drills in tests/test_chaos_serving.py and
                     single fsynced append), killing before op n models
                     every possible crash point in an evict / fault-in /
                     batch-commit sequence
+    stall_commit@n  the n-th committed serving round's COMMIT stage
+                    sleeps past the round's deadline budget before
+                    applying the (already journaled, hence durable)
+                    lane states — the pipelined-serving drill: acks
+                    arrive late, SLO burn shows it, and a flight
+                    bundle records the stall; state stays exact
+    queue_full@n    the n-th pipeline admission is shed as if the
+                    bounded admission queue were saturated — the
+                    request comes back a typed ``queue_full`` system
+                    fault without ever forming a lane
 
 Unsuffixed ``ckpt_corrupt`` / ``preempt`` / ``engine_crash`` default to
 n=1; every other kind requires an explicit site.
@@ -70,8 +80,10 @@ For the serving kinds ``+`` means a fault STORM rather than a one-shot:
 ``tick_nan@1+`` poisons EVERY tick from site 1 onward while the plan is
 active (the circuit-breaker open drill), ``store_io@2+`` fails every
 store op from the 2nd on (retry exhaustion), ``slow_req@1+`` stalls
-every request.  ``engine_crash`` and ``crash_io`` are kills — they fire
-once and cannot be persistent.
+every request, ``stall_commit@1+`` stalls every round's commit stage
+(the sustained-backpressure drill) and ``queue_full@1+`` sheds every
+admission from site 1 on (total saturation).  ``engine_crash`` and
+``crash_io`` are kills — they fire once and cannot be persistent.
 
 Everything here is host-side and import-cheap; with no spec active every
 probe returns the empty plan and the guarded program is unchanged.
@@ -103,13 +115,15 @@ _override: "FaultPlan | None" = None
 _KINDS = (
     "nan_estep", "chol_fail", "nan_draw", "ckpt_corrupt", "preempt",
     "tick_nan", "store_io", "slow_req", "engine_crash", "crash_io",
+    "stall_commit", "queue_full",
 )
 # kinds where a bare clause means "at the first site"
 _DEFAULT_SITE = {"ckpt_corrupt": 1, "preempt": 1, "engine_crash": 1}
 # kinds a trailing '+' may mark persistent (in-loop retries / serving storms)
-_PERSISTABLE = frozenset(
-    {"nan_estep", "chol_fail", "nan_draw", "tick_nan", "store_io", "slow_req"}
-)
+_PERSISTABLE = frozenset({
+    "nan_estep", "chol_fail", "nan_draw", "tick_nan", "store_io",
+    "slow_req", "stall_commit", "queue_full",
+})
 
 
 class SimulatedPreemption(RuntimeError):
@@ -142,6 +156,8 @@ class FaultPlan(NamedTuple):
     slow_req: int | None = None
     engine_crash: int | None = None
     crash_io: int | None = None
+    stall_commit: int | None = None
+    queue_full: int | None = None
     persistent: frozenset = frozenset()
 
     def any(self) -> bool:

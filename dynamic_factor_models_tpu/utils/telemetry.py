@@ -1290,7 +1290,7 @@ def summarize(path: str, entry: str | None = None) -> str:
         g = metrics.get("gauges") or {}
         vals = [
             float(g.get(f"serving.occupancy.{p}_s") or 0.0)
-            for p in ("dispatch", "journal", "commit", "envelope")
+            for p in ("admit", "dispatch", "journal", "commit", "envelope")
         ]
         tot = sum(vals)
         if tot <= 0:
@@ -1329,7 +1329,7 @@ def summarize(path: str, entry: str | None = None) -> str:
     aggregate = _fmt_table(
         ["entry", "runs", "err", "wall_s", "mean_s", "mean_iters",
          "conv%", "compile_s", "aot h/m", "faults", "ess_min", "avail",
-         "resident", "evict", "fault_in", "GFLOP", "occ d/j/c/e",
+         "resident", "evict", "fault_in", "GFLOP", "occ a/d/j/c/e",
          "p50_ms", "p99_ms"],
         arows,
     )

@@ -83,6 +83,22 @@ def test_fault_grammar_serving_kinds():
         faults.parse_spec("tick_nan")
 
 
+def test_fault_grammar_pipeline_kinds():
+    """`stall_commit@n` / `queue_full@n` ride the same grammar: one-shot
+    at a site, ``+`` for a persistent storm, composable with the other
+    serving kinds."""
+    plan = faults.parse_spec("stall_commit@2;queue_full@1+")
+    assert plan.stall_commit == 2 and plan.queue_full == 1
+    assert plan.persistent == frozenset({"queue_full"})
+    assert plan.hits("stall_commit", 2) and not plan.hits("stall_commit", 3)
+    assert plan.hits("queue_full", 1) and plan.hits("queue_full", 9)
+    plan = faults.parse_spec("stall_commit@1+;crash_io@4")
+    assert plan.persistent == frozenset({"stall_commit"})
+    assert plan.crash_io == 4
+    with pytest.raises(ValueError, match="needs an iteration"):
+        faults.parse_spec("stall_commit")
+
+
 def test_circuit_breaker_lifecycle():
     br = CircuitBreaker(threshold=3, cooldown=2)
     for _ in range(2):

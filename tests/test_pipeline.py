@@ -1,0 +1,673 @@
+"""Async pipelined serving: double-buffered rounds, bounded admission,
+tenant-sharded workers (PR: async pipelined serving).
+
+Pinned claims:
+
+1. pipelined rounds are BITWISE equal to sequential `flush_period`
+   serving — serial, threaded, and manual backstages all land every
+   tenant on the identical FilterState;
+2. the stage-handoff structure is explicit and bounded: at most `slots`
+   rounds in flight (two-slot ring), back halves commit strictly FIFO
+   by round index, and a tenant is in at most ONE in-flight round
+   (exclusion), so the crash analysis stays per-round per-tenant;
+3. `interleavings()` ENUMERATES every legal stage ordering of the
+   two-slot ring — 3 schedules for 2 rounds — and a manual-backstage
+   pipeline driven through each schedule produces bit-identical final
+   states: overlap is timing-independent by construction, not by luck;
+4. the admission front sheds with TYPES: a full bounded queue (or an
+   injected ``queue_full@n``) answers a ``queue_full`` system fault,
+   and entries whose deadline burned down while queued are shed at
+   round formation without dispatching — both countered
+   (``serving.admission.shed.*``) and both still one-Response-per-
+   submission through `poll()`;
+5. kill-matrix at EVERY stage boundary (admit / dispatch / journal /
+   commit, every round) and at every `crash_io@n` store site: restart
+   recovers, per tenant, acked ≤ recovered ≤ acked + 1 ticks, a second
+   restart is bit-identical, and no journal is ever quarantined;
+6. with a pipeline attached the every-1024-requests metrics flush runs
+   on the COMMIT stage, not the admission path;
+7. `TenantRouter` shards tenants by stable hash across M workers with
+   disjoint store partitions; routing, fan-out flush, and
+   gang-scheduled refits preserve the single-engine response contract
+   (the OS-process backend drill is `slow`-marked).
+"""
+
+import glob
+import os
+import time
+
+import numpy as np
+import pytest
+
+from dynamic_factor_models_tpu.serving.engine import ServingEngine
+from dynamic_factor_models_tpu.serving.pipeline import (
+    BACK_STAGES,
+    ServingPipeline,
+    interleavings,
+)
+from dynamic_factor_models_tpu.serving.resilience import RetryPolicy
+from dynamic_factor_models_tpu.serving.router import TenantRouter, worker_of
+from dynamic_factor_models_tpu.serving.store import worker_partition
+from dynamic_factor_models_tpu.utils import faults, flight, telemetry
+
+pytestmark = [pytest.mark.serving, pytest.mark.pipeline]
+
+_POLICY = RetryPolicy(max_retries=2, backoff_base_s=0.0)
+
+T, N = 48, 6
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs():
+    telemetry.disable()
+    flight.reset()
+    yield
+    telemetry.disable()
+    telemetry._explicit_enabled = None
+    flight.reset()
+
+
+def _panel(seed=0):
+    rng = np.random.default_rng(seed)
+    f = rng.standard_normal((T, 4)).cumsum(0) * 0.1
+    lam = rng.standard_normal((N, 4))
+    return f @ lam.T + 0.5 * rng.standard_normal((T, N))
+
+
+def _engine(store_dir=None, **kw):
+    kw.setdefault("retry_policy", _POLICY)
+    kw.setdefault("max_em_iter", 5)
+    return ServingEngine(store_dir=store_dir, **kw)
+
+
+def _mk(store_dir, n_tenants, seed=7):
+    """One registered seed + (n-1) shared clones: cheap to build, and
+    every tenant's state diverges as soon as rows differ."""
+    eng = _engine(store_dir)
+    eng.register("t0", _panel(seed))
+    for i in range(1, n_tenants):
+        eng.register_shared(f"t{i}", "t0")
+    return eng
+
+
+def _rows(n, seed=9):
+    return np.random.default_rng(seed).standard_normal((n, N))
+
+
+def _tick(tid, row, **extra):
+    return {"kind": "tick", "tenant": tid, "x": row, **extra}
+
+
+def _states(eng):
+    return {
+        tid: (np.asarray(eng._tenants[tid].state.s).copy(),
+              int(eng._tenants[tid].state.t))
+        for tid in eng.tenant_ids()
+    }
+
+
+# ---------------------------------------------------------------------------
+# 1. parity: pipelined == sequential, bitwise
+# ---------------------------------------------------------------------------
+
+
+def _workload(n_tenants=4, ticks=3, seed=11):
+    rows = np.random.default_rng(seed).standard_normal(
+        (ticks, n_tenants, N)
+    )
+    return [
+        _tick(f"t{i}", rows[k, i])
+        for k in range(ticks) for i in range(n_tenants)
+    ]
+
+
+@pytest.mark.parametrize("backstage", ["serial", "thread"])
+def test_pipeline_parity_with_sequential(tmp_path, backstage):
+    reqs = _workload()
+    ref = _mk(str(tmp_path / "ref"), 4)
+    for r in reqs:
+        ref.submit(r)
+    ref_out = ref.flush_period()
+    assert all(r.ok for r in ref_out)
+
+    eng = _mk(str(tmp_path / backstage), 4)
+    with ServingPipeline(eng, backstage=backstage,
+                         max_round_lanes=4) as pipe:
+        for r in reqs:
+            pipe.submit(r)
+        out = pipe.drain()
+    assert len(out) == len(ref_out) and all(r.ok for r in out)
+    # responses come back in submission order with matching tenants
+    assert [r.tenant for r in out] == [r["tenant"] for r in reqs]
+    ref_states, states = _states(ref), _states(eng)
+    assert ref_states.keys() == states.keys()
+    for tid, (s, t) in ref_states.items():
+        assert states[tid][1] == t
+        np.testing.assert_array_equal(states[tid][0], s)
+
+
+def test_pipeline_storeless_parity():
+    """No store: the journal stage degenerates but ordering and results
+    must be unchanged."""
+    reqs = _workload(n_tenants=3, ticks=2)
+    ref = _mk(None, 3)
+    for r in reqs:
+        ref.submit(r)
+    ref_out = ref.flush_period()
+    eng = _mk(None, 3)
+    with ServingPipeline(eng, backstage="serial", max_round_lanes=3) as p:
+        for r in reqs:
+            p.submit(r)
+        out = p.drain()
+    assert all(r.ok for r in out) and len(out) == len(ref_out)
+    for tid, (s, _t) in _states(ref).items():
+        np.testing.assert_array_equal(_states(eng)[tid][0], s)
+
+
+# ---------------------------------------------------------------------------
+# 2. explicit structure: ring bound, FIFO commits, exclusion
+# ---------------------------------------------------------------------------
+
+
+def test_ring_bound_and_fifo_commit_order(tmp_path):
+    events = []
+    eng = _mk(str(tmp_path / "s"), 8)
+    pipe = ServingPipeline(
+        eng, backstage="manual", max_round_lanes=4, slots=2,
+        boundary_hook=lambda stage, rnd: events.append((stage, rnd)),
+    )
+    rows = _rows(8)
+    for i in range(8):
+        pipe.submit(_tick(f"t{i}", rows[i]))
+    assert pipe.pump() == 4
+    assert pipe.pump() == 4
+    # ring full at slots=2: a third pump must refuse, not buffer
+    assert pipe.stats()["inflight"] == 2
+    with pytest.raises(RuntimeError, match="ring full"):
+        pipe.pump()
+    # back halves advance strictly FIFO by round index
+    assert pipe.step_back() == (0, "journal")
+    assert pipe.step_back() == (0, "commit")
+    assert pipe.step_back() == (1, "journal")
+    assert pipe.step_back() == (1, "commit")
+    out = pipe.poll()
+    assert len(out) == 8 and all(r.ok for r in out)
+    commit_rounds = [rnd for stage, rnd in events if stage == "commit"]
+    assert commit_rounds == [0, 1]
+    assert pipe.stats()["max_inflight"] == 2
+    pipe.close()
+
+
+def test_per_tenant_exclusion_across_inflight_rounds(tmp_path):
+    eng = _mk(str(tmp_path / "s"), 4)
+    pipe = ServingPipeline(eng, backstage="manual", max_round_lanes=8)
+    rows = _rows(2)
+    for k in range(2):
+        for i in range(4):
+            pipe.submit(_tick(f"t{i}", rows[k]))
+    assert pipe.pump() == 4
+    # every queued tenant is in an in-flight round: nothing admissible
+    assert pipe.pump() == 0
+    assert pipe.depth() == 4  # skipped entries kept their queue slot
+    pipe.step_back(), pipe.step_back()  # round 0 retires
+    assert pipe.pump() == 4
+    pipe.step_back(), pipe.step_back()
+    out = pipe.poll()
+    assert len(out) == 8 and all(r.ok for r in out)
+    # in-flight tenants were pinned, and the pin is released after
+    assert eng._admission_pin == set()
+    pipe.close()
+
+
+def test_pipeline_pin_blocks_mid_round_eviction(tmp_path):
+    """An in-flight round's tenants must not be evicted by budget
+    pressure from the NEXT round's fault-ins."""
+    eng = _engine(str(tmp_path / "s"), resident_tenants=2)
+    eng.register("t0", _panel())
+    for i in range(1, 4):
+        eng.register_shared(f"t{i}", "t0")
+    pipe = ServingPipeline(eng, backstage="manual", max_round_lanes=2)
+    rows = _rows(4)
+    pipe.submit(_tick("t0", rows[0]))
+    pipe.submit(_tick("t1", rows[1]))
+    pipe.submit(_tick("t2", rows[2]))
+    pipe.submit(_tick("t3", rows[3]))
+    assert pipe.pump() == 2          # round 0: t0, t1 (faulted in + pinned)
+    assert {"t0", "t1"} <= eng._admission_pin
+    assert pipe.pump() == 2          # round 1 faults t2, t3 in
+    # round 0's tenants survived round 1's admission
+    assert "t0" in eng._tenants and "t1" in eng._tenants
+    for _ in range(4):
+        pipe.step_back()
+    out = pipe.poll()
+    assert len(out) == 4 and all(r.ok for r in out)
+    assert eng._admission_pin == set()
+    pipe.close()
+
+
+# ---------------------------------------------------------------------------
+# 3. deterministic interleaving enumeration
+# ---------------------------------------------------------------------------
+
+
+def test_interleavings_enumeration():
+    ils = list(interleavings(n_rounds=2, slots=2))
+    assert len(ils) == 3 and len(set(map(tuple, ils))) == 3
+    for il in ils:
+        pumped, backed = 0, 0
+        for tok in il:
+            if tok[0] == "pump":
+                assert tok[1] == pumped
+                pumped += 1
+            else:
+                _b, rnd, stage = backed // len(BACK_STAGES), tok[1], tok[2]
+                assert rnd == _b and stage == BACK_STAGES[
+                    backed % len(BACK_STAGES)
+                ]
+                assert rnd < pumped  # back half never precedes its pump
+                backed += 1
+            assert pumped - backed // len(BACK_STAGES) <= 2  # ring bound
+        assert pumped == 2 and backed == 2 * len(BACK_STAGES)
+    # slots=1 collapses to the strictly sequential schedule
+    assert len(list(interleavings(n_rounds=3, slots=1))) == 1
+
+
+def test_all_interleavings_bitwise_equivalent(tmp_path):
+    rows = _rows(8)
+    reqs = [_tick(f"t{i}", rows[i]) for i in range(8)]
+    finals = []
+    for j, il in enumerate(interleavings(n_rounds=2, slots=2)):
+        eng = _mk(str(tmp_path / f"m{j}"), 8)
+        pipe = ServingPipeline(eng, backstage="manual", max_round_lanes=4)
+        for r in reqs:
+            pipe.submit(r)
+        for tok in il:
+            if tok[0] == "pump":
+                assert pipe.pump() == 4
+            else:
+                assert pipe.step_back() == (tok[1], tok[2])
+        out = pipe.poll()
+        assert len(out) == 8 and all(r.ok for r in out)
+        pipe.close()
+        finals.append(_states(eng))
+    for other in finals[1:]:
+        for tid, (s, t) in finals[0].items():
+            assert other[tid][1] == t
+            np.testing.assert_array_equal(other[tid][0], s)
+
+
+# ---------------------------------------------------------------------------
+# 4. admission front: typed shedding
+# ---------------------------------------------------------------------------
+
+
+def test_queue_full_sheds_typed(tmp_path):
+    eng = _mk(str(tmp_path / "s"), 2)
+    pipe = ServingPipeline(eng, backstage="serial", max_queue=1)
+    rows = _rows(3)
+    pipe.submit(_tick("t0", rows[0]))
+    pipe.submit(_tick("t1", rows[1]))  # queue at capacity: shed
+    out = pipe.drain()
+    assert len(out) == 2
+    assert out[0].ok
+    shed = out[1]
+    assert not shed.ok and shed.error.category == "system_fault"
+    assert shed.error.code == "queue_full" and shed.tenant == "t1"
+    assert pipe.stats()["shed_queue_full"] == 1
+    # the shed tenant never ticked
+    assert int(eng._tenants["t1"].state.t) == T
+    pipe.close()
+
+
+def test_queue_full_fault_injection(tmp_path):
+    eng = _mk(str(tmp_path / "s"), 2)
+    pipe = ServingPipeline(eng, backstage="serial", max_queue=1024)
+    with faults.inject("queue_full@1"):
+        pipe.submit(_tick("t0", _rows(1)[0]))
+    out = pipe.drain()
+    assert len(out) == 1 and not out[0].ok
+    assert out[0].error.code == "queue_full"  # forced despite empty queue
+    pipe.close()
+
+
+def test_deadline_shed_at_round_formation(tmp_path):
+    eng = _mk(str(tmp_path / "s"), 2)
+    pipe = ServingPipeline(eng, backstage="serial")
+    rows = _rows(2)
+    pipe.submit(_tick("t0", rows[0], deadline_s=0.001))
+    pipe.submit(_tick("t1", rows[1]))
+    time.sleep(0.01)  # t0's budget burns down while queued
+    out = pipe.drain()
+    assert len(out) == 2
+    assert not out[0].ok and out[0].error.code == "deadline_exceeded"
+    assert out[1].ok
+    assert pipe.stats()["shed_deadline"] == 1
+    # shed at FORMATION: the expired entry never dispatched or journaled
+    assert int(eng._tenants["t0"].state.t) == T
+    pipe.close()
+
+
+def test_stall_commit_drill(tmp_path):
+    """stall_commit@n sleeps the n-th committing round past its budget:
+    acks are DELAYED, never dropped — the lanes were already durable."""
+    eng = _mk(str(tmp_path / "s"), 2)
+    pipe = ServingPipeline(eng, backstage="serial", max_round_lanes=2)
+    rows = _rows(2)
+    t0 = time.perf_counter()
+    with faults.inject("stall_commit@1"):
+        pipe.submit(_tick("t0", rows[0]))
+        pipe.submit(_tick("t1", rows[1]))
+        out = pipe.drain()
+    assert time.perf_counter() - t0 >= 0.02  # the injected stall
+    assert len(out) == 2 and all(r.ok for r in out)
+    assert int(eng._tenants["t0"].state.t) == T + 1
+    pipe.close()
+
+
+def test_admission_gauges_and_shed_counters(tmp_path, monkeypatch):
+    monkeypatch.delenv("DFM_TELEMETRY", raising=False)
+    sink = str(tmp_path / "t.jsonl")
+    telemetry.enable(sink=sink)
+    telemetry.reset()  # counters are process-global
+    eng = _mk(str(tmp_path / "s"), 2)
+    pipe = ServingPipeline(eng, backstage="serial", max_queue=1)
+    rows = _rows(3)
+    pipe.submit(_tick("t0", rows[0]))
+    pipe.submit(_tick("t1", rows[1]))  # shed
+    pipe.drain()
+    eng.flush_metrics()
+    snap = telemetry.snapshot()
+    assert snap["counters"]["serving.admission.submitted"] == 1
+    assert snap["counters"]["serving.admission.shed.queue_full"] == 1
+    assert snap["counters"]["serving.pipeline.rounds"] >= 1
+    assert "serving.admission.depth" in snap["gauges"]
+    # the new admit phase feeds the occupancy split
+    assert snap["gauges"].get("serving.occupancy.admit_s", 0) > 0
+    pipe.close()
+
+
+def test_metrics_flush_rides_commit_stage(tmp_path, monkeypatch):
+    """Satellite 2: with a pipeline attached, the every-1024-requests
+    flush happens on the commit stage, not the admission path."""
+    monkeypatch.delenv("DFM_TELEMETRY", raising=False)
+    telemetry.enable(sink=str(tmp_path / "t.jsonl"))
+    eng = _mk(str(tmp_path / "s"), 2)
+    pipe = ServingPipeline(eng, backstage="manual", max_round_lanes=2)
+    eng._requests = 1023  # next submission is the 1024th request
+    pipe.submit(_tick("t0", _rows(1)[0]))
+    assert eng._metrics_due  # parked, NOT flushed on the request path
+    pipe.pump()
+    assert eng._metrics_due  # front half ran; still parked
+    pipe.step_back()          # journal
+    assert eng._metrics_due
+    pipe.step_back()          # commit drains the deferred flush
+    assert not eng._metrics_due
+    pipe.close()
+
+
+def test_queue_full_and_stall_commit_trigger_flight_dumps(
+    tmp_path, monkeypatch
+):
+    """Satellite: both new fault kinds are pre-mortem triggers — a shed
+    admission and a stalled commit each leave a flight bundle."""
+    d = str(tmp_path / "flight")
+    monkeypatch.setenv("DFM_FLIGHT_DIR", d)
+    monkeypatch.setenv("DFM_FLIGHT_MIN_INTERVAL_S", "0")
+    monkeypatch.delenv("DFM_TELEMETRY", raising=False)
+    telemetry.enable(sink=str(tmp_path / "t.jsonl"))
+    eng = _mk(str(tmp_path / "s"), 2)
+    pipe = ServingPipeline(eng, backstage="serial", max_queue=1)
+    rows = _rows(2)
+    pipe.submit(_tick("t0", rows[0]))
+    pipe.submit(_tick("t1", rows[1]))  # queue_full -> dump
+    with faults.inject("stall_commit@1"):
+        pipe.drain()                   # stall_commit -> dump
+    pipe.close()
+    dumps = sorted(glob.glob(os.path.join(d, "flight-*.json")))
+    triggers = set()
+    import json
+
+    for p in dumps:
+        with open(p) as f:
+            triggers.add(json.load(f)["trigger"]["trigger"])
+    assert "queue_full" in triggers
+    assert "stall_commit" in triggers
+
+
+# ---------------------------------------------------------------------------
+# 5. kill matrix: every stage boundary, every i/o site
+# ---------------------------------------------------------------------------
+
+
+def _acked_by_tenant(responses):
+    out = {}
+    for r in responses:
+        if r.ok:
+            out[r.tenant] = out.get(r.tenant, 0) + 1
+    return out
+
+
+def _run_killed(store, reqs, hook=None, fault_spec=None):
+    """Drive the pipelined workload until done or SimulatedCrash;
+    returns per-tenant ACKED tick counts (responses actually polled
+    before the crash)."""
+    eng = _mk(store, 4)
+    pipe = ServingPipeline(
+        eng, backstage="serial", max_round_lanes=4, boundary_hook=hook,
+    )
+    acked = []
+    try:
+        ctx = faults.inject(fault_spec) if fault_spec else None
+        if ctx:
+            ctx.__enter__()
+        try:
+            for r in reqs:
+                pipe.submit(r)
+                acked.extend(pipe.poll())
+            while pipe.depth() or pipe.stats()["inflight"]:
+                pipe.pump()
+                acked.extend(pipe.poll())
+        finally:
+            if ctx:
+                ctx.__exit__(None, None, None)
+    except faults.SimulatedCrash:
+        return _acked_by_tenant(acked), True
+    finally:
+        pipe.close()
+    acked.extend(pipe.poll())
+    return _acked_by_tenant(acked), False
+
+
+def _assert_exactly_once(store, acked, tag):
+    """Per tenant: acked ≤ recovered ≤ acked + 1; double restart
+    bit-identical; nothing quarantined."""
+    rec = _engine(store)
+    rec2 = _engine(store)
+    for tid in ("t0", "t1", "t2", "t3"):
+        ten = rec._lookup(tid)
+        assert ten is not None, f"{tag}: {tid} lost"
+        recovered = int(ten.state.t) - T
+        a = acked.get(tid, 0)
+        assert a <= recovered <= a + 1, (
+            f"{tag}: tenant {tid} acked {a}, recovered {recovered}"
+        )
+        ten2 = rec2._lookup(tid)
+        assert int(ten2.state.t) == int(ten.state.t)
+        np.testing.assert_array_equal(
+            np.asarray(ten.state.s), np.asarray(ten2.state.s)
+        )
+    assert not glob.glob(os.path.join(store, "*.corrupt"))
+
+
+@pytest.mark.chaos_serving
+def test_kill_matrix_every_stage_boundary(tmp_path):
+    """Acceptance: the PR 13 exactly-once contract holds with the
+    pipeline enabled, killed at EVERY stage boundary of every round."""
+    reqs = _workload(n_tenants=4, ticks=2, seed=23)
+    stages = ("admit", "dispatch", "journal", "commit")
+    killed = 0
+    for stage in stages:
+        for kill_round in (0, 1):
+            store = str(
+                tmp_path / f"kill_{stage}_{kill_round}"
+            )
+
+            def hook(s, rnd, _stage=stage, _kr=kill_round):
+                if s == _stage and rnd == _kr:
+                    raise faults.SimulatedCrash(
+                        f"boundary kill after {s} of round {rnd}"
+                    )
+
+            acked, crashed = _run_killed(store, reqs, hook=hook)
+            assert crashed, (stage, kill_round)
+            killed += 1
+            _assert_exactly_once(
+                store, acked, f"boundary {stage}/{kill_round}"
+            )
+    assert killed == len(stages) * 2
+
+
+@pytest.mark.chaos_serving
+def test_kill_matrix_crash_io_sites_pipelined(tmp_path):
+    """crash_io@n killed at every store i/o site of the pipelined
+    workload (registration sites excluded via the op-counter offset)."""
+    reqs = _workload(n_tenants=4, ticks=2, seed=29)
+    # measure the registration site count once on a throwaway store
+    probe = _mk(str(tmp_path / "probe"), 4)
+    reg_ops = probe.store._io_ops
+    site, crashes = 0, 0
+    while True:
+        site += 1
+        store = str(tmp_path / f"io{site}")
+        acked, crashed = _run_killed(
+            store, reqs, fault_spec=f"crash_io@{reg_ops + site}"
+        )
+        if not crashed:
+            break  # site count walked off the end of the workload
+        crashes += 1
+        _assert_exactly_once(store, acked, f"crash_io site {site}")
+    assert crashes >= 4  # the drill covered the round's journal sites
+
+
+# ---------------------------------------------------------------------------
+# 6. tenant-sharded router
+# ---------------------------------------------------------------------------
+
+
+def test_worker_hash_stable_and_partitions_disjoint(tmp_path):
+    assert worker_of("alpha", 4) == worker_of("alpha", 4)
+    assert 0 <= worker_of("alpha", 4) < 4
+    # partition paths are disjoint per worker
+    parts = {worker_partition(str(tmp_path), i) for i in range(4)}
+    assert len(parts) == 4
+    with pytest.raises(ValueError):
+        TenantRouter(0)
+    with pytest.raises(ValueError):
+        TenantRouter(1, backend="carrier_pigeon")
+
+
+def test_router_inproc_routing_and_flush(tmp_path):
+    rt = TenantRouter(2, store_dir=str(tmp_path / "rt"), backend="inproc",
+                      engine_kwargs={"max_em_iter": 5,
+                                     "retry_policy": _POLICY})
+    rt.register_seed("seed", _panel(3))
+    ids = [f"c{i}" for i in range(6)]
+    for tid in ids:
+        rt.register_shared(tid, "seed")
+    rng = np.random.default_rng(5)
+    # point routing: the owning engine (and only it) holds the tenant
+    for tid in ids:
+        w = rt.worker_of(tid)
+        assert tid in rt._engines[w]._tenants
+        assert tid not in rt._engines[1 - w]._tenants
+    r = rt.handle(_tick("c0", rng.standard_normal(N)))
+    assert r.ok
+    rt.submit([_tick(tid, rng.standard_normal(N)) for tid in ids])
+    out = rt.flush_all()
+    assert len(out) == 6 and all(o.ok for o in out)
+    # each worker's store partition holds exactly its own tenants
+    for i in range(2):
+        stored = set(rt._engines[i].store.list())
+        assert stored == {
+            t for t in ids + ["seed"] if rt.worker_of(t) == i
+        } | {"seed"}
+    rt.close()
+
+
+def test_router_gang_refit(tmp_path):
+    rt = TenantRouter(2, store_dir=str(tmp_path / "rt"), backend="inproc",
+                      engine_kwargs={"max_em_iter": 4,
+                                     "retry_policy": _POLICY})
+    for i in range(3):
+        rt.register(f"g{i}", _panel(seed=40 + i))
+    for i in range(3):
+        assert rt.handle({"kind": "refit", "tenant": f"g{i}"}).ok
+    summary = rt.flush_refits()
+    assert summary["n_requests"] == 3
+    assert summary["installed"] == 3 and summary["failed"] == []
+    # refits actually installed: queues drained everywhere
+    assert all(not e._refit_queue for e in rt._engines)
+    rt.close()
+
+
+def test_router_pipelined_inproc(tmp_path):
+    rt = TenantRouter(
+        2, store_dir=str(tmp_path / "rt"), backend="inproc",
+        pipelined=True,
+        pipeline_kwargs={"backstage": "serial", "max_round_lanes": 8},
+        engine_kwargs={"max_em_iter": 5, "retry_policy": _POLICY},
+    )
+    rt.register_seed("seed", _panel(6))
+    ids = [f"p{i}" for i in range(6)]
+    for tid in ids:
+        rt.register_shared(tid, "seed")
+    rng = np.random.default_rng(8)
+    rt.submit([_tick(tid, rng.standard_normal(N)) for tid in ids])
+    out = rt.flush_all()
+    assert len(out) == 6 and all(o.ok for o in out)
+    stats = rt.stats()
+    # every worker that received requests pipelined at least one round
+    assert all(
+        s["pipeline"]["rounds"] >= 1
+        for s in stats if s["pipeline"]["submitted"]
+    )
+    assert sum(s["pipeline"]["rounds"] for s in stats) >= 1
+    rt.close()
+
+
+@pytest.mark.slow
+def test_router_process_backend(tmp_path):
+    """OS-process workers: register/tick/flush round-trip the pipe with
+    numpy-sanitized responses; a restarted router recovers each
+    partition independently."""
+    store = str(tmp_path / "rt")
+    rt = TenantRouter(
+        2, store_dir=store, backend="process", pipelined=True,
+        pipeline_kwargs={"backstage": "thread", "max_round_lanes": 64},
+    )
+    try:
+        rt.register_seed("seed", _panel(2))
+        ids = [f"c{i}" for i in range(6)]
+        for tid in ids:
+            rt.register_shared(tid, "seed")
+        rng = np.random.default_rng(2)
+        resp = rt.handle(_tick("c0", rng.standard_normal(N)))
+        assert resp.ok
+        assert isinstance(np.asarray(resp.result.s), np.ndarray)
+        rt.submit([_tick(tid, rng.standard_normal(N)) for tid in ids])
+        out = rt.flush_all()
+        assert len(out) == 6 and all(o.ok for o in out)
+        stats = rt.stats()
+        assert sum(s["resident"] for s in stats) == 8
+        assert all("pipeline" in s for s in stats)
+    finally:
+        rt.close()
+    rt2 = TenantRouter(2, store_dir=store, backend="process")
+    try:
+        rec = rt2.recover(prewarm=8)
+        assert sum(r["tenants_on_disk"] for r in rec) == 8
+        # c0 ticked twice pre-restart; this tick's result is T+3
+        r2 = rt2.handle(_tick("c0", np.zeros(N)))
+        assert r2.ok and int(r2.result.t) == T + 3
+    finally:
+        rt2.close()
