@@ -915,7 +915,15 @@ def chaos_serving_section():
       is not envelope work), divided by the bare online_tick wall time
       from the same run.  Acceptance bar: < 5%;
     - chaos_serving_handle_updates_per_sec: end-to-end eng.handle()
-      ticks/s for context (compare serving_updates_per_sec).
+      ticks/s for context (compare serving_updates_per_sec);
+    - chaos_serving_worker_failover: availability under worker kill
+      (PR 19) — a live OS-process router worker is SIGKILLed mid-way
+      through a 40-tick stream; records the typed-response fraction
+      (bar: 1.0), availability, survivor-shard availability (bar:
+      1.0), supervisor detect latency vs the heartbeat deadline, and
+      the measured RTO (detect → respawn → recover → first ack).  The
+      same object is read-modify-written into docs/BENCH_load.json
+      under ``worker_failover``.
 
     Prints one JSON line and returns the dict.
     """
@@ -931,6 +939,7 @@ def chaos_serving_section():
         "chaos_serving_envelope_us": None,
         "chaos_serving_envelope_overhead_frac": None,
         "chaos_serving_handle_updates_per_sec": None,
+        "chaos_serving_worker_failover": None,
     }
     try:
         from dynamic_factor_models_tpu.serving.engine import ServingEngine
@@ -1053,6 +1062,96 @@ def chaos_serving_section():
         fields["chaos_serving_handle_updates_per_sec"] = round(
             n_bench / wall_h, 1
         )
+
+        # --- availability under worker kill (PR 19) ---
+        # SIGKILL one live router worker mid-stream: every request must
+        # come back typed, the survivor shard must stay at 100%
+        # availability, and the supervisor must respawn + recover the
+        # victim — the measured detect latency and RTO are the
+        # committed failover numbers.
+        import tempfile
+
+        from dynamic_factor_models_tpu.serving.router import TenantRouter
+
+        with tempfile.TemporaryDirectory() as td:
+            rt = TenantRouter(
+                2, store_dir=os.path.join(td, "rt"), backend="process",
+            )
+            try:
+                rt.register_seed("seed", panel)
+                ids = [f"w{i}" for i in range(4)]
+                for tid in ids:
+                    rt.register_shared(tid, "seed")
+                for tid in ids:  # warm every shard's tick program
+                    r = rt.handle(
+                        {"kind": "tick", "tenant": tid, "x": rows[0]}
+                    )
+                    assert r.ok, r
+                rt.rpc_timeout_s, rt.suspect_grace_s = 5.0, 1.0
+                n_stream = 40
+                kill_at = rt._rpc_no + n_stream // 2
+                drill = []
+                t0 = time.perf_counter()
+                with faults.inject(f"kill_worker@{kill_at}"):
+                    for i in range(n_stream):
+                        tid = ids[i % len(ids)]
+                        drill.append((tid, rt.handle(
+                            {"kind": "tick", "tenant": tid,
+                             "x": rows[(i + 1) % n_ticks]}
+                        )))
+                wall_s = time.perf_counter() - t0
+                sup = rt.supervisor
+                victim = max(
+                    range(rt.n_workers), key=lambda w: sup.deaths[w]
+                )
+                survivors = [
+                    r for tid, r in drill if rt.worker_of(tid) != victim
+                ]
+                typed = sum(isinstance(r, Response) for _, r in drill)
+                okd = sum(
+                    r.ok for _, r in drill if isinstance(r, Response)
+                )
+                failover = {
+                    "backend": "process",
+                    "n_workers": rt.n_workers,
+                    "n_requests": n_stream,
+                    "typed_response_frac": round(typed / n_stream, 4),
+                    "availability": round(okd / n_stream, 4),
+                    "survivor_ok_frac": round(
+                        sum(r.ok for r in survivors) / len(survivors), 4
+                    ),
+                    "unavailable_responses": n_stream - okd,
+                    "deaths": int(sup.deaths[victim]),
+                    "detect_s": (
+                        None if sup.detect_s[victim] is None
+                        else round(sup.detect_s[victim], 3)
+                    ),
+                    "heartbeat_deadline_s": (
+                        rt.rpc_timeout_s + rt.suspect_grace_s
+                    ),
+                    "rto_s": (
+                        None if sup.rto_s[victim] is None
+                        else round(sup.rto_s[victim], 3)
+                    ),
+                    "drill_wall_s": round(wall_s, 3),
+                    "time_unix": round(time.time(), 1),
+                }
+            finally:
+                rt.close()
+        fields["chaos_serving_worker_failover"] = failover
+        # read-modify-write so --load's full rewrite and this leg can
+        # each run without clobbering the other's committed record
+        path = os.path.join(REPO, "docs", "BENCH_load.json")
+        try:
+            with open(path) as fh:
+                cur = json.load(fh)
+        except Exception:
+            cur = {}
+        cur["worker_failover"] = failover
+        tmp = path + f".tmp.{os.getpid()}"
+        with open(tmp, "w") as fh:
+            json.dump(cur, fh, indent=1, sort_keys=True)
+        os.replace(tmp, path)
     except Exception as e:  # present-but-null contract
         fields["chaos_serving_error"] = f"{type(e).__name__}: {e}"
     print(json.dumps(fields))
@@ -1513,6 +1612,13 @@ def load_section(smoke: bool = False):
             **fields,
         })
         path = os.path.join(REPO, "docs", "BENCH_load.json")
+        try:  # --chaos-serving owns this key: carry it across rewrites
+            with open(path) as fh:
+                prev = json.load(fh)
+            if "worker_failover" in prev:
+                out.setdefault("worker_failover", prev["worker_failover"])
+        except Exception:
+            pass
         tmp = path + ".tmp"
         with open(tmp, "w") as fh:
             json.dump(out, fh, indent=1, sort_keys=True)

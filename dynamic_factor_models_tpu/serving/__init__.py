@@ -21,7 +21,11 @@ foundation:
   commits, per-tenant exclusion).
 * `router` — tenant-sharded serving: M engine workers (in-process or
   OS processes), each owning a hash slice of tenants with its own
-  store partition; refits gang-schedule through one batched EM.
+  store partition; refits gang-schedule through one batched EM.  A
+  supervision layer (deadline-bounded RPCs + `WorkerSupervisor`)
+  detects dead/stalled workers, sheds their requests as typed
+  ``worker_unavailable`` responses, and respawns + recovers them from
+  their untouched partition.
 
 See docs/serving.md for the request types and state-store layout.
 """
@@ -29,7 +33,7 @@ See docs/serving.md for the request types and state-store layout.
 from .batch import RefitResult, refit_batch, refit_sequential
 from .engine import ServingEngine
 from .pipeline import ServingPipeline
-from .router import TenantRouter
+from .router import TenantRouter, WorkerUnavailable
 from .online import (
     FilterState,
     ServingModel,
@@ -55,4 +59,5 @@ __all__ = [
     "ServingEngine",
     "ServingPipeline",
     "TenantRouter",
+    "WorkerUnavailable",
 ]

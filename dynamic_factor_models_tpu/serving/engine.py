@@ -303,6 +303,20 @@ class ServingEngine:
         self._pipeline = None
         self._metrics_due = False
         self._rounds_committed = 0
+        # router-worker identity (serving/router.py stamps it via
+        # set_worker_id): `_rec_extra` is splatted into every serving
+        # RunRecord, so router-routed requests carry `worker_id` and a
+        # standalone engine's records stay byte-identical to pre-PR-19
+        # sinks (empty splat, no extra field, no extra probe)
+        self._worker_id = None
+        self._rec_extra: dict = {}
+
+    def set_worker_id(self, worker_id: int) -> None:
+        """Mark this engine as router worker `worker_id`: serving
+        RunRecords (per-request and per-round) gain a ``worker_id``
+        field for shard-level attribution in `summarize`."""
+        self._worker_id = int(worker_id)
+        self._rec_extra = {"worker_id": self._worker_id}
 
     # -- registration ----------------------------------------------------
 
@@ -612,7 +626,8 @@ class ServingEngine:
         # is off too — a second probe (~1.6µs of env lookups) would blow
         # a visible hole in the <5% envelope bar
         rec_cm = run_record(
-            "serving", kind=rkind, config={"tenant": tenant_id}
+            "serving", kind=rkind, config={"tenant": tenant_id},
+            **self._rec_extra,
         )
         # occupancy attribution rides the SAME probe: phase timers in
         # _tick/_flush_round fire only while this flag is up, so the
@@ -1202,6 +1217,7 @@ class ServingEngine:
             ))
         with run_record(
             "serving", kind="refit_flush", config={"n_tenants": len(reqs)},
+            **self._rec_extra,
         ) as rec:
             results = refit_batch(
                 reqs, tol=self.tol, max_em_iter=self.max_em_iter,
@@ -1310,6 +1326,7 @@ class ServingEngine:
         with run_record(
             "serving", kind="tick_flush",
             config={"n_lanes": len(entries)},
+            **self._rec_extra,
         ) as rec:
             self._obs_live = rec is not _NULL_RECORD
             self._occ_req = 0.0

@@ -268,6 +268,7 @@ class ServingPipeline:
         with run_record(
             "serving", kind="tick_round",
             config={"n_lanes": len(entries), "round": idx},
+            **eng._rec_extra,
         ) as rec:
             obs = rec is not _NULL_RECORD
             eng._obs_live = obs

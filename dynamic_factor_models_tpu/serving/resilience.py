@@ -39,6 +39,15 @@ pre-hardening build (pinned by tests/test_serving.py).
 * **Deadline** — a started wall-clock budget; `exceeded()` probes are
   placed at admission and immediately before any state commit, so a
   blown deadline can never half-apply a tick.
+
+* **WorkerSupervisor** — the per-worker liveness state machine behind
+  `TenantRouter`'s process supervision (docs/robustness.md, worker
+  supervision):  ``healthy → suspect → dead → respawning → recovering
+  → healthy``.  The supervisor itself is pure bookkeeping plus
+  telemetry (``serving.worker.*`` gauges, detect-latency and RTO
+  histograms); the router drives the transitions from its
+  deadline-bounded RPC layer and performs the actual reap / respawn /
+  `engine.recover()` work.
 """
 
 from __future__ import annotations
@@ -47,7 +56,7 @@ import hashlib
 import time
 from typing import Any, NamedTuple
 
-from ..utils.telemetry import inc, trace_event
+from ..utils.telemetry import gauge_set, inc, register_hist, trace_event
 
 __all__ = [
     "CLIENT_ERROR",
@@ -56,11 +65,18 @@ __all__ = [
     "BREAKER_CLOSED",
     "BREAKER_OPEN",
     "BREAKER_HALF_OPEN",
+    "WORKER_HEALTHY",
+    "WORKER_SUSPECT",
+    "WORKER_DEAD",
+    "WORKER_RESPAWNING",
+    "WORKER_RECOVERING",
+    "WORKER_STATES",
     "ErrorInfo",
     "Response",
     "CircuitBreaker",
     "RetryPolicy",
     "Deadline",
+    "WorkerSupervisor",
     "call_with_retries",
 ]
 
@@ -223,6 +239,141 @@ class RetryPolicy(NamedTuple):
         h = hashlib.sha256(f"{key}:{attempt}".encode()).digest()
         u = int.from_bytes(h[:8], "big") / float(1 << 64)
         return base * (0.5 + 0.5 * u)
+
+
+WORKER_HEALTHY = "healthy"
+WORKER_SUSPECT = "suspect"
+WORKER_DEAD = "dead"
+WORKER_RESPAWNING = "respawning"
+WORKER_RECOVERING = "recovering"
+
+# ordinal codes: what the `serving.worker.state{worker="i"}` gauge
+# carries and what `TenantState`-style packing would use — the ORDER is
+# the lifecycle order and is part of the telemetry contract
+WORKER_STATES = (
+    WORKER_HEALTHY, WORKER_SUSPECT, WORKER_DEAD,
+    WORKER_RESPAWNING, WORKER_RECOVERING,
+)
+
+
+class WorkerSupervisor:
+    """Liveness state machine for M router workers.
+
+    One instance tracks every worker's lifecycle position::
+
+        healthy --deadline missed--> suspect --confirmed--> dead
+        healthy --pipe EOF / SIGKILL observed--------------> dead
+        suspect --late reply arrived-----------------------> healthy
+        dead --router spawns a fresh process---> respawning
+        respawning --ping answered, recover() driven--> recovering
+        respawning / recovering --died again--> dead   (double kill)
+        recovering --first successful client ack--> healthy
+
+    The supervisor records, per worker: death and respawn counts, the
+    detect latency (first missed observation → declared dead; bounded
+    by the router's heartbeat deadline), and the RTO (first missed
+    observation → first successful ack from the respawned worker, i.e.
+    detect→respawn→recover→first-ack).  Every transition lands in the
+    metrics registry (``serving.worker.state{worker="i"}`` gauge with
+    the `WORKER_STATES` ordinal, a ``serving.worker.transitions``
+    counter per target state) and the active span tree; detect latency
+    and RTO feed ``serving.worker.detect_latency`` / RTO histograms and
+    last-value gauges so `summarize` can render the worker column
+    without a live process."""
+
+    def __init__(self, n_workers: int):
+        if n_workers < 1:
+            raise ValueError("n_workers must be >= 1")
+        self.n_workers = int(n_workers)
+        self._states = [WORKER_HEALTHY] * self.n_workers
+        self.deaths = [0] * self.n_workers
+        self.respawns = [0] * self.n_workers
+        self.detect_s = [None] * self.n_workers   # last detect latency
+        self.rto_s = [None] * self.n_workers      # last full RTO
+        self._t_fail = [None] * self.n_workers    # first missed obs
+        self._h_detect = register_hist(
+            "serving.worker.detect_latency", entry="serving"
+        )
+        self._h_rto = register_hist("serving.worker.rto", entry="serving")
+        for w in range(self.n_workers):
+            gauge_set(f'serving.worker.state{{worker="{w}"}}', 0)
+
+    def state(self, w: int) -> str:
+        return self._states[w]
+
+    def all_healthy(self) -> bool:
+        return all(s == WORKER_HEALTHY for s in self._states)
+
+    def _transition(self, w: int, new_state: str) -> None:
+        self._states[w] = new_state
+        gauge_set(
+            f'serving.worker.state{{worker="{w}"}}',
+            WORKER_STATES.index(new_state),
+        )
+        inc(f'serving.worker.transitions{{state="{new_state}"}}')
+        trace_event("worker.transition", worker=w, state=new_state)
+
+    # -- transitions driven by the router's RPC layer --------------------
+
+    def mark_suspect(self, w: int) -> None:
+        """An RPC deadline expired: the worker may be stalled or dead.
+        Stamps the first-missed-observation clock that detect latency
+        and RTO are measured from (kept across suspect→dead)."""
+        if self._t_fail[w] is None:
+            self._t_fail[w] = time.perf_counter()
+        if self._states[w] == WORKER_HEALTHY:
+            self._transition(w, WORKER_SUSPECT)
+
+    def mark_healthy_probe(self, w: int) -> None:
+        """A suspect worker answered after all (late reply): false
+        alarm, back to healthy, failure clock cleared."""
+        self._t_fail[w] = None
+        if self._states[w] == WORKER_SUSPECT:
+            self._transition(w, WORKER_HEALTHY)
+
+    def mark_dead(self, w: int, reason: str = "unknown") -> float:
+        """Confirm death (pipe EOF, kill observed, or grace expired).
+        Returns the detect latency in seconds — 0.0 for an instantly
+        observable death (EOF arrives with no deadline wait)."""
+        if self._t_fail[w] is None:
+            self._t_fail[w] = time.perf_counter()
+            detect = 0.0
+        else:
+            detect = time.perf_counter() - self._t_fail[w]
+        self.detect_s[w] = detect
+        self.deaths[w] += 1
+        self._h_detect.record(detect)
+        gauge_set(f'serving.worker.detect_s{{worker="{w}"}}', detect)
+        inc("serving.worker.deaths")
+        inc(f'serving.worker.deaths{{reason="{reason}"}}')
+        self._transition(w, WORKER_DEAD)
+        return detect
+
+    def mark_respawning(self, w: int) -> None:
+        self.respawns[w] += 1
+        inc("serving.worker.respawns")
+        self._transition(w, WORKER_RESPAWNING)
+
+    def mark_recovering(self, w: int) -> None:
+        self._transition(w, WORKER_RECOVERING)
+
+    def mark_first_ack(self, w: int) -> None:
+        """First successful client-facing ack from the respawned worker
+        closes the loop: stamp the RTO and return to healthy.  Also the
+        no-op fast path (`healthy` stays `healthy`) so the router can
+        call it on every successful RPC."""
+        if self._states[w] == WORKER_HEALTHY:
+            return
+        if self._states[w] == WORKER_SUSPECT:
+            self.mark_healthy_probe(w)
+            return
+        if self._t_fail[w] is not None:
+            rto = time.perf_counter() - self._t_fail[w]
+            self.rto_s[w] = rto
+            self._h_rto.record(rto)
+            gauge_set(f'serving.worker.rto_s{{worker="{w}"}}', rto)
+            self._t_fail[w] = None
+        self._transition(w, WORKER_HEALTHY)
 
 
 class Deadline:

@@ -1,4 +1,4 @@
-"""Tenant-sharded serving: M engine workers behind one router.
+"""Tenant-sharded serving: M engine workers behind one supervised router.
 
 One `ServingEngine` is single-threaded by construction — its journal
 fsyncs, LRU bookkeeping, and breaker state all assume one writer.  To
@@ -22,16 +22,43 @@ Backends:
   boundary).  Fan-out calls (`flush_all`, `stats`, `close`) send to
   EVERY worker before receiving from any, so workers overlap.
 
+Supervision (docs/robustness.md, worker supervision): every
+router→worker RPC is DEADLINE-BOUNDED (`rpc_timeout_s` + a bounded
+suspect-grace window), so a dead or stalled worker is detected, never
+hung on.  A `resilience.WorkerSupervisor` tracks each worker through
+``healthy → suspect → dead → respawning → recovering → healthy``; on a
+confirmed death the router
+
+1. sheds the in-flight and subsequently-arriving requests for that
+   worker's tenants as typed ``worker_unavailable`` system faults
+   (degraded, not dropped — the other workers' tenants never miss a
+   tick),
+2. reaps the corpse (terminate → SIGKILL escalation for a stalled
+   process), dumps a flight-recorder bundle, and
+3. respawns the worker and drives it through ``engine.recover()`` on
+   its untouched ``worker{i:03d}`` partition — the PR 13
+   acked ≤ recovered ≤ acked+1 journal invariant makes failover
+   correct by construction.
+
+A worker answering its first successful post-recovery RPC closes the
+loop and stamps the RTO (detect→respawn→recover→first-ack) into the
+``serving.worker.*`` telemetry.  The ``kill_worker@n`` /
+``stall_worker@n`` fault kinds drive the drill at the n-th client RPC;
+supervision-internal RPCs (ping, the recovery call) are not sites.
+
 Refits GANG-SCHEDULE: workers only queue refit requests
 (`engine._queue_refit`); `flush_refits()` pulls every worker's queue,
 runs ONE `refit_batch` in the router process — inside
 `parallel.distributed.global_mesh` when the process-spanning init (PR
 15) is active, so a multi-host mesh sees one batched EM across all
 shards — and installs the fitted params back into the owning workers.
-`init_spec="module:function"` runs an arbitrary initializer in each
-worker at startup (e.g. `parallel.distributed.initialize_distributed`
-wired from env) for deployments where workers join the mesh
-themselves.
+A member worker dying mid-refit ABORTS the barrier for that worker
+only (one install retry after its respawn; its unfitted tenants land
+in ``failed`` and the worker in ``aborted_workers``) — the gang never
+wedges.  `init_spec="module:function"` runs an arbitrary initializer
+in each worker at startup (e.g.
+`parallel.distributed.initialize_distributed` wired from env) for
+deployments where workers join the mesh themselves.
 
 Per-worker isolation is the failure story: one worker's eviction
 budget, circuit breakers, and fault drills never touch another's
@@ -43,17 +70,46 @@ from __future__ import annotations
 
 import hashlib
 import importlib
+import math
 import multiprocessing as mp
 import os
+import signal
+import time
 
 import numpy as np
 
-from ..utils.telemetry import inc
+from ..utils import faults as _faults
+from ..utils import flight as _flight
+from ..utils.telemetry import emit_metrics, inc
+from .resilience import (
+    SYSTEM_FAULT,
+    WORKER_DEAD,
+    WORKER_HEALTHY,
+    WORKER_RESPAWNING,
+    ErrorInfo,
+    Response,
+    WorkerSupervisor,
+)
 from .store import worker_partition
 
-__all__ = ["TenantRouter", "worker_of"]
+__all__ = ["TenantRouter", "WorkerUnavailable", "worker_of"]
 
 _BACKENDS = ("inproc", "process")
+
+
+class WorkerUnavailable(RuntimeError):
+    """A router→worker RPC could not be served: the worker is dead (or
+    died mid-call) and — if auto-respawn is on — its replacement was
+    not yet able to answer.  Data-plane entry points (`handle`,
+    `submit`/`flush_all`) convert this into a typed
+    ``worker_unavailable`` system-fault Response; control-plane calls
+    (`register`, `register_shared`) let it propagate so the caller can
+    retry against the recovered worker."""
+
+    def __init__(self, worker: int, reason: str):
+        super().__init__(f"worker {worker} unavailable: {reason}")
+        self.worker = int(worker)
+        self.reason = reason
 
 
 def worker_of(tenant_id: str, n_workers: int) -> int:
@@ -65,11 +121,21 @@ def worker_of(tenant_id: str, n_workers: int) -> int:
 
 
 def _sanitize(obj):
-    """Replace device arrays with host numpy in a response pytree so it
-    pickles across a process boundary without dragging jax buffers."""
+    """Host-ify a response pytree so it pickles across a process
+    boundary: device arrays become numpy (a jax buffer must not cross),
+    and non-finite float SCALARS (NaN/Inf) become None — counted as
+    ``serving.sanitize.nonfinite`` — so a sick worker can never emit an
+    unparseable JSON-bound payload.  Arrays pass through unmapped:
+    they are bulk state, and NaN handling there belongs to the engine's
+    typed fault path, not the transport."""
     import jax
 
     def leaf(x):
+        if isinstance(x, (float, np.floating)):
+            if not math.isfinite(x):
+                inc("serving.sanitize.nonfinite")
+                return None
+            return x
         if hasattr(x, "__array__") and not isinstance(x, np.ndarray):
             return np.asarray(x)
         return x
@@ -89,7 +155,9 @@ def _make_engine(store_dir, worker_id, engine_kwargs):
 
     kw = dict(engine_kwargs or {})
     sd = worker_partition(store_dir, worker_id) if store_dir else None
-    return ServingEngine(store_dir=sd, **kw)
+    eng = ServingEngine(store_dir=sd, **kw)
+    eng.set_worker_id(worker_id)
+    return eng
 
 
 def _worker_main(conn, worker_id, store_dir, engine_kwargs,
@@ -97,7 +165,15 @@ def _worker_main(conn, worker_id, store_dir, engine_kwargs,
     """Engine-worker process body: one engine (plus optional pipeline)
     serving ops off the pipe until ``close``.  Never raises across the
     pipe — errors return as ``("err", repr)`` so one bad request
-    cannot wedge the router's recv."""
+    cannot wedge the router's recv.  Two deliberate exceptions:
+
+    * the injected kills (SimulatedCrash / SimulatedPreemption) model
+      an EXTERNAL death, so they are re-raised and take the process
+      down — the router's supervisor sees pipe EOF, exactly like a
+      real SIGKILL;
+    * a ``stall`` op sleeps without replying (the stall_worker drill:
+      the router must detect via its RPC deadline, never the pipe).
+    """
     _run_init_spec(init_spec)
     eng = _make_engine(store_dir, worker_id, engine_kwargs)
     pipe = None
@@ -110,6 +186,9 @@ def _worker_main(conn, worker_id, store_dir, engine_kwargs,
             op, payload = conn.recv()
         except EOFError:
             break
+        if op == "stall":
+            time.sleep(float(payload or 0.0))
+            continue
         try:
             if op == "close":
                 if pipe is not None:
@@ -117,6 +196,8 @@ def _worker_main(conn, worker_id, store_dir, engine_kwargs,
                 conn.send(("ok", None))
                 break
             conn.send(("ok", _worker_op(eng, pipe, op, payload)))
+        except (_faults.SimulatedCrash, _faults.SimulatedPreemption):
+            raise  # kills kill: the supervisor must see a dead worker
         except Exception as e:  # typed errors stay envelopes; this is
             conn.send(("err", f"{type(e).__name__}: {e}"))  # the backstop
     conn.close()
@@ -183,6 +264,11 @@ def _worker_op(eng, pipe, op, payload):
         return eng.recover(prewarm=payload)
     if op == "flush_metrics":
         return eng.flush_metrics()
+    if op == "ping":
+        # liveness heartbeat over the ordinary pipe protocol: cheap,
+        # side-effect free, and it exercises the full request round
+        # trip rather than a bespoke channel
+        return {"pid": os.getpid(), "requests": eng._requests}
     if op == "stats":
         st = {
             "resident": len(eng._tenants),
@@ -205,7 +291,17 @@ class TenantRouter:
     out (or route point-wise) to the owning worker.  Per-worker
     eviction budgets and breakers come from `engine_kwargs` — applied
     to EVERY worker, so M workers give M× the configured budget, each
-    enforced locally."""
+    enforced locally.
+
+    Liveness knobs: `rpc_timeout_s` bounds every worker RPC (None =
+    wait forever, the pre-supervision behavior — stalls then go
+    undetected); after a missed deadline the worker is `suspect` for
+    one `suspect_grace_s` window before being declared dead.  The
+    heartbeat deadline — the bound on detect latency — is therefore
+    ``rpc_timeout_s + suspect_grace_s``.  `spawn_timeout_s` separately
+    bounds the (jax-importing, hence slow) worker boot handshake.
+    `auto_respawn` controls whether a dead worker is replaced in place;
+    off, its tenants stay typed-unavailable until `close()`."""
 
     def __init__(
         self,
@@ -216,6 +312,11 @@ class TenantRouter:
         engine_kwargs: dict | None = None,
         pipeline_kwargs: dict | None = None,
         init_spec: str | None = None,
+        rpc_timeout_s: float | None = 60.0,
+        suspect_grace_s: float | None = None,
+        spawn_timeout_s: float = 120.0,
+        auto_respawn: bool = True,
+        close_timeout_s: float = 10.0,
     ):
         if backend not in _BACKENDS:
             raise ValueError(
@@ -229,7 +330,25 @@ class TenantRouter:
         self.pipelined = bool(pipelined)
         self.engine_kwargs = dict(engine_kwargs or {})
         self.pipeline_kwargs = dict(pipeline_kwargs or {})
+        self.init_spec = init_spec
+        self.rpc_timeout_s = (
+            None if rpc_timeout_s is None else float(rpc_timeout_s)
+        )
+        if suspect_grace_s is None:
+            suspect_grace_s = (
+                5.0 if self.rpc_timeout_s is None
+                else min(5.0, max(0.05, 0.5 * self.rpc_timeout_s))
+            )
+        self.suspect_grace_s = float(suspect_grace_s)
+        self.spawn_timeout_s = float(spawn_timeout_s)
+        self.auto_respawn = bool(auto_respawn)
+        self.close_timeout_s = float(close_timeout_s)
+        self.supervisor = WorkerSupervisor(self.n_workers)
         self._closed = False
+        self._rpc_no = 0  # client RPCs: the kill/stall_worker site axis
+        self._pending = [[] for _ in range(self.n_workers)]
+        self._orphans = [[] for _ in range(self.n_workers)]
+        self._kill_reason = [None] * self.n_workers
         self._engines = None
         self._pipes = None
         self._conns = None
@@ -249,50 +368,324 @@ class TenantRouter:
                     for eng in self._engines
                 ]
         else:
-            ctx = mp.get_context("spawn")
-            self._conns, self._procs = [], []
+            self._conns = [None] * self.n_workers
+            self._procs = [None] * self.n_workers
             for i in range(self.n_workers):
-                parent, child = ctx.Pipe()
-                proc = ctx.Process(
-                    target=_worker_main,
-                    args=(child, i, store_dir, self.engine_kwargs,
-                          self.pipelined, self.pipeline_kwargs, init_spec),
-                    daemon=True,
-                )
-                proc.start()
-                child.close()
-                self._conns.append(parent)
-                self._procs.append(proc)
+                self._spawn(i)
+            # boot handshake: workers import jax on spawn, which can
+            # dwarf rpc_timeout_s — ping each (boots overlap; the pings
+            # serialize only the residual wait) so the first client RPC
+            # runs against a live worker under the NORMAL deadline
+            for i in range(self.n_workers):
+                self._control(i, "ping", timeout=self.spawn_timeout_s)
 
     # -- shard addressing ------------------------------------------------
 
     def worker_of(self, tenant_id: str) -> int:
         return worker_of(tenant_id, self.n_workers)
 
-    def _call(self, w: int, op, payload=None):
+    def worker_states(self) -> list[str]:
+        """Current supervisor state per worker (lifecycle glyph data)."""
+        return [self.supervisor.state(w) for w in range(self.n_workers)]
+
+    # -- supervised RPC layer --------------------------------------------
+
+    def _spawn(self, w: int) -> None:
+        ctx = mp.get_context("spawn")
+        parent, child = ctx.Pipe()
+        proc = ctx.Process(
+            target=_worker_main,
+            args=(child, w, self.store_dir, self.engine_kwargs,
+                  self.pipelined, self.pipeline_kwargs, self.init_spec),
+            daemon=True,
+        )
+        proc.start()
+        child.close()
+        self._conns[w] = parent
+        self._procs[w] = proc
+
+    def _inject_kill(self, w: int) -> None:
+        """The ``kill_worker@n`` site: SIGKILL the target process (the
+        inproc backend discards the worker's in-memory engine — exactly
+        the state a process kill loses; its store partition survives
+        untouched).  Detection happens on the RPC that follows."""
         if self._engines is not None:
-            return _worker_op(self._engines[w], self._pipes[w], op, payload)
-        self._conns[w].send((op, payload))
-        status, out = self._conns[w].recv()
+            self._discard_inproc_worker(w, "kill")
+            return
+        proc = self._procs[w]
+        if proc is not None and proc.is_alive():
+            os.kill(proc.pid, signal.SIGKILL)
+            proc.join(timeout=5.0)
+
+    def _inject_stall(self, w: int) -> None:
+        """The ``stall_worker@n`` site: the process worker really stops
+        responding (a ``stall`` op it sleeps on without replying), so
+        the deadline/suspect/grace detection path runs end to end.  The
+        inproc backend cannot sleep its own thread — the drill
+        degenerates to a kill recorded with reason="stall"."""
+        if self._engines is not None:
+            self._discard_inproc_worker(w, "stall")
+            return
+        budget = (
+            60.0 if self.rpc_timeout_s is None
+            else 3.0 * (self.rpc_timeout_s + self.suspect_grace_s) + 1.0
+        )
+        try:
+            self._conns[w].send(("stall", budget))
+        except (BrokenPipeError, OSError):
+            pass
+
+    def _discard_inproc_worker(self, w: int, reason: str) -> None:
+        self._engines[w] = None
+        self._kill_reason[w] = reason
+        pipe = self._pipes[w]
+        if pipe is not None:
+            self._pipes[w] = None
+            try:
+                pipe.close()
+            except Exception:
+                pass
+
+    def _pre_rpc(self, w: int) -> None:
+        """Client-RPC preamble: count the site (the kill/stall_worker
+        fault axis), fire injections, and gate on worker health — a
+        worker that is dead and cannot be respawned sheds immediately
+        instead of hanging or cascading."""
+        self._rpc_no += 1
+        n = self._rpc_no
+        if _faults.site_hits("kill_worker", n):
+            _faults.fault_fired("kill_worker")
+            self._inject_kill(w)
+        elif _faults.site_hits("stall_worker", n):
+            _faults.fault_fired("stall_worker")
+            self._inject_stall(w)
+        st = self.supervisor.state(w)
+        if st == WORKER_DEAD:
+            # lazy respawn retry: an earlier respawn failed (or
+            # auto_respawn is off) — try once more before shedding
+            if not (self.auto_respawn and self._respawn(w)):
+                raise WorkerUnavailable(w, "worker dead")
+        elif st == WORKER_RESPAWNING:
+            raise WorkerUnavailable(w, "worker respawning")
+
+    def _call(self, w: int, op, payload=None):
+        self._pre_rpc(w)
+        if self._engines is not None:
+            return self._call_inproc(w, op, payload)
+        return self._call_process(w, op, payload)
+
+    def _call_inproc(self, w: int, op, payload):
+        eng = self._engines[w]
+        if eng is None:
+            reason = self._kill_reason[w] or "kill"
+            self._kill_reason[w] = None
+            self._on_worker_dead(w, reason)
+            raise WorkerUnavailable(w, f"worker {reason}ed")
+        try:
+            out = _worker_op(eng, self._pipes[w], op, payload)
+        except (_faults.SimulatedCrash, _faults.SimulatedPreemption) as e:
+            # the kill fired INSIDE the worker (engine_crash / crash_io
+            # site): in-memory state is gone, the partition survives
+            self._discard_inproc_worker(w, "crash")
+            self._kill_reason[w] = None
+            self._on_worker_dead(w, "crash")
+            raise WorkerUnavailable(w, str(e)) from None
+        self.supervisor.mark_first_ack(w)
+        return out
+
+    def _call_process(self, w: int, op, payload):
+        try:
+            self._conns[w].send((op, payload))
+        except (BrokenPipeError, EOFError, OSError):
+            self._handle_process_death(w)
+            raise WorkerUnavailable(w, "pipe closed") from None
+        status, out = self._recv_bounded(w, op)
         if status == "err":
             raise RuntimeError(f"worker {w}: {out}")
+        self.supervisor.mark_first_ack(w)
         return out
+
+    def _recv_bounded(self, w: int, op):
+        """Deadline-bounded receive: primary `rpc_timeout_s` wait, then
+        a suspect-grace window (during which a merely-slow reply still
+        clears the alarm), then the worker is declared dead.  Pipe EOF
+        short-circuits straight to dead — no deadline is burned on an
+        observable corpse."""
+        conn = self._conns[w]
+        sup = self.supervisor
+        try:
+            if self.rpc_timeout_s is None or conn.poll(self.rpc_timeout_s):
+                return conn.recv()
+            sup.mark_suspect(w)
+            deadline = time.perf_counter() + self.suspect_grace_s
+            while time.perf_counter() < deadline:
+                if not self._procs[w].is_alive():
+                    break
+                if conn.poll(min(0.05, self.suspect_grace_s)):
+                    out = conn.recv()
+                    sup.mark_healthy_probe(w)
+                    return out
+        except (EOFError, OSError):
+            pass
+        self._handle_process_death(w)
+        raise WorkerUnavailable(w, f"no reply to {op!r} within deadline")
+
+    def _handle_process_death(self, w: int) -> None:
+        reason = (
+            "stall"
+            if self._procs[w] is not None and self._procs[w].is_alive()
+            else "crash"
+        )
+        self._reap_process(w)
+        self._on_worker_dead(w, reason)
+
+    def _reap_process(self, w: int) -> None:
+        """Reap one worker corpse with terminate → SIGKILL escalation —
+        a stalled (still-running) process must not outlive its own
+        death certificate as an orphan."""
+        conn, proc = self._conns[w], self._procs[w]
+        if conn is not None:
+            try:
+                conn.close()
+            except Exception:
+                pass
+        if proc is not None:
+            try:
+                if proc.is_alive():
+                    proc.terminate()
+                    proc.join(timeout=2.0)
+                if proc.is_alive():
+                    proc.kill()
+                    proc.join(timeout=2.0)
+            except Exception:
+                pass
+
+    def _on_worker_dead(self, w: int, reason: str) -> None:
+        """Confirmed worker death: record it, dump a flight bundle,
+        convert the worker's in-flight (submitted-but-unflushed)
+        requests into typed orphan responses, and — with auto-respawn —
+        bring up the replacement synchronously.  Requests the dead
+        worker had already journaled are NOT orphaned twice: the
+        journal is the ack barrier, and `recover()` on the respawn
+        replays exactly the durable prefix (acked ≤ recovered ≤
+        acked+1)."""
+        detect = self.supervisor.mark_dead(w, reason=reason)
+        _flight.record(
+            "worker_dead", severity="error", worker=w, reason=reason,
+            detect_s=round(detect, 6), backend=self.backend,
+        )
+        _flight.dump("worker_dead", force=True, worker=w, reason=reason)
+        for kind, tid in self._pending[w]:
+            self._orphans[w].append(self._unavailable_response(kind, tid, w))
+        self._pending[w].clear()
+        if self.auto_respawn:
+            self._respawn(w)
+
+    def _respawn(self, w: int) -> bool:
+        """Replace a dead worker in place and drive recovery on its
+        untouched partition.  True on success (worker is `recovering`
+        and will go healthy on its first acked client RPC); False
+        leaves it dead — the next client RPC retries the respawn."""
+        sup = self.supervisor
+        sup.mark_respawning(w)
+        try:
+            if self._engines is not None:
+                self._engines[w] = _make_engine(
+                    self.store_dir, w, self.engine_kwargs
+                )
+                if self.pipelined:
+                    from .pipeline import ServingPipeline
+
+                    self._pipes[w] = ServingPipeline(
+                        self._engines[w], **self.pipeline_kwargs
+                    )
+                sup.mark_recovering(w)
+                if self.store_dir:
+                    self._engines[w].recover()
+                return True
+            self._spawn(w)
+            self._control(w, "ping", timeout=self.spawn_timeout_s)
+            sup.mark_recovering(w)
+            if self.store_dir:
+                self._control(w, "recover", timeout=self.spawn_timeout_s)
+            return True
+        except Exception:
+            # the respawn itself failed (or the replacement was killed
+            # before recovering — the double-kill drill): stay dead,
+            # requests shed typed, the next RPC retries
+            if self._procs is not None:
+                self._reap_process(w)
+            sup.mark_dead(w, reason="respawn_failed")
+            return False
+
+    def _control(self, w: int, op, payload=None, timeout=None):
+        """Supervision-internal RPC (ping / recovery): bounded like any
+        other, but NOT a fault site and with no death handling — a
+        failure raises and `_respawn` decides.  Keeping these off the
+        site axis makes `kill_worker@n` deterministic: n counts client
+        RPCs only."""
+        if timeout is None:
+            timeout = self.rpc_timeout_s
+        conn = self._conns[w]
+        try:
+            conn.send((op, payload))
+            if timeout is None or conn.poll(timeout):
+                status, out = conn.recv()
+                if status == "err":
+                    raise RuntimeError(f"worker {w}: {out}")
+                return out
+        except (BrokenPipeError, EOFError, OSError):
+            pass
+        raise WorkerUnavailable(w, f"no reply to control op {op!r}")
+
+    def _unavailable_response(self, kind, tenant, w: int) -> Response:
+        inc("serving.worker.unavailable_responses")
+        return Response(
+            ok=False,
+            kind=kind if isinstance(kind, str) else "invalid",
+            tenant=tenant if isinstance(tenant, str) else None,
+            error=ErrorInfo(
+                SYSTEM_FAULT, "worker_unavailable",
+                f"worker {w} is {self.supervisor.state(w)}; tenant "
+                f"state is durable and will be served after recovery",
+            ),
+        )
 
     def _fanout(self, op, payload=None) -> list:
         """Send `op` to every worker, THEN collect: with process
-        workers the M operations overlap — this is where M× shows up."""
+        workers the M operations overlap — this is where M× shows up.
+        A worker that is dead (and could not be respawned) or dies
+        mid-fan-out contributes ``None`` in its slot; callers degrade
+        per-worker instead of wedging the barrier."""
         if self._engines is not None:
-            return [
-                self._call(w, op, payload) for w in range(self.n_workers)
-            ]
-        for conn in self._conns:
-            conn.send((op, payload))
-        out = []
-        for w, conn in enumerate(self._conns):
-            status, val = conn.recv()
-            if status == "err":
-                raise RuntimeError(f"worker {w}: {val}")
-            out.append(val)
+            out = []
+            for w in range(self.n_workers):
+                try:
+                    out.append(self._call(w, op, payload))
+                except WorkerUnavailable:
+                    out.append(None)
+            return out
+        out = [None] * self.n_workers
+        sent = []
+        for w in range(self.n_workers):
+            try:
+                self._pre_rpc(w)
+                self._conns[w].send((op, payload))
+                sent.append(w)
+            except WorkerUnavailable:
+                continue
+            except (BrokenPipeError, EOFError, OSError):
+                self._handle_process_death(w)
+                continue
+        for w in sent:
+            try:
+                status, val = self._recv_bounded(w, op)
+                if status == "err":
+                    raise RuntimeError(f"worker {w}: {val}")
+                self.supervisor.mark_first_ack(w)
+                out[w] = val
+            except WorkerUnavailable:
+                continue
         return out
 
     # -- engine API, sharded ---------------------------------------------
@@ -310,7 +703,9 @@ class TenantRouter:
         """Install a SEED tenant on EVERY worker so `register_shared`
         can clone it locally regardless of which shard the clone hashes
         to — the sharded analogue of the engine's shared-fit mass
-        registration (register once, clone O(1) everywhere)."""
+        registration (register once, clone O(1) everywhere).  A dead
+        worker misses the seed for this call; with a store the seed is
+        durable on the surviving partitions and recoverable there."""
         payload = (
             tenant_id, np.asarray(x, float),
             None if mask is None else np.asarray(mask, bool),
@@ -325,12 +720,19 @@ class TenantRouter:
 
     def handle(self, req):
         tid = req.get("tenant") if isinstance(req, dict) else None
+        kind = req.get("kind") if isinstance(req, dict) else None
         w = self.worker_of(tid) if isinstance(tid, str) else 0
-        return self._call(w, "handle", req)
+        try:
+            return self._call(w, "handle", req)
+        except WorkerUnavailable:
+            return self._unavailable_response(kind, tid, w)
 
     def submit(self, reqs) -> None:
         """Batch-submit tick requests, bucketed per owning worker (one
-        pipe message per worker, not per request)."""
+        pipe message per worker, not per request).  A bucket whose
+        worker is (or dies) unavailable is converted to typed
+        ``worker_unavailable`` responses delivered by the next
+        `flush_all` — one Response per submission, never a drop."""
         if isinstance(reqs, dict):
             reqs = [reqs]
         buckets: list = [[] for _ in range(self.n_workers)]
@@ -339,15 +741,43 @@ class TenantRouter:
             w = self.worker_of(tid) if isinstance(tid, str) else 0
             buckets[w].append(req)
         for w, bucket in enumerate(buckets):
-            if bucket:
+            if not bucket:
+                continue
+            meta = [
+                (r.get("kind") if isinstance(r, dict) else None,
+                 r.get("tenant") if isinstance(r, dict) else None)
+                for r in bucket
+            ]
+            try:
                 self._call(w, "submit", bucket)
+            except WorkerUnavailable:
+                self._orphans[w].extend(
+                    self._unavailable_response(k, t, w) for k, t in meta
+                )
+                continue
+            self._pending[w].extend(meta)
 
     def flush_all(self) -> list:
         """Flush every worker's queue/pipeline; responses concatenated
-        in worker order (per-worker submission order preserved)."""
+        in worker order (per-worker submission order preserved).  A
+        worker that died holding submitted-but-unflushed requests
+        contributes one typed ``worker_unavailable`` Response per such
+        request — degraded, never dropped."""
         out = []
-        for part in self._fanout("flush"):
-            out.extend(part)
+        parts = self._fanout("flush")
+        for w in range(self.n_workers):
+            if self._orphans[w]:
+                out.extend(self._orphans[w])
+                self._orphans[w].clear()
+            part = parts[w]
+            if part is None:
+                out.extend(
+                    self._unavailable_response(kind, tid, w)
+                    for kind, tid in self._pending[w]
+                )
+            else:
+                out.extend(part)
+            self._pending[w].clear()
         inc("serving.router.flushes")
         return out
 
@@ -355,24 +785,32 @@ class TenantRouter:
         """Gang-scheduled refit flush: pull every worker's queued
         refits, run ONE batched EM in the router process — under the
         process-spanning mesh when `parallel.distributed` is initialized
-        — then install results back into the owning workers.  Returns
-        ``{"n_requests", "installed", "failed"}``."""
+        — then install results back into the owning workers.  A member
+        worker dying mid-refit aborts the barrier for that worker only:
+        its pull contributes nothing, its install is retried once
+        against the respawned worker, and whatever still fails lands in
+        ``failed`` — the other members' refits always land.  Returns
+        ``{"n_requests", "installed", "failed", "aborted_workers"}``."""
         import jax.numpy as jnp
 
         from .batch import RefitRequest, refit_batch
         from ..parallel import distributed as _dist
 
         pulls = self._fanout("refit_pull")
+        aborted = [w for w, part in enumerate(pulls) if part is None]
         reqs, owner = [], {}
         for w, part in enumerate(pulls):
-            for tid, x, mask, params in part:
+            for tid, x, mask, params in part or ():
                 reqs.append(RefitRequest(
                     tenant_id=tid, x=jnp.asarray(x),
                     mask=jnp.asarray(mask), params=params,
                 ))
                 owner[tid] = w
         if not reqs:
-            return {"n_requests": 0, "installed": 0, "failed": []}
+            return {
+                "n_requests": 0, "installed": 0, "failed": [],
+                "aborted_workers": sorted(set(aborted)),
+            }
         import jax
 
         eng_kw = self.engine_kwargs
@@ -401,19 +839,50 @@ class TenantRouter:
                 failed.append(res.tenant_id)
         installed = 0
         for w, batch in enumerate(installs):
-            if batch:
+            if not batch:
+                continue
+            try:
                 installed += self._call(w, "refit_install", batch)
+            except WorkerUnavailable:
+                # abort-and-retry: the owner died mid-refit; one retry
+                # reaches the respawned worker (freshly recovered
+                # tenants without history skip silently there)
+                try:
+                    installed += self._call(w, "refit_install", batch)
+                except WorkerUnavailable:
+                    failed.extend(tid for tid, _ in batch)
+                    aborted.append(w)
         inc("serving.router.gang_refits")
         return {
             "n_requests": len(reqs), "installed": installed,
             "failed": failed,
+            "aborted_workers": sorted(set(aborted)),
         }
 
     def recover(self, prewarm=None) -> list:
         return self._fanout("recover", prewarm)
 
     def flush_metrics(self) -> list:
-        return self._fanout("flush_metrics")
+        out = self._fanout("flush_metrics")
+        # the supervisor's serving.worker.* gauges live in the ROUTER
+        # process registry; snapshot them alongside the workers' flush
+        # so summarize's worker column works from the sink alone
+        emit_metrics()
+        return out
+
+    def check_liveness(self) -> list[str]:
+        """Active heartbeat sweep: ping every worker over the ordinary
+        pipe protocol (deadline-bounded like any RPC), detecting a dead
+        or stalled worker BETWEEN requests instead of on the next
+        client call.  Returns the post-sweep state per worker."""
+        for w in range(self.n_workers):
+            if self.supervisor.state(w) == WORKER_DEAD:
+                continue
+            try:
+                self._call(w, "ping")
+            except WorkerUnavailable:
+                pass
+        return self.worker_states()
 
     def stats(self) -> list:
         return self._fanout("stats")
@@ -421,39 +890,68 @@ class TenantRouter:
     def tenant_ids(self) -> list:
         out = []
         for part in self._fanout("tenant_ids"):
-            out.extend(part)
+            out.extend(part or ())
         return sorted(out)
 
     # -- lifecycle -------------------------------------------------------
 
     def close(self) -> None:
+        """Shut every worker down: idempotent, deadline-bounded
+        (`close_timeout_s` for the polite phase), and escalating —
+        a worker that does not answer the close op within the budget is
+        terminated, then SIGKILLed.  Never leaves an orphan process
+        behind a failed drill, and never raises."""
         if self._closed:
             return
         self._closed = True
         if self._engines is not None:
             for pipe in self._pipes:
                 if pipe is not None:
-                    pipe.close()
+                    try:
+                        pipe.close()
+                    except Exception:
+                        pass
             return
         for conn in self._conns:
+            if conn is None:
+                continue
             try:
                 conn.send(("close", None))
-            except (BrokenPipeError, OSError):
+            except Exception:
                 pass
+        deadline = time.perf_counter() + self.close_timeout_s
         for conn in self._conns:
+            if conn is None:
+                continue
             try:
-                conn.recv()
-            except (EOFError, OSError):
+                if conn.poll(max(0.0, deadline - time.perf_counter())):
+                    conn.recv()
+            except Exception:
                 pass
-            conn.close()
+            try:
+                conn.close()
+            except Exception:
+                pass
         for proc in self._procs:
-            proc.join(timeout=30.0)
-            if proc.is_alive():
-                proc.terminate()
+            if proc is None:
+                continue
+            try:
+                proc.join(timeout=max(0.1, deadline - time.perf_counter()))
+                if proc.is_alive():
+                    proc.terminate()
+                    proc.join(timeout=2.0)
+                if proc.is_alive():
+                    proc.kill()
+                    proc.join(timeout=2.0)
+            except Exception:
+                pass
 
     def __enter__(self):
         return self
 
     def __exit__(self, *exc):
-        self.close()
+        try:
+            self.close()
+        except Exception:
+            pass
         return False

@@ -61,6 +61,18 @@ chaos-serving drills in tests/test_chaos_serving.py and
                     bounded admission queue were saturated — the
                     request comes back a typed ``queue_full`` system
                     fault without ever forming a lane
+    kill_worker@n   SIGKILL the engine worker targeted by the n-th
+                    router→worker RPC (TenantRouter; the inproc
+                    backend discards the worker's in-memory engine,
+                    the exact state a process kill loses) — the
+                    supervision drill: detect, shed typed
+                    ``worker_unavailable``, respawn, recover
+    stall_worker@n  the worker targeted by the n-th router→worker RPC
+                    stops responding (the process backend really
+                    sleeps the worker; inproc degenerates to a kill)
+                    — the deadline-bounded-RPC drill: the router must
+                    declare the worker dead within the heartbeat
+                    deadline, never hang on the pipe
 
 Unsuffixed ``ckpt_corrupt`` / ``preempt`` / ``engine_crash`` default to
 n=1; every other kind requires an explicit site.
@@ -82,8 +94,9 @@ active (the circuit-breaker open drill), ``store_io@2+`` fails every
 store op from the 2nd on (retry exhaustion), ``slow_req@1+`` stalls
 every request, ``stall_commit@1+`` stalls every round's commit stage
 (the sustained-backpressure drill) and ``queue_full@1+`` sheds every
-admission from site 1 on (total saturation).  ``engine_crash`` and
-``crash_io`` are kills — they fire once and cannot be persistent.
+admission from site 1 on (total saturation).  ``engine_crash``,
+``crash_io``, ``kill_worker`` and ``stall_worker`` are kills — they fire
+once and cannot be persistent.
 
 Everything here is host-side and import-cheap; with no spec active every
 probe returns the empty plan and the guarded program is unchanged.
@@ -115,7 +128,7 @@ _override: "FaultPlan | None" = None
 _KINDS = (
     "nan_estep", "chol_fail", "nan_draw", "ckpt_corrupt", "preempt",
     "tick_nan", "store_io", "slow_req", "engine_crash", "crash_io",
-    "stall_commit", "queue_full",
+    "stall_commit", "queue_full", "kill_worker", "stall_worker",
 )
 # kinds where a bare clause means "at the first site"
 _DEFAULT_SITE = {"ckpt_corrupt": 1, "preempt": 1, "engine_crash": 1}
@@ -158,6 +171,8 @@ class FaultPlan(NamedTuple):
     crash_io: int | None = None
     stall_commit: int | None = None
     queue_full: int | None = None
+    kill_worker: int | None = None
+    stall_worker: int | None = None
     persistent: frozenset = frozenset()
 
     def any(self) -> bool:

@@ -24,9 +24,12 @@ lines.  The flight recorder keeps that tail pre-assembled:
 Triggers wired in this PR: EM guard trips / ladder exhaustion
 (models/emloop.py), serving typed ``system_fault`` envelopes, breaker
 opens and injected ``engine_crash`` kills (serving/engine.py), SLO pages
-(engine.flush_metrics), injected faults (utils/faults.fault_fired), and
-SIGTERM/atexit (installed on the first *event*-severity record; the exit
-dump fires only when an armed event is still undumped).  Drills ride the
+(engine.flush_metrics), injected faults (utils/faults.fault_fired),
+router-worker deaths (serving/router.py — FORCED, one bundle per death
+even inside the throttle window, carrying the worker id, death reason
+and detect latency), and SIGTERM/atexit (installed on the first
+*event*-severity record; the exit dump fires only when an armed event
+is still undumped).  Drills ride the
 existing ``DFM_FAULTS`` grammar — ``DFM_FAULTS=nan_estep@3`` produces a
 bundle with no bespoke test plumbing.
 

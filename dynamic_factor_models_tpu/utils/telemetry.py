@@ -1297,6 +1297,34 @@ def summarize(path: str, entry: str | None = None) -> str:
             return "-"
         return "/".join(f"{100.0 * v / tot:.0f}" for v in vals)
 
+    # worker column (PR 19): the serving row renders each router
+    # worker's supervisor state as a lifecycle glyph ("w0✓ w1↻ w2✗")
+    # from the last metrics snapshot's serving.worker.state gauges —
+    # files from sinks predating the supervision layer show "-"
+    _worker_glyphs = ("✓", "?", "✗", "↻", "↻")  # WORKER_STATES ordinals
+
+    def _worker_col(e):
+        if metrics is None or e != "serving":
+            return "-"
+        g = metrics.get("gauges") or {}
+        states = {}
+        for name, v in g.items():
+            base, lbl = _split_inline_labels(name)
+            if base != "serving.worker.state" or not lbl:
+                continue
+            try:
+                states[int(lbl.get("worker"))] = int(v)
+            except (TypeError, ValueError):
+                continue
+        if not states:
+            return "-"
+        return " ".join(
+            f"w{w}" + (
+                _worker_glyphs[c] if 0 <= c < len(_worker_glyphs) else "?"
+            )
+            for w, c in sorted(states.items())
+        )
+
     arows = []
     for e, a in sorted(agg.items()):
         p50, p99 = _lat(e)
@@ -1323,6 +1351,7 @@ def summarize(path: str, entry: str | None = None) -> str:
             fin,
             (_gflop_str(a["gflops"] * 1e9) if a["roofline_runs"] else "-"),
             _occ_col(e),
+            _worker_col(e),
             p50,
             p99,
         ])
@@ -1330,7 +1359,7 @@ def summarize(path: str, entry: str | None = None) -> str:
         ["entry", "runs", "err", "wall_s", "mean_s", "mean_iters",
          "conv%", "compile_s", "aot h/m", "faults", "ess_min", "avail",
          "resident", "evict", "fault_in", "GFLOP", "occ a/d/j/c/e",
-         "p50_ms", "p99_ms"],
+         "workers", "p50_ms", "p99_ms"],
         arows,
     )
     out = (

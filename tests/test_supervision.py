@@ -1,0 +1,697 @@
+"""Worker supervision (PR 19): liveness detection, automatic respawn,
+and tenant failover for the sharded serving router.
+
+Acceptance pins:
+
+1. `kill_worker` at EVERY client-RPC site of a tick stream yields 100%
+   typed ``worker_unavailable`` responses (no hang, no raw exception)
+   and — with client retry-until-ok — a final state BIT-IDENTICAL to a
+   never-killed control router, double-kill included;
+2. in-worker stage kills (`engine_crash` at admission, `crash_io` at
+   every journal/snapshot/commit I/O site) surface typed and respect
+   the PR 13 ``acked <= recovered <= acked + deaths`` journal bound;
+3. survivors never miss a tick, gang refits abort-and-retry instead of
+   wedging, `recover()` quarantines planted partition junk and
+   proceeds;
+4. `close()` is idempotent and deadline-bounded with terminate→kill
+   escalation on a wedged worker; ``__exit__`` never raises;
+5. RunRecords from router-routed requests carry `worker_id`, and
+   `summarize` renders the per-worker lifecycle glyph column.
+
+Process-backend drills (SIGKILL, real stall, wedged close) are marked
+slow; the inproc matrix rides tier-1.
+"""
+
+import glob
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from dynamic_factor_models_tpu.serving.engine import ServingEngine
+from dynamic_factor_models_tpu.serving.resilience import (
+    SYSTEM_FAULT,
+    WORKER_DEAD,
+    WORKER_HEALTHY,
+    WORKER_RECOVERING,
+    RetryPolicy,
+    WorkerSupervisor,
+)
+from dynamic_factor_models_tpu.serving.router import (
+    TenantRouter,
+    _sanitize,
+    worker_of,
+)
+from dynamic_factor_models_tpu.serving.store import worker_partition
+from dynamic_factor_models_tpu.utils import faults, flight, telemetry
+
+pytestmark = [pytest.mark.serving, pytest.mark.chaos_serving]
+
+_POLICY = RetryPolicy(max_retries=2, backoff_base_s=0.0)
+T, N = 48, 6
+
+# hash layout for n_workers=2 (worker_of is frozen by the on-disk
+# partition format, so these are stable): worker 0 owns c0/c1/seed,
+# worker 1 owns c2/c3
+_W0 = ("c0", "c1")
+_W1 = ("c2", "c3")
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs():
+    telemetry.disable()
+    flight.reset()
+    yield
+    telemetry.disable()
+    telemetry._explicit_enabled = None
+    flight.reset()
+
+
+def _panel(seed=0):
+    rng = np.random.default_rng(seed)
+    f = rng.standard_normal((T, 4)).cumsum(0) * 0.1
+    lam = rng.standard_normal((N, 4))
+    return f @ lam.T + 0.5 * rng.standard_normal((T, N))
+
+
+def _router(store_dir, **kw):
+    kw.setdefault(
+        "engine_kwargs", {"max_em_iter": 3, "retry_policy": _POLICY}
+    )
+    return TenantRouter(2, store_dir=store_dir, backend="inproc", **kw)
+
+
+def _register(rt, ids=_W0 + _W1, seed=7):
+    rt.register_seed("seed", _panel(seed))
+    for tid in ids:
+        rt.register_shared(tid, "seed")
+
+
+def _tick(tid, row):
+    return {"kind": "tick", "tenant": tid, "x": row}
+
+
+def _stream(ids, ticks=2, seed=11):
+    rows = np.random.default_rng(seed).standard_normal(
+        (ticks, len(ids), N)
+    )
+    return [
+        _tick(tid, rows[k, i])
+        for k in range(ticks) for i, tid in enumerate(ids)
+    ]
+
+
+def _final_states(rt, ids):
+    out = {}
+    for tid in ids:
+        ten = rt._engines[rt.worker_of(tid)]._lookup(tid)
+        assert ten is not None, f"{tid} lost"
+        out[tid] = (np.asarray(ten.state.s).copy(), int(ten.state.t))
+    return out
+
+
+def _assert_same_states(got, ref):
+    assert got.keys() == ref.keys()
+    for tid in ref:
+        assert got[tid][1] == ref[tid][1], tid
+        np.testing.assert_array_equal(got[tid][0], ref[tid][0])
+
+
+# ---------------------------------------------------------------------------
+# 1. supervisor state machine (unit)
+# ---------------------------------------------------------------------------
+
+
+def test_supervisor_state_machine_transitions():
+    sup = WorkerSupervisor(2)
+    assert sup.all_healthy()
+    assert sup.state(0) == WORKER_HEALTHY
+
+    # a merely-slow worker: suspect, then the late reply clears it
+    sup.mark_suspect(0)
+    assert sup.state(0) == "suspect" and not sup.all_healthy()
+    sup.mark_healthy_probe(0)
+    assert sup.state(0) == WORKER_HEALTHY and sup.deaths[0] == 0
+
+    # a real death: detect latency stamped from the first suspicion
+    sup.mark_suspect(0)
+    time.sleep(0.01)
+    detect = sup.mark_dead(0, reason="stall")
+    assert sup.state(0) == WORKER_DEAD
+    assert detect > 0.0 and sup.detect_s[0] == detect
+    assert sup.deaths[0] == 1
+
+    # respawn → recover → first ack stamps the RTO and closes the loop
+    sup.mark_respawning(0)
+    assert sup.state(0) == "respawning" and sup.respawns[0] == 1
+    sup.mark_recovering(0)
+    assert sup.state(0) == WORKER_RECOVERING
+    assert sup.rto_s[0] is None
+    sup.mark_first_ack(0)
+    assert sup.state(0) == WORKER_HEALTHY
+    assert sup.rto_s[0] is not None and sup.rto_s[0] >= detect
+
+    # worker 1 never left healthy; first_ack on healthy is a no-op
+    sup.mark_first_ack(1)
+    assert sup.state(1) == WORKER_HEALTHY and sup.all_healthy()
+
+    # instant-EOF death (no suspect phase): detect latency is 0
+    assert sup.mark_dead(1, reason="crash") == 0.0
+
+
+# ---------------------------------------------------------------------------
+# 2. kill_worker at every RPC site: typed + bit-identical failover
+# ---------------------------------------------------------------------------
+
+
+def test_kill_worker_every_site_bit_identical_vs_control(tmp_path):
+    """The kill matrix: for every client-RPC site of the tick stream,
+    SIGKILL-equivalent the targeted worker there.  Every affected
+    request surfaces typed `worker_unavailable`; client retry-until-ok
+    lands the exact same final state as the never-killed control
+    (`kill_worker` fires BEFORE dispatch, so a shed tick was never
+    applied and the retry is not a duplicate)."""
+    ids = ("c0", "c2")  # one tenant per worker: kills hit both shards
+    reqs = _stream(ids, ticks=2)
+
+    ctl = _router(str(tmp_path / "ctl"))
+    _register(ctl, ids)
+    n_sites = len(reqs)
+    for r in reqs:
+        assert ctl.handle(r).ok
+    ref = _final_states(ctl, ids)
+    ctl.close()
+
+    for s in range(1, n_sites + 1):
+        rt = _router(str(tmp_path / f"k{s}"))
+        _register(rt, ids)
+        site = rt._rpc_no + s  # the RPC axis counts from creation
+        shed = 0
+        with faults.inject(f"kill_worker@{site}"):
+            for r in reqs:
+                resp = rt.handle(r)
+                while not resp.ok:
+                    assert resp.error.category == SYSTEM_FAULT
+                    assert resp.error.code == "worker_unavailable"
+                    shed += 1
+                    resp = rt.handle(r)
+        assert shed >= 1, f"site {site}: kill never fired"
+        assert sum(rt.supervisor.deaths) == 1
+        assert rt.supervisor.rto_s[
+            rt.supervisor.deaths.index(1)
+        ] is not None
+        _assert_same_states(_final_states(rt, ids), ref)
+        assert rt.worker_states() == [WORKER_HEALTHY] * 2
+        rt.close()
+
+
+def test_double_kill_same_worker_still_bit_identical(tmp_path):
+    ids = ("c2", "c3")  # both on worker 1
+    reqs = _stream(ids, ticks=3, seed=13)
+
+    ctl = _router(str(tmp_path / "ctl"))
+    _register(ctl, ids)
+    for r in reqs:
+        assert ctl.handle(r).ok
+    ref = _final_states(ctl, ids)
+    ctl.close()
+
+    rt = _router(str(tmp_path / "rt"))
+    _register(rt, ids)
+    kills = 0
+    for r in reqs:
+        # kill the worker at the NEXT rpc, twice over the stream
+        if kills < 2:
+            kills += 1
+            with faults.inject(f"kill_worker@{rt._rpc_no + 1}"):
+                resp = rt.handle(r)
+            assert not resp.ok
+            assert resp.error.code == "worker_unavailable"
+            resp = rt.handle(r)
+        else:
+            resp = rt.handle(r)
+        assert resp.ok
+    assert rt.supervisor.deaths[1] == 2 and rt.supervisor.respawns[1] == 2
+    _assert_same_states(_final_states(rt, ids), ref)
+    rt.close()
+
+
+def test_stall_worker_inproc_degenerates_to_kill(tmp_path):
+    rt = _router(str(tmp_path / "rt"))
+    _register(rt, ("c0",))
+    row = np.zeros(N)
+    with faults.inject(f"stall_worker@{rt._rpc_no + 1}"):
+        resp = rt.handle(_tick("c0", row))
+    assert not resp.ok and resp.error.code == "worker_unavailable"
+    assert rt.handle(_tick("c0", row)).ok
+    snap = telemetry.snapshot()["counters"]
+    assert snap.get('serving.worker.deaths{reason="stall"}', 0) >= 1
+    rt.close()
+
+
+# ---------------------------------------------------------------------------
+# 3. in-worker stage kills: admission + every store I/O site
+# ---------------------------------------------------------------------------
+
+
+def test_engine_crash_inside_worker_is_typed_and_recovers(tmp_path):
+    """`engine_crash` fires INSIDE the worker at request admission —
+    the in-memory engine dies mid-call, the router converts it to a
+    typed response, and the respawn serves from the untouched
+    partition."""
+    rt = _router(str(tmp_path / "rt"))
+    _register(rt)
+    rng = np.random.default_rng(3)
+    w = rt.worker_of("c2")
+    assert w == 1
+    site = rt._engines[1]._requests + 1
+    with faults.inject(f"engine_crash@{site}"):
+        resp = rt.handle(_tick("c2", rng.standard_normal(N)))
+    assert not resp.ok and resp.error.code == "worker_unavailable"
+    assert rt.supervisor.deaths[1] == 1
+    # admission kill: the tick was never journaled — the retry is safe
+    r2 = rt.handle(_tick("c2", rng.standard_normal(N)))
+    assert r2.ok and int(r2.result.t) == T + 1
+    # the bystander worker never noticed
+    assert rt.supervisor.deaths[0] == 0
+    assert rt.handle(_tick("c0", rng.standard_normal(N))).ok
+    rt.close()
+
+
+def test_crash_io_killed_at_every_worker_io_site(tmp_path):
+    """Walk the kill point through EVERY tenant-store I/O site of one
+    worker's tick window (admit/journal/dispatch/commit from the
+    router's seat): each kill surfaces typed, the respawned worker
+    recovers its partition under the journal bound
+    acked <= recovered <= acked + deaths, and survivors on the other
+    worker never miss a tick."""
+    rng = np.random.default_rng(23)
+    drill = [
+        _tick(tid, rng.standard_normal(N))
+        for tid in ("c2", "c3", "c2", "c3")
+    ]
+
+    site = 0
+    killed_sites = 0
+    while True:
+        site += 1
+        rt = _router(str(tmp_path / f"s{site}"))
+        _register(rt)
+        ops0 = rt._engines[1].store._io_ops
+        acked = 0
+        # the drill window streams ONLY worker-1 tenants: the crash_io
+        # site axis counts each store's own I/O ops, so keeping worker
+        # 0 idle inside the window pins which worker the kill hits
+        with faults.inject(f"crash_io@{ops0 + site}"):
+            for r in drill:
+                resp = rt.handle(r)
+                assert resp.ok or (
+                    resp.error.code == "worker_unavailable"
+                ), resp
+                acked += bool(resp.ok)
+        deaths = rt.supervisor.deaths[1]
+        if deaths == 0:
+            rt.close()
+            break  # site walked past the window's last I/O op: done
+        killed_sites += 1
+        recovered = sum(
+            int(rt._engines[1]._lookup(tid).state.t) - T
+            for tid in _W1
+        )
+        assert acked <= recovered <= acked + deaths, (
+            f"site {site}: acked {acked}, recovered {recovered}, "
+            f"deaths {deaths}"
+        )
+        # the survivor shard never noticed and never missed a tick
+        assert rt.supervisor.deaths[0] == 0
+        assert rt.handle(_tick("c0", rng.standard_normal(N))).ok
+        # post-failover the killed worker serves normally again
+        assert rt.handle(_tick("c2", rng.standard_normal(N))).ok
+        rt.close()
+    assert killed_sites >= 4  # the walk covered multiple distinct sites
+
+
+# ---------------------------------------------------------------------------
+# 4. submit/flush failover + refit gang abort + fan-out degradation
+# ---------------------------------------------------------------------------
+
+
+def test_submitted_requests_become_typed_orphans_not_drops(tmp_path):
+    rt = _router(str(tmp_path / "rt"))
+    _register(rt)
+    rng = np.random.default_rng(5)
+    reqs = [_tick(tid, rng.standard_normal(N)) for tid in _W0 + _W1]
+    rt.submit(reqs)
+    rt._inject_kill(1)  # dies holding two submitted-but-unflushed ticks
+    out = rt.flush_all()
+    # one Response per submission — degraded, never dropped
+    assert len(out) == len(reqs)
+    by_tenant = {r.tenant: r for r in out}
+    for tid in _W0:
+        assert by_tenant[tid].ok
+    for tid in _W1:
+        assert not by_tenant[tid].ok
+        assert by_tenant[tid].error.code == "worker_unavailable"
+        assert by_tenant[tid].kind == "tick"
+    # the dead worker was respawned during the flush fan-out or will be
+    # on the next call; a fresh submit round fully succeeds
+    rt.submit([_tick(tid, rng.standard_normal(N)) for tid in _W0 + _W1])
+    out2 = rt.flush_all()
+    assert len(out2) == 4 and all(r.ok for r in out2)
+    counters = telemetry.snapshot()["counters"]
+    assert counters.get("serving.worker.unavailable_responses", 0) >= 2
+    rt.close()
+
+
+def test_submit_to_dead_worker_orphans_immediately(tmp_path):
+    rt = _router(str(tmp_path / "rt"), auto_respawn=False)
+    _register(rt)
+    rt._inject_kill(1)
+    rng = np.random.default_rng(6)
+    # the death is discovered ON this submit; the bucket orphans typed
+    rt.submit([_tick(tid, rng.standard_normal(N)) for tid in _W0 + _W1])
+    out = rt.flush_all()
+    assert len(out) == 4
+    dead = [r for r in out if not r.ok]
+    assert len(dead) == 2
+    assert all(r.error.code == "worker_unavailable" for r in dead)
+    # without auto-respawn the worker STAYS dead and sheds typed
+    assert rt.worker_states()[1] == WORKER_DEAD
+    resp = rt.handle(_tick("c2", np.zeros(N)))
+    assert not resp.ok and resp.error.code == "worker_unavailable"
+    rt.close()
+
+
+def test_gang_refit_aborts_dead_member_without_wedging(tmp_path):
+    rt = _router(str(tmp_path / "rt"))
+    _register(rt)
+    for tid in ("c0", "c2"):
+        assert rt.handle({"kind": "refit", "tenant": tid}).ok
+    rt._inject_kill(1)  # dies with its refit queue in memory
+    summary = rt.flush_refits()
+    # the dead member aborted; the surviving member's refit landed
+    assert summary["aborted_workers"] == [1]
+    assert summary["n_requests"] == 1 and summary["installed"] == 1
+    assert summary["failed"] == []
+    # the respawned worker serves again, and a follow-up gang round
+    # with the survivor still lands — the barrier never wedged.  (The
+    # dead member's queued refit died with its in-memory history:
+    # recovered tenants without hist skip silently by design.)
+    assert rt.handle(_tick("c2", np.zeros(N))).ok
+    assert rt.handle({"kind": "refit", "tenant": "c0"}).ok
+    summary2 = rt.flush_refits()
+    assert summary2["aborted_workers"] == []
+    assert summary2["installed"] == 1 and summary2["failed"] == []
+    rt.close()
+
+
+def test_check_liveness_detects_between_requests(tmp_path):
+    rt = _router(str(tmp_path / "rt"))
+    _register(rt, ("c0",))
+    assert rt.check_liveness() == [WORKER_HEALTHY] * 2
+    rt._inject_kill(1)
+    # the sweep itself discovers the corpse and triggers the respawn
+    states = rt.check_liveness()
+    assert states[0] == WORKER_HEALTHY
+    assert states[1] in (WORKER_RECOVERING, WORKER_HEALTHY)
+    assert rt.supervisor.deaths[1] == 1
+    # next sweep's ping acks the recovered worker back to healthy
+    assert rt.check_liveness() == [WORKER_HEALTHY] * 2
+    rt.close()
+
+
+# ---------------------------------------------------------------------------
+# 5. satellites: _sanitize, recover hygiene, close hardening
+# ---------------------------------------------------------------------------
+
+
+def test_sanitize_scrubs_nonfinite_scalars_and_counts():
+    before = telemetry.snapshot()["counters"].get(
+        "serving.sanitize.nonfinite", 0
+    )
+    out = _sanitize({
+        "a": float("nan"),
+        "b": [1.5, float("inf"), float("-inf")],
+        "arr": np.array([np.nan, 1.0]),
+        "s": "x", "n": 3,
+    })
+    assert out["a"] is None
+    assert out["b"] == [1.5, None, None]
+    # arrays are bulk state: passed through UNMAPPED, NaN and all
+    np.testing.assert_array_equal(
+        out["arr"], np.array([np.nan, 1.0])
+    )
+    assert out["s"] == "x" and out["n"] == 3
+    after = telemetry.snapshot()["counters"].get(
+        "serving.sanitize.nonfinite", 0
+    )
+    assert after - before == 3
+
+
+def test_router_recover_quarantines_planted_partition_junk(tmp_path):
+    store = str(tmp_path / "rt")
+    rt = _router(store)
+    _register(rt)
+    rng = np.random.default_rng(9)
+    for tid in _W0 + _W1:
+        assert rt.handle(_tick(tid, rng.standard_normal(N))).ok
+    rt.close()
+
+    # plant quarantine artifacts + in-flight temps in ONE partition
+    part0 = worker_partition(store, 0)
+    strays = (
+        "ghost.npz.corrupt", "c0.npz.tmp.1234", "weird.corrupt",
+        "c0.journal.tmp.7", "zz.journal.corrupt",
+    )
+    for stray in strays:
+        with open(os.path.join(part0, stray), "wb") as f:
+            f.write(b"\x00junk")
+
+    rt2 = _router(store)
+    rec = rt2.recover(prewarm=8)
+    # seed lives on BOTH partitions (register_seed); clones on their own
+    assert sum(r["tenants_on_disk"] for r in rec) == 6
+    # the junk neither crashed recovery nor resurrected as tenants
+    # (seed is legitimately on BOTH partitions, so it lists twice)
+    assert sorted(rt2.tenant_ids()) == sorted(
+        _W0 + _W1 + ("seed", "seed")
+    )
+    r = rt2.handle(_tick("c0", np.zeros(N)))
+    assert r.ok and int(r.result.t) == T + 2
+    # strays are still quarantined on disk, invisible, untouched
+    for stray in strays:
+        assert os.path.exists(os.path.join(part0, stray))
+    assert len(glob.glob(os.path.join(part0, "*.corrupt"))) == 3
+    rt2.close()
+
+
+def test_close_idempotent_and_exit_never_raises(tmp_path):
+    rt = _router(str(tmp_path / "rt"))
+    _register(rt, ("c0",))
+    rt.close()
+    rt.close()  # second close is a no-op, not an error
+    assert rt._closed
+
+    # __exit__ swallows even a close() that raises
+    with _router(None) as rt2:
+        pass
+    rt3 = _router(None)
+    rt3.close = lambda: (_ for _ in ()).throw(RuntimeError("boom"))
+    assert rt3.__exit__(None, None, None) is False
+    TenantRouter.close(rt3)  # real cleanup
+
+
+# ---------------------------------------------------------------------------
+# 6. observability: worker_id records, glyph column, flight bundles
+# ---------------------------------------------------------------------------
+
+
+def test_router_records_carry_worker_id_standalone_engine_does_not(
+    tmp_path,
+):
+    sink = str(tmp_path / "t.jsonl")
+    telemetry.enable(sink=sink)
+    rt = _router(str(tmp_path / "rt"))
+    _register(rt, ("c0", "c2"))
+    rng = np.random.default_rng(4)
+    assert rt.handle(_tick("c0", rng.standard_normal(N))).ok
+    assert rt.handle(_tick("c2", rng.standard_normal(N))).ok
+    rt.close()
+    eng = ServingEngine(max_em_iter=3, retry_policy=_POLICY)
+    eng.register("solo", _panel(5))
+    assert eng.handle(_tick("solo", rng.standard_normal(N))).ok
+
+    lines = [json.loads(ln) for ln in open(sink)]
+    served = [
+        ln for ln in lines
+        if ln.get("entry") == "serving" and ln.get("kind") == "tick"
+    ]
+    routed = [ln for ln in served if "worker_id" in ln]
+    # routed ticks are attributed to their owning worker...
+    assert sorted(ln["worker_id"] for ln in routed) == [0, 1]
+    # ...and a standalone engine's records are byte-compatible with
+    # pre-supervision vintage: no worker_id key at all
+    solo = [ln for ln in served if ln not in routed]
+    assert solo and all("worker_id" not in ln for ln in solo)
+
+
+def test_summarize_worker_glyph_column(tmp_path):
+    sink = str(tmp_path / "t.jsonl")
+    serving_line = {
+        "run_id": "s1", "entry": "serving", "time_unix": 3.0,
+        "wall_s": 0.01, "kind": "tick", "outcome": "ok",
+        "worker_id": 1,
+    }
+    metrics_line = {
+        "entry": "metrics", "time_unix": 4.0, "counters": {},
+        "gauges": {
+            'serving.worker.state{worker="0"}': 0.0,
+            'serving.worker.state{worker="1"}': 2.0,
+            'serving.worker.state{worker="2"}': 4.0,
+        },
+    }
+    old_line = {
+        "run_id": "e1", "entry": "estimate_dfm_em", "time_unix": 1.0,
+        "wall_s": 1.0,
+    }
+    with open(sink, "w") as f:
+        for ln in (old_line, serving_line, metrics_line):
+            f.write(json.dumps(ln) + "\n")
+    out = telemetry.summarize(sink)
+    assert "workers" in out
+    # healthy / dead / recovering render as lifecycle glyphs
+    assert "w0✓ w1✗ w2↻" in out
+    # the non-serving aggregate row degrades to "-", nothing crashes
+    erow = [
+        ln for ln in out.splitlines() if ln.startswith("estimate_dfm_em")
+    ]
+    assert erow and "w0" not in erow[0]
+
+
+def test_worker_death_dumps_forced_flight_bundle(tmp_path):
+    sink = str(tmp_path / "obs" / "t.jsonl")
+    telemetry.enable(sink=sink)
+    rt = _router(str(tmp_path / "rt"))
+    _register(rt, ("c2",))
+    flight.reset()  # registration noise out; drill from a clean ring
+    rng = np.random.default_rng(8)
+    # two deaths back to back: FORCED dumps ignore the throttle window
+    for _ in range(2):
+        with faults.inject(f"kill_worker@{rt._rpc_no + 1}"):
+            resp = rt.handle(_tick("c2", rng.standard_normal(N)))
+        assert not resp.ok
+        assert rt.handle(_tick("c2", rng.standard_normal(N))).ok
+    bundles = glob.glob(
+        os.path.join(str(tmp_path / "obs"), "flight",
+                     "flight-*worker_dead*.json")
+    )
+    assert len(bundles) >= 1
+    with open(sorted(bundles)[-1]) as f:
+        bundle = json.load(f)
+    assert bundle["trigger"]["trigger"] == "worker_dead"
+    assert bundle["trigger"]["worker"] == 1
+    deaths = [
+        ev for ev in bundle["ring"] if ev["kind"] == "worker_dead"
+    ]
+    assert deaths and deaths[-1]["reason"] == "kill"
+    assert deaths[-1]["severity"] == "error"
+    rt.close()
+
+
+def test_flush_metrics_exports_supervisor_gauges(tmp_path):
+    sink = str(tmp_path / "t.jsonl")
+    telemetry.enable(sink=sink)
+    rt = _router(str(tmp_path / "rt"))
+    _register(rt, ("c0", "c2"))
+    rt._inject_kill(1)
+    resp = rt.handle(_tick("c2", np.zeros(N)))  # discover + respawn
+    assert not resp.ok
+    assert rt.handle(_tick("c2", np.zeros(N))).ok
+    rt.flush_metrics()
+    rt.close()
+    lines = [json.loads(ln) for ln in open(sink)]
+    gauges = {}
+    for ln in lines:
+        if ln.get("entry") == "metrics":
+            gauges.update(ln.get("gauges") or {})
+    assert gauges.get('serving.worker.state{worker="0"}') == 0.0
+    assert gauges.get('serving.worker.state{worker="1"}') == 0.0
+    assert 'serving.worker.rto_s{worker="1"}' in gauges
+    assert 'serving.worker.detect_s{worker="1"}' in gauges
+
+
+# ---------------------------------------------------------------------------
+# 7. process backend: SIGKILL, real stall, wedged close (slow)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_process_backend_kill_stall_and_rto(tmp_path):
+    """OS-process drill: a SIGKILLed worker is detected on pipe EOF
+    (typed response, respawn, recover, RTO stamped); a stalled worker
+    is declared dead within the heartbeat deadline
+    rpc_timeout_s + suspect_grace_s — the router never hangs."""
+    store = str(tmp_path / "rt")
+    rt = TenantRouter(2, store_dir=store, backend="process")
+    try:
+        rt.register_seed("seed", _panel(2))
+        for tid in ("c0", "c2"):
+            rt.register_shared(tid, "seed")
+        rng = np.random.default_rng(2)
+        # warm both shards under the generous boot deadline, THEN
+        # tighten the liveness knobs for the drill
+        assert rt.handle(_tick("c0", rng.standard_normal(N))).ok
+        assert rt.handle(_tick("c2", rng.standard_normal(N))).ok
+        rt.rpc_timeout_s = 4.0
+        rt.suspect_grace_s = 1.0
+
+        # --- SIGKILL drill ---
+        w = rt.worker_of("c2")
+        with faults.inject(f"kill_worker@{rt._rpc_no + 1}"):
+            resp = rt.handle(_tick("c2", rng.standard_normal(N)))
+        assert not resp.ok and resp.error.code == "worker_unavailable"
+        assert rt.supervisor.deaths[w] == 1
+        r2 = rt.handle(_tick("c2", rng.standard_normal(N)))
+        assert r2.ok
+        assert rt.supervisor.rto_s[w] is not None
+        # survivor shard never noticed
+        assert rt.handle(_tick("c0", rng.standard_normal(N))).ok
+
+        # --- stall drill: detect latency bounded by the deadline ---
+        with faults.inject(f"stall_worker@{rt._rpc_no + 1}"):
+            t0 = time.perf_counter()
+            resp = rt.handle(_tick("c2", rng.standard_normal(N)))
+            wall = time.perf_counter() - t0
+        assert not resp.ok and resp.error.code == "worker_unavailable"
+        deadline = rt.rpc_timeout_s + rt.suspect_grace_s
+        assert rt.supervisor.detect_s[w] <= deadline + 0.5
+        # the wall includes detect + reap + respawn boot; the DETECT
+        # portion is what the heartbeat deadline bounds
+        assert wall >= rt.rpc_timeout_s
+        snap = telemetry.snapshot()["counters"]
+        assert snap.get('serving.worker.deaths{reason="stall"}', 0) >= 1
+        assert rt.handle(_tick("c2", rng.standard_normal(N))).ok
+    finally:
+        rt.close()
+    # close reaped everything: no orphan worker processes
+    assert all(p is None or not p.is_alive() for p in rt._procs)
+
+
+@pytest.mark.slow
+def test_process_close_escalates_on_wedged_worker(tmp_path):
+    """A worker wedged in a stall must not hang `close()`: the polite
+    phase is bounded by close_timeout_s, then terminate → SIGKILL."""
+    rt = TenantRouter(2, backend="process", close_timeout_s=2.0)
+    try:
+        rt._inject_stall(0)  # worker 0 sleeps far past any close budget
+        time.sleep(0.2)
+    finally:
+        t0 = time.perf_counter()
+        rt.close()
+        wall = time.perf_counter() - t0
+    assert wall < 30.0  # bounded: 2s polite phase + escalation joins
+    assert all(p is None or not p.is_alive() for p in rt._procs)
+    rt.close()  # and still idempotent afterwards
+    assert rt.__exit__(None, None, None) is False

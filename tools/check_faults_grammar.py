@@ -1,0 +1,102 @@
+#!/usr/bin/env python
+"""Audit the DFM_FAULTS grammar against its docs and its drills.
+
+The fault grammar (utils/faults.py `_KINDS`) is a contract: every kind
+the injector implements is a failure mode some guard layer claims to
+survive.  A kind that exists in code but not in docs/robustness.md's
+grammar table is an undocumented chaos axis; a kind no test references
+is an unproven claim.  This checker enforces both edges:
+
+* every kind in ``faults._KINDS`` must appear in docs/robustness.md as
+  a grammar row (the ``<kind>@`` site-suffix form the table uses);
+* every kind must be referenced by at least one file under tests/ —
+  an `inject("<kind>@...")` drill, a DFM_FAULTS env spec, or a
+  site-probe assertion all count (plain substring, the honest floor).
+
+Run with no arguments from anywhere in the repo; pass ``--repo PATH``
+to audit another checkout.  Exit 0 clean, 1 on violations, 2 when the
+inputs themselves are unreadable.  tests/test_faults_grammar.py runs
+this in tier-1 (the check_bench_honesty pattern), so adding a fault
+kind without its doc row and drill fails CI.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+__all__ = ["audit_kinds", "audit_repo", "main"]
+
+
+def audit_kinds(kinds, docs_text: str, test_texts: dict) -> list:
+    """Violations for `kinds` given the docs text and a mapping of
+    test-file name -> contents: ``(kind, message)`` rows."""
+    out = []
+    for kind in kinds:
+        if not re.search(rf"\b{re.escape(kind)}@", docs_text):
+            out.append((
+                kind,
+                "not documented: no '%s@' grammar row in "
+                "docs/robustness.md" % kind,
+            ))
+        if not any(kind in text for text in test_texts.values()):
+            out.append((
+                kind,
+                "not drilled: no file under tests/ references '%s'" % kind,
+            ))
+    return out
+
+
+def audit_repo(repo: str) -> list:
+    sys.path.insert(0, repo)
+    try:
+        from dynamic_factor_models_tpu.utils import faults
+    finally:
+        sys.path.pop(0)
+    docs_path = os.path.join(repo, "docs", "robustness.md")
+    with open(docs_path) as fh:
+        docs_text = fh.read()
+    tests_dir = os.path.join(repo, "tests")
+    test_texts = {}
+    for name in sorted(os.listdir(tests_dir)):
+        if not name.endswith(".py"):
+            continue
+        with open(os.path.join(tests_dir, name)) as fh:
+            test_texts[name] = fh.read()
+    if not test_texts:
+        raise OSError(f"no test files under {tests_dir}")
+    return audit_kinds(faults._KINDS, docs_text, test_texts)
+
+
+def main(argv=None) -> int:
+    args = list(sys.argv[1:] if argv is None else argv)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if args[:1] == ["--repo"]:
+        if len(args) < 2:
+            print("check_faults_grammar: --repo needs a path",
+                  file=sys.stderr)
+            return 2
+        repo = args[1]
+    elif args:
+        print(f"check_faults_grammar: unknown arguments {args}",
+              file=sys.stderr)
+        return 2
+    try:
+        violations = audit_repo(repo)
+    except (OSError, ImportError) as e:
+        print(f"check_faults_grammar: cannot audit {repo}: {e}",
+              file=sys.stderr)
+        return 2
+    for kind, msg in violations:
+        print(f"{kind}: {msg}")
+    if violations:
+        print(f"check_faults_grammar: {len(violations)} violation(s)",
+              file=sys.stderr)
+        return 1
+    print("check_faults_grammar: all fault kinds documented and drilled")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
