@@ -69,6 +69,7 @@ __all__ = [
     "convergence_horizon",
     "periodic_dare",
     "linear_recursion",
+    "power_stack",
     "constant_gain_tick",
     "steady_tail",
     "steady_smooth_tail",
@@ -454,6 +455,30 @@ def linear_recursion(M, g, s_init, block: int = 0):
 
     _, out = jax.lax.scan(chunk, s_init, gp.reshape(nb, block, k))
     return out.reshape(nb * block, k)[:n]
+
+
+def power_stack(M, depth: int):
+    """All powers M^0 .. M^depth as ONE (depth+1, k, k) stack, built by
+    log-depth square-and-multiply: a stack holding powers 0..n extends
+    to 0..2n with a single batched matmul (M^n @ [M^1..M^n]), so a
+    depth-1024 stack costs 10 batched (k, k) GEMMs instead of 1024
+    sequential ones.  `depth` is STATIC (a compile-time block bucket —
+    serving/prefill.py buckets burst depths to powers of two so one
+    executable serves every backlog in the bucket).  This is the
+    power-table half of `linear_recursion`'s blocked einsum, factored
+    out so the dual-form burst catch-up shares it."""
+    if depth <= 0:
+        return jnp.eye(M.shape[-1], dtype=M.dtype)[None]
+    P = jnp.stack([jnp.eye(M.shape[-1], dtype=M.dtype), M])  # powers 0..1
+    n = 1
+    while n < depth:
+        # M^{n+j} = M^n @ M^j for j = 1..n: one batched matmul doubles
+        # the covered range
+        P = jnp.concatenate(
+            [P, jnp.einsum("ab,ibc->iac", P[-1], P[1:])], axis=0
+        )
+        n *= 2
+    return P[: depth + 1]
 
 
 def constant_gain_tick(Abar, K, s, b, phase):

@@ -27,12 +27,13 @@ from ..models import ssm as _ssm
 from ..models.emloop import run_em_loop, run_em_loop_batched
 from ..parallel.mesh import series_pad as _series_pad
 from ..utils.compile import (
+    aot_call,
     bucket_shape,
     pad_panel,
     pad_ssm_params,
     unpad_ssm_params,
 )
-from ..utils.telemetry import trace_span
+from ..utils.telemetry import inc, trace_span
 
 __all__ = [
     "HEALTH_BUCKET_ERROR",
@@ -41,6 +42,7 @@ __all__ = [
     "RefitResult",
     "lane_bucket",
     "batched_tick_dispatch",
+    "batched_prefill_dispatch",
     "refit_batch",
     "refit_sequential",
 ]
@@ -197,6 +199,98 @@ def batched_tick_dispatch(lanes):
         new_np = jax.tree.map(np.asarray, new_B)
         for j, i in enumerate(idxs):
             out[i] = jax.tree.map(lambda a, j=j: a[j], new_np)
+    return out
+
+
+def batched_prefill_dispatch(lanes):
+    """Dual-form catch-up for many tenants in as few vmapped dispatches
+    as possible — `recover()`'s prewarm fan-in for deep journals.
+
+    `lanes` is a list of ``(model, state, rows)`` with `rows` one
+    tenant's journal backlog.  Lanes are grouped by (leaf signature,
+    depth bucket), each group's blocks stacked along a new leading lane
+    axis padded to `lane_bucket` with inert zero lanes (depth 0: the
+    dual degenerates to the zero state's identity carry), and dispatched
+    through ONE vmapped GEMM prefill per group
+    (serving/prefill._prefill_batched — derived by vmap from the scalar
+    kernel, per-lane ragged depths ride the traced depth operand).
+    Backlogs past the top depth bucket fall back to the per-lane chunked
+    host loop.  Returns post-burst FilterStates in input order.
+
+    NOT bitwise vs sequential replay (vmap re-associates the matvecs):
+    callers keep short backlogs on the round-based bitwise path and
+    route only >= `min_gemm_depth()` backlogs here — parity is pinned at
+    1e-14 / 1e-12 by tests/test_prefill.py."""
+    from .online import FilterState
+    from .prefill import (
+        MAX_PREFILL_DEPTH,
+        _pad_block,
+        _prefill_batched,
+        prefill_bucket,
+        prefill_ticks,
+    )
+
+    if not lanes:
+        return []
+    out: list = [None] * len(lanes)
+    groups: dict[tuple, list[int]] = {}
+    for i, (model, state, rows) in enumerate(lanes):
+        if not rows:
+            out[i] = state
+        elif len(rows) > MAX_PREFILL_DEPTH:
+            out[i] = prefill_ticks(model, state, rows)  # chunked host loop
+        else:
+            key = (
+                _lane_sig(model, state, np.asarray(rows[0][-2])),
+                prefill_bucket(len(rows)),
+            )
+            groups.setdefault(key, []).append(i)
+    for (_sig, Kb), idxs in groups.items():
+        n = len(idxs)
+        bucket = lane_bucket(n)
+        models = [lanes[i][0] for i in idxs]
+        states = [lanes[i][1] for i in idxs]
+        Xs, Ms, ks = [], [], []
+        for i in idxs:
+            X, Mk = _pad_block(lanes[i][0], lanes[i][2], Kb)
+            Xs.append(np.asarray(X))
+            Ms.append(np.asarray(Mk))
+            ks.append(len(lanes[i][2]))
+        if bucket > n:  # inert padding lanes (depth 0)
+            pad = bucket - n
+            s0 = np.asarray(states[0].s)
+            zs = FilterState(
+                s=np.zeros_like(s0),
+                t=np.zeros((), np.asarray(states[0].t).dtype),
+            )
+            states += [zs] * pad
+            Xs += [np.zeros_like(Xs[0])] * pad
+            Ms += [np.zeros_like(Ms[0])] * pad
+            ks += [0] * pad
+        if all(m is models[0] for m in models[1:]):
+            model_B = jax.tree.map(
+                lambda a: jnp.broadcast_to(a, (bucket,) + a.shape),
+                models[0],
+            )
+        else:
+            models += [models[0]] * (bucket - n)
+            model_B = jax.tree.map(lambda *ls: jnp.stack(ls), *models)
+        state_B = jax.tree.map(
+            lambda *ls: np.stack([np.asarray(a) for a in ls]), *states
+        )
+        with trace_span(
+            "prefill.batch", lanes=n, bucket=bucket, depth=Kb,
+        ):
+            new_B = aot_call(
+                "serving_prefill_batched", _prefill_batched,
+                model_B, state_B, np.stack(Xs), np.stack(Ms),
+                np.asarray(ks, np.int32),
+            )
+        new_np = jax.tree.map(np.asarray, new_B)
+        for j, i in enumerate(idxs):
+            out[i] = jax.tree.map(lambda a, j=j: a[j], new_np)
+        inc("serving.prefill.blocks", n)
+        inc("serving.prefill.ticks", float(sum(ks[:n])))
     return out
 
 

@@ -76,14 +76,19 @@ from ..utils.telemetry import (
     trace_span,
     trace_span_on,
 )
-from .batch import RefitRequest, batched_tick_dispatch, refit_batch
+from .batch import (
+    RefitRequest,
+    batched_prefill_dispatch,
+    batched_tick_dispatch,
+    refit_batch,
+)
 from .online import (
     FilterState,
     derive_serving_model,
     nowcast,
     online_tick,
-    replay_ticks,
 )
+from .prefill import min_gemm_depth, prefill_enabled, prefill_ticks, tick_block
 from .resilience import (
     BREAKER_OPEN,
     CLIENT_ERROR,
@@ -538,15 +543,23 @@ class ServingEngine:
         """Re-admit an evicted (or restart-orphaned) tenant from its
         snapshot + write-ahead journal.
 
-        Read-only except for stale-journal cleanup; the replay runs
-        every journaled row through the SAME tick executable the live
-        path used, so the faulted-in FilterState is bit-identical to
-        the never-evicted one (pinned by tests/test_eviction.py).  The
-        circuit breaker is RESTORED from its packed snapshot leaf — an
-        open breaker stays open across eviction.  Returns None when
-        the store has no intact, consistent state for the id; with
-        `defer_replay=True` returns ``(tenant, journal_rows)`` and
-        leaves the rows un-applied (recover()'s concurrent replay)."""
+        Read-only except for stale-journal cleanup.  Short journals
+        (< `DFM_PREFILL_MIN_K` rows) replay every row through the SAME
+        tick executable the live path used, so the faulted-in
+        FilterState is bit-identical to the never-evicted one (pinned
+        by tests/test_eviction.py); deep journals collapse to the
+        dual-form GEMM catch-up (serving/prefill.py — one Ā-power
+        stack plus one (k×q) input-response GEMM, parity ≤1e-14
+        complete / ≤1e-12 MF pinned by tests/test_prefill.py).  The
+        snapshot-load and journal-replay legs are timed separately
+        (`fault_in_load` / `fault_in_replay` histograms) on top of the
+        combined `fault_in` one, so the prefill A/B in `bench.py
+        --load` attributes the win honestly.  The circuit breaker is
+        RESTORED from its packed snapshot leaf — an open breaker stays
+        open across eviction.  Returns None when the store has no
+        intact, consistent state for the id; with `defer_replay=True`
+        returns ``(tenant, journal_rows)`` and leaves the rows
+        un-applied (recover()'s concurrent replay)."""
         t0 = time.perf_counter()
         stored = self.store.load(tenant_id, template_state(1, 1, 1))
         if stored is None:
@@ -589,8 +602,16 @@ class ServingEngine:
         )
         ten = _Tenant(None, params, model, state, breaker)
         ten.breaker_saved = breaker.pack()
+        t_load = time.perf_counter()
+        self._observe("fault_in_load", "ok", t_load - t0, True)
         if rows and not defer_replay:
-            ten.state = replay_ticks(model, state, rows)
+            # prefill_ticks routes short backlogs through the bitwise
+            # sequential replay and deep ones through the GEMM dual
+            ten.state = prefill_ticks(model, state, rows)
+            t_rep = time.perf_counter()
+            self._observe("fault_in_replay", "ok", t_rep - t_load, True)
+            if self._obs_live:
+                self._occ_add("prefill", t_rep - t_load)
         self._account_insert(tenant_id, ten)
         inc("serving.fault_ins")
         self._observe("fault_in", "ok", time.perf_counter() - t0, True)
@@ -1052,9 +1073,12 @@ class ServingEngine:
         Panel tenants get ONE exact refilter over history + buffered
         rows (`_install`), the recovery the chaos tests pin ≤ 1e-10
         against the never-faulted run; panel-less resumed tenants
-        replay the buffered rows through the same tick executable.
-        Raises OSError when persistence keeps failing — the caller
-        leaves the buffer intact and reports a system fault."""
+        journal the whole buffer COALESCED (one `append_many`, durable
+        before any state moves) and then catch up through
+        `prefill_ticks` — bitwise sequential replay below the GEMM
+        threshold, the dual-form burst kernel above it.  Raises
+        OSError when persistence keeps failing — the caller leaves the
+        buffer intact and reports a system fault."""
         rows, ten.replay = ten.replay, []
         try:
             if ten.hist is not None:
@@ -1062,18 +1086,19 @@ class ServingEngine:
                 ms = np.vstack([ten.hist.mask] + [r[1][None] for r in rows])
                 self._install(tenant_id, xs, ms, ten.params)
             else:
-                state = ten.state
-                for x_row, m_row in rows:
-                    if self.store is not None:
-                        journal = self.store.journal(tenant_id)
-                        t_idx = int(state.t)
-                        call_with_retries(
-                            lambda: journal.append(t_idx, x_row, m_row),
-                            self.retry_policy,
-                            key=f"{tenant_id}:reconcile:{t_idx}",
-                        )
-                    state = online_tick(ten.model, state, x_row, m_row)
-                ten.state = state
+                if rows and self.store is not None:
+                    journal = self.store.journal(tenant_id)
+                    t_idx = int(ten.state.t)
+                    jrows = [
+                        (t_idx + i, x_row, m_row)
+                        for i, (x_row, m_row) in enumerate(rows)
+                    ]
+                    call_with_retries(
+                        lambda: journal.append_many(jrows),
+                        self.retry_policy,
+                        key=f"{tenant_id}:reconcile:{t_idx}",
+                    )
+                ten.state = prefill_ticks(ten.model, ten.state, rows)
                 ten.dirty += len(rows)
         except OSError:
             ten.replay = rows + ten.replay  # keep the rows for next try
@@ -1306,19 +1331,25 @@ class ServingEngine:
     def flush_period(self) -> list:
         """Execute the admission queue as ONE serving period.
 
-        Each ROUND takes at most one queued tick per tenant (per-tenant
-        FIFO order preserved), batches the survivors into one vmapped
-        dispatch per lane-shape group — padded to a compile bucket with
-        inert lanes (serving/batch.py) — and returns one typed Response
-        per submitted request, in submission order.
+        The whole queue forms ONE round: a tenant's queued ticks become
+        a BLOCK lane (k sequential ticks in one scan dispatch, bitwise
+        equal to k single-tick dispatches — serving/prefill.tick_block),
+        single-tick tenants batch into one vmapped dispatch per
+        lane-shape group — padded to a compile bucket with inert lanes
+        (serving/batch.py) — and one typed Response returns per
+        submitted request, in submission order.  Per-tenant FIFO order
+        is preserved: lanes admit in submission order and a block
+        applies its rows in order.
 
         Exactly-once: every surviving lane's journal append (fsynced,
-        admission order) completes BEFORE any lane of the round commits
-        in memory.  A kill between the two replays the journaled ticks
-        on restart, while un-appended lanes never happened and their
-        callers were never acked — no tick is double-applied or
-        dropped.  One tenant's failure (tick_nan poison, journal
-        OSError) freezes only its own lane."""
+        admission order, one coalesced `append_many` per tenant)
+        completes BEFORE any lane of the round commits in memory.  A
+        kill between the two replays the journaled ticks on restart,
+        while un-appended lanes never happened and their callers were
+        never acked — no tick is double-applied or dropped.  One
+        tenant's failure (tick_nan poison, journal OSError) freezes
+        only its own lanes; a poisoned row poisons the REST of its
+        block (the rows behind it cannot commit past the hole)."""
         entries, self._tick_queue = self._tick_queue, []
         if not entries:
             return []
@@ -1331,24 +1362,8 @@ class ServingEngine:
             self._obs_live = rec is not _NULL_RECORD
             self._occ_req = 0.0
             t_period = time.perf_counter() if self._obs_live else 0.0
-            pending = list(range(len(entries)))
-            rounds = 0
-            while pending:
-                rounds += 1
-                seen, now_round, later = set(), [], []
-                for qi in pending:
-                    req = entries[qi][0]
-                    tid = (
-                        req.get("tenant") if isinstance(req, dict) else None
-                    )
-                    if isinstance(tid, str) and tid in seen:
-                        later.append(qi)  # same tenant again: next round
-                        continue
-                    if isinstance(tid, str):
-                        seen.add(tid)
-                    now_round.append(qi)
-                self._flush_round(entries, now_round, responses)
-                pending = later
+            rounds = 1
+            self._flush_round(entries, list(range(len(entries))), responses)
             inc("serving.batch.flushes")
             if self._obs_live:
                 # envelope = period wall-clock beyond the attributed
@@ -1500,11 +1515,17 @@ class ServingEngine:
             self._occ_add("admit", time.perf_counter() - t_ph)
 
     def _dispatch_lanes(self, lanes, obs=None) -> list:
-        """DISPATCH stage: one vmapped device dispatch for the whole
-        round.  Returns ``[(lane, new_state, poisoned)]`` in admission
-        order; the tick counter advances per lane in admission order,
-        so the tick_nan site fires on exactly the tick index it would
-        have under sequential serving."""
+        """DISPATCH stage: single-tick tenants share one vmapped device
+        dispatch; a tenant with several lanes this round gets ONE
+        decode-form block dispatch (scan over its rows — bitwise equal
+        to sequential single-tick dispatches, serving/prefill.py) whose
+        trajectory supplies the per-lane states.  Returns
+        ``[(lane, new_state, poisoned)]`` in admission order; the tick
+        counter advances per lane in admission order, so the tick_nan
+        site fires on exactly the tick index it would have under
+        sequential serving.  A poisoned row poisons the REST of its
+        tenant's block: the later rows were computed past a state that
+        will not commit, and committing them would skip the hole."""
         if obs is None:
             obs = self._obs_live
         if not lanes:
@@ -1516,13 +1537,37 @@ class ServingEngine:
             if hit:
                 _faults.fault_fired("tick_nan")
             poisoned.append(hit)
+        groups: dict = {}  # tenant -> lane indices, admission order
+        for li, lane in enumerate(lanes):
+            groups.setdefault(lane[1], []).append(li)
+        for lis in groups.values():
+            bad = False
+            for li in lis:
+                bad = bad or poisoned[li]
+                poisoned[li] = bad
+        new_states: list = [None] * len(lanes)
+        singles = [lis[0] for lis in groups.values() if len(lis) == 1]
+        blocks = [lis for lis in groups.values() if len(lis) > 1]
         t_ph = time.perf_counter() if obs else 0.0
-        new_states = batched_tick_dispatch(
-            [(ten.model, ten.state, row[0], row[1])
-             for _qi, _tid, ten, row, _dl, _rc in lanes]
-        )
-        if obs:  # one vmapped device dispatch for the whole round
+        if singles:
+            sts = batched_tick_dispatch(
+                [(lanes[li][2].model, lanes[li][2].state,
+                  lanes[li][3][0], lanes[li][3][1]) for li in singles]
+            )
+            for li, st in zip(singles, sts):
+                new_states[li] = st
+        if obs:  # one vmapped device dispatch for the singleton lanes
             self._occ_add("dispatch", time.perf_counter() - t_ph)
+        t_pf = time.perf_counter() if obs else 0.0
+        for lis in blocks:
+            ten = lanes[lis[0]][2]
+            _final, traj = tick_block(
+                ten.model, ten.state, [lanes[li][3] for li in lis]
+            )
+            for li, st in zip(lis, traj):
+                new_states[li] = st
+        if obs and blocks:  # one scan dispatch per burst tenant
+            self._occ_add("prefill", time.perf_counter() - t_pf)
         return list(zip(lanes, new_states, poisoned))
 
     def _journal_lanes(self, staged, responses, obs=None) -> list:
@@ -1565,8 +1610,9 @@ class ServingEngine:
             ]
         else:
             # phase A: one buffered write per tenant journal (grouped
-            # in admission order; round formation admits one lane per
-            # tenant, so a group is almost always a single record)
+            # in admission order; a burst tenant's whole block is one
+            # group, so its records land in one buffered write with
+            # consecutive tick indices)
             groups: dict = {}
             order = []
             for lane in alive:
@@ -1584,7 +1630,10 @@ class ServingEngine:
                 if journal is None:
                     journal = ten.journal = self.store.journal(tid)
                 t_idx = int(ten.state.t)
-                rows = [(t_idx, lane[3][0], lane[3][1]) for lane in group]
+                rows = [
+                    (t_idx + i, lane[3][0], lane[3][1])
+                    for i, lane in enumerate(group)
+                ]
                 holder = {}
 
                 def _write(j=journal, r=rows, h=holder):
@@ -1706,11 +1755,18 @@ class ServingEngine:
         tenant count beyond the directory scan.  ``prewarm > 0``
         eagerly faults in the `prewarm` most-recently-snapshotted
         tenants (capped by the resident budget) and replays their
-        journals CONCURRENTLY: round i advances every prewarmed tenant
-        holding an i-th journaled row through one batched vmapped
-        dispatch — bit-identical to sequential replay.  Returns a
-        summary dict (``tenants_on_disk`` / ``prewarmed`` /
-        ``resident`` / ``resident_bytes`` / ``wall_s``)."""
+        journals CONCURRENTLY.  Short journals advance round by round
+        — round i ticks every prewarmed tenant holding an i-th
+        journaled row through one batched vmapped dispatch,
+        bit-identical to sequential replay.  Deep journals (>=
+        `min_gemm_depth()` rows) collapse through the lane-batched
+        dual-form GEMM prefill instead (serving/batch.
+        batched_prefill_dispatch — parity <=1e-14 complete / 1e-12 MF,
+        tests/test_prefill.py), which is what makes respawned-worker
+        failover (serving/router.py rides this path) O(log k) in
+        backlog depth.  Returns a summary dict (``tenants_on_disk`` /
+        ``prewarmed`` / ``resident`` / ``resident_bytes`` /
+        ``wall_s``)."""
         if self.store is None:
             raise ValueError("recover() requires a store_dir")
         t0 = time.perf_counter()
@@ -1724,14 +1780,29 @@ class ServingEngine:
                 ids, key=self.store.snapshot_mtime, reverse=True
             )[:cap]
             pending = []  # (tenant_id, tenant, journal rows)
+            deep = []  # backlogs past the GEMM threshold
+            gemm_k = min_gemm_depth() if prefill_enabled() else None
             for tid in hot:
                 got = self._fault_in(tid, defer_replay=True)
                 if got is None:
                     continue
                 warmed += 1
                 ten, rows = got
-                if rows:
+                if not rows:
+                    continue
+                if gemm_k is not None and len(rows) >= gemm_k:
+                    deep.append((tid, ten, rows))
+                else:
                     pending.append((tid, ten, rows))
+            if deep:
+                new_states = batched_prefill_dispatch(
+                    [(ten.model, ten.state, rows) for _tid, ten, rows in deep]
+                )
+                for (tid, ten, _rows), st in zip(deep, new_states):
+                    # identity check mirrors the round loop below: never
+                    # clobber a re-faulted-in instance
+                    if self._tenants.get(tid) is ten:
+                        ten.state = st
             step = 0
             while pending:
                 lanes, keep = [], []

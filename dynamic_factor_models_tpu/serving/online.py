@@ -215,14 +215,16 @@ def online_tick_batched(models, states, x_B, mask_B) -> FilterState:
 
 
 def replay_ticks(model: ServingModel, state: FilterState, rows) -> FilterState:
-    """Re-apply journaled ticks: `rows` iterates ``(t, x, mask)`` in
-    append order (serving/journal.py).  Each row goes through the SAME
+    """Re-apply journaled ticks: `rows` iterates ``(t, x, mask)``
+    (journal format, serving/journal.py) or ``(x, mask)`` (replay-buffer
+    format) in append order.  Each row goes through the SAME
     `online_tick` executable the live path used, so a restart that
     replays snapshot + journal lands on a bit-identical FilterState —
     same program, same inputs, same floats.  Host loop: journals are
-    short (ticks since the last snapshot), replay is a restart path."""
-    for _t, x_t, mask_t in rows:
-        state = online_tick(model, state, x_t, mask_t)
+    short (ticks since the last snapshot) — deep backlogs go through
+    serving/prefill.py's GEMM dual instead."""
+    for row in rows:
+        state = online_tick(model, state, row[-2], row[-1])
     return state
 
 

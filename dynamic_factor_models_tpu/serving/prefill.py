@@ -1,0 +1,363 @@
+"""Dual-form burst catch-up: GEMM prefill for every replay path.
+
+After the steady-state horizon t* a serving tenant's tick stream
+(serving/online.py) is a constant-gain linear recursion
+
+    s_{t+1} = Abar[j] s_t + K[j] (xz_t @ Wb),      j = t mod d,
+
+with d = 1 (complete panel) or d = 3 (mixed-frequency cyclostationary
+gains).  That recursion has an EXACT convolutional dual: a backlog of k
+ticks collapses to
+
+    s_{t+k} = M^C s_t + sum_{c<C} M^{C-1-c} g_c   (+ <d remainder ticks)
+
+where M is the per-cycle composite transition (C = k // d full cycles)
+and the forcing rows g_c come out of ONE batched (k, q) input-response
+GEMM — the LLM prefill/decode split applied to serving.  k sequential
+O(k_dim^2) dispatches become one Ā-power stack (log-depth
+square-and-multiply, models/steady.power_stack — the power-table half of
+`linear_recursion`'s blocked einsum) plus one GEMM, exact after t* by
+the PR 3 steady-state argument.
+
+Two kernel forms, picked per call site:
+
+* `_prefill_impl` — the GEMM dual.  O(log k) matmul depth, ~1e-15-close
+  to sequential replay (matmul reassociation), NOT bitwise.  Used by
+  the replay paths (fault-in, reconcile, recover) for backlogs of at
+  least `min_gemm_depth()` ticks; shorter journals keep the sequential
+  `replay_ticks` loop so the seed bit-identity pins (tests/
+  test_eviction.py) hold unchanged.  Parity vs sequential replay is
+  pinned at 1e-14 (complete) / 1e-12 (MF period-3) by
+  tests/test_prefill.py over k in 1..1024 including ragged depths.
+* `_tick_block_impl` — the decode-form block: k sequential ticks inside
+  ONE scan dispatch, per-step arithmetic exactly `online._tick`'s, so
+  the result is BITWISE identical to k single-tick dispatches.  Used by
+  `flush_period` block lanes, where batched admission is pinned
+  bit-equal to sequential `handle` ticks.
+
+Burst depths are padded to power-of-two buckets (`PREFILL_BUCKETS`) so
+AOT plans key on ceil(log2 k): `utils/compile.precompile` registers
+`serving_prefill@K{2^j}` / `serving_tick_block@K{2^j}` plans when
+`CompileSpec.prefill_depth > 0`, and every backlog in a bucket shares
+one executable (the actual depth is a traced operand; padded steps are
+masked inert).  Backlogs beyond `MAX_PREFILL_DEPTH` chunk through the
+top bucket.  `DFM_PREFILL=0` disables the dual everywhere (the bench
+A/B off arm); `DFM_PREFILL_MIN_K` moves the GEMM threshold.
+"""
+
+from __future__ import annotations
+
+import os
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.steady import power_stack
+from ..utils.compile import aot_call
+from ..utils.telemetry import inc, register_hist
+from .online import FilterState, ServingModel, _tick, replay_ticks
+
+__all__ = [
+    "PREFILL_BUCKETS",
+    "MAX_PREFILL_DEPTH",
+    "prefill_bucket",
+    "prefill_enabled",
+    "min_gemm_depth",
+    "prefill_ticks",
+    "tick_block",
+]
+
+# power-of-two burst-depth buckets: one AOT plan per bucket, so a cold
+# depth costs at most one compile and a warm fleet sees ceil(log2 1024)
+# + 1 = 11 executables total per panel bucket
+PREFILL_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024)
+MAX_PREFILL_DEPTH = PREFILL_BUCKETS[-1]
+
+
+def prefill_enabled() -> bool:
+    """`DFM_PREFILL=0` forces every replay back to the sequential tick
+    loop — the bench A/B off arm and the escape hatch."""
+    return os.environ.get("DFM_PREFILL", "1") != "0"
+
+
+def min_gemm_depth() -> int:
+    """Backlogs shorter than this keep the sequential `replay_ticks`
+    loop: below it the dual's power-stack setup costs more than it
+    saves, and — the binding constraint — sequential replay is BITWISE
+    identical to the live tick stream, which the eviction/recover
+    bit-identity pins rely on for short journals."""
+    try:
+        return max(1, int(os.environ.get("DFM_PREFILL_MIN_K", "8")))
+    except ValueError:
+        return 8
+
+
+def prefill_bucket(k: int) -> int:
+    """Smallest power-of-two bucket holding a k-tick burst (capped at
+    MAX_PREFILL_DEPTH; deeper backlogs chunk)."""
+    if k <= 1:
+        return 1
+    if k >= MAX_PREFILL_DEPTH:
+        return MAX_PREFILL_DEPTH
+    return 1 << (k - 1).bit_length()
+
+
+# ---------------------------------------------------------------------------
+# GEMM dual
+# ---------------------------------------------------------------------------
+
+
+def _cycle_maps(model: ServingModel):
+    """Trace-time candidates, one per start phase ph in 0..d-1: the
+    composite per-cycle transition
+
+        M(ph) = Abar[ph+d-1] @ ... @ Abar[ph]
+
+    and the within-cycle input-response maps
+
+        E_j(ph) = (Abar[ph+d-1] @ ... @ Abar[ph+j+1]) @ K[ph+j]
+
+    (indices mod d), so one cycle starting at phase ph advances
+
+        s' = M(ph) s + sum_j E_j(ph) b_j.
+
+    d is static and tiny (1 or 3): the candidate products are a handful
+    of (k, k) matmuls folded at trace time; the traced start phase picks
+    its row by gather."""
+    d = model.Abar.shape[0]
+    kdim = model.Abar.shape[1]
+    eye = jnp.eye(kdim, dtype=model.Abar.dtype)
+    M_cands, E_cands = [], [[] for _ in range(d)]
+    for ph in range(d):
+        suf = eye  # suffix product Abar[ph+d-1] @ ... @ Abar[ph+j+1]
+        Ej = [None] * d
+        for j in range(d - 1, -1, -1):
+            Ej[j] = suf @ model.K[(ph + j) % d]
+            suf = suf @ model.Abar[(ph + j) % d]
+        M_cands.append(suf)
+        for j in range(d):
+            E_cands[j].append(Ej[j])
+    return jnp.stack(M_cands), [jnp.stack(E) for E in E_cands]
+
+
+@jax.jit
+def _prefill_impl(model: ServingModel, state: FilterState, X, mask, k_actual):
+    """The dual-form catch-up kernel: post-burst FilterState from one
+    power stack + one batched input-response GEMM.
+
+    X (Kb, N) / mask (Kb, N) hold the burst rows padded to the static
+    depth bucket Kb; `k_actual` (traced i32, <= Kb) is the live depth —
+    padding enters only through masked gathers, never the state.  The
+    phase of tick i is (t + i) mod d with the start phase traced, so MF
+    period-3 tenants fold d ticks per composite cycle and finish with
+    up to d-1 masked remainder ticks.  Matmuls and selects only — no
+    factorization, O(log Kb) matmul depth."""
+    d = model.Abar.shape[0]  # static: 1 complete, 3 mixed-frequency
+    Kb = X.shape[0]  # static: the depth bucket
+    Cmax = -(-Kb // d)  # ceil: max whole cycles in the bucket
+    phi = state.t % d
+
+    # the batched collapse: every burst row's b_i in one (Kb, N)x(N, q)
+    xz = jnp.where(mask, X, jnp.zeros((), X.dtype))
+    B = xz @ model.Wb  # (Kb, q)
+
+    M_cands, E_cands = _cycle_maps(model)
+    M = jnp.take(M_cands, phi, axis=0)
+    # per-cycle forcing g_c = sum_j E_j(phi) b_{cd+j}: pad B to whole
+    # cycles, then d skinny GEMMs (one per within-cycle offset)
+    Bp = jnp.zeros((Cmax * d, B.shape[1]), B.dtype).at[:Kb].set(B)
+    Bc = Bp.reshape(Cmax, d, -1)
+    g = sum(
+        Bc[:, j, :] @ jnp.take(E_cands[j], phi, axis=0).T for j in range(d)
+    )  # (Cmax, kdim)
+
+    P = power_stack(M, Cmax)  # (Cmax+1, k, k), log-depth
+    C = k_actual // d  # traced: live whole cycles
+    rho = k_actual - C * d  # traced: remainder ticks < d
+    c_idx = jnp.arange(Cmax)
+    Wp = jnp.where(
+        (c_idx < C)[:, None, None],
+        jnp.take(P, jnp.clip(C - 1 - c_idx, 0, Cmax), axis=0),
+        jnp.zeros((), P.dtype),
+    )
+    s = jnp.take(P, C, axis=0) @ state.s + jnp.einsum("cab,cb->a", Wp, g)
+
+    # remainder: up to d-1 sequential ticks, masked inert past rho
+    for m in range(d - 1):
+        i = C * d + m
+        b_i = jnp.take(B, jnp.clip(i, 0, Kb - 1), axis=0)
+        jm = (phi + m) % d
+        s_new = (
+            jnp.take(model.Abar, jm, axis=0) @ s
+            + jnp.take(model.K, jm, axis=0) @ b_i
+        )
+        s = jnp.where(m < rho, s_new, s)
+    return FilterState(s=s, t=state.t + jnp.asarray(k_actual, state.t.dtype))
+
+
+# the lane-batched prefill is DERIVED, not hand-written — the same
+# batch() doctrine as online._tick_batched: vmap over a leading lane
+# axis of the SAME jitted kernel (per-lane depths ride the traced
+# k_actual operand, so ragged backlogs share one executable per
+# (lane bucket, depth bucket) pair)
+_prefill_batched = jax.jit(jax.vmap(_prefill_impl))
+
+
+# ---------------------------------------------------------------------------
+# decode-form block (bitwise-exact scan)
+# ---------------------------------------------------------------------------
+
+
+@jax.jit
+def _tick_block_impl(model: ServingModel, state: FilterState, X, mask, k_actual):
+    """Decode-form block: k sequential ticks inside ONE scan dispatch.
+
+    The step body IS `online._tick` (inlined by jit), so every per-step
+    contraction runs in the same order as k single-tick dispatches and
+    the result is BITWISE identical to them — the property flush block
+    lanes need, where batched admission is pinned bit-equal to
+    sequential `handle` ticks (tests/test_eviction.py).  NOT vmapped
+    across tenants: batching the scan re-associates the per-step
+    matvecs and breaks bit-equality (measured), so the engine dispatches
+    one block per backlogged tenant.  Steps at or past `k_actual` are
+    inert selects (padding to the depth bucket).  Returns (final
+    FilterState, per-step FilterState stack (Kb,...))."""
+
+    def step(st, inp):
+        i, x, m = inp
+        new = _tick(model, st, x, m)
+        live = i < k_actual
+        st2 = FilterState(
+            s=jnp.where(live, new.s, st.s),
+            t=jnp.where(live, new.t, st.t),
+        )
+        return st2, st2
+
+    idx = jnp.arange(X.shape[0])
+    return jax.lax.scan(step, state, (idx, X, mask))
+
+
+# ---------------------------------------------------------------------------
+# host wrappers
+# ---------------------------------------------------------------------------
+
+_depth_hist = None
+
+
+def _observe_depth(k: int) -> None:
+    global _depth_hist
+    if _depth_hist is None:
+        # unit label: NOT a latency — summarize keeps it out of the
+        # per-entry latency merge and reads its p50 for the ticks-per-
+        # prefill column
+        _depth_hist = register_hist("serving.prefill.depth", unit="ticks")
+    _depth_hist.record(float(k))
+
+
+def _pad_block(model: ServingModel, rows, Kb: int):
+    """Stack journal rows ((t, x, mask) or (x, mask)) into the bucketed
+    (Kb, N) block; padded rows are zero/unobserved (inert by masking)."""
+    N = model.Wb.shape[0]
+    dt = np.dtype(model.Wb.dtype)
+    X = np.zeros((Kb, N), dt)
+    Mk = np.zeros((Kb, N), bool)
+    for i, row in enumerate(rows):
+        x_r, m_r = row[-2], row[-1]
+        m = np.asarray(m_r, bool)
+        X[i] = np.where(m, np.asarray(x_r, dt), 0.0)
+        Mk[i] = m
+    return jnp.asarray(X), jnp.asarray(Mk)
+
+
+def _prefill_call(model, state, X, mask, k):
+    return aot_call(
+        "serving_prefill", _prefill_impl, model, state, X, mask,
+        jnp.asarray(k, jnp.int32),
+    )
+
+
+def _tick_block_call(model, state, X, mask, k):
+    return aot_call(
+        "serving_tick_block", _tick_block_impl, model, state, X, mask,
+        jnp.asarray(k, jnp.int32),
+    )
+
+
+def prefill_ticks(
+    model: ServingModel, state: FilterState, rows, *, t_star=None
+) -> FilterState:
+    """Dual-form catch-up over journaled rows.
+
+    `rows` iterates ``(t, x, mask)`` (journal format) or ``(x, mask)``
+    (replay-buffer format) in append order.  Dispatch policy:
+
+    * disabled (`DFM_PREFILL=0`) or short (< `min_gemm_depth()` rows):
+      sequential `replay_ticks` — bitwise identical to the live stream;
+    * pre-t* (caller passed `t_star` and state.t < t_star): the gains
+      are not yet at their fixed point, so the dual would be silently
+      wrong — warn LOUDLY, count it, and fall back to sequential;
+    * else: chunked GEMM prefill, one dispatch per depth bucket.
+
+    Returns the post-burst FilterState: exact equal to sequential
+    replay below the GEMM threshold, <= 1e-14 (complete) / 1e-12 (MF
+    period-3) above it (tests/test_prefill.py)."""
+    rows = list(rows)
+    k = len(rows)
+    if k == 0:
+        return state
+    if not prefill_enabled() or k < min_gemm_depth():
+        return replay_ticks(model, state, rows)
+    if t_star is not None and int(state.t) < int(t_star):
+        warnings.warn(
+            f"prefill_ticks: state.t={int(state.t)} is before the "
+            f"steady-state horizon t*={int(t_star)}; the dual form is "
+            "only exact past t* — falling back to sequential replay",
+            RuntimeWarning,
+            stacklevel=2,
+        )
+        inc("serving.prefill.pre_tstar_fallback")
+        return replay_ticks(model, state, rows)
+    blocks = 0
+    i = 0
+    while i < k:
+        chunk = rows[i : i + MAX_PREFILL_DEPTH]
+        Kb = prefill_bucket(len(chunk))
+        X, Mk = _pad_block(model, chunk, Kb)
+        state = _prefill_call(model, state, X, Mk, len(chunk))
+        blocks += 1
+        i += len(chunk)
+    inc("serving.prefill.blocks", blocks)
+    inc("serving.prefill.ticks", k)
+    _observe_depth(k)
+    return state
+
+
+def tick_block(model: ServingModel, state: FilterState, rows):
+    """Bitwise-exact decode-form catch-up for one tenant's tick block.
+
+    One scan dispatch per depth bucket instead of one dispatch per tick;
+    per-row states come back for the per-request Responses.  Returns
+    ``(final_state, [FilterState per row])`` — every element bit-equal
+    to the sequential single-tick path."""
+    rows = list(rows)
+    k = len(rows)
+    if k == 0:
+        return state, []
+    per_row = []
+    i = 0
+    blocks = 0
+    while i < k:
+        chunk = rows[i : i + MAX_PREFILL_DEPTH]
+        Kb = prefill_bucket(len(chunk))
+        X, Mk = _pad_block(model, chunk, Kb)
+        state, traj = _tick_block_call(model, state, X, Mk, len(chunk))
+        for j in range(len(chunk)):
+            per_row.append(FilterState(s=traj.s[j], t=traj.t[j]))
+        blocks += 1
+        i += len(chunk)
+    inc("serving.prefill.blocks", blocks)
+    inc("serving.prefill.ticks", k)
+    _observe_depth(k)
+    return state, per_row

@@ -477,6 +477,14 @@ class CompileSpec:
     serving_period: int = 0
     em_batch: int = 0
     tick_batch: int = 0
+    # dual-form burst catch-up (serving/prefill.py): prefill_depth > 0
+    # additionally registers the GEMM prefill ("serving_prefill@K{2^j}")
+    # and the bitwise decode-form block ("serving_tick_block@K{2^j}")
+    # for every power-of-two depth bucket up to prefill_bucket(
+    # prefill_depth) — the burst depth is a traced operand, so one
+    # executable per bucket serves every backlog in it.  Default off so
+    # existing specs are unchanged.
+    prefill_depth: int = 0
     # scenario engine (scenarios/): scenario_draws > 0 adds the fan-out
     # kernels — "scenario_fan" (the posterior_forecast / draw-fan forward
     # simulation over scenario_draws parameter draws), "scenario_cond_fan"
@@ -920,9 +928,11 @@ def _kernel_plan(spec: CompileSpec):
                 H=jnp.asarray(0.1 * rng.standard_normal((Nb, q)), dt),
                 Tm=0.5 * jnp.eye(k, dtype=dt),
                 Abar=jnp.broadcast_to(0.5 * jnp.eye(k, dtype=dt), (d, k, k)),
-                K=jnp.zeros((d, k, q), dt).at[:, :q, :].set(
-                    0.1 * jnp.eye(q, dtype=dt)
-                ),
+                # benign gain: identity block on the leading min(k, q)
+                # square (MF specs have q = 5r > k when p < 5)
+                K=jnp.zeros((d, k, q), dt)
+                .at[:, : min(k, q), : min(k, q)]
+                .set(0.1 * jnp.eye(min(k, q), dtype=dt)),
             )
             state = online.FilterState(
                 s=jnp.zeros((k,), dt), t=jnp.asarray(0, jnp.int32)
@@ -968,6 +978,46 @@ def _kernel_plan(spec: CompileSpec):
                     {},
                     (),
                     tick_batch_inputs,
+                )
+
+        if spec.prefill_depth > 0:
+            # dual-form burst catch-up plans: both kernel forms share
+            # one aval body — (model, state, (Kb, N) burst block,
+            # (Kb, N) mask, traced live depth) — per power-of-two
+            # depth bucket, so a cold fleet compiles ceil(log2 depth)+1
+            # executables per form and every backlog in a bucket reuses
+            # its plan (the actual k rides the traced operand; padding
+            # is masked inert).  The lane-batched prefill
+            # (batch.batched_prefill_dispatch) is vmap-derived from the
+            # same scalar kernel and jit-caches in process.
+            from ..serving import prefill as _prefill_mod
+
+            K_top = _prefill_mod.prefill_bucket(int(spec.prefill_depth))
+            for Kb in [
+                b for b in _prefill_mod.PREFILL_BUCKETS if b <= K_top
+            ]:
+                burst_avals = (
+                    model_s, state_s,
+                    _sds((Kb, Nb), dt), _sds((Kb, Nb), jnp.bool_),
+                    _sds((), jnp.int32),
+                )
+
+                def burst_inputs(Kb=Kb):
+                    model, state, x_t, m_t = tick_inputs()
+                    return (
+                        model, state,
+                        jnp.broadcast_to(x_t, (Kb,) + x_t.shape),
+                        jnp.broadcast_to(m_t, (Kb,) + m_t.shape),
+                        jnp.asarray(Kb, jnp.int32),
+                    )
+
+                plans[f"serving_prefill@K{Kb}"] = (
+                    _prefill_mod._prefill_impl,
+                    burst_avals, {}, (), burst_inputs,
+                )
+                plans[f"serving_tick_block@K{Kb}"] = (
+                    _prefill_mod._tick_block_impl,
+                    burst_avals, {}, (), burst_inputs,
                 )
 
     if spec.scenario_draws > 0:

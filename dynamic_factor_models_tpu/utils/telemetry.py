@@ -1254,7 +1254,10 @@ def summarize(path: str, entry: str | None = None) -> str:
     # whose `entry` label matches (engine histograms carry entry=serving)
     ent_hist: dict[str, LatencyHistogram] = {}
     for (name, lbl), h in hists.items():
-        e = dict(lbl).get("entry", "serving")
+        d = dict(lbl)
+        if "unit" in d:  # unit-labeled hists (e.g. prefill depth in
+            continue     # ticks) are counts, not latencies
+        e = d.get("entry", "serving")
         ent_hist.setdefault(e, LatencyHistogram()).merge(h)
 
     def _lat(e):
@@ -1281,21 +1284,46 @@ def summarize(path: str, entry: str | None = None) -> str:
         )
 
     # occupancy column (PR 17): the serving row shows the last metrics
-    # snapshot's phase-seconds split — dispatch/journal/commit/envelope
-    # as percentages of accounted time; other entries, and sinks written
-    # before the occupancy gauges existed, show "-"
+    # snapshot's phase-seconds split — admit/dispatch/prefill/journal/
+    # commit/envelope as percentages of accounted time (prefill is the
+    # PR 20 burst-catch-up phase; pre-PR-20 sinks simply report it as
+    # 0); other entries, and sinks written before the occupancy gauges
+    # existed, show "-"
     def _occ_col(e):
         if metrics is None or e != "serving":
             return "-"
         g = metrics.get("gauges") or {}
         vals = [
             float(g.get(f"serving.occupancy.{p}_s") or 0.0)
-            for p in ("admit", "dispatch", "journal", "commit", "envelope")
+            for p in (
+                "admit", "dispatch", "prefill", "journal", "commit",
+                "envelope",
+            )
         ]
         tot = sum(vals)
         if tot <= 0:
             return "-"
         return "/".join(f"{100.0 * v / tot:.0f}" for v in vals)
+
+    # prefill columns (PR 20): blocks replayed through the dual-form
+    # burst catch-up and the ticks-per-prefill p50 from the depth
+    # histogram; other entries — and sinks written before the prefill
+    # layer — show "-"
+    def _prefill_cols(e):
+        if metrics is None or e != "serving":
+            return "-", "-"
+        c = metrics.get("counters") or {}
+        blocks = c.get("serving.prefill.blocks")
+        if not blocks:
+            return "-", "-"
+        dh = None
+        for (name, lbl), h in hists.items():
+            if name == "serving.prefill.depth":
+                dh = h
+        return (
+            str(int(blocks)),
+            f"{dh.quantile(0.5):.0f}" if dh is not None and dh.n else "-",
+        )
 
     # worker column (PR 19): the serving row renders each router
     # worker's supervisor state as a lifecycle glyph ("w0✓ w1↻ w2✗")
@@ -1329,6 +1357,7 @@ def summarize(path: str, entry: str | None = None) -> str:
     for e, a in sorted(agg.items()):
         p50, p99 = _lat(e)
         res, evd, fin = _resident_cols(e)
+        pfb, pfd = _prefill_cols(e)
         arows.append([
             e,
             str(a["runs"]),
@@ -1349,6 +1378,8 @@ def summarize(path: str, entry: str | None = None) -> str:
             res,
             evd,
             fin,
+            pfb,
+            pfd,
             (_gflop_str(a["gflops"] * 1e9) if a["roofline_runs"] else "-"),
             _occ_col(e),
             _worker_col(e),
@@ -1358,8 +1389,8 @@ def summarize(path: str, entry: str | None = None) -> str:
     aggregate = _fmt_table(
         ["entry", "runs", "err", "wall_s", "mean_s", "mean_iters",
          "conv%", "compile_s", "aot h/m", "faults", "ess_min", "avail",
-         "resident", "evict", "fault_in", "GFLOP", "occ a/d/j/c/e",
-         "workers", "p50_ms", "p99_ms"],
+         "resident", "evict", "fault_in", "pf_blk", "pf_k50", "GFLOP",
+         "occ a/d/p/j/c/e", "workers", "p50_ms", "p99_ms"],
         arows,
     )
     out = (

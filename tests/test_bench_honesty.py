@@ -64,6 +64,29 @@ def test_ancestor_scope_labels_nested_fragments():
     ) == []
 
 
+def test_unlabeled_speedup_claim_is_a_violation():
+    # PR 20's prefill fault-in A/B (and every earlier *_speedup_* row)
+    # is a wall-clock ratio: off-TPU it must carry flop_proxy
+    chk = _checker()
+    bad = chk.audit_obj({"speedup_p50_x": 11.0})
+    assert bad and "speedup" in bad[0][1] and "flop_proxy" in bad[0][1]
+    assert chk.audit_obj(
+        {"flop_proxy": True, "speedup_p50_x": 11.0}
+    ) == []
+    # ancestor scope covers the nested prefill record shape
+    rec = {
+        "flop_proxy": True,
+        "prefill": {
+            "speedup_p50_x": 11.0,
+            "before": {"p50_ms": 152.0},
+            "after": {"p50_ms": 14.0},
+        },
+    }
+    assert chk.audit_obj(rec) == []
+    del rec["flop_proxy"]
+    assert [w for w, _ in chk.audit_obj(rec)] == ["$.prefill"]
+
+
 def test_sibling_scope_does_not_leak():
     chk = _checker()
     rec = {

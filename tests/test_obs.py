@@ -470,15 +470,16 @@ def test_summarize_occupancy_column_and_rotated_sink(tmp_path):
     out = telemetry.summarize(sink)
     # both files were read: the rotated old run + the live serving run
     assert "2 record(s)" in out
-    assert "occ a/d/j/c/e" in out
-    # a sink with no admit gauge (pre-pipeline) renders admit as 0
+    assert "occ a/d/p/j/c/e" in out
+    # a sink with no admit/prefill gauges (pre-pipeline / pre-PR-20)
+    # renders those phases as 0
     srow = [
         l for l in out.splitlines()
-        if l.startswith("serving") and "0/60/20/10/10" in l
+        if l.startswith("serving") and "0/60/0/20/10/10" in l
     ]
     assert srow, out
     # the old entry's aggregate row renders "-" in the occupancy column
     erow = [
         l for l in out.splitlines() if l.startswith("estimate_dfm_em")
     ]
-    assert erow and "60/20/10/10" not in erow[0]
+    assert erow and "60/0/20/10/10" not in erow[0]

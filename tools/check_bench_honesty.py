@@ -13,7 +13,12 @@ must therefore say so explicitly:
   may label once at the root for all of its nested fragments
   (BENCH_time_parallel.json does);
 * any object with an MFU value key (``*_mfu_*``) must carry
-  ``mfu_peak_source`` in self-or-ancestor scope.
+  ``mfu_peak_source`` in self-or-ancestor scope;
+* any object with a SPEEDUP claim key (``*speedup*``, e.g. the
+  ``prefill`` fault-in A/B's ``speedup_p50_x`` or large-N's
+  ``em_ar_collapse_speedup_*``) must carry ``flop_proxy`` in
+  self-or-ancestor scope — a wall-clock ratio measured off-TPU is a
+  CPU proxy for the accelerator claim, not a hardware measurement.
 
 Run with no arguments from anywhere in the repo (globs docs/BENCH_*.json
 next to this file's parent), or pass explicit paths.  Exit 0 clean,
@@ -45,6 +50,10 @@ def _is_mfu_value_key(key: str) -> bool:
     return "mfu" in k and k != "mfu_peak_source"
 
 
+def _is_speedup_value_key(key: str) -> bool:
+    return "speedup" in key.lower()
+
+
 def audit_obj(obj, path: str = "$", scope: frozenset = frozenset()) -> list:
     """Violations in one parsed JSON value: ``(json_path, message)``
     rows.  `scope` carries the label keys visible from ancestors."""
@@ -53,6 +62,13 @@ def audit_obj(obj, path: str = "$", scope: frozenset = frozenset()) -> list:
         here = scope | {lbl for lbl in _LABELS if lbl in obj}
         flop_keys = sorted(k for k in obj if _is_flop_value_key(k))
         mfu_keys = sorted(k for k in obj if _is_mfu_value_key(k))
+        speedup_keys = sorted(k for k in obj if _is_speedup_value_key(k))
+        if speedup_keys and "flop_proxy" not in here:
+            out.append((
+                path,
+                "speedup claims %s lack a flop_proxy label in "
+                "self-or-ancestor scope" % speedup_keys,
+            ))
         if flop_keys and "flop_proxy" not in here:
             out.append((
                 path,
